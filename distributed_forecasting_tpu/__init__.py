@@ -1,0 +1,26 @@
+"""distributed_forecasting_tpu — a TPU-native fine-grained demand-forecasting framework.
+
+Capability-parity rebuild of the reference Spark/Prophet solution accelerator
+(rafaelvp-db/distributed-forecasting): fit one seasonal-trend model per
+(store, item) series at 500+-series scale, cross-validate, track every fit,
+register a batched-inference model, and run distributed fine-grained
+prediction.
+
+Where the reference fans independent Prophet/Stan fits out over Spark
+executors (`notebooks/prophet/02_training.py:304-307` in the reference), this
+framework tensorizes all series into one padded ``(n_series, T)`` batch and
+fits them in a single XLA-compiled program — ``jit(vmap(fit))`` on one chip,
+``shard_map`` over a ``jax.sharding.Mesh`` across a pod slice.
+
+Layer map (mirrors SURVEY.md §1):
+  - L1 data plane ......... :mod:`distributed_forecasting_tpu.data`
+  - L2 model kernels ...... :mod:`distributed_forecasting_tpu.models`
+  - L2 tracking/registry .. :mod:`distributed_forecasting_tpu.tracking`
+  - L3 fit/CV engine ...... :mod:`distributed_forecasting_tpu.engine`
+  - L3 batched serving .... :mod:`distributed_forecasting_tpu.serving`
+  - L4/L5 tasks ........... :mod:`distributed_forecasting_tpu.tasks`
+  - L6 workflows/CLI ...... :mod:`distributed_forecasting_tpu.workflows`
+  - scale-out ............. :mod:`distributed_forecasting_tpu.parallel`
+"""
+
+from distributed_forecasting_tpu.version import __version__  # noqa: F401

@@ -1,0 +1,16 @@
+from distributed_forecasting_tpu.data.tensorize import SeriesBatch, tensorize
+from distributed_forecasting_tpu.data.dataset import (
+    load_sales_csv,
+    load_sales_parquet,
+    synthetic_store_item_sales,
+)
+from distributed_forecasting_tpu.data.catalog import DatasetCatalog
+
+__all__ = [
+    "SeriesBatch",
+    "tensorize",
+    "load_sales_csv",
+    "load_sales_parquet",
+    "synthetic_store_item_sales",
+    "DatasetCatalog",
+]

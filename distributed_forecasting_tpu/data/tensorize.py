@@ -1,0 +1,139 @@
+"""Group-and-pad: long-format sales rows -> one dense ``(n_series, T)`` tensor.
+
+This is the TPU-native replacement for the reference's distribution mechanism,
+Spark's ``groupBy('store','item').applyInPandas(...)`` (reference
+``notebooks/prophet/02_training.py:304-307`` and ``04_inference.py:46-49``).
+Where Spark hash-shuffles rows by group key and streams each group to a Python
+worker over Arrow, we align every series onto one shared daily date grid and
+stack them into a single float tensor plus a validity mask.  After this step
+there is no shuffle, no IPC, and no per-group Python: every downstream fit is
+a ``vmap`` over axis 0, shardable across chips with ``shard_map``.
+
+Design choices for XLA friendliness:
+  * static shapes — the grid covers min..max date; ragged starts/ends and
+    missing days become mask zeros, never shape changes;
+  * the time axis is a shared absolute day index so seasonal design matrices
+    (day-of-week / day-of-year Fourier bases) are computed ONCE for all
+    series and hit the MXU as one big matmul;
+  * series keys (store, item) stay host-side in numpy — device code only
+    ever sees dense arrays.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class SeriesBatch:
+    """All series of a dataset as one padded dense batch.
+
+    Device-side leaves (pytree):
+      y:    (S, T) float32  observed values, 0 where unobserved
+      mask: (S, T) float32  1.0 where observed, 0.0 where padded/missing
+      day:  (T,)   int32    absolute day number (days since Unix epoch)
+
+    Host-side static metadata:
+      keys:  (S, k) int64 numpy array of series keys (e.g. store, item)
+      key_names: names of the key columns
+      start_date: ISO date of day[0] (grid origin)
+    """
+
+    y: jax.Array
+    mask: jax.Array
+    day: jax.Array
+    keys: np.ndarray = dataclasses.field(metadata=dict(static=True))
+    key_names: tuple = dataclasses.field(metadata=dict(static=True))
+    start_date: str = dataclasses.field(metadata=dict(static=True))
+
+    @property
+    def n_series(self) -> int:
+        return self.y.shape[0]
+
+    @property
+    def n_time(self) -> int:
+        return self.y.shape[1]
+
+    def dates(self) -> pd.DatetimeIndex:
+        """Reconstruct the shared daily date grid on the host."""
+        return pd.date_range(self.start_date, periods=self.n_time, freq="D")
+
+    def key_frame(self) -> pd.DataFrame:
+        return pd.DataFrame(np.asarray(self.keys), columns=list(self.key_names))
+
+    def pad_series_to(self, n: int) -> "SeriesBatch":
+        """Pad the series axis up to ``n`` (mask=0 rows) so it divides a mesh."""
+        s = self.n_series
+        if n < s:
+            raise ValueError(f"cannot pad {s} series down to {n}")
+        if n == s:
+            return self
+        pad = n - s
+        y = jnp.concatenate([self.y, jnp.zeros((pad, self.n_time), self.y.dtype)])
+        mask = jnp.concatenate(
+            [self.mask, jnp.zeros((pad, self.n_time), self.mask.dtype)]
+        )
+        keys = np.concatenate(
+            [self.keys, np.full((pad, self.keys.shape[1]), -1, self.keys.dtype)]
+        )
+        return dataclasses.replace(self, y=y, mask=mask, keys=keys)
+
+    def take_series(self, idx: Sequence[int]) -> "SeriesBatch":
+        idx = np.asarray(idx)
+        return dataclasses.replace(
+            self,
+            y=self.y[idx],
+            mask=self.mask[idx],
+            keys=self.keys[np.asarray(idx)],
+        )
+
+
+def tensorize(
+    df: pd.DataFrame,
+    key_cols: Sequence[str] = ("store", "item"),
+    date_col: str = "date",
+    value_col: str = "sales",
+    dtype=jnp.float32,
+) -> SeriesBatch:
+    """Long table ``(date, *keys, value)`` -> :class:`SeriesBatch`.
+
+    Equivalent of the reference's shuffle-by-(store,item) plus Arrow transfer,
+    done once on the host.  Duplicate (key, date) rows are summed, matching
+    SQL ``GROUP BY`` aggregation semantics of the reference's history queries
+    (reference ``02_training.py:225-231``).
+    """
+    df = df[[date_col, *key_cols, value_col]].copy()
+    dates = pd.to_datetime(df[date_col])
+    day = (dates.values.astype("datetime64[D]") - np.datetime64("1970-01-01", "D")).astype(
+        np.int64
+    )
+    d0, d1 = int(day.min()), int(day.max())
+    T = d1 - d0 + 1
+
+    keys_df = df[list(key_cols)].astype(np.int64)
+    uniq, series_idx = np.unique(keys_df.values, axis=0, return_inverse=True)
+    S = uniq.shape[0]
+
+    y = np.zeros((S, T), dtype=np.float64)
+    m = np.zeros((S, T), dtype=np.float32)
+    tpos = (day - d0).astype(np.int64)
+    vals = df[value_col].to_numpy(dtype=np.float64)
+    np.add.at(y, (series_idx, tpos), vals)
+    m[series_idx, tpos] = 1.0
+
+    start_date = str(np.datetime64(d0, "D"))
+    return SeriesBatch(
+        y=jnp.asarray(y, dtype=dtype),
+        mask=jnp.asarray(m, dtype=dtype),
+        day=jnp.arange(d0, d1 + 1, dtype=jnp.int32),
+        keys=uniq,
+        key_names=tuple(key_cols),
+        start_date=start_date,
+    )

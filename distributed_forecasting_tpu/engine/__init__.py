@@ -1,0 +1,16 @@
+from distributed_forecasting_tpu.engine.fit import (
+    ForecastResult,
+    fit_forecast,
+    forecast_frame,
+    seasonal_naive,
+)
+from distributed_forecasting_tpu.engine.cv import CVConfig, cross_validate
+
+__all__ = [
+    "ForecastResult",
+    "fit_forecast",
+    "forecast_frame",
+    "seasonal_naive",
+    "CVConfig",
+    "cross_validate",
+]

@@ -1,0 +1,96 @@
+"""Rolling-origin cross-validation as one more vmapped axis.
+
+Reproduces Prophet's ``cross_validation(horizon="90 days", period="360 days",
+initial="730 days", parallel="processes")`` + ``performance_metrics`` protocol
+(reference ``notebooks/prophet/02_training.py:179-188``): cutoffs every
+``period`` days starting after ``initial`` days of history, fit on data up to
+the cutoff, score the next ``horizon`` days, then average each metric over
+cutoffs.  The reference spends a process pool *per series per cutoff*
+(SURVEY.md §3.1 marks it the hottest loop); here the cutoff axis is folded
+into ``vmap`` — train masks differ per cutoff, everything else is shared, so
+all series x all cutoffs fit in one compiled program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from distributed_forecasting_tpu.data.tensorize import SeriesBatch
+from distributed_forecasting_tpu.models.base import get_model
+from distributed_forecasting_tpu.ops import metrics as metrics_ops
+
+
+@dataclasses.dataclass(frozen=True)
+class CVConfig:
+    horizon: int = 90   # days scored after each cutoff
+    period: int = 360   # days between cutoffs
+    initial: int = 730  # minimum history before the first cutoff
+
+
+def cutoff_indices(n_time: int, cv: CVConfig) -> List[int]:
+    """Static (host-side) list of cutoff row indices into the time grid.
+
+    Cutoff c means: train on rows [0, c], score rows (c, c + horizon].
+    Matches Prophet's semantics of cutoffs spaced by `period` with at least
+    `initial` days of history and a full `horizon` after each cutoff.
+    """
+    cuts = []
+    c = cv.initial - 1
+    while c + cv.horizon < n_time:
+        cuts.append(c)
+        c += cv.period
+    if not cuts:
+        raise ValueError(
+            f"series too short for CV: T={n_time}, initial={cv.initial}, "
+            f"horizon={cv.horizon}"
+        )
+    return cuts
+
+
+def cross_validate(
+    batch: SeriesBatch,
+    model: str = "prophet",
+    config=None,
+    cv: CVConfig = CVConfig(),
+    key: Optional[jax.Array] = None,
+) -> Dict[str, jax.Array]:
+    """Per-series CV-mean metrics: mse, rmse, mae, mape, smape, mdape,
+    coverage — each an (S,) array (the reference logs the first three per
+    series, ``02_training.py:187-192``; the AutoML path adds the rest).
+
+    Returns the dict plus ``"n_cutoffs"`` (python int) under key
+    ``"_n_cutoffs"`` for logging parity.
+    """
+    fns = get_model(model)
+    config = config if config is not None else fns.config_cls()
+    if key is None:
+        key = jax.random.PRNGKey(0)
+
+    T = batch.n_time
+    cuts = cutoff_indices(T, cv)
+    idx = jnp.arange(T)
+    train_masks = jnp.stack(
+        [batch.mask * (idx <= c)[None, :] for c in cuts]
+    )  # (C, S, T)
+    eval_masks = jnp.stack(
+        [batch.mask * ((idx > c) & (idx <= c + cv.horizon))[None, :] for c in cuts]
+    )
+    t_ends = jnp.asarray([batch.day[c] for c in cuts], dtype=jnp.float32)
+    keys = jax.random.split(key, len(cuts))
+
+    def one_cutoff(train_mask, t_end, k):
+        params = fns.fit(batch.y, train_mask, batch.day, config)
+        yhat, lo, hi = fns.forecast(params, batch.day, t_end, config, k)
+        return yhat, lo, hi
+
+    yhat, lo, hi = jax.vmap(one_cutoff)(train_masks, t_ends, keys)  # (C, S, T)
+
+    y = jnp.broadcast_to(batch.y[None], yhat.shape)
+    per_cut = metrics_ops.compute_all(y, yhat, eval_masks, lo=lo, hi=hi)
+    out = {name: jnp.mean(v, axis=0) for name, v in per_cut.items()}  # (S,)
+    out["_n_cutoffs"] = len(cuts)
+    return out

@@ -1,0 +1,136 @@
+"""The fit engine: one compiled program instead of a Spark fan-out.
+
+Replaces the reference's distribution mechanism — ``groupBy('store','item')
+.applyInPandas(forecast_store_item, schema)`` feeding one Prophet fit per
+Python worker (reference ``notebooks/prophet/02_training.py:282-307``) — with
+a single batched fit + forecast over the tensorized series batch.
+
+Per-series fault tolerance reproduces the AutoML path's ``train_with_fail_safe``
+semantics (reference ``notebooks/automl/22-09-26...py:131-136,151-160``): a
+series whose fit produced non-finite output, or with too little history, is
+flagged not-ok and its forecast replaced by a seasonal-naive fallback — the
+batch never dies because one series is degenerate, and callers can log the
+``partial_model`` condition exactly like the reference does.
+
+``forecast_frame`` assembles the reference's output schema
+``[ds, store, item, y, yhat, yhat_upper, yhat_lower]``
+(``02_training.py:304-313``) as a pandas frame ready for the dataset catalog.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from distributed_forecasting_tpu.data.tensorize import SeriesBatch
+from distributed_forecasting_tpu.models.base import get_model
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class ForecastResult:
+    yhat: jax.Array   # (S, T_all)
+    lo: jax.Array     # (S, T_all)
+    hi: jax.Array     # (S, T_all)
+    ok: jax.Array     # (S,) bool — fit healthy (fail-safe flag)
+    day_all: jax.Array  # (T_all,) absolute day grid (history + horizon)
+
+
+def seasonal_naive(y, mask, horizon: int, season: int = 7):
+    """Fallback forecast: repeat the last observed seasonal cycle.
+
+    (S, T) history -> (S, T + horizon) path whose history part is y itself
+    and future part tiles the last `season` observed values.
+    """
+    S, T = y.shape
+    # last observed value per seasonal slot: scan backwards is overkill —
+    # use the final `season` positions, masked-filled with series mean.
+    tail = y[:, -season:]
+    tail_mask = mask[:, -season:]
+    mean = jnp.sum(y * mask, axis=1) / jnp.maximum(jnp.sum(mask, axis=1), 1.0)
+    cycle = jnp.where(tail_mask > 0, tail, mean[:, None])  # (S, season)
+    reps = -(-horizon // season)  # ceil
+    fut = jnp.tile(cycle, (1, reps))[:, :horizon]
+    return jnp.concatenate([y, fut], axis=1)
+
+
+def fit_forecast(
+    batch: SeriesBatch,
+    model: str = "prophet",
+    config=None,
+    horizon: int = 90,
+    key: Optional[jax.Array] = None,
+    min_points: int = 14,
+) -> Tuple[object, ForecastResult]:
+    """Fit every series and forecast ``horizon`` days past the end of history.
+
+    Equivalent of the whole fine-grained training fan-out plus
+    ``make_future_dataframe(periods=90, include_history=True)`` + ``predict``
+    (reference ``02_training.py:201-205,260-313``) in one compiled call.
+    """
+    fns = get_model(model)
+    config = config if config is not None else fns.config_cls()
+    if key is None:
+        key = jax.random.PRNGKey(0)
+
+    params = fns.fit(batch.y, batch.mask, batch.day, config)
+    day_all = jnp.arange(
+        int(batch.day[0]), int(batch.day[-1]) + horizon + 1, dtype=jnp.int32
+    )
+    t_end = batch.day[-1].astype(jnp.float32)
+    yhat, lo, hi = fns.forecast(params, day_all, t_end, config, key)
+
+    finite = (
+        jnp.all(jnp.isfinite(yhat), axis=1)
+        & jnp.all(jnp.isfinite(lo), axis=1)
+        & jnp.all(jnp.isfinite(hi), axis=1)
+    )
+    enough = jnp.sum(batch.mask, axis=1) >= min_points
+    ok = finite & enough
+
+    fb = seasonal_naive(batch.y, batch.mask, horizon)
+    yhat = jnp.where(ok[:, None], yhat, fb)
+    lo = jnp.where(ok[:, None], lo, fb)
+    hi = jnp.where(ok[:, None], hi, fb)
+    return params, ForecastResult(yhat=yhat, lo=lo, hi=hi, ok=ok, day_all=day_all)
+
+
+def forecast_frame(
+    batch: SeriesBatch,
+    result: ForecastResult,
+    training_date: Optional[str] = None,
+) -> pd.DataFrame:
+    """Long output table with the reference schema
+    ``[ds, store, item, y, yhat, yhat_upper, yhat_lower, training_date]``
+    (reference ``02_training.py:304-313`` renames ds->date downstream)."""
+    S = batch.n_series
+    T_all = int(result.day_all.shape[0])
+    T_hist = batch.n_time
+    dates = pd.to_datetime(
+        np.asarray(result.day_all, dtype="int64"), unit="D", origin="unix"
+    )
+    y_full = np.full((S, T_all), np.nan)
+    y_hist = np.asarray(batch.y)
+    m_hist = np.asarray(batch.mask) > 0
+    y_full[:, :T_hist] = np.where(m_hist, y_hist, np.nan)
+
+    keys = np.asarray(batch.keys)
+    frame = {
+        "ds": np.tile(dates.values, S),
+    }
+    for j, name in enumerate(batch.key_names):
+        frame[name] = np.repeat(keys[:, j], T_all)
+    frame["y"] = y_full.reshape(-1)
+    frame["yhat"] = np.asarray(result.yhat).reshape(-1)
+    frame["yhat_upper"] = np.asarray(result.hi).reshape(-1)
+    frame["yhat_lower"] = np.asarray(result.lo).reshape(-1)
+    df = pd.DataFrame(frame)
+    df["training_date"] = pd.Timestamp(
+        training_date if training_date else pd.Timestamp.now().date()
+    )
+    return df

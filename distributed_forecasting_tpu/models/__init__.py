@@ -1,0 +1,13 @@
+from distributed_forecasting_tpu.models.base import MODEL_REGISTRY, register_model
+from distributed_forecasting_tpu.models import prophet_glm, holt_winters, arima  # noqa: F401 (registration)
+from distributed_forecasting_tpu.models.prophet_glm import CurveModelConfig
+from distributed_forecasting_tpu.models.holt_winters import HoltWintersConfig
+from distributed_forecasting_tpu.models.arima import ArimaConfig
+
+__all__ = [
+    "MODEL_REGISTRY",
+    "register_model",
+    "CurveModelConfig",
+    "HoltWintersConfig",
+    "ArimaConfig",
+]

@@ -1,0 +1,41 @@
+"""Model protocol + registry.
+
+Every model family exposes the same two pure functions over a *batch* of
+series (the whole point: one compiled program for all 500 fits, replacing the
+reference's one-Prophet-per-Spark-group fan-out):
+
+    fit(y, mask, day, config)                 -> params (pytree, leaves lead
+                                                 with the series axis S)
+    forecast(params, day_all, t_end, config, key)
+        -> (yhat, lo, hi) each (S, len(day_all))
+
+``day_all`` covers history + horizon (``make_future_dataframe(...,
+include_history=True)`` semantics, reference ``notebooks/prophet/
+02_training.py:201-205``); ``t_end`` is the last *training* day so the model
+knows where forecast uncertainty starts.
+
+Both functions must be jit-safe with static config, and batch-shaped so the
+engine can shard the S axis over a device mesh unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple
+
+MODEL_REGISTRY: dict = {}
+
+
+class ModelFns(NamedTuple):
+    fit: Callable
+    forecast: Callable
+    config_cls: type
+
+
+def register_model(name: str, fit: Callable, forecast: Callable, config_cls: type):
+    MODEL_REGISTRY[name] = ModelFns(fit=fit, forecast=forecast, config_cls=config_cls)
+
+
+def get_model(name: str) -> ModelFns:
+    if name not in MODEL_REGISTRY:
+        raise KeyError(f"unknown model {name!r}; known: {sorted(MODEL_REGISTRY)}")
+    return MODEL_REGISTRY[name]

@@ -1,0 +1,3 @@
+from distributed_forecasting_tpu.ops import features, metrics, solve
+
+__all__ = ["features", "metrics", "solve"]

@@ -1,0 +1,91 @@
+"""Masked forecast-accuracy metrics as pure JAX reductions.
+
+Covers the reference's tracked metric set: mse/mae/mape CV means
+(``notebooks/prophet/02_training.py:178-188``) plus the AutoML path's
+rmse/mdape/smape/coverage (``notebooks/automl/22-09-26...py:91-105``).
+
+All functions take ``y, yhat: (..., T)`` and ``mask: (..., T)`` and reduce the
+last axis; they are safe under vmap over series and CV-cutoff axes.  Division
+guards keep padded rows finite so a fully-masked (failed/padded) series yields
+0, not NaN — callers use the companion ``valid`` count to filter.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_EPS = 1e-9
+
+
+def _mean(x, mask):
+    n = jnp.maximum(jnp.sum(mask, axis=-1), 1.0)
+    return jnp.sum(x * mask, axis=-1) / n
+
+
+def mse(y, yhat, mask):
+    return _mean((y - yhat) ** 2, mask)
+
+
+def rmse(y, yhat, mask):
+    return jnp.sqrt(mse(y, yhat, mask))
+
+
+def mae(y, yhat, mask):
+    return _mean(jnp.abs(y - yhat), mask)
+
+
+def mape(y, yhat, mask):
+    """Mean absolute percentage error; near-zero actuals are masked out
+    (Prophet's performance_metrics drops |y| ~ 0 rows the same way)."""
+    ok = mask * (jnp.abs(y) > _EPS)
+    return _mean(jnp.abs((y - yhat) / jnp.where(jnp.abs(y) > _EPS, y, 1.0)), ok)
+
+
+def smape(y, yhat, mask):
+    denom = (jnp.abs(y) + jnp.abs(yhat)) / 2.0
+    ok = mask * (denom > _EPS)
+    return _mean(jnp.abs(y - yhat) / jnp.maximum(denom, _EPS), ok)
+
+
+def mdape(y, yhat, mask):
+    """Median absolute percentage error under the mask.
+
+    Median-under-mask via sorting with +inf sentinels on masked slots, then
+    indexing the middle of the valid prefix (static shapes; vmap-safe).
+    """
+    ok = mask * (jnp.abs(y) > _EPS)
+    ape = jnp.abs((y - yhat) / jnp.where(jnp.abs(y) > _EPS, y, 1.0))
+    ape = jnp.where(ok > 0, ape, jnp.inf)
+    s = jnp.sort(ape, axis=-1)
+    n = jnp.sum(ok > 0, axis=-1).astype(jnp.int32)
+    hi = jnp.clip((n - 1) // 2 + (n - 1) % 2, 0, ape.shape[-1] - 1)
+    lo = jnp.clip((n - 1) // 2, 0, ape.shape[-1] - 1)
+    med = (
+        jnp.take_along_axis(s, lo[..., None], axis=-1)
+        + jnp.take_along_axis(s, hi[..., None], axis=-1)
+    )[..., 0] / 2.0
+    return jnp.where(n > 0, med, 0.0)
+
+
+def coverage(y, lo, hi, mask):
+    """Fraction of actuals inside [lo, hi] — interval calibration
+    (AutoML 'coverage', should approach interval_width=0.95)."""
+    inside = ((y >= lo) & (y <= hi)).astype(y.dtype)
+    return _mean(inside, mask)
+
+
+METRIC_FNS = {
+    "mse": mse,
+    "rmse": rmse,
+    "mae": mae,
+    "mape": mape,
+    "smape": smape,
+    "mdape": mdape,
+}
+
+
+def compute_all(y, yhat, mask, lo=None, hi=None) -> dict:
+    out = {name: fn(y, yhat, mask) for name, fn in METRIC_FNS.items()}
+    if lo is not None and hi is not None:
+        out["coverage"] = coverage(y, lo, hi, mask)
+    return out

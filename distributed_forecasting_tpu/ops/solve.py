@@ -1,0 +1,65 @@
+"""Batched masked ridge solves — the XLA replacement for Stan's L-BFGS.
+
+The reference's sole native compute kernel is pystan's C++ L-BFGS MAP
+optimizer, invoked once per series by ``Prophet.fit`` (reference
+``requirements.txt:3-4``, hot loop at ``notebooks/prophet/02_training.py:172``).
+For the curve model the MAP problem is (after fixing the observation-noise
+scale) a penalized least squares in the feature basis, so the whole 500-series
+fit collapses into one batched normal-equation solve:
+
+    (X^T diag(w_s) X + diag(lambda)) beta_s = X^T diag(w_s) y_s
+
+with X the SHARED (T, F) design matrix and only the mask/weight vector w_s
+per-series.  The Gram tensor for all series is a single einsum that XLA maps
+onto the MXU; the (F, F) Cholesky solves are batched.
+
+Everything here is shape-static and vmap/shard_map friendly.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def masked_gram(X: jnp.ndarray, w: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-series Gram matrices and moment vectors without materializing SxTxF.
+
+    X: (T, F) shared design; w: (S, T) weights (mask or mask*obs-weight).
+    Returns (G, ) where G is (S, F, F); callers compute b with weighted y.
+    """
+    # (S, T) x (T, F) -> weighted einsum; XLA fuses the w broadcast into the
+    # matmul so the (S, T, F) intermediate never hits HBM whole.
+    G = jnp.einsum("st,tf,tg->sfg", w, X, X, optimize=True)
+    return G
+
+
+def ridge_solve_batch(
+    X: jnp.ndarray,
+    y: jnp.ndarray,
+    w: jnp.ndarray,
+    lam: jnp.ndarray,
+    jitter: float = 1e-6,
+) -> jnp.ndarray:
+    """Solve the batched penalized normal equations.
+
+    X: (T, F); y, w: (S, T); lam: (F,) per-feature ridge precision.
+    Returns beta: (S, F).  Uses Cholesky (SPD by construction).
+    """
+    F = X.shape[1]
+    G = masked_gram(X, w)
+    b = jnp.einsum("st,tf->sf", w * y, X, optimize=True)
+    A = G + jnp.diag(lam + jitter)[None, :, :]
+    chol = jax.scipy.linalg.cho_factor(A, lower=True)
+    beta = jax.scipy.linalg.cho_solve(chol, b[..., None])[..., 0]
+    return beta
+
+
+def weighted_residual_scale(
+    X: jnp.ndarray, y: jnp.ndarray, w: jnp.ndarray, beta: jnp.ndarray
+) -> jnp.ndarray:
+    """Per-series residual standard deviation under the mask.  (S,)"""
+    yhat = beta @ X.T  # (S, T)
+    r2 = w * (y - yhat) ** 2
+    n = jnp.maximum(jnp.sum(w, axis=1), 1.0)
+    return jnp.sqrt(jnp.sum(r2, axis=1) / n)

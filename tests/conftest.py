@@ -1,0 +1,60 @@
+"""Test harness: hermetic CPU backend with a virtual 8-device mesh.
+
+This is the analogue of the reference's unit-test fixtures
+(``tests/unit/conftest.py:20-72`` in the reference): there, a real local
+``SparkSession`` (``master("local[1]")`` with the Delta extension) and a
+temp-dir MLflow file store stand in for the cluster — the same API surface on
+one local thread.  Here, the JAX CPU backend forced to expose 8 virtual
+devices stands in for a TPU pod slice — the same ``Mesh``/``shard_map`` code
+paths, no TPU needed — and temp-dir catalog/tracking fixtures stand in for
+the table store and tracking server.
+
+The env vars MUST be set before jax is imported anywhere, hence module top.
+"""
+
+import os
+
+# Force the hermetic CPU backend: the ambient environment may point
+# JAX_PLATFORMS at a real accelerator (e.g. "axon" tunnel to a TPU), but unit
+# tests are the local[1]-style fake-backend tier and must not depend on it.
+# Real-hardware tests live in tests/integration and set their own platform.
+if os.environ.get("DFTPU_TEST_PLATFORM", "cpu") == "cpu":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def sales_df_small():
+    """10-series fixture dataset (BASELINE config #1 scale)."""
+    from distributed_forecasting_tpu.data import synthetic_store_item_sales
+
+    return synthetic_store_item_sales(n_stores=2, n_items=5, n_days=1096, seed=7)
+
+
+@pytest.fixture(scope="session")
+def batch_small(sales_df_small):
+    from distributed_forecasting_tpu.data import tensorize
+
+    return tensorize(sales_df_small)
+
+
+@pytest.fixture()
+def catalog(tmp_path):
+    from distributed_forecasting_tpu.data import DatasetCatalog
+
+    return DatasetCatalog(str(tmp_path / "warehouse"))
+
+
+@pytest.fixture()
+def tracker(tmp_path):
+    """File-store tracking client in a temp dir — the reference's
+    ``mlflow_local`` fixture equivalent (its conftest.py:47-72)."""
+    from distributed_forecasting_tpu.tracking import FileTracker
+
+    return FileTracker(str(tmp_path / "mlruns"))

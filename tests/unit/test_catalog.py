@@ -1,0 +1,54 @@
+"""Dataset-catalog tests — the working version of the reference's broken
+``tests/unit/test_catalog.py`` (invalid SQL + UC-only DDL, SURVEY.md §2.3-5):
+same intent (create catalog/schema, assert visibility), runnable semantics.
+"""
+
+import pandas as pd
+import pytest
+
+from distributed_forecasting_tpu.data.catalog import TableNotFoundError
+
+
+def _frame(n=3, offset=0):
+    return pd.DataFrame({"a": range(offset, offset + n), "b": ["x"] * n})
+
+
+def test_create_catalog_and_schema(catalog):
+    catalog.create_catalog("hackathon", grants=["CREATE", "USAGE"])
+    catalog.create_schema("hackathon", "sales")
+    assert "hackathon" in catalog.catalogs()
+    assert "sales" in catalog.schemas("hackathon")
+    assert catalog.grants("hackathon") == ["CREATE", "USAGE"]
+
+
+def test_save_and_read_table(catalog):
+    v = catalog.save_table("hackathon.sales.raw", _frame())
+    df = catalog.read_table("hackathon.sales.raw")
+    assert len(df) == 3
+    assert catalog.table_versions("hackathon.sales.raw") == [v]
+    assert catalog.table_exists("hackathon.sales.raw")
+    assert not catalog.table_exists("hackathon.sales.nope")
+
+
+def test_overwrite_keeps_time_travel(catalog):
+    v1 = catalog.save_table("c.s.t", _frame(3))
+    v2 = catalog.save_table("c.s.t", _frame(5, offset=10))
+    assert len(catalog.read_table("c.s.t")) == 5
+    assert len(catalog.read_table("c.s.t", version=v1)) == 3
+    assert catalog.table_versions("c.s.t") == [v1, v2]
+
+
+def test_append_mode(catalog):
+    catalog.save_table("c.s.t2", _frame(3))
+    catalog.save_table("c.s.t2", _frame(2, offset=100), mode="append")
+    assert len(catalog.read_table("c.s.t2")) == 5
+
+
+def test_missing_table_raises(catalog):
+    with pytest.raises(TableNotFoundError):
+        catalog.read_table("no.such.table")
+
+
+def test_bad_name_raises(catalog):
+    with pytest.raises(ValueError):
+        catalog.save_table("only_two.parts", _frame())

@@ -1,0 +1,72 @@
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_forecasting_tpu.ops import metrics as M
+
+
+def test_basic_metrics_unmasked():
+    y = jnp.array([[1.0, 2.0, 4.0, 8.0]])
+    yhat = jnp.array([[1.0, 1.0, 5.0, 6.0]])
+    mask = jnp.ones_like(y)
+    np.testing.assert_allclose(M.mae(y, yhat, mask), [(0 + 1 + 1 + 2) / 4])
+    np.testing.assert_allclose(M.mse(y, yhat, mask), [(0 + 1 + 1 + 4) / 4])
+    np.testing.assert_allclose(M.rmse(y, yhat, mask), [np.sqrt(1.5)])
+    np.testing.assert_allclose(
+        M.mape(y, yhat, mask), [(0 + 0.5 + 0.25 + 0.25) / 4], rtol=1e-6
+    )
+
+
+def test_mask_excludes_points():
+    y = jnp.array([[1.0, 100.0]])
+    yhat = jnp.array([[1.0, 0.0]])
+    mask = jnp.array([[1.0, 0.0]])
+    assert float(M.mae(y, yhat, mask)[0]) == 0.0
+    assert float(M.mape(y, yhat, mask)[0]) == 0.0
+
+
+def test_mape_guards_zero_actuals():
+    y = jnp.array([[0.0, 2.0]])
+    yhat = jnp.array([[5.0, 1.0]])
+    mask = jnp.ones_like(y)
+    # zero actual dropped, only the second point counts
+    np.testing.assert_allclose(M.mape(y, yhat, mask), [0.5])
+
+
+def test_smape_symmetric():
+    y = jnp.array([[100.0]])
+    yhat = jnp.array([[50.0]])
+    mask = jnp.ones_like(y)
+    np.testing.assert_allclose(M.smape(y, yhat, mask), [50.0 / 75.0], rtol=1e-6)
+
+
+def test_mdape_median():
+    y = jnp.array([[1.0, 1.0, 1.0, 0.0]])
+    yhat = jnp.array([[1.1, 1.5, 2.0, 9.0]])  # apes 0.1, 0.5, 1.0; last masked by |y|~0
+    mask = jnp.ones_like(y)
+    np.testing.assert_allclose(M.mdape(y, yhat, mask), [0.5], rtol=1e-5)
+
+
+def test_coverage():
+    y = jnp.array([[1.0, 2.0, 3.0, 4.0]])
+    lo = jnp.array([[0.0, 2.5, 2.0, 0.0]])
+    hi = jnp.array([[2.0, 3.0, 4.0, 3.0]])
+    mask = jnp.ones_like(y)
+    np.testing.assert_allclose(M.coverage(y, lo, hi, mask), [0.5])
+
+
+def test_fully_masked_series_finite():
+    y = jnp.zeros((2, 5))
+    yhat = jnp.ones((2, 5))
+    mask = jnp.zeros((2, 5))
+    for name, fn in M.METRIC_FNS.items():
+        v = np.asarray(fn(y, yhat, mask))
+        assert np.all(np.isfinite(v)), name
+
+
+def test_vmap_axes_consistency():
+    # metrics reduce only the last axis: (C, S, T) in -> (C, S) out
+    y = jnp.ones((3, 4, 7))
+    yhat = jnp.ones((3, 4, 7)) * 2
+    mask = jnp.ones((3, 4, 7))
+    assert M.mae(y, yhat, mask).shape == (3, 4)
+    assert M.mdape(y, yhat, mask).shape == (3, 4)

@@ -1,0 +1,170 @@
+"""Numeric tests of the model kernels — coverage the reference lacks entirely
+(SURVEY.md §4: "Coverage of the real workload: none"): each family must
+actually recover known structure on synthetic series.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_forecasting_tpu.data import synthetic_store_item_sales, tensorize
+from distributed_forecasting_tpu.engine import fit_forecast
+from distributed_forecasting_tpu.models import (
+    ArimaConfig,
+    CurveModelConfig,
+    HoltWintersConfig,
+)
+from distributed_forecasting_tpu.models import prophet_glm
+from distributed_forecasting_tpu.ops import metrics as M
+
+
+def _holdout_eval(df, model, config, horizon=60):
+    b_all = tensorize(df)
+    T = b_all.n_time
+    hist = jax.tree_util.tree_map(lambda x: x, b_all)
+    import dataclasses
+
+    hist = dataclasses.replace(
+        b_all,
+        y=b_all.y[:, : T - horizon],
+        mask=b_all.mask[:, : T - horizon],
+        day=b_all.day[: T - horizon],
+    )
+    _, res = fit_forecast(hist, model=model, config=config, horizon=horizon)
+    yhat_future = res.yhat[:, T - horizon :]
+    y_future = b_all.y[:, T - horizon :]
+    m_future = b_all.mask[:, T - horizon :]
+    return (
+        float(jnp.mean(M.mape(y_future, yhat_future, m_future))),
+        res,
+        (y_future, m_future),
+    )
+
+
+@pytest.fixture(scope="module")
+def df10():
+    return synthetic_store_item_sales(n_stores=2, n_items=5, n_days=1096, seed=11)
+
+
+def test_curve_model_holdout_accuracy(df10):
+    mape, res, _ = _holdout_eval(df10, "prophet", CurveModelConfig())
+    # synthetic noise floor is ~6-8% MAPE; the curve model should land near it
+    assert mape < 0.12, mape
+    assert bool(res.ok.all())
+
+
+def test_curve_model_additive_mode(df10):
+    cfg = CurveModelConfig(seasonality_mode="additive")
+    mape, res, _ = _holdout_eval(df10, "prophet", cfg)
+    assert mape < 0.15, mape
+
+
+def test_curve_intervals_calibrated(df10):
+    mape, res, (y_f, m_f) = _holdout_eval(df10, "prophet", CurveModelConfig())
+    T_f = y_f.shape[1]
+    lo = res.lo[:, -T_f:]
+    hi = res.hi[:, -T_f:]
+    cov = float(jnp.mean(M.coverage(y_f, lo, hi, m_f)))
+    # nominal 95%; allow generous play but must be a real interval
+    assert 0.80 <= cov <= 1.0, cov
+    assert bool(jnp.all(hi >= lo))
+
+
+def test_curve_mc_intervals_match_analytic(df10):
+    b = tensorize(df10)
+    _, res_a = fit_forecast(b, model="prophet", config=CurveModelConfig(), horizon=30)
+    _, res_mc = fit_forecast(
+        b,
+        model="prophet",
+        config=CurveModelConfig(uncertainty_samples=300),
+        horizon=30,
+    )
+    # same point forecasts, commensurate interval widths on the future window
+    np.testing.assert_allclose(
+        np.asarray(res_a.yhat), np.asarray(res_mc.yhat), rtol=1e-5
+    )
+    w_a = np.asarray(res_a.hi - res_a.lo)[:, -30:].mean()
+    w_mc = np.asarray(res_mc.hi - res_mc.lo)[:, -30:].mean()
+    assert 0.5 < w_a / w_mc < 2.0, (w_a, w_mc)
+
+
+def test_holt_winters_holdout(df10):
+    cfg = HoltWintersConfig(seasonality_mode="multiplicative")
+    mape, res, _ = _holdout_eval(df10, "holt_winters", cfg)
+    # HW has weekly season only (no yearly), so looser bar than the curve model
+    assert mape < 0.30, mape
+    assert bool(res.ok.all())
+
+
+def test_holt_winters_recovers_pure_seasonal():
+    # exact additive weekly pattern + linear trend, no noise -> near-zero error
+    T = 200
+    t = np.arange(T)
+    season = np.array([0.0, 1.0, 2.0, 3.0, -1.0, -2.0, -3.0])
+    y = 50 + 0.1 * t + season[t % 7]
+    import pandas as pd
+
+    df = pd.DataFrame(
+        {
+            "date": pd.date_range("2020-01-01", periods=T),
+            "store": 1,
+            "item": 1,
+            "sales": y,
+        }
+    )
+    mape, res, _ = _holdout_eval(df, "holt_winters", HoltWintersConfig(), horizon=28)
+    assert mape < 0.02, mape
+
+
+def test_arima_fits_ar_process():
+    # AR(2) with known coefficients: forecasts should beat the mean baseline
+    rng = np.random.default_rng(5)
+    T = 500
+    y = np.zeros(T)
+    for i in range(2, T):
+        y[i] = 0.6 * y[i - 1] - 0.2 * y[i - 2] + rng.normal(0, 1.0)
+    y = y + 30.0
+    import pandas as pd
+
+    df = pd.DataFrame(
+        {
+            "date": pd.date_range("2020-01-01", periods=T),
+            "store": 1,
+            "item": 1,
+            "sales": y,
+        }
+    )
+    b = tensorize(df)
+    from distributed_forecasting_tpu.models import arima as A
+
+    cfg = ArimaConfig(p=2, d=0, q=0, fit_steps=300)
+    params = A.fit(b.y, b.mask, b.day, cfg)
+    phi = np.asarray(params.phi)[0]
+    assert abs(phi[0] - 0.6) < 0.15, phi
+    assert abs(phi[1] + 0.2) < 0.15, phi
+
+
+def test_arima_d1_integrates_back(df10):
+    cfg = ArimaConfig(p=1, d=1, q=1, fit_steps=150)
+    mape, res, _ = _holdout_eval(df10, "arima", cfg, horizon=28)
+    # ARIMA(1,1,1) has no weekly seasonality; just require sane level tracking
+    assert mape < 0.5, mape
+    assert bool(res.ok.all())
+
+
+def test_failsafe_masks_degenerate_series(df10):
+    # append one empty series: all-masked -> fallback path, ok=False for it
+    b = tensorize(df10).pad_series_to(11)
+    _, res = fit_forecast(b, model="prophet", horizon=30)
+    ok = np.asarray(res.ok)
+    assert ok[:10].all()
+    assert not ok[10]
+    assert np.isfinite(np.asarray(res.yhat)).all()
+
+
+def test_extract_params_loggable():
+    cfg = CurveModelConfig()
+    p = prophet_glm.extract_params(None, cfg)
+    assert p["seasonality_mode"] == "multiplicative"
+    assert p["interval_width"] == 0.95

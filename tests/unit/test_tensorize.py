@@ -1,0 +1,65 @@
+import numpy as np
+import pandas as pd
+
+from distributed_forecasting_tpu.data import synthetic_store_item_sales, tensorize
+
+
+def test_tensorize_shapes(batch_small):
+    assert batch_small.n_series == 10
+    assert batch_small.n_time == 1096
+    assert batch_small.y.shape == (10, 1096)
+    assert batch_small.mask.shape == (10, 1096)
+    assert batch_small.keys.shape == (10, 2)
+    assert batch_small.key_names == ("store", "item")
+
+
+def test_tensorize_roundtrip_values(sales_df_small, batch_small):
+    # pick one (store, item) and check values land in the right slots
+    df = sales_df_small
+    row = df[(df.store == 1) & (df.item == 3)].sort_values("date")
+    keys = batch_small.keys
+    sidx = int(np.where((keys[:, 0] == 1) & (keys[:, 1] == 3))[0][0])
+    y = np.asarray(batch_small.y[sidx])
+    np.testing.assert_allclose(y, row.sales.values, rtol=1e-6)
+    assert np.asarray(batch_small.mask[sidx]).sum() == len(row)
+
+
+def test_tensorize_missing_dates_masked():
+    df = synthetic_store_item_sales(
+        n_stores=1, n_items=2, n_days=100, missing_rate=0.2, seed=3
+    )
+    b = tensorize(df)
+    m = np.asarray(b.mask)
+    assert b.n_time == 100 or b.n_time <= 100  # grid spans observed range
+    assert 0 < m.sum() < m.size  # holes masked, not imputed
+    # masked slots carry zero values
+    y = np.asarray(b.y)
+    assert np.all(y[m == 0] == 0)
+
+
+def test_tensorize_duplicate_rows_summed():
+    df = pd.DataFrame(
+        {
+            "date": ["2020-01-01", "2020-01-01", "2020-01-02"],
+            "store": [1, 1, 1],
+            "item": [1, 1, 1],
+            "sales": [2.0, 3.0, 7.0],
+        }
+    )
+    b = tensorize(df)
+    y = np.asarray(b.y)[0]
+    np.testing.assert_allclose(y, [5.0, 7.0])
+
+
+def test_pad_series_to():
+    df = synthetic_store_item_sales(n_stores=1, n_items=3, n_days=60)
+    b = tensorize(df).pad_series_to(8)
+    assert b.y.shape[0] == 8
+    assert np.asarray(b.mask)[3:].sum() == 0
+    assert (np.asarray(b.keys)[3:] == -1).all()
+
+
+def test_dates_grid(batch_small):
+    dates = batch_small.dates()
+    assert dates[0] == pd.Timestamp("2013-01-01")
+    assert len(dates) == batch_small.n_time
