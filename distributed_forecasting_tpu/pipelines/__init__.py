@@ -1,0 +1,4 @@
+from distributed_forecasting_tpu.pipelines.catalog import CatalogPipeline
+from distributed_forecasting_tpu.pipelines.training import TrainingPipeline
+
+__all__ = ["CatalogPipeline", "TrainingPipeline"]

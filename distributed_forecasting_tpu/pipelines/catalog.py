@@ -1,0 +1,31 @@
+"""Catalog bootstrap pipeline — the Unity-Catalog DDL equivalent.
+
+Reference: ``forecasting/pipelines/catalog.py:3-22`` runs ``CREATE CATALOG IF
+NOT EXISTS``, ``GRANT CREATE, USAGE ... TO account users``, ``USE CATALOG``,
+``CREATE SCHEMA IF NOT EXISTS`` with defaults ``hackathon.sales``.  Same
+bootstrap against the framework's dataset catalog.
+"""
+
+from __future__ import annotations
+
+from distributed_forecasting_tpu.data.catalog import DatasetCatalog
+
+DEFAULT_CATALOG = "hackathon"
+DEFAULT_SCHEMA = "sales"
+DEFAULT_GRANTS = ["CREATE", "USAGE"]
+
+
+class CatalogPipeline:
+    def __init__(
+        self,
+        catalog: DatasetCatalog,
+        catalog_name: str = DEFAULT_CATALOG,
+        schema_name: str = DEFAULT_SCHEMA,
+    ):
+        self.catalog = catalog
+        self.catalog_name = catalog_name or DEFAULT_CATALOG
+        self.schema_name = schema_name or DEFAULT_SCHEMA
+
+    def initialize_catalog(self) -> None:
+        self.catalog.create_catalog(self.catalog_name, grants=DEFAULT_GRANTS)
+        self.catalog.create_schema(self.catalog_name, self.schema_name)

@@ -1,0 +1,239 @@
+"""Training pipelines, librarized.
+
+The reference never moved training out of its notebook — its
+``forecasting/pipelines/training.py`` is an empty file (SURVEY.md §2.3-7).
+This module librarizes both notebook training paths:
+
+  * :meth:`TrainingPipeline.fine_grained` — the headline 500-series
+    per-(store,item) workload (reference ``notebooks/prophet/
+    02_training.py:260-328``): history -> batched fit -> rolling-origin CV ->
+    tracked run(s) -> forecast table -> serving artifact.
+  * :meth:`TrainingPipeline.allocated` — the traditional baseline
+    (``02_training.py:119-256``): aggregate per item across stores, fit
+    item-level models, allocate store forecasts by each store's historical
+    share of the item's sales (the window-function ratio join at
+    ``02_training.py:237-247``).
+
+Tracking layout: ONE batched run per fit carrying aggregate metrics, the
+model config, the per-series metric table (parquet artifact) and the
+serving artifact — collapsing the reference's 500 tracking-server round
+trips (SURVEY.md §3.1 hot loop (b)).  Optionally, per-series drill-down runs
+named ``run_item_{item}_store_{store}`` for naming parity with the
+reference's run tree (``02_training.py:160-161``).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+import pandas as pd
+
+from distributed_forecasting_tpu.data import DatasetCatalog, tensorize
+from distributed_forecasting_tpu.engine import (
+    CVConfig,
+    cross_validate,
+    fit_forecast,
+    forecast_frame,
+)
+from distributed_forecasting_tpu.models.base import get_model
+from distributed_forecasting_tpu.serving import BatchForecaster
+from distributed_forecasting_tpu.tracking import FileTracker
+from distributed_forecasting_tpu.utils import get_logger
+
+_METRICS = ("mse", "rmse", "mae", "mape", "smape", "mdape", "coverage")
+
+
+def _config_from_conf(model: str, model_conf: Optional[Dict[str, Any]]):
+    fns = get_model(model)
+    return fns.config_cls(**(model_conf or {}))
+
+
+class TrainingPipeline:
+    def __init__(self, catalog: DatasetCatalog, tracker: FileTracker):
+        self.catalog = catalog
+        self.tracker = tracker
+        self.logger = get_logger("TrainingPipeline")
+
+    # ------------------------------------------------------------------ fine
+    def fine_grained(
+        self,
+        source_table: str,
+        output_table: str,
+        model: str = "prophet",
+        model_conf: Optional[Dict[str, Any]] = None,
+        cv_conf: Optional[Dict[str, Any]] = None,
+        experiment: str = "finegrain_forecasting",
+        horizon: int = 90,
+        key_cols=("store", "item"),
+        run_cross_validation: bool = True,
+        per_series_runs: bool = False,
+        seed: int = 0,
+    ) -> Dict[str, Any]:
+        config = _config_from_conf(model, model_conf)
+        df = self.catalog.read_table(source_table)
+        batch = tensorize(df, key_cols=key_cols)
+        self.logger.info(
+            "fine-grained fit: %d series x %d days, model=%s",
+            batch.n_series, batch.n_time, model,
+        )
+
+        t_start = time.time()
+        key = jax.random.PRNGKey(seed)
+        cv_metrics = None
+        if run_cross_validation:
+            cv = CVConfig(**(cv_conf or {}))
+            cv_metrics = cross_validate(batch, model=model, config=config, cv=cv, key=key)
+        params, result = fit_forecast(
+            batch, model=model, config=config, horizon=horizon, key=key
+        )
+        jax.block_until_ready(result.yhat)
+        fit_seconds = time.time() - t_start
+
+        ok = np.asarray(result.ok)
+        n_failed = int((~ok).sum())
+        if n_failed == batch.n_series:
+            # the reference's automl post-pass raises when nothing trained
+            # (notebooks/automl/...py:151-156)
+            raise RuntimeError("no series trained successfully")
+
+        eid = self.tracker.create_experiment(experiment)
+        with self.tracker.start_run(
+            eid,
+            run_name=f"batched_{model}_fit",
+            tags={"model": model, "partial_model": str(n_failed > 0)},
+        ) as run:
+            from distributed_forecasting_tpu.models import prophet_glm
+
+            if model in ("prophet", "curve"):
+                run.log_params(prophet_glm.extract_params(params, config))
+            else:
+                import dataclasses as _dc
+
+                run.log_params(_dc.asdict(config))
+            run.log_params(
+                {
+                    "n_series": batch.n_series,
+                    "n_time": batch.n_time,
+                    "horizon": horizon,
+                    "n_failed_series": n_failed,
+                }
+            )
+            agg = {"fit_seconds": fit_seconds,
+                   "series_per_second": batch.n_series / max(fit_seconds, 1e-9)}
+            series_table = batch.key_frame()
+            series_table["fit_ok"] = ok
+            if cv_metrics is not None:
+                for name in _METRICS:
+                    vals = np.asarray(cv_metrics[name])
+                    series_table[name] = vals
+                    agg[f"val_{name}"] = float(np.mean(vals[ok])) if ok.any() else float("nan")
+                agg["n_cv_cutoffs"] = cv_metrics["_n_cutoffs"]
+            run.log_metrics(agg)
+            run.log_table("series_metrics.parquet", series_table)
+
+            forecaster = BatchForecaster.from_fit(batch, params, model, config)
+            forecaster.save(run.artifact_path("forecaster"))
+
+            if per_series_runs:
+                self._log_per_series_runs(eid, series_table, run.run_id)
+
+            run_id = run.run_id
+
+        table_df = forecast_frame(batch, result)
+        version = self.catalog.save_table(output_table, table_df)
+        self.logger.info(
+            "wrote %s (version %s): %d rows; fit %.2fs (%.1f series/s); "
+            "%d/%d series ok",
+            output_table, version, len(table_df), fit_seconds,
+            agg["series_per_second"], batch.n_series - n_failed, batch.n_series,
+        )
+        if n_failed:
+            self.logger.warning("partial model: %d series fell back", n_failed)
+        return {
+            "experiment_id": eid,
+            "run_id": run_id,
+            "table_version": version,
+            "n_series": batch.n_series,
+            "n_failed": n_failed,
+            "fit_seconds": fit_seconds,
+            "metrics": {k: v for k, v in agg.items()},
+        }
+
+    def _log_per_series_runs(self, eid: str, series_table: pd.DataFrame, parent: str):
+        for row in series_table.itertuples(index=False):
+            d = row._asdict()
+            name = f"run_item_{d.get('item')}_store_{d.get('store')}"
+            with self.tracker.start_run(
+                eid, run_name=name, tags={"parent_run_id": parent}
+            ) as r:
+                r.log_metrics(
+                    {k: float(v) for k, v in d.items()
+                     if k in _METRICS and np.isfinite(v)}
+                )
+
+    # ------------------------------------------------------------- allocated
+    def allocated(
+        self,
+        source_table: str,
+        output_table: str,
+        model: str = "prophet",
+        model_conf: Optional[Dict[str, Any]] = None,
+        experiment: str = "allocated_forecasting",
+        horizon: int = 90,
+        seed: int = 0,
+    ) -> Dict[str, Any]:
+        """Item-level fit + store-share allocation.
+
+        Reference steps (``02_training.py:225-254``): sum sales per item
+        across stores; fit one model per item; compute each store's
+        historical share ``sales / SUM(sales) OVER (PARTITION BY item)``;
+        scale item forecasts down to (store, item) granularity.
+        """
+        config = _config_from_conf(model, model_conf)
+        df = self.catalog.read_table(source_table)
+
+        item_df = (
+            df.groupby(["date", "item"], as_index=False)["sales"].sum()
+        )
+        batch = tensorize(item_df, key_cols=("item",))
+        key = jax.random.PRNGKey(seed)
+        params, result = fit_forecast(
+            batch, model=model, config=config, horizon=horizon, key=key
+        )
+        item_fc = forecast_frame(batch, result)  # [ds, item, y, yhat, ...]
+
+        # store share of each item's historical sales
+        totals = df.groupby(["store", "item"], as_index=False)["sales"].sum()
+        item_totals = totals.groupby("item")["sales"].transform("sum")
+        totals["ratio"] = totals["sales"] / item_totals
+        ratios = totals[["store", "item", "ratio"]]
+
+        merged = item_fc.merge(ratios, on="item", how="inner")
+        for col in ("y", "yhat", "yhat_upper", "yhat_lower"):
+            merged[col] = merged[col] * merged["ratio"]
+        out = merged[
+            ["ds", "store", "item", "y", "yhat", "yhat_upper", "yhat_lower",
+             "training_date"]
+        ]
+
+        eid = self.tracker.create_experiment(experiment)
+        with self.tracker.start_run(eid, run_name=f"allocated_{model}_fit") as run:
+            run.log_params({"n_items": batch.n_series, "horizon": horizon})
+            forecaster = BatchForecaster.from_fit(batch, params, model, config)
+            forecaster.save(run.artifact_path("forecaster"))
+            run_id = run.run_id
+
+        version = self.catalog.save_table(output_table, out)
+        self.logger.info(
+            "allocated forecasts: %d items -> %d (store,item) rows -> %s v%s",
+            batch.n_series, len(out), output_table, version,
+        )
+        return {
+            "experiment_id": eid,
+            "run_id": run_id,
+            "table_version": version,
+            "n_items": batch.n_series,
+        }

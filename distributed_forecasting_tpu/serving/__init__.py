@@ -1,0 +1,3 @@
+from distributed_forecasting_tpu.serving.predictor import BatchForecaster
+
+__all__ = ["BatchForecaster"]

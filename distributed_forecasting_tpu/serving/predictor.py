@@ -1,0 +1,171 @@
+"""Batched inference model — the PyFunc-equivalent, minus the anti-patterns.
+
+The reference serves inference through a custom ``mlflow.pyfunc.PythonModel``
+that, per (store, item) group, looks a run up by name in a pickled run table,
+sleeps 0.5 s as a rate-limit guard, and downloads + loads the per-series
+Prophet model *inside every predict call* (reference
+``notebooks/prophet/model_wrapper.py:11-73``), dispatched by another
+``applyInPandas`` fan-out that also re-resolves the registered model per group
+(``notebooks/prophet/04_inference.py:4-16``).  SURVEY.md §2.3-2/3 documents
+the cost: >=250 s of sleep plus 1000+ registry/artifact round trips per batch.
+
+:class:`BatchForecaster` is the TPU-native replacement: ONE artifact holding
+the fitted parameter pytree for ALL series plus the key table; loaded once;
+``predict`` selects the requested series by key and runs one compiled
+forecast for the whole request.  Unseen keys raise a clear error (or are
+skipped) instead of the reference's IndexError (§2.3-3).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import pickle
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from distributed_forecasting_tpu.models.base import get_model
+
+_PARAMS_FILE = "params.pkl"
+_META_FILE = "forecaster.json"
+
+
+class UnknownSeriesError(KeyError):
+    pass
+
+
+class BatchForecaster:
+    """Loads once, predicts every requested series in one compiled call."""
+
+    def __init__(
+        self,
+        model: str,
+        config,
+        params,
+        keys: np.ndarray,
+        key_names: tuple,
+        day0: int,
+        day1: int,
+    ):
+        self.model = model
+        self.config = config
+        self.params = params
+        self.keys = np.asarray(keys)
+        self.key_names = tuple(key_names)
+        self.day0 = int(day0)  # first training day (absolute day number)
+        self.day1 = int(day1)  # last training day
+        self._index = {tuple(k): i for i, k in enumerate(self.keys.tolist())}
+
+    # -- construction -------------------------------------------------------
+    @classmethod
+    def from_fit(cls, batch, params, model: str, config) -> "BatchForecaster":
+        return cls(
+            model=model,
+            config=config,
+            params=params,
+            keys=batch.keys,
+            key_names=batch.key_names,
+            day0=int(batch.day[0]),
+            day1=int(batch.day[-1]),
+        )
+
+    # -- persistence --------------------------------------------------------
+    def save(self, directory: str) -> None:
+        os.makedirs(directory, exist_ok=True)
+        host_params = jax.tree_util.tree_map(np.asarray, self.params)
+        with open(os.path.join(directory, _PARAMS_FILE), "wb") as f:
+            pickle.dump(host_params, f)
+        meta = {
+            "model": self.model,
+            "config": dataclasses.asdict(self.config),
+            "key_names": list(self.key_names),
+            "keys": self.keys.tolist(),
+            "day0": self.day0,
+            "day1": self.day1,
+            # serving-schema string, the tag the reference sets on its model
+            # version (03_deploy.py:44-58)
+            "serving_schema": "ds date, "
+            + ", ".join(f"{k} int" for k in self.key_names)
+            + ", yhat double, yhat_upper double, yhat_lower double",
+        }
+        with open(os.path.join(directory, _META_FILE), "w") as f:
+            json.dump(meta, f, indent=2)
+
+    @classmethod
+    def load(cls, directory: str) -> "BatchForecaster":
+        with open(os.path.join(directory, _META_FILE)) as f:
+            meta = json.load(f)
+        with open(os.path.join(directory, _PARAMS_FILE), "rb") as f:
+            params = pickle.load(f)
+        fns = get_model(meta["model"])
+        config = fns.config_cls(**meta["config"])
+        return cls(
+            model=meta["model"],
+            config=config,
+            params=params,
+            keys=np.asarray(meta["keys"], dtype=np.int64),
+            key_names=tuple(meta["key_names"]),
+            day0=meta["day0"],
+            day1=meta["day1"],
+        )
+
+    # -- inference ----------------------------------------------------------
+    def series_indices(
+        self, request: pd.DataFrame, on_missing: str = "raise"
+    ) -> np.ndarray:
+        req = request[list(self.key_names)].drop_duplicates().astype(np.int64)
+        idx = []
+        for row in req.itertuples(index=False):
+            key = tuple(row)
+            if key in self._index:
+                idx.append(self._index[key])
+            elif on_missing == "raise":
+                raise UnknownSeriesError(
+                    f"series {dict(zip(self.key_names, key))} was not in the "
+                    f"training set ({len(self._index)} known series)"
+                )
+            # on_missing == 'skip': drop silently
+        return np.asarray(idx, dtype=np.int64)
+
+    def predict(
+        self,
+        request: pd.DataFrame,
+        horizon: int = 90,
+        include_history: bool = False,
+        key: Optional[jax.Array] = None,
+        on_missing: str = "raise",
+    ) -> pd.DataFrame:
+        """Forecast every requested (store, item) ``horizon`` days past the
+        end of training.  ``request`` needs the key columns only (extra
+        columns — e.g. the history the reference ships to its UDF — are
+        ignored; the fitted params already encode history)."""
+        sidx = self.series_indices(request, on_missing=on_missing)
+        if sidx.size == 0:
+            return pd.DataFrame(
+                columns=["ds", *self.key_names, "yhat", "yhat_upper", "yhat_lower"]
+            )
+        fns = get_model(self.model)
+        start = self.day0 if include_history else self.day1 + 1
+        day_all = jnp.arange(start, self.day1 + horizon + 1, dtype=jnp.int32)
+        params = jax.tree_util.tree_map(jnp.asarray, self.params)
+        yhat, lo, hi = fns.forecast(
+            params, day_all, jnp.float32(self.day1), self.config, key
+        )
+        yhat = np.asarray(yhat)[sidx]
+        lo = np.asarray(lo)[sidx]
+        hi = np.asarray(hi)[sidx]
+
+        T = day_all.shape[0]
+        dates = pd.to_datetime(np.asarray(day_all, dtype="int64"), unit="D")
+        frame = {"ds": np.tile(dates.values, len(sidx))}
+        for j, name in enumerate(self.key_names):
+            frame[name] = np.repeat(self.keys[sidx, j], T)
+        frame["yhat"] = yhat.reshape(-1)
+        frame["yhat_upper"] = hi.reshape(-1)
+        frame["yhat_lower"] = lo.reshape(-1)
+        return pd.DataFrame(frame)

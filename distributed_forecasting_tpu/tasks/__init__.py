@@ -1,0 +1,27 @@
+from distributed_forecasting_tpu.tasks.common import Task
+from distributed_forecasting_tpu.tasks.catalog import CatalogTask
+from distributed_forecasting_tpu.tasks.ingest import IngestTask
+from distributed_forecasting_tpu.tasks.train import TrainTask
+from distributed_forecasting_tpu.tasks.deploy import DeployTask
+from distributed_forecasting_tpu.tasks.inference import InferenceTask
+from distributed_forecasting_tpu.tasks.sample_ml import SampleMLTask
+
+TASK_TYPES = {
+    "catalog": CatalogTask,
+    "ingest": IngestTask,
+    "train": TrainTask,
+    "deploy": DeployTask,
+    "inference": InferenceTask,
+    "sample_ml": SampleMLTask,
+}
+
+__all__ = [
+    "Task",
+    "CatalogTask",
+    "IngestTask",
+    "TrainTask",
+    "DeployTask",
+    "InferenceTask",
+    "SampleMLTask",
+    "TASK_TYPES",
+]

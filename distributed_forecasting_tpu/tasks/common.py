@@ -1,0 +1,85 @@
+"""Task ABC — the job harness (L5).
+
+Shape mirrors the reference's ``Task`` (``forecasting/common.py:25-104``):
+conf from ``--conf-file`` YAML (unknown args pass through) or an injected
+dict for tests; a logger; an abstract ``launch()``.  What the reference wires
+to Spark/DBUtils, this wires to the framework's own infrastructure handles —
+the dataset catalog (table store), tracker (runs), and registry (models) —
+built lazily from the conf's ``env:`` section:
+
+    env:
+      warehouse: /path/to/warehouse     # DatasetCatalog root
+      tracking:  /path/to/mlruns        # FileTracker root
+      registry:  /path/to/registry      # ModelRegistry root
+
+Paths default to ``./dftpu_store/{warehouse,mlruns,registry}`` so a bare task
+run works out of the box.  Handles can also be injected directly (the test
+hook, same role as the reference's patchable ``get_dbutils``,
+``common.py:10-22``).
+"""
+
+from __future__ import annotations
+
+import os
+from abc import ABC, abstractmethod
+from typing import Any, Dict, Optional
+
+from distributed_forecasting_tpu.data.catalog import DatasetCatalog
+from distributed_forecasting_tpu.tracking import FileTracker, ModelRegistry
+from distributed_forecasting_tpu.utils import get_logger, parse_conf_args
+
+_DEFAULT_ROOT = "./dftpu_store"
+
+
+class Task(ABC):
+    def __init__(
+        self,
+        init_conf: Optional[Dict[str, Any]] = None,
+        catalog: Optional[DatasetCatalog] = None,
+        tracker: Optional[FileTracker] = None,
+        registry: Optional[ModelRegistry] = None,
+    ):
+        self.logger = get_logger(self.__class__.__name__)
+        if init_conf is not None:
+            self.conf = init_conf
+        else:
+            self.conf = parse_conf_args()
+        self._log_conf()
+        env = self.conf.get("env", {}) if isinstance(self.conf, dict) else {}
+        root = env.get("root", _DEFAULT_ROOT)
+        self._catalog = catalog
+        self._tracker = tracker
+        self._registry = registry
+        self._paths = {
+            "warehouse": env.get("warehouse", os.path.join(root, "warehouse")),
+            "tracking": env.get("tracking", os.path.join(root, "mlruns")),
+            "registry": env.get("registry", os.path.join(root, "registry")),
+        }
+
+    # lazy infra handles ----------------------------------------------------
+    @property
+    def catalog(self) -> DatasetCatalog:
+        if self._catalog is None:
+            self._catalog = DatasetCatalog(self._paths["warehouse"])
+        return self._catalog
+
+    @property
+    def tracker(self) -> FileTracker:
+        if self._tracker is None:
+            self._tracker = FileTracker(self._paths["tracking"])
+        return self._tracker
+
+    @property
+    def registry(self) -> ModelRegistry:
+        if self._registry is None:
+            self._registry = ModelRegistry(self._paths["registry"])
+        return self._registry
+
+    def _log_conf(self) -> None:
+        self.logger.info("Launching task with configuration:")
+        for key, item in (self.conf or {}).items():
+            self.logger.info("\t%s: %s", key, item)
+
+    @abstractmethod
+    def launch(self) -> Any:
+        """Run the task's business logic."""

@@ -1,0 +1,79 @@
+"""Deploy task: register the batched forecaster from a training run.
+
+Parity with the reference's deploy notebook (``notebooks/prophet/
+03_deploy.py``): it logs the PyFunc wrapper pointing at the training
+experiment (``:20-30``), registers it as ``ForecastingModelUDF`` (``:34-36``)
+and sets serving-metadata version tags including the schema string
+(``:44-58``).  Here the training run already saved the serving artifact
+(see ``pipelines/training.py``), so deploy = resolve run -> register its
+``forecaster/`` artifact dir -> tag the version.
+
+Conf::
+
+    deploy:
+      experiment: finegrain_forecasting
+      run_id: <optional — defaults to the newest batched run>
+      model_name: ForecastingBatchModel
+      tags: {reviewed: "false"}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from distributed_forecasting_tpu.tasks.common import Task
+
+
+class DeployTask(Task):
+    def launch(self) -> dict:
+        dep = self.conf.get("deploy", {})
+        experiment = dep.get("experiment", "finegrain_forecasting")
+        model_name = dep.get("model_name", "ForecastingBatchModel")
+
+        eid = self.tracker.get_experiment_by_name(experiment)
+        if eid is None:
+            raise KeyError(f"experiment {experiment!r} not found")
+        run_id = dep.get("run_id")
+        if run_id is None:
+            runs = [
+                r for r in self.tracker.search_runs(eid)
+                if os.path.isdir(r.artifact_path("forecaster"))
+            ]
+            if not runs:
+                raise KeyError(f"no runs with a forecaster artifact in {experiment!r}")
+            runs.sort(key=lambda r: r.meta().get("start_time", 0.0))
+            run = runs[-1]
+        else:
+            run = self.tracker.get_run(eid, run_id)
+
+        art = run.artifact_path("forecaster")
+        with open(os.path.join(art, "forecaster.json")) as f:
+            meta = json.load(f)
+        version = self.registry.register_model(
+            model_name,
+            art,
+            run_id=run.run_id,
+            tags={
+                "udf": "batched",  # one batched model, not one per series
+                "reviewed": dep.get("tags", {}).get("reviewed", "false"),
+                "serving_schema": meta.get("serving_schema", ""),
+                "source_experiment": experiment,
+                "model_family": meta.get("model", ""),
+            },
+        )
+        for k, v in dep.get("tags", {}).items():
+            self.registry.set_version_tag(model_name, version.version, k, v)
+        self.logger.info(
+            "registered %s v%d from run %s", model_name, version.version, run.run_id
+        )
+        return {"model_name": model_name, "version": version.version,
+                "run_id": run.run_id}
+
+
+def entrypoint():
+    DeployTask().launch()
+
+
+if __name__ == "__main__":
+    entrypoint()

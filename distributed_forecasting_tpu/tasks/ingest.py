@@ -1,0 +1,56 @@
+"""Ingest task: CSV/parquet long table -> catalog raw table.
+
+Replaces the reference's data-setup cells — ``spark.read.csv(train.csv,
+schema="date date, store int, item int, sales int")`` written to
+``hackathon.sales.raw`` (reference ``notebooks/prophet/02_training.py:30-44``)
+and the analogous ``test.csv`` load (``04_inference.py:20-30``).
+
+Conf::
+
+    input:
+      path: /data/train.csv          # .csv or .parquet
+    output:
+      table: hackathon.sales.raw
+"""
+
+from __future__ import annotations
+
+from distributed_forecasting_tpu.data.dataset import (
+    load_sales_csv,
+    load_sales_parquet,
+    synthetic_store_item_sales,
+)
+from distributed_forecasting_tpu.tasks.common import Task
+
+
+class IngestTask(Task):
+    def launch(self) -> str:
+        inp = self.conf.get("input", {})
+        out = self.conf.get("output", {})
+        table = out.get("table", "hackathon.sales.raw")
+        path = inp.get("path")
+        if path is None:
+            # hermetic mode: generate the synthetic Kaggle-shaped dataset
+            synth = inp.get("synthetic", {})
+            df = synthetic_store_item_sales(
+                n_stores=int(synth.get("n_stores", 10)),
+                n_items=int(synth.get("n_items", 50)),
+                n_days=int(synth.get("n_days", 1826)),
+                seed=int(synth.get("seed", 0)),
+            )
+            self.logger.info("generated synthetic dataset: %d rows", len(df))
+        elif path.endswith(".parquet"):
+            df = load_sales_parquet(path)
+        else:
+            df = load_sales_csv(path)
+        version = self.catalog.save_table(table, df)
+        self.logger.info("ingested %d rows -> %s (v%s)", len(df), table, version)
+        return version
+
+
+def entrypoint():
+    IngestTask().launch()
+
+
+if __name__ == "__main__":
+    entrypoint()

@@ -1,0 +1,61 @@
+"""Training task: the fine-grained (and optionally allocated) fit as a job.
+
+Librarized equivalent of the reference's training notebook entry point
+(``notebooks/prophet/02_training.py:260-328``), wired through
+:class:`TrainingPipeline`.  Conf::
+
+    input:
+      table: hackathon.sales.raw
+    output:
+      table: hackathon.sales.finegrain_forecasts
+    training:
+      model: prophet                # prophet | holt_winters | arima
+      model_conf: {...}             # fields of the model's config dataclass
+      cv: {initial: 730, period: 360, horizon: 90}
+      horizon: 90
+      experiment: finegrain_forecasting
+      per_series_runs: false
+      path: fine_grained            # or 'allocated'
+"""
+
+from __future__ import annotations
+
+from distributed_forecasting_tpu.pipelines.training import TrainingPipeline
+from distributed_forecasting_tpu.tasks.common import Task
+
+
+class TrainTask(Task):
+    def launch(self) -> dict:
+        inp = self.conf.get("input", {})
+        out = self.conf.get("output", {})
+        tr = self.conf.get("training", {})
+        pipeline = TrainingPipeline(self.catalog, self.tracker)
+        path = tr.get("path", "fine_grained")
+        if path == "allocated":
+            return pipeline.allocated(
+                source_table=inp.get("table", "hackathon.sales.raw"),
+                output_table=out.get("table", "hackathon.sales.allocated_forecasts"),
+                model=tr.get("model", "prophet"),
+                model_conf=tr.get("model_conf"),
+                experiment=tr.get("experiment", "allocated_forecasting"),
+                horizon=int(tr.get("horizon", 90)),
+            )
+        return pipeline.fine_grained(
+            source_table=inp.get("table", "hackathon.sales.raw"),
+            output_table=out.get("table", "hackathon.sales.finegrain_forecasts"),
+            model=tr.get("model", "prophet"),
+            model_conf=tr.get("model_conf"),
+            cv_conf=tr.get("cv"),
+            experiment=tr.get("experiment", "finegrain_forecasting"),
+            horizon=int(tr.get("horizon", 90)),
+            run_cross_validation=bool(tr.get("run_cross_validation", True)),
+            per_series_runs=bool(tr.get("per_series_runs", False)),
+        )
+
+
+def entrypoint():
+    TrainTask().launch()
+
+
+if __name__ == "__main__":
+    entrypoint()

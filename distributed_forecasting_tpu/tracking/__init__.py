@@ -1,0 +1,4 @@
+from distributed_forecasting_tpu.tracking.filestore import FileTracker, Run
+from distributed_forecasting_tpu.tracking.registry import ModelRegistry, ModelVersion
+
+__all__ = ["FileTracker", "Run", "ModelRegistry", "ModelVersion"]

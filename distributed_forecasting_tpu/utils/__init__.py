@@ -1,0 +1,4 @@
+from distributed_forecasting_tpu.utils.logging import get_logger
+from distributed_forecasting_tpu.utils.config import load_conf, parse_conf_args
+
+__all__ = ["get_logger", "load_conf", "parse_conf_args"]

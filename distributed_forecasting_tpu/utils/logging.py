@@ -1,0 +1,21 @@
+"""Logging — plain stdlib logging where the reference bridges to Spark's
+log4j over py4j (reference ``forecasting/common.py:88-96``).  No JVM here, so
+the logger is a normal Python logger with one consistent format."""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+_FORMAT = "[dftpu][%(asctime)s][%(name)s][%(levelname)s] %(message)s"
+
+
+def get_logger(name: str = "dftpu", level: int = logging.INFO) -> logging.Logger:
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(logging.Formatter(_FORMAT, datefmt="%Y-%m-%d %H:%M:%S"))
+        logger.addHandler(handler)
+        logger.setLevel(level)
+        logger.propagate = False
+    return logger

@@ -1,0 +1,3 @@
+from distributed_forecasting_tpu.workflows.runner import WorkflowRunner, run_workflow_file
+
+__all__ = ["WorkflowRunner", "run_workflow_file"]

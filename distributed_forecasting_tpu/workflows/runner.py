@@ -1,0 +1,146 @@
+"""YAML workflow runner — the dbx/Databricks-jobs stand-in (L6).
+
+The reference deploys YAML-defined workflows of tasks with dependencies via
+``dbx deploy/launch`` (``conf/deployment.yml:19-58`` — including the
+commented-out multitask etl -> ml job the new framework should honor,
+SURVEY.md §2.4 "Pipeline parallelism" row), launched by ``make deploy/run``
+(``Makefile:1-5``).  No cluster manager is needed for a single-host TPU, so
+the runner is in-process: topological execution of task nodes with explicit
+``depends_on`` edges, per-task conf (inline or ``conf_file``), shared ``env``
+roots, and fail-fast with a structured result report.
+
+Workflow YAML::
+
+    env:
+      root: ./dftpu_store
+    workflows:
+      - name: forecasting-e2e
+        tasks:
+          - name: catalog
+            task: catalog                # key into TASK_TYPES
+            conf: {output: {catalog_name: hackathon, schema_name: sales}}
+          - name: etl
+            task: ingest
+            depends_on: [catalog]
+            conf_file: conf/tasks/ingest_config.yml
+          - name: train
+            task: train
+            depends_on: [etl]
+            ...
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from distributed_forecasting_tpu.utils import get_logger, load_conf
+
+
+class WorkflowError(RuntimeError):
+    pass
+
+
+class WorkflowRunner:
+    def __init__(self, spec: Dict[str, Any], env: Optional[Dict[str, Any]] = None):
+        self.spec = spec
+        self.env = {**(spec.get("env", {}) or {}), **(env or {})}
+        self.logger = get_logger("WorkflowRunner")
+
+    def _workflow(self, name: Optional[str]) -> Dict[str, Any]:
+        flows = self.spec.get("workflows", [])
+        if not flows:
+            raise WorkflowError("no workflows defined")
+        if name is None:
+            return flows[0]
+        for wf in flows:
+            if wf.get("name") == name:
+                return wf
+        raise WorkflowError(f"workflow {name!r} not found")
+
+    @staticmethod
+    def _topo_order(tasks: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+        by_name = {t["name"]: t for t in tasks}
+        order: List[Dict[str, Any]] = []
+        state: Dict[str, int] = {}  # 0 visiting, 1 done
+
+        def visit(name: str, chain=()):
+            if name in chain:
+                raise WorkflowError(f"dependency cycle at {name!r}")
+            if state.get(name) == 1:
+                return
+            node = by_name.get(name)
+            if node is None:
+                raise WorkflowError(f"unknown dependency {name!r}")
+            for dep in node.get("depends_on", []) or []:
+                visit(dep, chain + (name,))
+            state[name] = 1
+            order.append(node)
+
+        for t in tasks:
+            visit(t["name"])
+        return order
+
+    def run(self, workflow: Optional[str] = None) -> Dict[str, Any]:
+        from distributed_forecasting_tpu.tasks import TASK_TYPES
+
+        wf = self._workflow(workflow)
+        order = self._topo_order(wf.get("tasks", []))
+        self.logger.info(
+            "workflow %s: %d tasks (%s)",
+            wf.get("name"), len(order), " -> ".join(t["name"] for t in order),
+        )
+        results: Dict[str, Any] = {}
+        for node in order:
+            ttype = node.get("task")
+            if ttype not in TASK_TYPES:
+                raise WorkflowError(
+                    f"task {node['name']!r}: unknown task type {ttype!r} "
+                    f"(known: {sorted(TASK_TYPES)})"
+                )
+            conf: Dict[str, Any] = {}
+            if node.get("conf_file"):
+                conf.update(load_conf(node["conf_file"]))
+            if node.get("conf"):
+                conf.update(node["conf"])
+            if self.env:
+                conf.setdefault("env", {}).update(
+                    {k: v for k, v in self.env.items() if k not in conf.get("env", {})}
+                )
+            t0 = time.time()
+            self.logger.info("task %s (%s) starting", node["name"], ttype)
+            try:
+                out = TASK_TYPES[ttype](init_conf=conf).launch()
+            except Exception as e:
+                self.logger.error("task %s failed: %s", node["name"], e)
+                results[node["name"]] = {"status": "FAILED", "error": str(e)}
+                raise WorkflowError(f"task {node['name']} failed: {e}") from e
+            results[node["name"]] = {
+                "status": "OK",
+                "seconds": time.time() - t0,
+                "result": out,
+            }
+        return results
+
+
+def run_workflow_file(path: str, workflow: Optional[str] = None,
+                      env: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    return WorkflowRunner(load_conf(path), env=env).run(workflow)
+
+
+def main(argv=None):
+    import argparse
+
+    p = argparse.ArgumentParser("dftpu-workflow")
+    p.add_argument("--file", "-f", required=True, help="workflow YAML")
+    p.add_argument("--workflow", "-w", default=None, help="workflow name")
+    p.add_argument("--env-root", default=None, help="override env.root")
+    args = p.parse_args(argv)
+    env = {"root": args.env_root} if args.env_root else None
+    results = run_workflow_file(args.file, args.workflow, env=env)
+    for name, r in results.items():
+        print(f"{name}: {r['status']} ({r.get('seconds', 0):.2f}s)")
+
+
+if __name__ == "__main__":
+    main()

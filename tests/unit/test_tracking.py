@@ -1,0 +1,93 @@
+import os
+
+import pandas as pd
+import pytest
+
+from distributed_forecasting_tpu.tracking import ModelRegistry
+
+
+def test_experiment_create_idempotent(tracker):
+    e1 = tracker.create_experiment("exp")
+    e2 = tracker.create_experiment("exp")
+    assert e1 == e2
+    assert tracker.get_experiment_by_name("exp") == e1
+    assert tracker.get_experiment_by_name("nope") is None
+
+
+def test_run_logging_roundtrip(tracker):
+    eid = tracker.create_experiment("exp")
+    with tracker.start_run(eid, run_name="run_item_3_store_1") as run:
+        run.log_params({"growth": "linear", "n_changepoints": 25})
+        run.log_metrics({"mape": 0.06})
+        run.log_metrics({"mape": 0.05}, step=1)
+        run.set_tags({"model": "prophet"})
+        run.log_artifact_bytes("notes.txt", b"hello")
+    r = tracker.get_run(eid, run.run_id)
+    assert r.params()["n_changepoints"] == 25
+    assert r.metrics()["mape"] == 0.05  # latest value wins
+    meta = r.meta()
+    assert meta["status"] == "FINISHED"
+    assert meta["tags"]["model"] == "prophet"
+    with open(r.artifact_path("notes.txt")) as f:
+        assert f.read() == "hello"
+
+
+def test_run_failure_status(tracker):
+    eid = tracker.create_experiment("exp")
+    with pytest.raises(ValueError):
+        with tracker.start_run(eid) as run:
+            raise ValueError("boom")
+    assert run.meta()["status"] == "FAILED"
+
+
+def test_search_runs_by_name_and_tags(tracker):
+    eid = tracker.create_experiment("exp")
+    with tracker.start_run(eid, run_name="a", tags={"k": "1"}):
+        pass
+    with tracker.start_run(eid, run_name="b", tags={"k": "2"}):
+        pass
+    assert len(tracker.search_runs(eid)) == 2
+    assert len(tracker.search_runs(eid, run_name="a")) == 1
+    assert len(tracker.search_runs(eid, tags={"k": "2"})) == 1
+    assert tracker.search_runs(eid, run_name="zzz") == []
+
+
+def test_log_table_artifact(tracker):
+    eid = tracker.create_experiment("exp")
+    df = pd.DataFrame({"store": [1], "item": [2], "mape": [0.05]})
+    with tracker.start_run(eid) as run:
+        run.log_table("series_metrics.parquet", df)
+    back = pd.read_parquet(run.artifact_path("series_metrics.parquet"))
+    assert back.mape[0] == 0.05
+
+
+def test_registry_lifecycle(tmp_path, tracker):
+    # build an artifact dir to register
+    eid = tracker.create_experiment("exp")
+    with tracker.start_run(eid) as run:
+        run.log_artifact_bytes("forecaster/weights.bin", b"\x00\x01")
+    reg = ModelRegistry(str(tmp_path / "registry"))
+    v1 = reg.register_model(
+        "ForecastingBatchModel", run.artifact_path("forecaster"),
+        run_id=run.run_id, tags={"udf": "batched"},
+    )
+    assert v1.version == 1
+    assert v1.stage == "None"
+    assert os.path.exists(os.path.join(v1.artifact_dir, "weights.bin"))
+
+    v2 = reg.register_model("ForecastingBatchModel", run.artifact_path("forecaster"))
+    assert v2.version == 2
+    assert reg.latest_version("ForecastingBatchModel").version == 2
+
+    # stage transitions: the reference promotes None -> Staging after
+    # inference (04_inference.py:66-76)
+    reg.transition_stage("ForecastingBatchModel", 1, "Staging")
+    assert reg.latest_version("ForecastingBatchModel", stage="Staging").version == 1
+    with pytest.raises(ValueError):
+        reg.transition_stage("ForecastingBatchModel", 1, "NotAStage")
+
+    reg.set_version_tag("ForecastingBatchModel", 1, "reviewed", "true")
+    assert reg.get_version("ForecastingBatchModel", 1).tags["reviewed"] == "true"
+    assert reg.models() == ["ForecastingBatchModel"]
+    with pytest.raises(KeyError):
+        reg.latest_version("Nope")
