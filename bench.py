@@ -1,0 +1,95 @@
+"""Headline benchmark: the reference's 500-series fine-grained workload.
+
+Reference workload (BASELINE.md): 500 (store, item) series x 5 years daily
+(~913k rows), one seasonal-trend model per series, 90-day forecast — which
+the reference runs as ~500 Prophet/Stan fits fanned out over a Spark cluster
+(minutes of wall time; its own inference path adds a 0.5 s/series sleep
+floor).  Target from BASELINE.json: fit + forecast on one TPU chip in <10 s.
+
+This benchmark runs the full batched pipeline on whatever device JAX
+provides (TPU on the driver; CPU fallback works too): tensorized 500-series
+batch -> curve-model fit -> 90-day forecast with intervals -> in-sample fit
+quality check.  Reported value is steady-state series throughput
+(series/sec); vs_baseline is measured against the 50 series/s the <10 s
+target implies.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+N_STORES = 10
+N_ITEMS = 50
+N_DAYS = 1826
+HORIZON = 90
+TARGET_SERIES_PER_S = 50.0  # 500 series / 10 s (BASELINE.json north star)
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_forecasting_tpu.data import (
+        synthetic_store_item_sales,
+        tensorize,
+    )
+    from distributed_forecasting_tpu.engine import fit_forecast
+    from distributed_forecasting_tpu.ops import metrics as M
+
+    dev = jax.devices()[0]
+    print(f"[bench] device: {dev.platform} ({dev.device_kind})", file=sys.stderr)
+
+    df = synthetic_store_item_sales(
+        n_stores=N_STORES, n_items=N_ITEMS, n_days=N_DAYS, seed=0
+    )
+    batch = tensorize(df)
+    S = batch.n_series
+    print(f"[bench] {S} series x {batch.n_time} days", file=sys.stderr)
+
+    def run(seed: int):
+        params, res = fit_forecast(
+            batch, model="prophet", horizon=HORIZON,
+            key=jax.random.PRNGKey(seed),
+        )
+        jax.block_until_ready(res.yhat)
+        return res
+
+    t0 = time.time()
+    res = run(0)
+    compile_s = time.time() - t0
+    print(f"[bench] first call (incl. compile): {compile_s:.2f}s", file=sys.stderr)
+
+    times = []
+    for i in range(3):
+        t0 = time.time()
+        res = run(i + 1)
+        times.append(time.time() - t0)
+    steady = min(times)
+    series_per_s = S / steady
+
+    mape = float(jnp.mean(M.mape(batch.y, res.yhat[:, : batch.n_time], batch.mask)))
+    ok = bool(res.ok.all())
+    print(
+        f"[bench] steady-state fit+forecast: {steady:.3f}s "
+        f"({series_per_s:.0f} series/s); in-sample MAPE {mape:.4f}; all_ok={ok}",
+        file=sys.stderr,
+    )
+
+    print(
+        json.dumps(
+            {
+                "metric": "series_fit_forecast_per_sec_single_chip",
+                "value": round(series_per_s, 1),
+                "unit": "series/s",
+                "vs_baseline": round(series_per_s / TARGET_SERIES_PER_S, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -5,8 +5,16 @@ from distributed_forecasting_tpu.engine.fit import (
     seasonal_naive,
 )
 from distributed_forecasting_tpu.engine.cv import CVConfig, cross_validate
+from distributed_forecasting_tpu.engine.hyper import (
+    HyperSearchConfig,
+    TuneResult,
+    tune_curve_model,
+)
 
 __all__ = [
+    "HyperSearchConfig",
+    "TuneResult",
+    "tune_curve_model",
     "ForecastResult",
     "fit_forecast",
     "forecast_frame",

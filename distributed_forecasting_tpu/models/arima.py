@@ -104,7 +104,10 @@ def _kalman_loglik(z, mask, phi, theta, r):
     T_mat, Rv = _build_ssm(phi, theta, r)
     RRt = jnp.outer(Rv, Rv)
     P0 = _init_cov(T_mat, RRt)
-    a0 = jnp.zeros((r,))
+    # data-derived zeros keep the scan carry's varying type consistent under
+    # shard_map (see holt_winters._filter)
+    zero = jnp.sum(z) * 0.0
+    a0 = jnp.zeros((r,)) + zero
 
     def step(carry, inp):
         a, P, ssq, ldet, n = carry
@@ -124,7 +127,7 @@ def _kalman_loglik(z, mask, phi, theta, r):
         return (a_new, P_new, ssq, ldet, n + mt), (pred, F)
 
     (a_T, P_T, ssq, ldet, n), (preds, Fs) = jax.lax.scan(
-        step, (a0, P0, 0.0, 0.0, 0.0), (z, mask)
+        step, (a0, P0, zero, zero, zero), (z, mask)
     )
     return ssq, ldet, n, preds, Fs, a_T, P_T
 

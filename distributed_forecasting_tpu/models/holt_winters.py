@@ -103,8 +103,11 @@ def _filter(y, mask, alpha, beta, gamma, m, mode):
         err = (yt - pred) * mt
         return (l_new, b_new, s_new, sse + err**2, n + mt), pred
 
+    # derive the zero from data so the carry's device-varying type matches
+    # under shard_map (literal 0.0 would be replicated -> VMA mismatch)
+    zero = jnp.sum(y) * 0.0
     (l, b, s, sse, n), preds = jax.lax.scan(
-        step, (l0, b0, s0, 0.0, 0.0), (y, mask, idx)
+        step, (l0, b0, s0, zero, zero), (y, mask, idx)
     )
     mse = sse / jnp.maximum(n, 1.0)
     return (l, b, s), mse, preds

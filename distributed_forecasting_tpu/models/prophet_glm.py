@@ -78,18 +78,46 @@ def _fit_space(y, mask, mode):
     return y * mask
 
 
-def _prior_precision(layout, cfg: CurveModelConfig) -> jnp.ndarray:
-    """Per-feature ridge precision: flat prior on intercept/slope, Laplace->
-    ridge surrogate 1/scale^2 on changepoint deltas and seasonality."""
+def _feature_masks(layout):
+    """Static 0/1 masks over the feature axis for each prior group."""
     F = layout["n_features"]
-    lam = jnp.zeros((F,))
-    lam = lam.at[layout["changepoints"]].set(1.0 / cfg.changepoint_prior_scale**2)
-    sl = 1.0 / cfg.seasonality_prior_scale**2
-    lam = lam.at[layout["weekly"]].set(sl)
-    lam = lam.at[layout["yearly"]].set(sl)
-    lam = lam.at[layout["intercept"]].set(1e-8)
+    import numpy as _np
+
+    cp = _np.zeros(F, _np.float32)
+    cp[layout["changepoints"]] = 1.0
+    seas = _np.zeros(F, _np.float32)
+    seas[layout["weekly"]] = 1.0
+    seas[layout["yearly"]] = 1.0
+    fixed = _np.zeros(F, _np.float32)
+    fixed[layout["intercept"]] = 1.0
+    slope = _np.zeros(F, _np.float32)
+    slope[layout["slope"]] = 1.0
+    return jnp.asarray(cp), jnp.asarray(seas), jnp.asarray(fixed), jnp.asarray(slope)
+
+
+def _prior_precision(layout, cfg: CurveModelConfig, cp_scale=None, seas_scale=None):
+    """Per-feature ridge precision: flat prior on intercept/slope, Laplace->
+    ridge surrogate 1/scale^2 on changepoint deltas and seasonality.
+
+    ``cp_scale`` / ``seas_scale`` may be traced scalars or (S,)/(S,1) arrays —
+    the hyperparameter-search path (engine/hyper.py) sweeps them WITHOUT
+    recompiling, the analogue of the reference AutoML's per-series hyperopt
+    over changepoint/seasonality prior scales
+    (``notebooks/automl/22-09-26...py:111-123``).  Result broadcasts to
+    (F,) or (S, F).
+    """
+    cp_scale = cfg.changepoint_prior_scale if cp_scale is None else cp_scale
+    seas_scale = cfg.seasonality_prior_scale if seas_scale is None else seas_scale
+    cp_scale = jnp.asarray(cp_scale)[..., None]  # (...,1) broadcasts over F
+    seas_scale = jnp.asarray(seas_scale)[..., None]
+    cp_m, seas_m, fixed_m, slope_m = _feature_masks(layout)
     slope_prec = 1e-8 if cfg.growth == "linear" else 1e8
-    lam = lam.at[layout["slope"]].set(slope_prec)
+    lam = (
+        cp_m * (1.0 / cp_scale**2)
+        + seas_m * (1.0 / seas_scale**2)
+        + fixed_m * 1e-8
+        + slope_m * slope_prec
+    )
     return lam
 
 
@@ -106,8 +134,13 @@ def _design(day, t0, t1, cfg: CurveModelConfig):
 
 
 @partial(jax.jit, static_argnames=("config",))
-def fit(y, mask, day, config: CurveModelConfig) -> CurveParams:
-    """Fit all series at once.  y, mask: (S, T); day: (T,) absolute days."""
+def fit(y, mask, day, config: CurveModelConfig, prior_scales=None) -> CurveParams:
+    """Fit all series at once.  y, mask: (S, T); day: (T,) absolute days.
+
+    ``prior_scales``: optional (changepoint_scale, seasonality_scale)
+    overrides — traced scalars or per-series (S,) arrays (hyper-search path);
+    ``None`` uses the static config values.
+    """
     t0 = day[0].astype(jnp.float32)
     t1 = day[-1].astype(jnp.float32)
     z = _fit_space(y, mask, config.seasonality_mode)
@@ -120,7 +153,8 @@ def fit(y, mask, day, config: CurveModelConfig) -> CurveParams:
         )
     zn = z / y_scale[:, None]
     X, layout = _design(day, t0, t1, config)
-    lam = _prior_precision(layout, config)
+    cp_s, seas_s = (None, None) if prior_scales is None else prior_scales
+    lam = _prior_precision(layout, config, cp_s, seas_s)
     beta = ridge_solve_batch(X, zn, mask, lam)
     sigma = weighted_residual_scale(X, zn, mask, beta)
     return CurveParams(beta=beta, sigma=sigma, y_scale=y_scale, t0=t0, t1=t1)

@@ -43,13 +43,19 @@ def ridge_solve_batch(
 ) -> jnp.ndarray:
     """Solve the batched penalized normal equations.
 
-    X: (T, F); y, w: (S, T); lam: (F,) per-feature ridge precision.
+    X: (T, F); y, w: (S, T); lam: per-feature ridge precision, shape (F,)
+    shared or (S, F) per-series (the hyper-search refit path).
     Returns beta: (S, F).  Uses Cholesky (SPD by construction).
     """
     F = X.shape[1]
     G = masked_gram(X, w)
     b = jnp.einsum("st,tf->sf", w * y, X, optimize=True)
-    A = G + jnp.diag(lam + jitter)[None, :, :]
+    lam = jnp.asarray(lam)
+    if lam.ndim == 1:
+        D = jnp.diag(lam + jitter)[None, :, :]
+    else:
+        D = (lam + jitter)[:, :, None] * jnp.eye(F)[None, :, :]
+    A = G + D
     chol = jax.scipy.linalg.cho_factor(A, lower=True)
     beta = jax.scipy.linalg.cho_solve(chol, b[..., None])[..., 0]
     return beta

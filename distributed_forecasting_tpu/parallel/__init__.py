@@ -1,0 +1,19 @@
+from distributed_forecasting_tpu.parallel.mesh import (
+    make_mesh,
+    initialize_distributed,
+)
+from distributed_forecasting_tpu.parallel.sharded import (
+    shard_batch,
+    sharded_fit_forecast,
+    sharded_cv_metrics,
+    global_metric_means,
+)
+
+__all__ = [
+    "make_mesh",
+    "initialize_distributed",
+    "shard_batch",
+    "sharded_fit_forecast",
+    "sharded_cv_metrics",
+    "global_metric_means",
+]

@@ -1,0 +1,71 @@
+"""Device mesh construction + multi-host initialization.
+
+The communication model (SURVEY.md §2.4, §5): the reference's transport is a
+Spark hash-shuffle + Arrow IPC + py4j + HTTP; fits are independent, so the
+only *collective* traffic in this problem is small reductions of per-series
+metrics and hierarchy reconciliation.  TPU-native mapping:
+
+  * one mesh axis, ``"series"`` — the embarrassingly-parallel axis the
+    reference shards with ``groupBy().applyInPandas`` — laid out over all
+    chips so collectives ride ICI within a slice;
+  * ``psum``/``all_gather`` over that axis replace the driver-side
+    ``performance_metrics`` aggregation;
+  * multi-host (the 50k-series config, BASELINE #4) uses the standard JAX
+    runtime: ``jax.distributed.initialize`` + every host feeding its local
+    shard of the series axis; DCN only carries input loading, never fit
+    traffic.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+from jax.sharding import Mesh
+
+SERIES_AXIS = "series"
+
+
+def make_mesh(
+    n_devices: Optional[int] = None,
+    devices: Optional[Sequence] = None,
+    axis_name: str = SERIES_AXIS,
+) -> Mesh:
+    """1-D mesh over the series axis.
+
+    ``n_devices=None`` uses every visible device (a v5e-8 slice gives an
+    8-way series shard); tests pass the virtual CPU devices.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        if len(devices) < n_devices:
+            raise ValueError(
+                f"requested {n_devices} devices but only {len(devices)} visible "
+                f"({[d.platform for d in devices[:4]]}...); for CPU dry runs set "
+                f"XLA_FLAGS=--xla_force_host_platform_device_count={n_devices}"
+            )
+        devices = devices[:n_devices]
+    import numpy as np
+
+    return Mesh(np.asarray(devices), (axis_name,))
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Multi-host bring-up (BASELINE config #4 path).
+
+    Thin wrapper over ``jax.distributed.initialize`` so tasks can switch a
+    single-host run to a pod-slice run from conf; no-op when already
+    initialized or when running single-process (the common case).
+    """
+    if num_processes in (None, 0, 1):
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )

@@ -1,0 +1,164 @@
+"""Sharded fit/forecast/CV over a device mesh.
+
+This is the distribution mechanism at scale (BASELINE config #4: 50k series
+over a v5e-8 slice).  Two complementary idioms:
+
+  * **pjit-style propagation** for the fit itself: the padded series batch is
+    placed with ``NamedSharding(P("series", None))`` and the SAME jitted
+    batch-fit the single-chip path uses runs unchanged — XLA's SPMD
+    partitioner keeps every per-series tensor sharded on axis 0 end to end.
+    Fits are independent, so the partitioned program has **zero** cross-chip
+    traffic; this is the honest TPU analogue of the reference's
+    embarrassingly-parallel ``groupBy().applyInPandas`` fan-out
+    (``notebooks/prophet/02_training.py:304-307``, SURVEY.md §2.4 DP row).
+
+  * **explicit shard_map + psum** for the places the reference does have
+    cross-worker dataflow: aggregating per-series CV metrics to global means
+    (its driver-side mean over ``performance_metrics`` frames,
+    ``02_training.py:187-188``).  The (sum, count) psum rides ICI.
+
+The series axis is padded to a multiple of the mesh size (mask-zero rows) so
+every chip gets an identical static shape; the shared day grid / design
+matrices are replicated, so features never need an all-gather.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_forecasting_tpu.data.tensorize import SeriesBatch
+from distributed_forecasting_tpu.engine.fit import ForecastResult, fit_forecast
+from distributed_forecasting_tpu.models.base import get_model
+from distributed_forecasting_tpu.parallel.mesh import SERIES_AXIS
+
+
+def shard_batch(batch: SeriesBatch, mesh: Mesh) -> SeriesBatch:
+    """Pad the series axis to a mesh multiple and place shards on devices."""
+    n = mesh.devices.size
+    S = batch.n_series
+    padded = batch.pad_series_to(((S + n - 1) // n) * n)
+    sharding = NamedSharding(mesh, P(SERIES_AXIS, None))
+    rep = NamedSharding(mesh, P(None))
+    return dataclasses.replace(
+        padded,
+        y=jax.device_put(padded.y, sharding),
+        mask=jax.device_put(padded.mask, sharding),
+        day=jax.device_put(padded.day, rep),
+    )
+
+
+def sharded_fit_forecast(
+    batch: SeriesBatch,
+    model: str = "prophet",
+    config=None,
+    horizon: int = 90,
+    mesh: Optional[Mesh] = None,
+    key: Optional[jax.Array] = None,
+    min_points: int = 14,
+) -> Tuple[object, ForecastResult]:
+    """Mesh-sharded ``engine.fit_forecast``: shard the batch, run the same
+    compiled program, let the partitioner scale it.  Returns sharded params
+    and a sharded :class:`ForecastResult` (padding rows have ok=False)."""
+    if mesh is None:
+        raise ValueError("pass a Mesh (parallel.make_mesh())")
+    sharded = shard_batch(batch, mesh)
+    return fit_forecast(
+        sharded, model=model, config=config, horizon=horizon, key=key,
+        min_points=min_points,
+    )
+
+
+def global_metric_means(
+    per_series: Dict[str, jax.Array], ok: jax.Array, mesh: Mesh
+) -> Dict[str, jax.Array]:
+    """Mesh-wide means of per-series metrics over healthy series only.
+
+    One ``psum`` of (sum, count) over the ICI ring — the collective replacing
+    the reference driver's mean of per-group metric frames.  ``per_series``
+    values and ``ok`` must be sharded on the series axis (padded rows carry
+    ok=False and are excluded).
+    """
+    names = sorted(k for k in per_series if not k.startswith("_"))
+    stacked = jnp.stack([per_series[k] for k in names])  # (M, S)
+
+    def local_reduce(vals, okv):
+        w = okv.astype(vals.dtype)[None, :]
+        s = jax.lax.psum(jnp.sum(vals * w, axis=1), SERIES_AXIS)
+        n = jax.lax.psum(jnp.sum(w), SERIES_AXIS)
+        return s / jnp.maximum(n, 1.0)
+
+    means = jax.jit(
+        jax.shard_map(
+            local_reduce,
+            mesh=mesh,
+            in_specs=(P(None, SERIES_AXIS), P(SERIES_AXIS)),
+            out_specs=P(),
+        )
+    )(stacked, ok)
+    return {k: means[i] for i, k in enumerate(names)}
+
+
+def sharded_cv_metrics(
+    batch: SeriesBatch,
+    model: str = "prophet",
+    config=None,
+    cv=None,
+    mesh: Optional[Mesh] = None,
+    key: Optional[jax.Array] = None,
+) -> Dict[str, jax.Array]:
+    """Rolling-origin CV with the series axis sharded via ``shard_map``:
+    each chip fits/scores its local block for every cutoff; per-series means
+    come back sharded, ready for :func:`global_metric_means`."""
+    from distributed_forecasting_tpu.engine.cv import CVConfig, cutoff_indices
+    from distributed_forecasting_tpu.ops import metrics as metrics_ops
+
+    if mesh is None:
+        raise ValueError("pass a Mesh (parallel.make_mesh())")
+    fns = get_model(model)
+    config = config if config is not None else fns.config_cls()
+    cv = cv or CVConfig()
+    if key is None:
+        key = jax.random.PRNGKey(0)
+
+    orig_n = batch.n_series
+    batch = shard_batch(batch, mesh)
+    T = batch.n_time
+    cuts = cutoff_indices(T, cv)
+    idx = jnp.arange(T)
+    cut_steps = jnp.asarray(cuts, dtype=jnp.int32)
+    t_ends = batch.day[cut_steps].astype(jnp.float32)
+    metric_names = sorted(list(metrics_ops.METRIC_FNS) + ["coverage"])
+
+    def local_cv(y, mask, day, cut_steps, t_ends, key):
+        k0 = jax.random.fold_in(key, jax.lax.axis_index(SERIES_AXIS))
+
+        def one_cutoff(c, t_end, k):
+            train_mask = mask * (idx <= c)[None, :]
+            eval_mask = mask * ((idx > c) & (idx <= c + cv.horizon))[None, :]
+            params = fns.fit(y, train_mask, day, config)
+            yhat, lo, hi = fns.forecast(params, day, t_end, config, k)
+            m = metrics_ops.compute_all(y, yhat, eval_mask, lo=lo, hi=hi)
+            return jnp.stack([m[n] for n in metric_names])
+
+        keys = jax.random.split(k0, len(cuts))
+        per_cut = jax.vmap(one_cutoff)(cut_steps, t_ends, keys)  # (C, M, S_l)
+        return jnp.mean(per_cut, axis=0)  # (M, S_local)
+
+    out = jax.jit(
+        jax.shard_map(
+            local_cv,
+            mesh=mesh,
+            in_specs=(P(SERIES_AXIS, None), P(SERIES_AXIS, None), P(), P(),
+                      P(), P()),
+            out_specs=P(None, SERIES_AXIS),
+        )
+    )(batch.y, batch.mask, batch.day, cut_steps, t_ends, key)
+
+    result = {name: out[i, :orig_n] for i, name in enumerate(metric_names)}
+    result["_n_cutoffs"] = len(cuts)
+    return result

@@ -50,6 +50,7 @@ class TrainTask(Task):
             horizon=int(tr.get("horizon", 90)),
             run_cross_validation=bool(tr.get("run_cross_validation", True)),
             per_series_runs=bool(tr.get("per_series_runs", False)),
+            tuning=tr.get("tuning"),
         )
 
 

@@ -1,0 +1,86 @@
+"""Hyperparameter-search tests (AutoML-path parity)."""
+
+import numpy as np
+import pytest
+
+from distributed_forecasting_tpu.engine.cv import CVConfig
+from distributed_forecasting_tpu.engine.hyper import (
+    HyperSearchConfig,
+    tune_curve_model,
+)
+
+
+@pytest.fixture(scope="module")
+def tuned(batch_small):
+    return tune_curve_model(
+        batch_small,
+        search=HyperSearchConfig(n_trials=4, seed=1),
+        cv=CVConfig(initial=730, period=180, horizon=90),
+    )
+
+
+def test_shapes_and_trial_table(tuned, batch_small):
+    S = batch_small.n_series
+    assert tuned.best_cp_scale.shape == (S,)
+    assert tuned.best_seas_scale.shape == (S,)
+    assert tuned.best_mode.shape == (S,)
+    assert np.isfinite(tuned.best_score).all()
+    # 4 trials x 2 modes recorded
+    assert len(tuned.trials) == 8
+    assert {"mode", "changepoint_prior_scale", "seasonality_prior_scale",
+            "mean_smape"} <= set(tuned.trials.columns)
+
+
+def test_selection_picks_multiplicative_for_multiplicative_data(tuned):
+    # the synthetic generator is multiplicative; most series should pick it
+    frac_mult = float((tuned.best_mode == "multiplicative").mean())
+    assert frac_mult >= 0.5, frac_mult
+
+
+def test_tuned_beats_or_matches_worst_trial(tuned):
+    # per-series best must be <= every trial's mean for that metric
+    best_mean = float(tuned.best_score.mean())
+    worst_trial = float(tuned.trials["mean_smape"].max())
+    assert best_mean <= worst_trial + 1e-9
+
+
+def test_refit_params_usable_for_forecast(tuned, batch_small):
+    import jax.numpy as jnp
+
+    from distributed_forecasting_tpu.models import prophet_glm
+
+    day_all = jnp.arange(
+        int(batch_small.day[0]), int(batch_small.day[-1]) + 31, dtype=jnp.int32
+    )
+    yhat, lo, hi = prophet_glm.forecast(
+        tuned.params, day_all, batch_small.day[-1].astype(jnp.float32),
+        tuned.config,
+    )
+    assert np.isfinite(np.asarray(yhat)).all()
+    assert bool((hi >= lo).all())
+
+
+def test_tuned_training_pipeline(tmp_path, sales_df_small):
+    from distributed_forecasting_tpu.data import DatasetCatalog
+    from distributed_forecasting_tpu.pipelines.training import TrainingPipeline
+    from distributed_forecasting_tpu.tracking import FileTracker
+
+    catalog = DatasetCatalog(str(tmp_path / "wh"))
+    tracker = FileTracker(str(tmp_path / "runs"))
+    catalog.save_table("hackathon.sales.raw", sales_df_small)
+    pipe = TrainingPipeline(catalog, tracker)
+    summary = pipe.fine_grained(
+        "hackathon.sales.raw",
+        "hackathon.sales.finegrain_forecasts",
+        cv_conf={"initial": 730, "period": 360, "horizon": 60},
+        tuning={"enabled": True, "n_trials": 3},
+        horizon=30,
+    )
+    assert summary["n_failed"] == 0
+    run = tracker.get_run(summary["experiment_id"], summary["run_id"])
+    assert run.meta()["tags"]["tuned"] == "true"
+    import os
+
+    assert os.path.exists(run.artifact_path("trials.parquet"))
+    out = catalog.read_table("hackathon.sales.finegrain_forecasts")
+    assert np.isfinite(out.yhat).all()

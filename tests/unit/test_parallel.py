@@ -1,0 +1,85 @@
+"""Mesh-sharding tests on the virtual 8-device CPU mesh — the multi-chip
+code path exercised without TPU hardware (the reference's analogue is its
+local[1] Spark fixture standing in for a cluster, reference
+``tests/unit/conftest.py:20-44``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_forecasting_tpu.engine import CVConfig, fit_forecast
+from distributed_forecasting_tpu.parallel import (
+    global_metric_means,
+    make_mesh,
+    shard_batch,
+    sharded_cv_metrics,
+    sharded_fit_forecast,
+)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) >= 8, "conftest should force 8 virtual CPU devices"
+    return make_mesh(8)
+
+
+def test_shard_batch_pads_and_places(batch_small, mesh):
+    sb = shard_batch(batch_small, mesh)
+    assert sb.n_series == 16  # 10 -> next multiple of 8
+    assert np.asarray(sb.mask)[10:].sum() == 0
+    # sharded on the series axis
+    assert len(sb.y.sharding.device_set) == 8
+
+
+def test_sharded_fit_matches_single_device(batch_small, mesh):
+    _, res_single = fit_forecast(batch_small, model="prophet", horizon=30)
+    _, res_shard = sharded_fit_forecast(
+        batch_small, model="prophet", horizon=30, mesh=mesh
+    )
+    np.testing.assert_allclose(
+        np.asarray(res_shard.yhat)[:10],
+        np.asarray(res_single.yhat),
+        rtol=2e-3, atol=1e-2,
+    )
+    ok = np.asarray(res_shard.ok)
+    assert ok[:10].all()
+    assert not ok[10:].any()  # padding rows flagged not-ok
+
+
+def test_global_metric_means_psum(batch_small, mesh):
+    cvm = sharded_cv_metrics(
+        batch_small, model="holt_winters",
+        cv=CVConfig(initial=730, period=180, horizon=60), mesh=mesh,
+    )
+    sb = shard_batch(batch_small, mesh)
+    # pad per-series metrics up to the sharded width and mark padding not-ok
+    ok = jnp.concatenate([jnp.ones(10, bool), jnp.zeros(6, bool)])
+    padded = {
+        k: jnp.concatenate([v, jnp.zeros(6)]) for k, v in cvm.items()
+        if not k.startswith("_")
+    }
+    means = global_metric_means(padded, ok, mesh)
+    # psum mean must equal the host-side mean over real series
+    for k, v in means.items():
+        np.testing.assert_allclose(
+            float(v), float(np.mean(np.asarray(cvm[k]))), rtol=1e-5
+        )
+
+
+def test_sharded_cv_matches_unsharded(batch_small, mesh):
+    from distributed_forecasting_tpu.engine import cross_validate
+
+    cv = CVConfig(initial=730, period=360, horizon=60)
+    ref = cross_validate(batch_small, model="holt_winters", cv=cv)
+    shd = sharded_cv_metrics(batch_small, model="holt_winters", cv=cv, mesh=mesh)
+    assert shd["_n_cutoffs"] == ref["_n_cutoffs"]
+    for k in ("mape", "rmse", "smape"):
+        np.testing.assert_allclose(
+            np.asarray(shd[k]), np.asarray(ref[k]), rtol=2e-3, atol=1e-3
+        )
+
+
+def test_mesh_too_many_devices_errors():
+    with pytest.raises(ValueError, match="devices"):
+        make_mesh(1024)
