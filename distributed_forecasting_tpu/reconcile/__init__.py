@@ -1,0 +1,7 @@
+from distributed_forecasting_tpu.reconcile.hierarchy import (
+    Hierarchy,
+    aggregate_bottom_up,
+    reconcile_forecasts,
+)
+
+__all__ = ["Hierarchy", "aggregate_bottom_up", "reconcile_forecasts"]

@@ -1,0 +1,166 @@
+"""Hierarchical forecast reconciliation (BASELINE config #5).
+
+The reference's only cross-series arithmetic is its allocation path: item
+forecasts scaled to stores by historical share (``notebooks/prophet/
+02_training.py:237-247``) — a top-down method.  This module provides the full
+coherent-hierarchy toolkit over batched base forecasts:
+
+  * :class:`Hierarchy` — the store x item two-level hierarchy as a static
+    summing matrix ``S_mat`` (rows: total, per-store, per-item, bottom);
+  * bottom-up aggregation (sum bottom forecasts to every level);
+  * top-down allocation by historical proportions (the reference's method);
+  * MinT-diagonal (WLS) reconciliation: given base forecasts at EVERY level,
+    the trace-minimizing coherent revision
+    ``y~ = S (S' W^-1 S)^-1 S' W^-1 y^`` with diagonal W from base-forecast
+    error variances — one batched solve, MXU-friendly.
+
+All ops are pure jnp over (n_nodes, H) arrays; under a series-sharded mesh
+the bottom level is gathered with ``jax.lax.all_gather`` first (aggregation
+is a cross-shard reduction — the one place this workload genuinely needs a
+collective beyond metric psums, SURVEY.md §2.4 backend row).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class Hierarchy:
+    """Two-level (store, item) hierarchy over S bottom series.
+
+    Node order: [total, stores..., items..., bottom...].
+    """
+
+    keys: np.ndarray          # (S, 2) int64 (store, item) per bottom series
+    stores: np.ndarray        # unique store ids (sorted)
+    items: np.ndarray         # unique item ids (sorted)
+    S_mat: np.ndarray         # (n_nodes, S) float32 summing matrix
+
+    @classmethod
+    def from_keys(cls, keys: np.ndarray) -> "Hierarchy":
+        keys = np.asarray(keys)
+        S = keys.shape[0]
+        stores = np.unique(keys[:, 0])
+        items = np.unique(keys[:, 1])
+        rows = [np.ones((1, S), np.float32)]
+        rows.append((keys[None, :, 0] == stores[:, None]).astype(np.float32))
+        rows.append((keys[None, :, 1] == items[:, None]).astype(np.float32))
+        rows.append(np.eye(S, dtype=np.float32))
+        return cls(keys=keys, stores=stores, items=items,
+                   S_mat=np.concatenate(rows, axis=0))
+
+    @property
+    def n_bottom(self) -> int:
+        return self.keys.shape[0]
+
+    @property
+    def n_nodes(self) -> int:
+        return self.S_mat.shape[0]
+
+    def node_labels(self) -> list:
+        labels = ["total"]
+        labels += [f"store_{s}" for s in self.stores]
+        labels += [f"item_{i}" for i in self.items]
+        labels += [f"store_{s}_item_{i}" for s, i in self.keys.tolist()]
+        return labels
+
+
+def aggregate_bottom_up(h: Hierarchy, bottom: jnp.ndarray) -> jnp.ndarray:
+    """(S, H) bottom forecasts -> (n_nodes, H) coherent forecasts by summing.
+    One matmul with the summing matrix (the MXU path)."""
+    return jnp.asarray(h.S_mat) @ bottom
+
+
+def top_down_allocate(
+    h: Hierarchy, total: jnp.ndarray, proportions: jnp.ndarray
+) -> jnp.ndarray:
+    """(H,) total forecast + (S,) historical proportions -> coherent
+    (n_nodes, H).  The reference's allocation method generalized to the full
+    hierarchy (its ratio join, ``02_training.py:237-247``)."""
+    p = proportions / jnp.maximum(jnp.sum(proportions), 1e-12)
+    bottom = p[:, None] * total[None, :]
+    return aggregate_bottom_up(h, bottom)
+
+
+def reconcile_forecasts(
+    h: Hierarchy,
+    base_all_levels: jnp.ndarray,
+    error_var: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """MinT-diagonal (WLS) reconciliation.
+
+    base_all_levels: (n_nodes, H) independent base forecasts at every level
+    (incoherent in general); error_var: (n_nodes,) base-error variances
+    (defaults to structural variances = row sums of S_mat, i.e. WLS-struct).
+    Returns coherent (n_nodes, H) revised forecasts.
+    """
+    S_mat = jnp.asarray(h.S_mat)  # (m, n)
+    if error_var is None:
+        error_var = jnp.sum(S_mat, axis=1)  # WLS-struct
+    w_inv = 1.0 / jnp.maximum(error_var, 1e-12)  # (m,)
+    SW = S_mat * w_inv[:, None]  # rows scaled: W^-1 S  (m, n)
+    G = S_mat.T @ SW  # (n, n) = S' W^-1 S
+    rhs = SW.T @ base_all_levels  # (n, H)
+    chol = jax.scipy.linalg.cho_factor(
+        G + 1e-8 * jnp.eye(G.shape[0]), lower=True
+    )
+    bottom_tilde = jax.scipy.linalg.cho_solve(chol, rhs)  # (n, H)
+    return S_mat @ bottom_tilde
+
+
+def coherency_error(h: Hierarchy, all_levels: jnp.ndarray) -> jnp.ndarray:
+    """Max absolute violation of the aggregation constraints (0 = coherent)."""
+    bottom = all_levels[-h.n_bottom :]
+    return jnp.max(jnp.abs(all_levels - aggregate_bottom_up(h, bottom)))
+
+
+def gather_bottom_sharded(bottom_sharded: jnp.ndarray, mesh, axis_name: str):
+    """All-gather the series-sharded bottom forecasts so every chip holds the
+    full bottom level for aggregation — the ICI collective of this module."""
+    from jax.sharding import PartitionSpec as P
+
+    def body(x):
+        return jax.lax.all_gather(x, axis_name, axis=0, tiled=True)
+
+    return jax.jit(
+        jax.shard_map(
+            body, mesh=mesh, in_specs=P(axis_name, None),
+            out_specs=P(None, None), check_vma=False,
+        )
+    )(bottom_sharded)
+
+
+def reconciliation_report(
+    h: Hierarchy, bottom_forecast: jnp.ndarray, bottom_actual: jnp.ndarray,
+    mask: jnp.ndarray,
+) -> Dict[str, float]:
+    """Accuracy of coherent aggregates vs aggregated actuals (smoke-level
+    observability for the reconcile step)."""
+    from distributed_forecasting_tpu.ops import metrics as M
+
+    agg_f = aggregate_bottom_up(h, bottom_forecast)
+    agg_a = aggregate_bottom_up(h, bottom_actual)
+    agg_m = (aggregate_bottom_up(h, mask) > 0).astype(jnp.float32)
+    return {
+        "total_mape": float(M.mape(agg_a[:1], agg_f[:1], agg_m[:1])[0]),
+        "store_mape": float(
+            jnp.mean(M.mape(agg_a[1 : 1 + len(h.stores)],
+                            agg_f[1 : 1 + len(h.stores)],
+                            agg_m[1 : 1 + len(h.stores)]))
+        ),
+        "item_mape": float(
+            jnp.mean(
+                M.mape(
+                    agg_a[1 + len(h.stores) : 1 + len(h.stores) + len(h.items)],
+                    agg_f[1 + len(h.stores) : 1 + len(h.stores) + len(h.items)],
+                    agg_m[1 + len(h.stores) : 1 + len(h.stores) + len(h.items)],
+                )
+            )
+        ),
+    }

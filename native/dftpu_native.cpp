@@ -1,0 +1,210 @@
+// dftpu_native — native data-plane kernels for the TPU forecasting framework.
+//
+// Role: the host-side runtime work the reference delegates to native code in
+// its dependencies — Arrow C++ serialization inside Spark's applyInPandas and
+// the JVM shuffle (SURVEY.md §2.2 "Spark applyInPandas" row) — done here as a
+// small, dependency-free C++ library:
+//
+//   * one-pass CSV parsing of the (date,store,item,sales) long format with
+//     native date->epoch-day conversion (days_from_civil, Howard Hinnant's
+//     public-domain civil-calendar algorithm);
+//   * group-key interning (store,item) -> dense series index;
+//   * fused scatter-add tensorization into the padded (S, T) value/mask
+//     buffers the device consumes.
+//
+// The Python wrapper (distributed_forecasting_tpu/data/native.py) binds via
+// ctypes; everything works on caller-allocated numpy buffers, zero copies
+// beyond the parse itself.  Build: `make -C native` (g++ -O3 -shared -fPIC).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+// days from civil date (proleptic Gregorian), epoch 1970-01-01.
+inline int64_t days_from_civil(int64_t y, unsigned m, unsigned d) {
+  y -= m <= 2;
+  const int64_t era = (y >= 0 ? y : y - 399) / 400;
+  const unsigned yoe = static_cast<unsigned>(y - era * 400);           // [0, 399]
+  const unsigned doy = (153 * (m + (m > 2 ? -3 : 9)) + 2) / 5 + d - 1;  // [0, 365]
+  const unsigned doe = yoe * 365 + yoe / 4 - yoe / 100 + doy;           // [0, 146096]
+  return era * 146097 + static_cast<int64_t>(doe) - 719468;
+}
+
+// parse an integer field; returns pointer past the terminator.
+inline const char* parse_i64(const char* p, const char* end, int64_t* out) {
+  int64_t v = 0;
+  bool neg = false;
+  if (p < end && *p == '-') { neg = true; ++p; }
+  while (p < end && *p >= '0' && *p <= '9') { v = v * 10 + (*p - '0'); ++p; }
+  *out = neg ? -v : v;
+  return p;
+}
+
+inline const char* parse_f64(const char* p, const char* end, double* out) {
+  char* q = nullptr;
+  *out = strtod(p, &q);
+  return (q && q <= end) ? q : p;
+}
+
+struct FileBuf {
+  char* data = nullptr;
+  size_t size = 0;
+  ~FileBuf() { free(data); }
+  bool read(const char* path) {
+    FILE* f = fopen(path, "rb");
+    if (!f) return false;
+    fseek(f, 0, SEEK_END);
+    long n = ftell(f);
+    fseek(f, 0, SEEK_SET);
+    if (n < 0) { fclose(f); return false; }
+    data = static_cast<char*>(malloc(static_cast<size_t>(n) + 1));
+    if (!data) { fclose(f); return false; }
+    size = fread(data, 1, static_cast<size_t>(n), f);
+    data[size] = '\0';
+    fclose(f);
+    return true;
+  }
+};
+
+struct KeyHash {
+  size_t operator()(const std::pair<int64_t, int64_t>& k) const {
+    return std::hash<int64_t>()(k.first * 1000003 + k.second);
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Count data rows (excluding a header line if the first field is not a digit).
+// Returns 0 on success.
+int dftpu_csv_count(const char* path, int64_t* n_rows) {
+  FileBuf buf;
+  if (!buf.read(path)) return 1;
+  int64_t rows = 0;
+  const char* p = buf.data;
+  const char* end = buf.data + buf.size;
+  bool first = true;
+  while (p < end) {
+    const char* nl = static_cast<const char*>(memchr(p, '\n', end - p));
+    const char* line_end = nl ? nl : end;
+    if (line_end > p) {
+      bool header = first && !(*p >= '0' && *p <= '9');
+      if (!header) ++rows;
+    }
+    first = false;
+    p = nl ? nl + 1 : end;
+  }
+  *n_rows = rows;
+  return 0;
+}
+
+// Parse "YYYY-MM-DD,store,item,sales" rows into caller buffers of length n
+// (from dftpu_csv_count).  Returns 0 on success, 2 on malformed row.
+int dftpu_csv_parse(const char* path, int64_t n, int32_t* day, int64_t* store,
+                    int64_t* item, double* sales) {
+  FileBuf buf;
+  if (!buf.read(path)) return 1;
+  const char* p = buf.data;
+  const char* end = buf.data + buf.size;
+  int64_t i = 0;
+  bool first = true;
+  while (p < end && i < n) {
+    const char* nl = static_cast<const char*>(memchr(p, '\n', end - p));
+    const char* line_end = nl ? nl : end;
+    if (line_end > p) {
+      bool header = first && !(*p >= '0' && *p <= '9');
+      if (!header) {
+        int64_t y, m, d, s, it;
+        const char* q = parse_i64(p, line_end, &y);
+        if (q >= line_end || *q != '-') return 2;
+        q = parse_i64(q + 1, line_end, &m);
+        if (q >= line_end || *q != '-') return 2;
+        q = parse_i64(q + 1, line_end, &d);
+        if (q >= line_end || *q != ',') return 2;
+        q = parse_i64(q + 1, line_end, &s);
+        if (q >= line_end || *q != ',') return 2;
+        q = parse_i64(q + 1, line_end, &it);
+        if (q >= line_end || *q != ',') return 2;
+        double v;
+        parse_f64(q + 1, line_end, &v);
+        day[i] = static_cast<int32_t>(days_from_civil(y, static_cast<unsigned>(m),
+                                                      static_cast<unsigned>(d)));
+        store[i] = s;
+        item[i] = it;
+        sales[i] = v;
+        ++i;
+      }
+    }
+    first = false;
+    p = nl ? nl + 1 : end;
+  }
+  return (i == n) ? 0 : 2;
+}
+
+// Intern (store,item) pairs to dense series ids in first-seen order, then
+// sort-stable remap so ids follow (store,item) lexicographic order (matching
+// numpy.unique semantics used by the pandas tensorizer).  Outputs:
+//   series_idx[n]  — series id per row
+//   keys_out[2*S]  — (store,item) per series id (row-major)
+//   *S_out         — number of series
+// keys_out must have room for 2*n entries. Returns 0.
+int dftpu_group_keys(const int64_t* store, const int64_t* item, int64_t n,
+                     int64_t* series_idx, int64_t* keys_out, int64_t* S_out) {
+  std::unordered_map<std::pair<int64_t, int64_t>, int64_t, KeyHash> interned;
+  interned.reserve(static_cast<size_t>(n) / 4 + 16);
+  std::vector<std::pair<int64_t, int64_t>> keys;
+  for (int64_t i = 0; i < n; ++i) {
+    auto k = std::make_pair(store[i], item[i]);
+    auto it = interned.find(k);
+    int64_t id;
+    if (it == interned.end()) {
+      id = static_cast<int64_t>(keys.size());
+      interned.emplace(k, id);
+      keys.push_back(k);
+    } else {
+      id = it->second;
+    }
+    series_idx[i] = id;
+  }
+  // remap ids to lexicographic (store,item) order
+  const int64_t S = static_cast<int64_t>(keys.size());
+  std::vector<int64_t> order(S);
+  for (int64_t i = 0; i < S; ++i) order[i] = i;
+  std::sort(order.begin(), order.end(), [&](int64_t a, int64_t b) {
+    return keys[a] < keys[b];
+  });
+  std::vector<int64_t> rank(S);
+  for (int64_t r = 0; r < S; ++r) rank[order[r]] = r;
+  for (int64_t i = 0; i < n; ++i) series_idx[i] = rank[series_idx[i]];
+  for (int64_t r = 0; r < S; ++r) {
+    keys_out[2 * r] = keys[order[r]].first;
+    keys_out[2 * r + 1] = keys[order[r]].second;
+  }
+  *S_out = S;
+  return 0;
+}
+
+// Fused scatter-add tensorization: rows -> dense float32 (S, T) value and
+// mask planes (duplicates summed — SQL GROUP BY semantics).  y/mask must be
+// zero-initialized by the caller.
+int dftpu_scatter(const int64_t* series_idx, const int32_t* day,
+                  const double* sales, int64_t n, int32_t day0, int64_t S,
+                  int64_t T, float* y, float* mask) {
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t s = series_idx[i];
+    const int64_t t = static_cast<int64_t>(day[i]) - day0;
+    if (s < 0 || s >= S || t < 0 || t >= T) return 3;
+    y[s * T + t] += static_cast<float>(sales[i]);
+    mask[s * T + t] = 1.0f;
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,79 @@
+"""Native data-plane tests: the C++ CSV->tensor path must agree exactly with
+the pandas/numpy reference path."""
+
+import numpy as np
+import pytest
+
+from distributed_forecasting_tpu.data import native, synthetic_store_item_sales, tensorize
+
+pytestmark = pytest.mark.skipif(
+    not native.is_available(), reason="native library not built and no compiler"
+)
+
+
+@pytest.fixture(scope="module")
+def csv_path(tmp_path_factory):
+    df = synthetic_store_item_sales(
+        n_stores=3, n_items=4, n_days=200, seed=9, missing_rate=0.1
+    )
+    p = tmp_path_factory.mktemp("data") / "train.csv"
+    df.to_csv(p, index=False, date_format="%Y-%m-%d")
+    return str(p), df
+
+
+def test_native_parse_matches_pandas(csv_path):
+    path, df = csv_path
+    day, store, item, sales = native.parse_sales_csv(path)
+    assert len(day) == len(df)
+    # epoch-day conversion matches numpy's
+    expected_day = (
+        df["date"].values.astype("datetime64[D]") - np.datetime64("1970-01-01", "D")
+    ).astype(np.int64)
+    np.testing.assert_array_equal(day.astype(np.int64), expected_day)
+    np.testing.assert_array_equal(store, df["store"].to_numpy())
+    np.testing.assert_array_equal(item, df["item"].to_numpy())
+    np.testing.assert_allclose(sales, df["sales"].to_numpy(), rtol=1e-12)
+
+
+def test_native_tensorize_matches_reference(csv_path):
+    path, df = csv_path
+    ref = tensorize(df)
+    nat = native.load_and_tensorize_csv(path)
+    assert nat.start_date == ref.start_date
+    np.testing.assert_array_equal(np.asarray(nat.keys), np.asarray(ref.keys))
+    np.testing.assert_array_equal(np.asarray(nat.day), np.asarray(ref.day))
+    np.testing.assert_allclose(np.asarray(nat.y), np.asarray(ref.y), rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(nat.mask), np.asarray(ref.mask))
+
+
+def test_native_duplicate_rows_summed(tmp_path):
+    p = tmp_path / "dup.csv"
+    p.write_text(
+        "date,store,item,sales\n"
+        "2020-01-01,1,1,2.5\n"
+        "2020-01-01,1,1,3.5\n"
+        "2020-01-02,1,1,7\n"
+        "2020-01-02,2,1,1\n"
+    )
+    b = native.load_and_tensorize_csv(str(p))
+    assert b.n_series == 2
+    y = np.asarray(b.y)
+    np.testing.assert_allclose(y[0], [6.0, 7.0])
+    np.testing.assert_allclose(y[1], [0.0, 1.0])
+    np.testing.assert_array_equal(np.asarray(b.mask)[1], [0.0, 1.0])
+
+
+def test_native_no_header(tmp_path):
+    p = tmp_path / "nohdr.csv"
+    p.write_text("2021-03-05,7,9,1.25\n2021-03-06,7,9,2\n")
+    day, store, item, sales = native.parse_sales_csv(str(p))
+    assert len(day) == 2
+    assert store[0] == 7 and item[0] == 9
+    assert day[1] == day[0] + 1
+
+
+def test_malformed_csv_raises(tmp_path):
+    p = tmp_path / "bad.csv"
+    p.write_text("date,store,item,sales\nnot-a-date,xx\n")
+    with pytest.raises(ValueError):
+        native.parse_sales_csv(str(p))

@@ -1,0 +1,107 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_forecasting_tpu.reconcile import (
+    Hierarchy,
+    aggregate_bottom_up,
+    reconcile_forecasts,
+)
+from distributed_forecasting_tpu.reconcile.hierarchy import (
+    coherency_error,
+    gather_bottom_sharded,
+    top_down_allocate,
+)
+
+
+@pytest.fixture(scope="module")
+def hier(batch_small):
+    return Hierarchy.from_keys(batch_small.keys)
+
+
+def test_hierarchy_structure(hier):
+    # 10 bottom series: 2 stores x 5 items -> 1 + 2 + 5 + 10 nodes
+    assert hier.n_bottom == 10
+    assert hier.n_nodes == 18
+    assert hier.S_mat.shape == (18, 10)
+    labels = hier.node_labels()
+    assert labels[0] == "total"
+    assert len(labels) == 18
+
+
+def test_bottom_up_sums_exactly(hier):
+    bottom = jnp.asarray(np.random.default_rng(0).random((10, 6)))
+    agg = aggregate_bottom_up(hier, bottom)
+    np.testing.assert_allclose(np.asarray(agg[0]), np.asarray(bottom.sum(0)), rtol=1e-6)
+    # store rows sum their 5 items
+    np.testing.assert_allclose(
+        np.asarray(agg[1]), np.asarray(bottom[:5].sum(0)), rtol=1e-6
+    )
+    assert float(coherency_error(hier, agg)) < 1e-5
+
+
+def test_top_down_matches_reference_allocation(hier):
+    total = jnp.asarray([100.0, 200.0])
+    props = jnp.asarray(np.arange(1.0, 11.0))
+    out = top_down_allocate(hier, total, props)
+    # bottom shares proportional, coherent at every level
+    np.testing.assert_allclose(float(out[0, 0]), 100.0, rtol=1e-5)
+    bottom = out[-10:]
+    np.testing.assert_allclose(
+        np.asarray(bottom[:, 0] / bottom[0, 0]),
+        np.arange(1.0, 11.0),
+        rtol=1e-4,
+    )
+    assert float(coherency_error(hier, out)) < 1e-4
+
+
+def test_mint_reconciliation_correctness(hier):
+    """MinT output must be coherent, and equal bottom-up when only bottom
+    forecasts are trusted (zero variance on bottom, huge on aggregates)."""
+    rng = np.random.default_rng(1)
+    bottom_truth = jnp.asarray(rng.random((10, 4)) * 10)
+    coherent = aggregate_bottom_up(hier, bottom_truth)
+    noise = jnp.asarray(rng.normal(0, 1.0, coherent.shape))
+    base = coherent + noise  # incoherent base forecasts
+    assert float(coherency_error(hier, base)) > 0.1
+
+    rec = reconcile_forecasts(hier, base)
+    assert float(coherency_error(hier, rec)) < 1e-3
+
+    # trust-bottom-only limit -> exactly bottom-up of the base bottom rows
+    var = jnp.concatenate([jnp.full(8, 1e6), jnp.full(10, 1e-6)])
+    rec2 = reconcile_forecasts(hier, base, error_var=var)
+    np.testing.assert_allclose(
+        np.asarray(rec2[-10:]), np.asarray(base[-10:]), atol=1e-2
+    )
+    np.testing.assert_allclose(
+        np.asarray(rec2), np.asarray(aggregate_bottom_up(hier, base[-10:])),
+        atol=5e-2,
+    )
+
+
+def test_mint_improves_noisy_base(hier):
+    """Reconciliation with informative variances should not hurt accuracy."""
+    rng = np.random.default_rng(2)
+    bottom_truth = jnp.asarray(rng.random((10, 8)) * 20 + 5)
+    truth = aggregate_bottom_up(hier, bottom_truth)
+    # aggregate forecasts are accurate, bottom ones noisy (common in practice)
+    sd = np.concatenate([np.full(8, 0.1), np.full(10, 2.0)])
+    base = truth + jnp.asarray(rng.normal(0, 1, truth.shape) * sd[:, None])
+    rec = reconcile_forecasts(hier, base, error_var=jnp.asarray(sd**2))
+    err_base = float(jnp.mean((base - truth) ** 2))
+    err_rec = float(jnp.mean((rec - truth) ** 2))
+    assert err_rec < err_base
+
+
+def test_gather_bottom_sharded(batch_small):
+    from distributed_forecasting_tpu.parallel import make_mesh, shard_batch
+
+    mesh = make_mesh(8)
+    sb = shard_batch(batch_small, mesh)
+    bottom = sb.y[:, :16]  # (16, 16) sharded on axis 0
+    gathered = gather_bottom_sharded(bottom, mesh, "series")
+    np.testing.assert_allclose(np.asarray(gathered), np.asarray(bottom), rtol=1e-6)
+    # replicated output
+    assert gathered.sharding.is_fully_replicated
