@@ -1,0 +1,28 @@
+# Developer workflow — the reference drives deploy/test through a Makefile
+# (its Makefile:1-5 wraps dbx execute/deploy/launch); same shape, no cluster.
+
+.PHONY: install test test-tpu native bench e2e clean
+
+install:
+	pip install -e ".[local,test]"
+
+native:
+	$(MAKE) -C native
+
+test: native
+	env -u PALLAS_AXON_POOL_IPS python -m pytest tests/unit -x -q
+
+test-tpu:
+	DFTPU_TEST_PLATFORM=tpu python -m pytest tests/integration -x -q
+
+bench:
+	python bench.py
+
+e2e:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+	python -m distributed_forecasting_tpu.workflows.runner \
+	  -f conf/workflows.yml -w forecasting-e2e
+
+clean:
+	rm -rf dftpu_store build dist *.egg-info
+	$(MAKE) -C native clean

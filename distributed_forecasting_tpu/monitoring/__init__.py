@@ -1,0 +1,7 @@
+from distributed_forecasting_tpu.monitoring.monitor import (
+    MonitorConfig,
+    MonitorRegistry,
+    run_monitor,
+)
+
+__all__ = ["MonitorConfig", "MonitorRegistry", "run_monitor"]

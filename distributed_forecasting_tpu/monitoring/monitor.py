@@ -1,0 +1,166 @@
+"""Model monitoring — the working version of the reference's WIP.
+
+The reference sketches Databricks model monitoring (``notebooks/prophet/
+05_monitoring_wip.py``): ``create_monitor`` over a logging table with
+granularities, id/timestamp columns and slicing expressions, plus cleanup
+helpers for monitors and registered models — but the notebook is
+non-functional (undefined variables, classifier model type for a forecaster,
+SURVEY.md §2.3-6).  This module implements that intent for real:
+
+  * :class:`MonitorConfig` — what to monitor: a forecast table (the
+    ``[ds, keys..., y, yhat, ...]`` schema), timestamp column, granularities
+    (e.g. ``1 day``/``1 week``/``1 month``), slicing columns (store, item);
+  * :class:`MonitorRegistry` — monitor lifecycle (create/get/list/delete)
+    persisted as JSON next to the warehouse;
+  * :func:`run_monitor` — computes the profile-metrics table: per
+    (window, granularity, slice) forecast-quality metrics (mape, smape,
+    bias, rmse, coverage) over rows where actuals exist, written back to the
+    dataset catalog as ``<table>_profile_metrics`` for dashboards/alerts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+import pandas as pd
+
+from distributed_forecasting_tpu.data.catalog import DatasetCatalog
+
+_GRANULARITY_FREQ = {"1 day": "D", "1 week": "W", "1 month": "ME"}
+
+
+@dataclasses.dataclass
+class MonitorConfig:
+    name: str
+    table: str                        # catalog table with forecasts+actuals
+    timestamp_col: str = "ds"
+    prediction_col: str = "yhat"
+    label_col: str = "y"
+    granularities: tuple = ("1 day", "1 week")
+    slicing_cols: tuple = ("store", "item")
+    interval_cols: tuple = ("yhat_lower", "yhat_upper")
+
+    def to_dict(self) -> Dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "MonitorConfig":
+        d = dict(d)
+        for k in ("granularities", "slicing_cols", "interval_cols"):
+            if k in d and isinstance(d[k], list):
+                d[k] = tuple(d[k])
+        return cls(**d)
+
+
+class MonitorRegistry:
+    """Create/list/delete monitors (the reference's ``create_monitor`` /
+    ``cleanup_existing_monitor`` lifecycle, ``05_monitoring_wip.py:20-78``)."""
+
+    def __init__(self, root: str):
+        self.root = os.path.join(root, "monitors")
+        os.makedirs(self.root, exist_ok=True)
+
+    def _path(self, name: str) -> str:
+        return os.path.join(self.root, f"{name}.json")
+
+    def create_monitor(self, config: MonitorConfig, exist_ok: bool = True) -> None:
+        path = self._path(config.name)
+        if os.path.exists(path) and not exist_ok:
+            raise FileExistsError(f"monitor {config.name!r} exists")
+        with open(path, "w") as f:
+            json.dump({**config.to_dict(), "created_at": time.time()}, f, indent=2)
+
+    def get_monitor(self, name: str) -> MonitorConfig:
+        path = self._path(name)
+        if not os.path.exists(path):
+            raise KeyError(f"monitor {name!r} not found")
+        with open(path) as f:
+            d = json.load(f)
+        d.pop("created_at", None)
+        return MonitorConfig.from_dict(d)
+
+    def list_monitors(self) -> List[str]:
+        return sorted(
+            f[:-5] for f in os.listdir(self.root) if f.endswith(".json")
+        )
+
+    def delete_monitor(self, name: str) -> None:
+        path = self._path(name)
+        if os.path.exists(path):
+            os.remove(path)
+
+
+def _window_metrics(g: pd.DataFrame, cfg: MonitorConfig) -> Dict[str, float]:
+    y = g[cfg.label_col].to_numpy(dtype=float)
+    yhat = g[cfg.prediction_col].to_numpy(dtype=float)
+    err = yhat - y
+    denom = np.where(np.abs(y) > 1e-9, y, np.nan)
+    out = {
+        "n_obs": int(len(g)),
+        "mape": float(np.nanmean(np.abs(err / denom))),
+        "smape": float(
+            np.nanmean(np.abs(err) / np.maximum((np.abs(y) + np.abs(yhat)) / 2, 1e-9))
+        ),
+        "rmse": float(np.sqrt(np.mean(err**2))),
+        "bias": float(np.mean(err)),
+    }
+    lo_c, hi_c = cfg.interval_cols
+    if lo_c in g.columns and hi_c in g.columns:
+        inside = (y >= g[lo_c].to_numpy(float)) & (y <= g[hi_c].to_numpy(float))
+        out["coverage"] = float(np.mean(inside))
+    return out
+
+
+def run_monitor(
+    catalog: DatasetCatalog,
+    config: MonitorConfig,
+    output_table: Optional[str] = None,
+) -> pd.DataFrame:
+    """Compute the profile-metrics table and persist it.
+
+    Output rows: one per (window_start, granularity, slice_key, slice_value)
+    plus un-sliced ``:all`` rows; written to ``<table>_profile_metrics``.
+    """
+    df = catalog.read_table(config.table)
+    df = df[~df[config.label_col].isna()].copy()
+    if df.empty:
+        raise ValueError(f"no labeled rows in {config.table} to monitor")
+    ts = pd.to_datetime(df[config.timestamp_col])
+
+    rows = []
+    for gran in config.granularities:
+        freq = _GRANULARITY_FREQ.get(gran)
+        if freq is None:
+            raise ValueError(
+                f"unknown granularity {gran!r}; valid: {sorted(_GRANULARITY_FREQ)}"
+            )
+        window = ts.dt.to_period(freq).dt.start_time
+        slices = [(None, None)] + [
+            (c, v) for c in config.slicing_cols if c in df.columns
+            for v in df[c].unique()
+        ]
+        for col, val in slices:
+            sub = df if col is None else df[df[col] == val]
+            if sub.empty:
+                continue
+            wcol = window if col is None else window[sub.index]
+            for wstart, g in sub.groupby(wcol):
+                m = _window_metrics(g, config)
+                rows.append(
+                    {
+                        "window_start": wstart,
+                        "granularity": gran,
+                        "slice_key": col or ":all",
+                        "slice_value": str(val) if val is not None else ":all",
+                        **m,
+                    }
+                )
+    profile = pd.DataFrame(rows)
+    out_name = output_table or f"{config.table}_profile_metrics"
+    catalog.save_table(out_name, profile)
+    return profile

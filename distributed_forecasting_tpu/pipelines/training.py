@@ -71,6 +71,7 @@ class TrainingPipeline:
         run_cross_validation: bool = True,
         per_series_runs: bool = False,
         tuning: Optional[Dict[str, Any]] = None,
+        trace_dir: Optional[str] = None,
         seed: int = 0,
     ) -> Dict[str, Any]:
         if tuning and tuning.get("enabled"):
@@ -78,9 +79,14 @@ class TrainingPipeline:
                 source_table, output_table, model_conf, cv_conf, tuning,
                 experiment, horizon, key_cols,
             )
+        from distributed_forecasting_tpu.utils.profiling import PhaseTimer, device_trace
+
         config = _config_from_conf(model, model_conf)
-        df = self.catalog.read_table(source_table)
-        batch = tensorize(df, key_cols=key_cols)
+        timer = PhaseTimer()
+        with timer.phase("read"):
+            df = self.catalog.read_table(source_table)
+        with timer.phase("tensorize"):
+            batch = tensorize(df, key_cols=key_cols)
         self.logger.info(
             "fine-grained fit: %d series x %d days, model=%s",
             batch.n_series, batch.n_time, model,
@@ -89,13 +95,19 @@ class TrainingPipeline:
         t_start = time.time()
         key = jax.random.PRNGKey(seed)
         cv_metrics = None
-        if run_cross_validation:
-            cv = CVConfig(**(cv_conf or {}))
-            cv_metrics = cross_validate(batch, model=model, config=config, cv=cv, key=key)
-        params, result = fit_forecast(
-            batch, model=model, config=config, horizon=horizon, key=key
-        )
-        jax.block_until_ready(result.yhat)
+        with device_trace(trace_dir):
+            if run_cross_validation:
+                cv = CVConfig(**(cv_conf or {}))
+                with timer.phase("cross_validation"):
+                    cv_metrics = cross_validate(
+                        batch, model=model, config=config, cv=cv, key=key
+                    )
+                    jax.block_until_ready(cv_metrics["mape"])
+            with timer.phase("fit_forecast"):
+                params, result = fit_forecast(
+                    batch, model=model, config=config, horizon=horizon, key=key
+                )
+                jax.block_until_ready(result.yhat)
         fit_seconds = time.time() - t_start
 
         ok = np.asarray(result.ok)
@@ -129,6 +141,7 @@ class TrainingPipeline:
             )
             agg = {"fit_seconds": fit_seconds,
                    "series_per_second": batch.n_series / max(fit_seconds, 1e-9)}
+            agg.update(timer.metrics())  # per-phase wall-clock tracing
             series_table = batch.key_frame()
             series_table["fit_ok"] = ok
             if cv_metrics is not None:

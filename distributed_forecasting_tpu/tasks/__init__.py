@@ -5,6 +5,7 @@ from distributed_forecasting_tpu.tasks.train import TrainTask
 from distributed_forecasting_tpu.tasks.deploy import DeployTask
 from distributed_forecasting_tpu.tasks.inference import InferenceTask
 from distributed_forecasting_tpu.tasks.sample_ml import SampleMLTask
+from distributed_forecasting_tpu.tasks.monitor import MonitorTask
 
 TASK_TYPES = {
     "catalog": CatalogTask,
@@ -13,6 +14,7 @@ TASK_TYPES = {
     "deploy": DeployTask,
     "inference": InferenceTask,
     "sample_ml": SampleMLTask,
+    "monitor": MonitorTask,
 }
 
 __all__ = [
@@ -23,5 +25,6 @@ __all__ = [
     "DeployTask",
     "InferenceTask",
     "SampleMLTask",
+    "MonitorTask",
     "TASK_TYPES",
 ]

@@ -1,0 +1,56 @@
+"""Monitoring task: create/run a model monitor over a forecast table.
+
+Working task-ified version of the reference's WIP monitoring notebook
+(``notebooks/prophet/05_monitoring_wip.py`` — see ``monitoring/monitor.py``).
+
+Conf::
+
+    monitor:
+      name: finegrain
+      table: hackathon.sales.finegrain_forecasts
+      granularities: ["1 day", "1 week"]
+      slicing_cols: [store, item]
+"""
+
+from __future__ import annotations
+
+from distributed_forecasting_tpu.monitoring import (
+    MonitorConfig,
+    MonitorRegistry,
+    run_monitor,
+)
+from distributed_forecasting_tpu.tasks.common import Task
+
+
+class MonitorTask(Task):
+    def launch(self) -> dict:
+        mc = self.conf.get("monitor", {})
+        config = MonitorConfig(
+            name=mc.get("name", "finegrain"),
+            table=mc.get("table", "hackathon.sales.finegrain_forecasts"),
+            granularities=tuple(mc.get("granularities", ("1 day", "1 week"))),
+            slicing_cols=tuple(mc.get("slicing_cols", ("store", "item"))),
+        )
+        registry = MonitorRegistry(self._paths["warehouse"])
+        registry.create_monitor(config)
+        profile = run_monitor(self.catalog, config)
+        self.logger.info(
+            "monitor %s: %d profile rows -> %s_profile_metrics",
+            config.name, len(profile), config.table,
+        )
+        overall = profile[
+            (profile.slice_key == ":all") & (profile.granularity == "1 day")
+        ]
+        return {
+            "monitor": config.name,
+            "rows": len(profile),
+            "daily_mape_mean": float(overall.mape.mean()),
+        }
+
+
+def entrypoint():
+    MonitorTask().launch()
+
+
+if __name__ == "__main__":
+    entrypoint()

@@ -1,0 +1,60 @@
+"""Tracing/profiling hooks.
+
+The reference has no tracer (SURVEY.md §5): observability is the MLflow run
+tree plus the Spark UI.  Here:
+
+  * :class:`PhaseTimer` — wall-clock per named phase (tensorize / cv / fit /
+    write...), loggable straight into a tracking run as metrics — run-level
+    tracing that survives into the experiment store;
+  * :func:`device_trace` — context manager around ``jax.profiler`` emitting a
+    TensorBoard-loadable device trace when requested (gated: profiling absent
+    or broken never breaks a run).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, Iterator, Optional
+
+
+class PhaseTimer:
+    def __init__(self) -> None:
+        self._durations: Dict[str, float] = {}
+
+    @contextlib.contextmanager
+    def phase(self, name: str) -> Iterator[None]:
+        t0 = time.time()
+        try:
+            yield
+        finally:
+            self._durations[name] = self._durations.get(name, 0.0) + time.time() - t0
+
+    def metrics(self, prefix: str = "phase_") -> Dict[str, float]:
+        return {f"{prefix}{k}_seconds": round(v, 4) for k, v in self._durations.items()}
+
+    def total(self) -> float:
+        return sum(self._durations.values())
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: Optional[str]) -> Iterator[None]:
+    """JAX profiler trace into ``log_dir`` (None = disabled no-op)."""
+    if not log_dir:
+        yield
+        return
+    try:
+        import jax.profiler as _prof
+
+        _prof.start_trace(log_dir)
+        started = True
+    except Exception:  # pragma: no cover - profiler unavailable
+        started = False
+    try:
+        yield
+    finally:
+        if started:
+            try:
+                _prof.stop_trace()
+            except Exception:  # pragma: no cover
+                pass

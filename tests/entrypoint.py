@@ -1,0 +1,11 @@
+"""Remote pytest driver — submit this file as the job "main" with the test
+directory as an argument to run the suite on a remote TPU host (the pattern
+the reference uses to ship its integration tests to a job cluster,
+``tests/entrypoint.py`` + ``conf/deployment.yml:19-26``)."""
+
+import sys
+
+import pytest
+
+if __name__ == "__main__":
+    sys.exit(pytest.main(sys.argv[1:]))

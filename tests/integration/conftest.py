@@ -1,0 +1,27 @@
+"""Integration tier: real accelerator, no fakes.
+
+The reference's integration tests assume the ambient Databricks runtime and
+run on a live cluster (``tests/integration/catalog_test.py``).  Here they
+assume a real TPU (or other non-CPU) JAX backend and are skipped otherwise:
+
+    DFTPU_TEST_PLATFORM=tpu python -m pytest tests/integration -x -q
+"""
+
+import os
+
+import pytest
+
+# do NOT force the CPU platform here — the point is the real backend; the
+# parent conftest honors DFTPU_TEST_PLATFORM != cpu by leaving JAX_PLATFORMS
+# alone.
+os.environ.setdefault("DFTPU_TEST_PLATFORM", "tpu")
+
+
+@pytest.fixture(scope="session")
+def tpu_device():
+    import jax
+
+    devs = [d for d in jax.devices() if d.platform != "cpu"]
+    if not devs:
+        pytest.skip("no accelerator device visible")
+    return devs[0]

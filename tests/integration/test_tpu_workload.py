@@ -1,0 +1,65 @@
+"""Real-accelerator integration tests: the headline 500-series workload on
+actual TPU hardware, including the <10 s fit+forecast envelope from
+BASELINE.md.  Skipped when no accelerator is visible."""
+
+import time
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def batch500():
+    from distributed_forecasting_tpu.data import (
+        synthetic_store_item_sales,
+        tensorize,
+    )
+
+    df = synthetic_store_item_sales(n_stores=10, n_items=50, n_days=1826, seed=0)
+    return tensorize(df)
+
+
+def test_device_is_accelerator(tpu_device):
+    assert tpu_device.platform != "cpu"
+
+
+def test_500_series_fit_forecast_under_envelope(tpu_device, batch500):
+    import jax
+
+    from distributed_forecasting_tpu.engine import fit_forecast
+
+    # warmup/compile
+    params, res = fit_forecast(batch500, model="prophet", horizon=90)
+    jax.block_until_ready(res.yhat)
+    t0 = time.time()
+    params, res = fit_forecast(
+        batch500, model="prophet", horizon=90, key=jax.random.PRNGKey(1)
+    )
+    jax.block_until_ready(res.yhat)
+    elapsed = time.time() - t0
+    assert bool(res.ok.all())
+    assert elapsed < 10.0, f"500-series fit+forecast took {elapsed:.2f}s (target <10s)"
+
+
+def test_500_series_accuracy_on_synthetic(tpu_device, batch500):
+    import jax.numpy as jnp
+
+    from distributed_forecasting_tpu.engine import CVConfig, cross_validate
+
+    cvm = cross_validate(
+        batch500, model="prophet", cv=CVConfig(initial=730, period=360, horizon=90)
+    )
+    mape = float(jnp.mean(cvm["mape"]))
+    # synthetic noise floor ~6-8%; hold a loose ceiling on real hardware
+    assert mape < 0.15, mape
+
+
+def test_holt_winters_and_arima_run_on_device(tpu_device, batch500):
+    import jax
+
+    from distributed_forecasting_tpu.engine import fit_forecast
+
+    for model in ("holt_winters", "arima"):
+        params, res = fit_forecast(batch500, model=model, horizon=28)
+        jax.block_until_ready(res.yhat)
+        assert np.isfinite(np.asarray(res.yhat)).all(), model

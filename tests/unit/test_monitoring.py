@@ -1,0 +1,113 @@
+import numpy as np
+import pandas as pd
+import pytest
+
+from distributed_forecasting_tpu.monitoring import (
+    MonitorConfig,
+    MonitorRegistry,
+    run_monitor,
+)
+
+
+@pytest.fixture()
+def forecast_table(catalog):
+    rng = np.random.default_rng(0)
+    dates = pd.date_range("2024-01-01", periods=60)
+    rows = []
+    for store in (1, 2):
+        for item in (1, 2):
+            y = 50 + 10 * rng.random(60)
+            yhat = y * (1 + rng.normal(0, 0.05, 60))
+            rows.append(
+                pd.DataFrame(
+                    {
+                        "ds": dates, "store": store, "item": item,
+                        "y": y, "yhat": yhat,
+                        "yhat_lower": yhat * 0.8, "yhat_upper": yhat * 1.2,
+                    }
+                )
+            )
+    df = pd.concat(rows, ignore_index=True)
+    # future rows without actuals must be ignored by the monitor
+    fut = df.tail(10).copy()
+    fut["y"] = np.nan
+    catalog.save_table("hackathon.sales.finegrain_forecasts",
+                       pd.concat([df, fut], ignore_index=True))
+    return catalog
+
+
+def test_monitor_registry_lifecycle(tmp_path):
+    reg = MonitorRegistry(str(tmp_path))
+    cfg = MonitorConfig(name="m1", table="a.b.c")
+    reg.create_monitor(cfg)
+    assert reg.list_monitors() == ["m1"]
+    back = reg.get_monitor("m1")
+    assert back.table == "a.b.c"
+    assert back.granularities == ("1 day", "1 week")
+    with pytest.raises(FileExistsError):
+        reg.create_monitor(cfg, exist_ok=False)
+    reg.delete_monitor("m1")
+    assert reg.list_monitors() == []
+    with pytest.raises(KeyError):
+        reg.get_monitor("m1")
+
+
+def test_run_monitor_profile(forecast_table):
+    catalog = forecast_table
+    cfg = MonitorConfig(name="fg", table="hackathon.sales.finegrain_forecasts")
+    profile = run_monitor(catalog, cfg)
+    assert {"window_start", "granularity", "slice_key", "slice_value",
+            "n_obs", "mape", "smape", "rmse", "bias", "coverage"} <= set(profile.columns)
+    # overall + store/item slices at both granularities
+    assert set(profile.granularity) == {"1 day", "1 week"}
+    assert {":all", "store", "item"} <= set(profile.slice_key)
+    # ~5% multiplicative noise -> mape around 0.0x, coverage high
+    overall = profile[(profile.slice_key == ":all") & (profile.granularity == "1 week")]
+    assert overall.mape.mean() < 0.15
+    assert overall.coverage.mean() > 0.9
+    # persisted to the catalog
+    saved = catalog.read_table(
+        "hackathon.sales.finegrain_forecasts_profile_metrics"
+    )
+    assert len(saved) == len(profile)
+
+
+def test_monitor_task(tmp_path, forecast_table):
+    # reuse the populated warehouse through the Task surface
+    from distributed_forecasting_tpu.tasks.monitor import MonitorTask
+
+    task = MonitorTask(
+        init_conf={
+            "monitor": {"name": "fg",
+                        "table": "hackathon.sales.finegrain_forecasts"}
+        },
+        catalog=forecast_table,
+    )
+    out = task.launch()
+    assert out["rows"] > 0
+    assert np.isfinite(out["daily_mape_mean"])
+
+
+def test_monitor_rejects_unlabeled(catalog):
+    df = pd.DataFrame({"ds": pd.date_range("2024-01-01", periods=3),
+                       "store": 1, "item": 1, "y": [np.nan] * 3, "yhat": 1.0})
+    catalog.save_table("a.b.empty", df)
+    with pytest.raises(ValueError, match="no labeled rows"):
+        run_monitor(catalog, MonitorConfig(name="x", table="a.b.empty"))
+
+
+def test_phase_timer():
+    import time as _t
+
+    from distributed_forecasting_tpu.utils.profiling import PhaseTimer
+
+    t = PhaseTimer()
+    with t.phase("a"):
+        _t.sleep(0.01)
+    with t.phase("a"):
+        pass
+    with t.phase("b"):
+        pass
+    m = t.metrics()
+    assert m["phase_a_seconds"] >= 0.01
+    assert set(m) == {"phase_a_seconds", "phase_b_seconds"}
