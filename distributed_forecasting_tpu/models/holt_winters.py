@@ -113,6 +113,90 @@ def _filter(y, mask, alpha, beta, gamma, m, mode):
     return (l, b, s), mse, preds
 
 
+def parallel_filter(y, mask, alpha, beta, gamma, m):
+    """Additive HW filter via parallel prefix over time (O(log T) depth).
+
+    The sequential ``_filter`` is a lax.scan — fine at T~2k, but serial depth
+    T dominates for very long series.  The additive update is affine in the
+    state x = [l, b, s_0..s_{m-1}]:  x_t = A_t x_{t-1} + c_t, with A_t
+    depending only on (observed_t, slot_t) — so the whole trajectory is an
+    associative scan over affine maps (ops/pscan.py), the time-dimension
+    parallelism story of this framework (SURVEY.md §5).
+
+    Returns (final_state_tuple, mse, preds) matching ``_filter`` semantics
+    (mode='additive').
+    """
+    from distributed_forecasting_tpu.ops.pscan import affine_scan
+
+    T = y.shape[0]
+    d = m + 2
+    idx = jnp.arange(T) % m
+    eye_m = jnp.eye(m)
+    e = eye_m[idx]  # (T, m) one-hot seasonal slot per step
+
+    # observed-update matrix rows (affine in previous state):
+    #   l' = (1-a) l + (1-a) b - a s_i            + a y
+    #   b' = -ab l + (b(1-a)+(1-b)) b - ab s_i    + ab y
+    #   s_i' = -g(1-a) l - g(1-a) b + (ga+1-g)s_i + g(1-a) y ; s_j'=s_j
+    row_l = jnp.concatenate(
+        [jnp.full((T, 1), 1 - alpha), jnp.full((T, 1), 1 - alpha), -alpha * e],
+        axis=1,
+    )
+    bb = beta * (1 - alpha) + (1 - beta)
+    row_b = jnp.concatenate(
+        [jnp.full((T, 1), -alpha * beta), jnp.full((T, 1), bb),
+         -alpha * beta * e],
+        axis=1,
+    )
+    # seasonal block: identity + slot-row replacement
+    s_rows = (
+        jnp.broadcast_to(eye_m[None], (T, m, m))
+        + e[:, :, None]
+        * (
+            (gamma * alpha + 1 - gamma - 1.0) * e[:, None, :]  # diag slot adj
+        )
+    )
+    s_lb = e[:, :, None] * jnp.stack(
+        [jnp.full((T,), -gamma * (1 - alpha)),
+         jnp.full((T,), -gamma * (1 - alpha))], axis=-1
+    )[:, None, :]  # (T, m, 2) only slot row gets l/b terms
+    A_obs = jnp.concatenate(
+        [
+            row_l[:, None, :],
+            row_b[:, None, :],
+            jnp.concatenate([s_lb, s_rows], axis=2),
+        ],
+        axis=1,
+    )  # (T, d, d)
+    c_obs = jnp.concatenate(
+        [
+            (alpha * y)[:, None],
+            (alpha * beta * y)[:, None],
+            e * (gamma * (1 - alpha) * y)[:, None],
+        ],
+        axis=1,
+    )  # (T, d)
+
+    A_pred = jnp.zeros((d, d)).at[0, 0].set(1.0).at[0, 1].set(1.0)
+    A_pred = A_pred.at[1, 1].set(1.0)
+    A_pred = A_pred.at[2:, 2:].set(eye_m)
+    mt = mask[:, None, None]
+    A = jnp.where(mt > 0, A_obs, A_pred[None])
+    c = jnp.where(mask[:, None] > 0, c_obs, 0.0)
+
+    l0, b0, s0 = _init_state(y, mask, m, "additive")
+    x0 = jnp.concatenate([jnp.stack([l0, b0]), s0])
+    states = affine_scan(A, c, x0)  # (T, d) after each step
+
+    prev = jnp.concatenate([x0[None], states[:-1]], axis=0)  # state before t
+    preds = prev[:, 0] + prev[:, 1] + jnp.sum(prev[:, 2:] * e, axis=1)
+    err = (y - preds) * mask
+    n = jnp.maximum(jnp.sum(mask), 1.0)
+    mse = jnp.sum(err**2) / n
+    xT = states[-1]
+    return (xT[0], xT[1], xT[2:]), mse, preds
+
+
 def _candidate_grid(cfg: HoltWintersConfig):
     a = jnp.linspace(0.05, 0.95, cfg.n_alpha)
     b = jnp.linspace(0.01, 0.4, cfg.n_beta)

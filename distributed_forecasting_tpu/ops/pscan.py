@@ -1,0 +1,55 @@
+"""Parallel-prefix (associative scan) linear-recurrence solvers.
+
+Long-context story of this framework (SURVEY.md §5 "long-context /
+sequence parallelism"): the reference caps series length at ~1.8k daily
+points and scales only in series *count*; nothing in the workload is
+attention-shaped, so ring attention would be cargo cult.  The honest TPU
+analogue of sequence parallelism for state-space forecasters is the
+**parallel prefix over the time dimension**: every filter/smoother used here
+(exponential smoothing, Holt-Winters, the Kalman mean recursion) is an
+affine recurrence
+
+    x_t = A_t x_{t-1} + c_t,
+
+and composition of affine maps is associative:
+
+    (A2, c2) o (A1, c1) = (A2 A1, A2 c1 + c2)
+
+so ``jax.lax.associative_scan`` evaluates all T states in O(log T) parallel
+depth — turning a serial 100k-step scan into ~17 rounds of batched (d, d)
+matmuls the MXU eats.  Cost trade: O(T d^3) FLOPs vs the sequential scan's
+O(T d^2); for small state dims (d <= ~16) and long T this wins on TPUs
+because depth, not FLOPs, is the bottleneck.
+
+Used by ``models/holt_winters.parallel_filter`` (d = season_length + 2).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def affine_scan(A: jnp.ndarray, c: jnp.ndarray, x0: jnp.ndarray) -> jnp.ndarray:
+    """All states of ``x_t = A_t x_{t-1} + c_t`` for t = 1..T.
+
+    A: (T, d, d); c: (T, d); x0: (d,) initial state (= x_0).
+    Returns (T, d): states AFTER each step.
+    """
+
+    def compose(left, right):
+        A1, c1 = left
+        A2, c2 = right
+        return A2 @ A1, (A2 @ c1[..., None])[..., 0] + c2
+
+    # cumulative maps: (Â_t, ĉ_t) with x_t = Â_t x0 + ĉ_t
+    A_cum, c_cum = jax.lax.associative_scan(compose, (A, c))
+    return (A_cum @ x0[None, :, None])[..., 0] + c_cum
+
+
+def affine_scan_batched(A, c, x0):
+    """Batch over leading axes: A (..., T, d, d), c (..., T, d), x0 (..., d)."""
+    fn = affine_scan
+    for _ in range(A.ndim - 3):
+        fn = jax.vmap(fn)
+    return fn(A, c, x0)

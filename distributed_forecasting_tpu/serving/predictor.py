@@ -19,9 +19,9 @@ skipped) instead of the reference's IndexError (§2.3-3).
 from __future__ import annotations
 
 import dataclasses
+import importlib
 import json
 import os
-import pickle
 from typing import Optional
 
 import jax
@@ -31,8 +31,31 @@ import pandas as pd
 
 from distributed_forecasting_tpu.models.base import get_model
 
-_PARAMS_FILE = "params.pkl"
+_PARAMS_FILE = "params.npz"
 _META_FILE = "forecaster.json"
+
+
+def save_params_npz(path: str, params) -> str:
+    """Serialize a flat-dataclass param pytree (fields = arrays/scalars) to a
+    single .npz — the one-artifact-for-all-series persistence this framework
+    uses where the reference stores one serialized Prophet model per series
+    run (``notebooks/prophet/02_training.py:193-196``).  No pickle: plain
+    arrays + a recorded dataclass type for reconstruction."""
+    fields = {
+        f.name: np.asarray(getattr(params, f.name))
+        for f in dataclasses.fields(params)
+    }
+    np.savez(path, **fields)
+    cls = type(params)
+    return f"{cls.__module__}:{cls.__qualname__}"
+
+
+def load_params_npz(path: str, params_type: str):
+    module, qualname = params_type.split(":")
+    cls = getattr(importlib.import_module(module), qualname)
+    with np.load(path) as z:
+        fields = {k: jnp.asarray(z[k]) for k in z.files}
+    return cls(**fields)
 
 
 class UnknownSeriesError(KeyError):
@@ -77,10 +100,11 @@ class BatchForecaster:
     # -- persistence --------------------------------------------------------
     def save(self, directory: str) -> None:
         os.makedirs(directory, exist_ok=True)
-        host_params = jax.tree_util.tree_map(np.asarray, self.params)
-        with open(os.path.join(directory, _PARAMS_FILE), "wb") as f:
-            pickle.dump(host_params, f)
+        params_type = save_params_npz(
+            os.path.join(directory, _PARAMS_FILE), self.params
+        )
         meta = {
+            "params_type": params_type,
             "model": self.model,
             "config": dataclasses.asdict(self.config),
             "key_names": list(self.key_names),
@@ -100,8 +124,9 @@ class BatchForecaster:
     def load(cls, directory: str) -> "BatchForecaster":
         with open(os.path.join(directory, _META_FILE)) as f:
             meta = json.load(f)
-        with open(os.path.join(directory, _PARAMS_FILE), "rb") as f:
-            params = pickle.load(f)
+        params = load_params_npz(
+            os.path.join(directory, _PARAMS_FILE), meta["params_type"]
+        )
         fns = get_model(meta["model"])
         config = fns.config_cls(**meta["config"])
         return cls(
