@@ -1,0 +1,80 @@
+"""Holiday calendars for holiday-effect regressors.
+
+The reference's AutoML path fits Prophet with US holidays and tunes a
+``holidays_prior_scale`` (``notebooks/automl/22-09-26...py:111-123``).  No
+holiday package ships in this environment, so the US federal calendar is
+computed algorithmically (fixed dates + nth-weekday rules); custom calendars
+are plain ``{name: [dates]}`` dicts.
+
+``holiday_spec`` converts a calendar to the static, hashable form the curve
+model's config carries (tuples of epoch-day ints), so holiday indicator
+columns are ordinary design-matrix features under jit.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Tuple
+
+import numpy as np
+import pandas as pd
+
+
+def _nth_weekday(year: int, month: int, weekday: int, n: int) -> pd.Timestamp:
+    """n-th (1-based) given weekday of a month; n=-1 = last."""
+    if n > 0:
+        d = pd.Timestamp(year=year, month=month, day=1)
+        offset = (weekday - d.dayofweek) % 7 + 7 * (n - 1)
+        return d + pd.Timedelta(days=offset)
+    d = pd.Timestamp(year=year, month=month, day=1) + pd.offsets.MonthEnd(0)
+    offset = (d.dayofweek - weekday) % 7
+    return d - pd.Timedelta(days=offset)
+
+
+def us_federal_holidays(years: Iterable[int]) -> Dict[str, List[pd.Timestamp]]:
+    """Major US federal holidays per year (fixed + floating rules)."""
+    cal: Dict[str, List[pd.Timestamp]] = {}
+
+    def add(name, ts):
+        cal.setdefault(name, []).append(ts)
+
+    for y in years:
+        add("new_years_day", pd.Timestamp(y, 1, 1))
+        add("mlk_day", _nth_weekday(y, 1, 0, 3))          # 3rd Mon Jan
+        add("presidents_day", _nth_weekday(y, 2, 0, 3))   # 3rd Mon Feb
+        add("memorial_day", _nth_weekday(y, 5, 0, -1))    # last Mon May
+        add("independence_day", pd.Timestamp(y, 7, 4))
+        add("labor_day", _nth_weekday(y, 9, 0, 1))        # 1st Mon Sep
+        add("thanksgiving", _nth_weekday(y, 11, 3, 4))    # 4th Thu Nov
+        add("christmas", pd.Timestamp(y, 12, 25))
+    return cal
+
+
+def holiday_spec(
+    calendar: Dict[str, Iterable], lower_window: int = 0, upper_window: int = 0
+) -> Tuple[Tuple[str, Tuple[int, ...]], ...]:
+    """Calendar -> static config spec: ((name, (epoch_day, ...)), ...).
+
+    ``lower/upper_window`` widen each occurrence like Prophet's holiday
+    windows (e.g. upper_window=1 also marks the day after).
+    """
+    out = []
+    for name in sorted(calendar):
+        days = set()
+        for ts in calendar[name]:
+            base = (
+                np.datetime64(pd.Timestamp(ts).date()) - np.datetime64("1970-01-01")
+            ).astype(int)
+            for off in range(-lower_window, upper_window + 1):
+                days.add(int(base + off))
+        out.append((name, tuple(sorted(days))))
+    return tuple(out)
+
+
+def us_holiday_spec_for_range(
+    start, end, lower_window: int = 0, upper_window: int = 0
+) -> Tuple[Tuple[str, Tuple[int, ...]], ...]:
+    """Convenience: US federal calendar covering [start, end] dates."""
+    y0, y1 = pd.Timestamp(start).year, pd.Timestamp(end).year
+    return holiday_spec(
+        us_federal_holidays(range(y0, y1 + 1)), lower_window, upper_window
+    )

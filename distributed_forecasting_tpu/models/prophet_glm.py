@@ -53,6 +53,10 @@ class CurveModelConfig:
     weekly_order: int = 3
     yearly_order: int = 10
     seasonality_mode: str = "multiplicative"  # or 'additive'
+    # static holiday spec ((name, (epoch_day, ...)), ...) — build with
+    # data/holidays.holiday_spec / us_holiday_spec_for_range
+    holidays: tuple = ()
+    holiday_prior_scale: float = 10.0
     interval_width: float = 0.95
     # 0 = analytic intervals (closed-form variance of the simulated
     # changepoint process — deterministic and compile-cheap, the default);
@@ -92,7 +96,11 @@ def _feature_masks(layout):
     fixed[layout["intercept"]] = 1.0
     slope = _np.zeros(F, _np.float32)
     slope[layout["slope"]] = 1.0
-    return jnp.asarray(cp), jnp.asarray(seas), jnp.asarray(fixed), jnp.asarray(slope)
+    hol = _np.zeros(F, _np.float32)
+    if "holidays" in layout:
+        hol[layout["holidays"]] = 1.0
+    return (jnp.asarray(cp), jnp.asarray(seas), jnp.asarray(fixed),
+            jnp.asarray(slope), jnp.asarray(hol))
 
 
 def _prior_precision(layout, cfg: CurveModelConfig, cp_scale=None, seas_scale=None):
@@ -110,13 +118,14 @@ def _prior_precision(layout, cfg: CurveModelConfig, cp_scale=None, seas_scale=No
     seas_scale = cfg.seasonality_prior_scale if seas_scale is None else seas_scale
     cp_scale = jnp.asarray(cp_scale)[..., None]  # (...,1) broadcasts over F
     seas_scale = jnp.asarray(seas_scale)[..., None]
-    cp_m, seas_m, fixed_m, slope_m = _feature_masks(layout)
+    cp_m, seas_m, fixed_m, slope_m, hol_m = _feature_masks(layout)
     slope_prec = 1e-8 if cfg.growth == "linear" else 1e8
     lam = (
         cp_m * (1.0 / cp_scale**2)
         + seas_m * (1.0 / seas_scale**2)
         + fixed_m * 1e-8
         + slope_m * slope_prec
+        + hol_m * (1.0 / cfg.holiday_prior_scale**2)
     )
     return lam
 
@@ -130,6 +139,7 @@ def _design(day, t0, t1, cfg: CurveModelConfig):
         weekly_order=cfg.weekly_order,
         yearly_order=cfg.yearly_order,
         changepoint_range=cfg.changepoint_range,
+        holidays=cfg.holidays,
     )
 
 
@@ -272,6 +282,8 @@ def extract_params(params: CurveParams, config: CurveModelConfig) -> dict:
         "weekly_order": config.weekly_order,
         "yearly_order": config.yearly_order,
         "uncertainty_samples": config.uncertainty_samples,
+        "n_holidays": len(config.holidays),
+        "holiday_prior_scale": config.holiday_prior_scale,
     }
 
 

@@ -58,6 +58,22 @@ def changepoint_features(
     return A, s
 
 
+def holiday_features(day: jnp.ndarray, holidays: tuple) -> jnp.ndarray:
+    """(T, H) indicator columns, one per named holiday.
+
+    ``holidays`` is the static spec from ``data/holidays.holiday_spec``:
+    ((name, (epoch_day, ...)), ...) — each column is 1 on every occurrence
+    of that holiday (all years share one coefficient, like Prophet's holiday
+    regressors; reference AutoML fits US holidays,
+    ``notebooks/automl/22-09-26...py:118``).
+    """
+    cols = [
+        jnp.isin(day, jnp.asarray(days, dtype=day.dtype)).astype(jnp.float32)
+        for _name, days in holidays
+    ]
+    return jnp.stack(cols, axis=1)
+
+
 def curve_design_matrix(
     day: jnp.ndarray,
     t0,
@@ -66,12 +82,14 @@ def curve_design_matrix(
     weekly_order: int = 3,
     yearly_order: int = 10,
     changepoint_range: float = 0.8,
+    holidays: tuple = (),
 ) -> tuple[jnp.ndarray, dict]:
     """Full (T, F) design matrix + a static layout descriptor.
 
-    Column layout: [1, t, hinge_1..K, weekly sin/cos, yearly sin/cos].
-    The layout dict gives slices for parameter interpretation (trend
-    uncertainty needs the changepoint block; see models/prophet_glm.py).
+    Column layout: [1, t, hinge_1..K, weekly sin/cos, yearly sin/cos,
+    holiday indicators].  The layout dict gives slices for parameter
+    interpretation (trend uncertainty needs the changepoint block; see
+    models/prophet_glm.py).
     """
     t = scaled_time(day, t0, t1)
     A, s = changepoint_features(t, n_changepoints, changepoint_range)
@@ -86,14 +104,19 @@ def curve_design_matrix(
         cols.append(wk)
     if yr is not None:
         cols.append(yr)
+    n_hol = len(holidays)
+    if n_hol:
+        cols.append(holiday_features(day, holidays))
     X = jnp.concatenate(cols, axis=1)
+    base = n_fixed + k + n_wk + n_yr
     layout = {
         "intercept": slice(0, 1),
         "slope": slice(1, 2),
         "changepoints": slice(n_fixed, n_fixed + k),
         "weekly": slice(n_fixed + k, n_fixed + k + n_wk),
-        "yearly": slice(n_fixed + k + n_wk, n_fixed + k + n_wk + n_yr),
-        "n_features": n_fixed + k + n_wk + n_yr,
+        "yearly": slice(n_fixed + k + n_wk, base),
+        "holidays": slice(base, base + n_hol),
+        "n_features": base + n_hol,
         "changepoint_grid": s,
     }
     return X, layout

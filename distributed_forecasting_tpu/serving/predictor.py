@@ -50,6 +50,14 @@ def save_params_npz(path: str, params) -> str:
     return f"{cls.__module__}:{cls.__qualname__}"
 
 
+def _freeze(value):
+    """JSON round-trips tuples as lists; config dataclasses must stay
+    hashable (they are static jit args), so re-freeze recursively."""
+    if isinstance(value, list):
+        return tuple(_freeze(v) for v in value)
+    return value
+
+
 def load_params_npz(path: str, params_type: str):
     module, qualname = params_type.split(":")
     cls = getattr(importlib.import_module(module), qualname)
@@ -128,7 +136,9 @@ class BatchForecaster:
             os.path.join(directory, _PARAMS_FILE), meta["params_type"]
         )
         fns = get_model(meta["model"])
-        config = fns.config_cls(**meta["config"])
+        config = fns.config_cls(
+            **{k: _freeze(v) for k, v in meta["config"].items()}
+        )
         return cls(
             model=meta["model"],
             config=config,
