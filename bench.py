@@ -79,6 +79,58 @@ def main() -> None:
         file=sys.stderr,
     )
 
+    # secondary probes (stderr only): pallas gram kernel + 5k-series scale
+    try:
+        import os
+
+        from distributed_forecasting_tpu.models import prophet_glm
+
+        os.environ["DFTPU_GRAM_BACKEND"] = "pallas"
+        prophet_glm.fit.clear_cache()
+        t0 = time.time()
+        res_p = run(10)
+        pallas_compile = time.time() - t0
+        t0 = time.time()
+        res_p = run(11)
+        pallas_steady = time.time() - t0
+        print(
+            f"[bench] pallas gram backend: {pallas_steady:.3f}s steady "
+            f"(compile {pallas_compile:.1f}s) vs einsum {steady:.3f}s",
+            file=sys.stderr,
+        )
+    except Exception as e:  # never let the probe kill the headline number
+        print(f"[bench] pallas probe failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+    finally:
+        import os
+
+        os.environ.pop("DFTPU_GRAM_BACKEND", None)
+        from distributed_forecasting_tpu.models import prophet_glm
+
+        prophet_glm.fit.clear_cache()
+
+    try:
+        df5k = synthetic_store_item_sales(
+            n_stores=100, n_items=50, n_days=N_DAYS, seed=1
+        )
+        b5k = tensorize(df5k)
+        params, r = fit_forecast(b5k, model="prophet", horizon=HORIZON)
+        jax.block_until_ready(r.yhat)
+        t0 = time.time()
+        params, r = fit_forecast(
+            b5k, model="prophet", horizon=HORIZON, key=jax.random.PRNGKey(2)
+        )
+        jax.block_until_ready(r.yhat)
+        dt = time.time() - t0
+        print(
+            f"[bench] scale probe: {b5k.n_series} series in {dt:.3f}s "
+            f"({b5k.n_series / dt:.0f} series/s)",
+            file=sys.stderr,
+        )
+    except Exception as e:
+        print(f"[bench] scale probe failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+
     print(
         json.dumps(
             {

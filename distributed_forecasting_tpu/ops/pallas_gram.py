@@ -1,0 +1,93 @@
+"""Pallas TPU kernel: fused masked Gram + moment accumulation.
+
+The compute core of the curve-model fit (the op that replaced 500 Stan runs)
+is, per series s:
+
+    G[s] = sum_t w[s,t] * X[t,:] X[t,:]^T      (F, F)
+    b[s] = sum_t w[s,t] y[s,t] * X[t,:]        (F,)
+
+XLA compiles the einsum formulation well, but it reads the shared design
+matrix X once per einsum; this kernel fuses both accumulations in one pass —
+X is loaded into VMEM once per series-tile and hit twice (one (BS, T) x
+(T, F) matmul for all moments, one (F, T) x (T, F) MXU contraction per
+series for the Gram) before results stream back to HBM.  The feature axis is
+padded to the 128-lane boundary so both matmuls tile the MXU exactly.
+
+``interpret=True`` runs the same kernel on CPU for tests; the solver keeps
+the einsum path as the default until the Pallas path measures faster on the
+target chip (bench.py compares both), switchable via
+``DFTPU_GRAM_BACKEND=pallas``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_LANE = 128
+_DEFAULT_BS = 8  # series per program
+
+
+def _gram_kernel(x_ref, w_ref, wy_ref, g_ref, b_ref):
+    X = x_ref[:]    # (T, Fp) shared design block, VMEM-resident
+    W = w_ref[:]    # (BS, T) weights for this series tile
+    WY = wy_ref[:]  # (BS, T) weight * value
+    # all moment vectors of the tile in one MXU matmul
+    b_ref[:] = jnp.dot(WY, X, preferred_element_type=jnp.float32)
+
+    def body(i, _):
+        Xw = X * W[i][:, None]  # (T, Fp) VPU broadcast-multiply
+        g_ref[i] = jax.lax.dot_general(
+            Xw, X, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return 0
+
+    jax.lax.fori_loop(0, W.shape[0], body, 0)
+
+
+@functools.partial(jax.jit, static_argnames=("block_series", "interpret"))
+def masked_gram_moments_pallas(
+    X: jnp.ndarray,
+    w: jnp.ndarray,
+    y: jnp.ndarray,
+    block_series: int = _DEFAULT_BS,
+    interpret: bool = False,
+):
+    """(G, b): G (S, F, F), b (S, F) for shared X (T, F), per-series w, y (S, T)."""
+    S, T = w.shape
+    F = X.shape[1]
+    Fp = ((F + _LANE - 1) // _LANE) * _LANE
+    Sp = ((S + block_series - 1) // block_series) * block_series
+    Xp = jnp.pad(X.astype(jnp.float32), ((0, 0), (0, Fp - F)))
+    wp = jnp.pad(w.astype(jnp.float32), ((0, Sp - S), (0, 0)))
+    wyp = jnp.pad((w * y).astype(jnp.float32), ((0, Sp - S), (0, 0)))
+
+    grid = (Sp // block_series,)
+    G, b = pl.pallas_call(
+        _gram_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((T, Fp), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_series, T), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_series, T), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_series, Fp, Fp), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_series, Fp), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((Sp, Fp, Fp), jnp.float32),
+            jax.ShapeDtypeStruct((Sp, Fp), jnp.float32),
+        ],
+        interpret=interpret,
+    )(Xp, wp, wyp)
+    return G[:S, :F, :F], b[:S, :F]

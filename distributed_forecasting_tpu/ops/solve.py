@@ -18,8 +18,16 @@ Everything here is shape-static and vmap/shard_map friendly.
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
+
+
+def _gram_backend() -> str:
+    """'einsum' (default) or 'pallas' — see ops/pallas_gram.py.  Read at
+    trace time so a run can opt in via DFTPU_GRAM_BACKEND=pallas."""
+    return os.environ.get("DFTPU_GRAM_BACKEND", "einsum")
 
 
 def masked_gram(X: jnp.ndarray, w: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
@@ -48,8 +56,16 @@ def ridge_solve_batch(
     Returns beta: (S, F).  Uses Cholesky (SPD by construction).
     """
     F = X.shape[1]
-    G = masked_gram(X, w)
-    b = jnp.einsum("st,tf->sf", w * y, X, optimize=True)
+    if _gram_backend() == "pallas":
+        from distributed_forecasting_tpu.ops.pallas_gram import (
+            masked_gram_moments_pallas,
+        )
+
+        interpret = jax.default_backend() == "cpu"
+        G, b = masked_gram_moments_pallas(X, w, y, interpret=interpret)
+    else:
+        G = masked_gram(X, w)
+        b = jnp.einsum("st,tf->sf", w * y, X, optimize=True)
     lam = jnp.asarray(lam)
     if lam.ndim == 1:
         D = jnp.diag(lam + jitter)[None, :, :]
