@@ -45,7 +45,11 @@ _LOG_EPS = 1e-3
 
 @dataclasses.dataclass(frozen=True)
 class CurveModelConfig:
-    growth: str = "linear"  # 'linear' | 'flat'
+    growth: str = "linear"  # 'linear' | 'flat' | 'logistic'
+    # logistic growth: per-series carrying capacity = cap_multiplier * max(y)
+    # (Prophet takes an explicit cap column; a data-derived cap covers the
+    # retail-demand case without a second input table)
+    cap_multiplier: float = 1.1
     n_changepoints: int = 25
     changepoint_range: float = 0.8
     changepoint_prior_scale: float = 0.05
@@ -72,11 +76,22 @@ class CurveParams:
     beta: jax.Array        # (S, F) coefficients in the design basis
     sigma: jax.Array       # (S,) residual std (in fit space)
     y_scale: jax.Array     # (S,) per-series scale used to normalize y
+    cap: jax.Array         # (S,) carrying capacity (logistic growth; else 1)
     t0: jax.Array          # () scalar: first training day (absolute)
     t1: jax.Array          # () scalar: last training day (absolute)
 
 
-def _fit_space(y, mask, mode):
+def _fit_space(y, mask, mode, cap=None):
+    """Transform observations into the (additive) fitting space.
+
+    multiplicative -> log space; logistic growth -> logit of y/cap (the
+    saturating-growth analogue: a linear trend in logit space is a logistic
+    curve in data space, matching Prophet's ``growth='logistic'`` intent
+    with a data-derived cap); otherwise identity.
+    """
+    if cap is not None:
+        frac = jnp.clip(y / cap[:, None], _LOG_EPS, 1.0 - _LOG_EPS)
+        return jnp.log(frac / (1.0 - frac)) * mask
     if mode == "multiplicative":
         return jnp.log(jnp.maximum(y, _LOG_EPS)) * mask
     return y * mask
@@ -119,7 +134,11 @@ def _prior_precision(layout, cfg: CurveModelConfig, cp_scale=None, seas_scale=No
     cp_scale = jnp.asarray(cp_scale)[..., None]  # (...,1) broadcasts over F
     seas_scale = jnp.asarray(seas_scale)[..., None]
     cp_m, seas_m, fixed_m, slope_m, hol_m = _feature_masks(layout)
-    slope_prec = 1e-8 if cfg.growth == "linear" else 1e8
+    # flat growth = no trend at all: clamp the slope AND the changepoint
+    # hinges (which would otherwise reintroduce a piecewise trend)
+    slope_prec = 1e8 if cfg.growth == "flat" else 1e-8
+    if cfg.growth == "flat":
+        cp_scale = jnp.full_like(cp_scale, 1e-4)
     lam = (
         cp_m * (1.0 / cp_scale**2)
         + seas_m * (1.0 / seas_scale**2)
@@ -153,21 +172,28 @@ def fit(y, mask, day, config: CurveModelConfig, prior_scales=None) -> CurveParam
     """
     t0 = day[0].astype(jnp.float32)
     t1 = day[-1].astype(jnp.float32)
-    z = _fit_space(y, mask, config.seasonality_mode)
-    # normalize per series for conditioning (Prophet divides by max |y|)
-    if config.seasonality_mode == "multiplicative":
+    if config.growth == "logistic":
+        cap = config.cap_multiplier * jnp.maximum(
+            jnp.max(y * mask, axis=1), _LOG_EPS
+        )
+        z = _fit_space(y, mask, config.seasonality_mode, cap=cap)
         y_scale = jnp.ones((y.shape[0],))
     else:
-        y_scale = jnp.maximum(
-            jnp.max(jnp.abs(z) * mask, axis=1), 1.0
-        )
+        cap = jnp.ones((y.shape[0],))
+        z = _fit_space(y, mask, config.seasonality_mode)
+        # normalize per series for conditioning (Prophet divides by max |y|)
+        if config.seasonality_mode == "multiplicative":
+            y_scale = jnp.ones((y.shape[0],))
+        else:
+            y_scale = jnp.maximum(jnp.max(jnp.abs(z) * mask, axis=1), 1.0)
     zn = z / y_scale[:, None]
     X, layout = _design(day, t0, t1, config)
     cp_s, seas_s = (None, None) if prior_scales is None else prior_scales
     lam = _prior_precision(layout, config, cp_s, seas_s)
     beta = ridge_solve_batch(X, zn, mask, lam)
     sigma = weighted_residual_scale(X, zn, mask, beta)
-    return CurveParams(beta=beta, sigma=sigma, y_scale=y_scale, t0=t0, t1=t1)
+    return CurveParams(beta=beta, sigma=sigma, y_scale=y_scale, cap=cap,
+                       t0=t0, t1=t1)
 
 
 _FUTURE_CP_GRID = 25  # static count of candidate future changepoint sites
@@ -260,7 +286,10 @@ def forecast(
         lo = zhat - z * sd
         hi = zhat + z * sd
 
-    if config.seasonality_mode == "multiplicative":
+    if config.growth == "logistic":
+        sig = lambda v: params.cap[:, None] * jax.nn.sigmoid(v)
+        yhat, lo, hi = sig(zhat), sig(lo), sig(hi)
+    elif config.seasonality_mode == "multiplicative":
         yhat, lo, hi = jnp.exp(zhat), jnp.exp(lo), jnp.exp(hi)
     else:
         yhat = zhat

@@ -1,0 +1,51 @@
+import numpy as np
+import pandas as pd
+
+import jax.numpy as jnp
+
+from distributed_forecasting_tpu.data import tensorize
+from distributed_forecasting_tpu.models import prophet_glm as P
+
+
+def _saturating_batch():
+    T = 700
+    t = np.arange(T)
+    sat = 100 / (1 + np.exp(-(t - 250) / 60))
+    y = sat * (1 + 0.1 * np.sin(2 * np.pi * t / 7))
+    y = y + np.random.default_rng(0).normal(0, 1, T)
+    df = pd.DataFrame(
+        {"date": pd.date_range("2020-01-01", periods=T), "store": 1, "item": 1,
+         "sales": np.maximum(y, 0.1)}
+    )
+    return tensorize(df)
+
+
+def _forecast(b, growth, horizon=180):
+    cfg = P.CurveModelConfig(growth=growth, seasonality_mode="additive",
+                             yearly_order=0)
+    p = P.fit(b.y, b.mask, b.day, cfg)
+    day_all = jnp.arange(int(b.day[0]), int(b.day[-1]) + horizon + 1,
+                         dtype=jnp.int32)
+    yh, lo, hi = P.forecast(p, day_all, b.day[-1].astype(jnp.float32), cfg)
+    return np.asarray(yh)[0]
+
+
+def test_logistic_growth_saturates():
+    b = _saturating_batch()
+    lin = _forecast(b, "linear")
+    log = _forecast(b, "logistic")
+    # linear keeps climbing; logistic respects the data-derived cap (~110)
+    assert lin[-30:].mean() > 135
+    assert log[-30:].mean() < 125
+    # forecasts never exceed the data-derived cap = cap_multiplier * max(y)
+    y_max = float(np.asarray(b.y).max())
+    assert log.max() <= 1.1 * y_max * 1.001
+
+
+def test_flat_growth_has_no_trend():
+    b = _saturating_batch()
+    flat = _forecast(b, "flat", horizon=400)
+    # far-future forecasts stay level (no linear escape)
+    early_future = flat[700:730].mean()
+    late_future = flat[-30:].mean()
+    assert abs(late_future - early_future) < 12
