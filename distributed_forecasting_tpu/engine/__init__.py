@@ -1,6 +1,7 @@
 from distributed_forecasting_tpu.engine.fit import (
     ForecastResult,
     fit_forecast,
+    fit_forecast_chunked,
     forecast_frame,
     seasonal_naive,
 )
@@ -17,6 +18,7 @@ __all__ = [
     "tune_curve_model",
     "ForecastResult",
     "fit_forecast",
+    "fit_forecast_chunked",
     "forecast_frame",
     "seasonal_naive",
     "CVConfig",

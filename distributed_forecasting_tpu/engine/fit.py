@@ -100,6 +100,66 @@ def fit_forecast(
     return params, ForecastResult(yhat=yhat, lo=lo, hi=hi, ok=ok, day_all=day_all)
 
 
+def fit_forecast_chunked(
+    batch: SeriesBatch,
+    model: str = "prophet",
+    config=None,
+    horizon: int = 90,
+    key: Optional[jax.Array] = None,
+    chunk_size: int = 4096,
+    min_points: int = 14,
+) -> Tuple[object, ForecastResult]:
+    """Memory-bounded fit for very large batches (the 50k-series regime).
+
+    Splits the series axis into equal ``chunk_size`` blocks (last block
+    padded), so HBM holds one block's intermediates at a time and every
+    chunk reuses the SAME compiled executable — the series-count analogue of
+    the reference scaling executors, without recompiles.  Params come back
+    concatenated along axis 0.
+    """
+    S = batch.n_series
+    if S <= chunk_size:
+        return fit_forecast(
+            batch, model=model, config=config, horizon=horizon, key=key,
+            min_points=min_points,
+        )
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    n_chunks = -(-S // chunk_size)
+    padded = batch.pad_series_to(n_chunks * chunk_size)
+    params_list, yhat, lo, hi, ok = [], [], [], [], []
+    for c in range(n_chunks):
+        sl = slice(c * chunk_size, (c + 1) * chunk_size)
+        sub = dataclasses.replace(
+            padded,
+            y=padded.y[sl], mask=padded.mask[sl], keys=padded.keys[sl],
+        )
+        p, r = fit_forecast(
+            sub, model=model, config=config, horizon=horizon,
+            key=jax.random.fold_in(key, c), min_points=min_points,
+        )
+        params_list.append(p)
+        yhat.append(r.yhat)
+        lo.append(r.lo)
+        hi.append(r.hi)
+        ok.append(r.ok)
+        day_all = r.day_all
+    params = jax.tree_util.tree_map(
+        lambda *xs: jnp.concatenate(xs, axis=0)[:S]
+        if getattr(xs[0], "ndim", 0) > 0 and xs[0].shape[:1] == (chunk_size,)
+        else xs[0],
+        *params_list,
+    )
+    result = ForecastResult(
+        yhat=jnp.concatenate(yhat)[:S],
+        lo=jnp.concatenate(lo)[:S],
+        hi=jnp.concatenate(hi)[:S],
+        ok=jnp.concatenate(ok)[:S],
+        day_all=day_all,
+    )
+    return params, result
+
+
 def forecast_frame(
     batch: SeriesBatch,
     result: ForecastResult,

@@ -1,0 +1,65 @@
+"""Multi-host input sharding (the 50k-series, BASELINE #4 regime).
+
+At pod-slice scale every host feeds only its own shard of the series axis
+over DCN (SURVEY.md §2.4 backend row: DCN carries input loading only, never
+fit traffic — fits are independent).  The contract: deterministic,
+coordination-free assignment of series to hosts, so each host can tensorize
+its local shard without ever materializing the global batch.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+import pandas as pd
+
+
+def series_owner(
+    keys: np.ndarray, num_hosts: int
+) -> np.ndarray:
+    """Owner host of each (store, item) series — stable hash, no coordination.
+
+    Uses a Fibonacci-style multiplicative hash of the key pair so
+    reassignment is uniform regardless of id ranges.
+    """
+    keys = np.asarray(keys, dtype=np.uint64)
+    h = keys[:, 0] * np.uint64(0x9E3779B97F4A7C15)
+    for j in range(1, keys.shape[1]):
+        h ^= keys[:, j] * np.uint64(0xC2B2AE3D27D4EB4F)
+        h = (h << np.uint64(31)) | (h >> np.uint64(33))
+    return (h % np.uint64(num_hosts)).astype(np.int64)
+
+
+def host_local_frame(
+    df: pd.DataFrame,
+    process_index: Optional[int] = None,
+    process_count: Optional[int] = None,
+    key_cols: Sequence[str] = ("store", "item"),
+) -> pd.DataFrame:
+    """Rows of the long table whose series belong to this host.
+
+    Defaults to ``jax.process_index()/process_count()`` so the same code
+    runs single-host (identity) and multi-host (1/N of the series).
+    """
+    if process_index is None or process_count is None:
+        import jax
+
+        process_index = jax.process_index()
+        process_count = jax.process_count()
+    if process_count <= 1:
+        return df
+    keys = df[list(key_cols)].to_numpy()
+    owner = series_owner(keys, process_count)
+    return df[owner == process_index].reset_index(drop=True)
+
+
+def host_shard_summary(
+    df: pd.DataFrame, process_count: int,
+    key_cols: Sequence[str] = ("store", "item"),
+) -> Tuple[np.ndarray, float]:
+    """(series per host, imbalance ratio max/mean) — for capacity checks."""
+    uniq = df[list(key_cols)].drop_duplicates().to_numpy()
+    owner = series_owner(uniq, process_count)
+    counts = np.bincount(owner, minlength=process_count)
+    return counts, float(counts.max() / max(counts.mean(), 1e-9))

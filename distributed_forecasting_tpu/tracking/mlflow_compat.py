@@ -1,0 +1,157 @@
+"""Optional MLflow-backed tracker — same surface as FileTracker.
+
+SURVEY.md §2.2 recommends keeping MLflow as an *optional* client behind the
+tracking interface (it is pure-Python and file/sqlite-backed in the
+reference's own unit fixture, reference ``tests/unit/conftest.py:56-62``).
+mlflow is not part of this runtime image, so the adapter degrades to a clear
+ImportError and the factory falls back to the file store; when mlflow IS
+installed, runs/params/metrics/artifacts land in a real MLflow tracking
+store, interoperable with the reference's tooling.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Dict, Optional
+
+from distributed_forecasting_tpu.tracking.filestore import FileTracker
+
+
+def mlflow_available() -> bool:
+    try:
+        import mlflow  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+def get_tracker(root: str, kind: str = "auto"):
+    """Factory: 'file', 'mlflow', or 'auto' (mlflow when importable)."""
+    if kind == "file":
+        return FileTracker(root)
+    if kind == "mlflow" or (kind == "auto" and mlflow_available()):
+        return MlflowTracker(root)
+    if kind == "auto":
+        return FileTracker(root)
+    raise ValueError(f"unknown tracker kind {kind!r}")
+
+
+class MlflowTracker:
+    """FileTracker-compatible adapter over the MLflow client API."""
+
+    def __init__(self, root: str):
+        try:
+            import mlflow
+        except ImportError as e:
+            raise ImportError(
+                "MlflowTracker requires the optional 'mlflow' package; "
+                "install it or use FileTracker (tracking kind 'file')"
+            ) from e
+        self._mlflow = mlflow
+        uri = root if "://" in root else f"file://{os.path.abspath(root)}"
+        self._client = mlflow.tracking.MlflowClient(tracking_uri=uri)
+
+    # -- experiments --------------------------------------------------------
+    def create_experiment(self, name: str) -> str:
+        existing = self._client.get_experiment_by_name(name)
+        if existing is not None:
+            return existing.experiment_id
+        return self._client.create_experiment(name)
+
+    def get_experiment_by_name(self, name: str) -> Optional[str]:
+        exp = self._client.get_experiment_by_name(name)
+        return None if exp is None else exp.experiment_id
+
+    # -- runs ---------------------------------------------------------------
+    def start_run(self, experiment_id: str, run_name: Optional[str] = None,
+                  tags: Optional[Dict[str, str]] = None):
+        run = self._client.create_run(
+            experiment_id, run_name=run_name,
+            tags={k: str(v) for k, v in (tags or {}).items()},
+        )
+        return _MlflowRun(self._client, experiment_id, run.info.run_id)
+
+    def get_run(self, experiment_id: str, run_id: str):
+        self._client.get_run(run_id)  # raises if missing
+        return _MlflowRun(self._client, experiment_id, run_id)
+
+    def search_runs(self, experiment_id: str, run_name: Optional[str] = None,
+                    tags: Optional[Dict[str, str]] = None):
+        clauses = []
+        if run_name is not None:
+            clauses.append(f"attributes.run_name = '{run_name}'")
+        for k, v in (tags or {}).items():
+            clauses.append(f"tags.`{k}` = '{v}'")
+        runs = self._client.search_runs(
+            [experiment_id], filter_string=" and ".join(clauses)
+        )
+        return [
+            _MlflowRun(self._client, experiment_id, r.info.run_id) for r in runs
+        ]
+
+
+class _MlflowRun:
+    def __init__(self, client, experiment_id: str, run_id: str):
+        self._client = client
+        self.experiment_id = experiment_id
+        self.run_id = run_id
+
+    def log_params(self, params: Dict) -> None:
+        for k, v in params.items():
+            self._client.log_param(self.run_id, k, v)
+
+    def log_metrics(self, metrics: Dict[str, float], step: int = 0) -> None:
+        for k, v in metrics.items():
+            self._client.log_metric(self.run_id, k, float(v), step=step)
+
+    def set_tags(self, tags: Dict[str, str]) -> None:
+        for k, v in tags.items():
+            self._client.set_tag(self.run_id, k, str(v))
+
+    def log_artifact(self, local_path: str, name: Optional[str] = None) -> str:
+        self._client.log_artifact(self.run_id, local_path)
+        return local_path
+
+    def log_artifact_bytes(self, name: str, data: bytes) -> str:
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, os.path.basename(name))
+            with open(p, "wb") as f:
+                f.write(data)
+            self._client.log_artifact(self.run_id, p)
+        return name
+
+    def log_table(self, name: str, df) -> str:
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, name)
+            df.to_parquet(p, index=False)
+            self._client.log_artifact(self.run_id, p)
+        return name
+
+    def artifact_path(self, name: str) -> str:
+        return self._client.download_artifacts(self.run_id, name)
+
+    def params(self) -> Dict:
+        return dict(self._client.get_run(self.run_id).data.params)
+
+    def metrics(self) -> Dict[str, float]:
+        return dict(self._client.get_run(self.run_id).data.metrics)
+
+    def meta(self) -> Dict:
+        info = self._client.get_run(self.run_id)
+        return {
+            "run_id": self.run_id,
+            "run_name": info.info.run_name,
+            "status": info.info.status,
+            "tags": dict(info.data.tags),
+        }
+
+    def end(self, status: str = "FINISHED") -> None:
+        self._client.set_terminated(self.run_id, status=status)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.end("FAILED" if exc_type else "FINISHED")
