@@ -1,0 +1,115 @@
+"""Forecast visualization — plot parity with the reference's AutoML cells.
+
+The reference AutoML notebook renders the fitted Prophet forecast with
+changepoints overlaid (``notebooks/automl/22-09-26...py:231-253``).  These
+helpers do the same from this framework's artifacts: history + forecast with
+interval band, learned changepoint magnitudes, and decomposed components
+(trend / weekly / yearly) recovered from the curve model's linear basis.
+
+matplotlib is imported lazily (headless 'Agg' backend) so the library never
+requires a display and the dependency stays optional.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+def _plt():
+    import matplotlib
+
+    matplotlib.use("Agg", force=False)
+    import matplotlib.pyplot as plt
+
+    return plt
+
+
+def plot_forecast(
+    batch,
+    result,
+    series_index: int = 0,
+    ax=None,
+    title: Optional[str] = None,
+):
+    """History points + forecast line with the interval band (one series)."""
+    plt = _plt()
+    if ax is None:
+        _, ax = plt.subplots(figsize=(10, 4))
+    import pandas as pd
+
+    dates = pd.to_datetime(np.asarray(result.day_all, "int64"), unit="D")
+    T_hist = batch.n_time
+    y = np.asarray(batch.y[series_index])
+    m = np.asarray(batch.mask[series_index]) > 0
+    ax.plot(batch.dates()[m], y[m], "k.", ms=2, label="observed")
+    ax.plot(dates, np.asarray(result.yhat[series_index]), lw=1.2, label="yhat")
+    ax.fill_between(
+        dates,
+        np.asarray(result.lo[series_index]),
+        np.asarray(result.hi[series_index]),
+        alpha=0.25, linewidth=0, label="interval",
+    )
+    ax.axvline(batch.dates()[T_hist - 1], ls="--", lw=0.8, color="grey")
+    keys = dict(zip(batch.key_names, batch.keys[series_index]))
+    ax.set_title(title or f"forecast {keys}")
+    ax.legend(loc="best", fontsize=8)
+    return ax
+
+
+def plot_changepoints(params, config, series_index: int = 0, ax=None):
+    """Learned changepoint slope deltas over the changepoint grid — the
+    reference's changepoint overlay, shown as the model actually stores it."""
+    plt = _plt()
+    if ax is None:
+        _, ax = plt.subplots(figsize=(8, 3))
+    deltas = np.asarray(
+        params.beta[series_index, 2 : 2 + config.n_changepoints]
+    )
+    grid = np.arange(1, config.n_changepoints + 1) / (config.n_changepoints + 1)
+    grid = grid * config.changepoint_range
+    ax.bar(grid, deltas, width=0.8 / (config.n_changepoints + 1))
+    ax.set_xlabel("scaled time of changepoint")
+    ax.set_ylabel("slope delta")
+    ax.set_title("changepoint magnitudes")
+    return ax
+
+
+def plot_components(params, config, day_all, series_index: int = 0):
+    """Trend / weekly / yearly decomposition from the linear basis (the
+    Prophet components plot equivalent).  Returns the figure."""
+    import jax.numpy as jnp
+
+    from distributed_forecasting_tpu.models.prophet_glm import _design
+
+    plt = _plt()
+    X, layout = _design(
+        jnp.asarray(day_all, dtype=jnp.int32), params.t0, params.t1, config
+    )
+    X = np.asarray(X)
+    beta = np.asarray(params.beta[series_index])
+    import pandas as pd
+
+    dates = pd.to_datetime(np.asarray(day_all, "int64"), unit="D")
+
+    comps = {}
+    trend_cols = list(range(2 + config.n_changepoints))
+    comps["trend"] = X[:, trend_cols] @ beta[trend_cols]
+    for name in ("weekly", "yearly", "holidays"):
+        sl = layout.get(name)
+        if sl is not None and (sl.stop - sl.start) > 0:
+            comps[name] = X[:, sl] @ beta[sl]
+
+    fig, axes = plt.subplots(len(comps), 1, figsize=(9, 2.2 * len(comps)),
+                             sharex=True)
+    if len(comps) == 1:
+        axes = [axes]
+    for ax, (name, vals) in zip(axes, comps.items()):
+        if name == "weekly":
+            ax.plot(dates[:15], vals[:15])  # two weeks is enough to read
+        else:
+            ax.plot(dates, vals)
+        ax.set_ylabel(name)
+    fig.tight_layout()
+    return fig

@@ -1,0 +1,46 @@
+import numpy as np
+
+from distributed_forecasting_tpu.data import eda
+
+
+def test_dataset_stats(sales_df_small):
+    s = eda.dataset_stats(sales_df_small)
+    assert s["n_stores"] == 2
+    assert s["n_items"] == 5
+    assert s["n_series"] == 10
+    assert s["expected_models"] == 10
+    assert s["days"] == 1096
+    assert s["rows"] == len(sales_df_small)
+
+
+def test_trends(sales_df_small):
+    yr = eda.yearly_trend(sales_df_small)
+    assert set(yr.columns) == {"year", "sales"}
+    assert len(yr) == 4  # 2013..2016 (3 years + 1 day)
+    mo = eda.monthly_trend(sales_df_small)
+    assert len(mo) == 37
+    wd = eda.weekday_trend(sales_df_small)
+    assert set(wd.weekday.unique()) == set(range(7))
+    assert "mean_daily_sales" in wd.columns
+    # totals preserved
+    np.testing.assert_allclose(yr.sales.sum(), sales_df_small.sales.sum(),
+                               rtol=1e-9)
+
+
+def test_plots_render(batch_small):
+    import matplotlib
+
+    matplotlib.use("Agg")
+    from distributed_forecasting_tpu.engine import fit_forecast
+    from distributed_forecasting_tpu.models.prophet_glm import CurveModelConfig
+    from distributed_forecasting_tpu import visualization as viz
+
+    cfg = CurveModelConfig()
+    params, res = fit_forecast(batch_small, model="prophet", config=cfg,
+                               horizon=30)
+    ax = viz.plot_forecast(batch_small, res, series_index=1)
+    assert ax.get_title()
+    ax2 = viz.plot_changepoints(params, cfg)
+    assert ax2.patches  # bars drawn
+    fig = viz.plot_components(params, cfg, np.asarray(res.day_all))
+    assert len(fig.axes) >= 3  # trend + weekly + yearly
