@@ -1,0 +1,27 @@
+"""Step 1 — bootstrap the dataset catalog (the Unity-Catalog-equivalent).
+
+Mirrors the reference's ``notebooks/prophet/01_unity_catalog.py`` flow:
+create catalog + schema, apply grants, show what exists.
+
+Run: python examples/01_catalog_setup.py [--root ./dftpu_store]
+"""
+
+import argparse
+
+from distributed_forecasting_tpu.tasks import CatalogTask
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--root", default="./dftpu_store")
+    args = p.parse_args()
+
+    task = CatalogTask(
+        init_conf={
+            "env": {"root": args.root},
+            "output": {"catalog_name": "hackathon", "schema_name": "sales"},
+        }
+    )
+    task.launch()
+    print("catalogs:", task.catalog.catalogs())
+    print("schemas:", task.catalog.schemas("hackathon"))
+    print("grants:", task.catalog.grants("hackathon"))

@@ -1,0 +1,27 @@
+"""Step 3 — register the batched forecaster (``03_deploy.py`` equivalent).
+
+Run: python examples/03_deploy.py [--root ./dftpu_store]
+"""
+
+import argparse
+
+from distributed_forecasting_tpu.tasks import DeployTask
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--root", default="./dftpu_store")
+    args = p.parse_args()
+
+    task = DeployTask(
+        init_conf={
+            "env": {"root": args.root},
+            "deploy": {
+                "experiment": "finegrain_forecasting",
+                "model_name": "ForecastingBatchModel",
+                "tags": {"reviewed": "false"},
+            },
+        }
+    )
+    out = task.launch()
+    v = task.registry.get_version(out["model_name"], out["version"])
+    print(f"registered {v.name} v{v.version}; tags: {v.tags}")

@@ -1,0 +1,33 @@
+"""Step 4 — distributed batched inference (``04_inference.py`` equivalent).
+
+Loads the registered model ONCE and forecasts every requested (store, item)
+in one compiled call — no per-group model downloads, no sleep throttle.
+
+Run: python examples/04_inference.py [--root ./dftpu_store]
+"""
+
+import argparse
+
+from distributed_forecasting_tpu.tasks import InferenceTask
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--root", default="./dftpu_store")
+    args = p.parse_args()
+
+    task = InferenceTask(
+        init_conf={
+            "env": {"root": args.root},
+            "input": {"table": "hackathon.sales.raw"},
+            "output": {"table": "hackathon.sales.test_finegrain_forecasts"},
+            "inference": {
+                "model_name": "ForecastingBatchModel",
+                "horizon": 90,
+                "promote_to": "Staging",
+            },
+        }
+    )
+    out = task.launch()
+    print("inference:", out)
+    fc = task.catalog.read_table("hackathon.sales.test_finegrain_forecasts")
+    print(fc.head().to_string(index=False))

@@ -1,0 +1,32 @@
+"""Step 5 — model monitoring (the working ``05_monitoring_wip.py``).
+
+Run: python examples/05_monitoring.py [--root ./dftpu_store]
+"""
+
+import argparse
+
+from distributed_forecasting_tpu.tasks.monitor import MonitorTask
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--root", default="./dftpu_store")
+    args = p.parse_args()
+
+    task = MonitorTask(
+        init_conf={
+            "env": {"root": args.root},
+            "monitor": {
+                "name": "finegrain",
+                "table": "hackathon.sales.finegrain_forecasts",
+                "granularities": ["1 day", "1 week"],
+                "slicing_cols": ["store", "item"],
+            },
+        }
+    )
+    out = task.launch()
+    print("monitor:", out)
+    profile = task.catalog.read_table(
+        "hackathon.sales.finegrain_forecasts_profile_metrics"
+    )
+    overall = profile[profile.slice_key == ":all"]
+    print(overall.tail(8).to_string(index=False))
