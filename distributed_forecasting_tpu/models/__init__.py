@@ -1,8 +1,14 @@
 from distributed_forecasting_tpu.models.base import MODEL_REGISTRY, register_model
-from distributed_forecasting_tpu.models import prophet_glm, holt_winters, arima  # noqa: F401 (registration)
+from distributed_forecasting_tpu.models import (  # noqa: F401 (registration)
+    arima,
+    croston,
+    holt_winters,
+    prophet_glm,
+)
 from distributed_forecasting_tpu.models.prophet_glm import CurveModelConfig
 from distributed_forecasting_tpu.models.holt_winters import HoltWintersConfig
 from distributed_forecasting_tpu.models.arima import ArimaConfig
+from distributed_forecasting_tpu.models.croston import CrostonConfig
 
 __all__ = [
     "MODEL_REGISTRY",
@@ -10,4 +16,5 @@ __all__ = [
     "CurveModelConfig",
     "HoltWintersConfig",
     "ArimaConfig",
+    "CrostonConfig",
 ]

@@ -6,8 +6,10 @@ from distributed_forecasting_tpu.tasks.deploy import DeployTask
 from distributed_forecasting_tpu.tasks.inference import InferenceTask
 from distributed_forecasting_tpu.tasks.sample_ml import SampleMLTask
 from distributed_forecasting_tpu.tasks.monitor import MonitorTask
+from distributed_forecasting_tpu.tasks.reconcile import ReconcileTask
 
 TASK_TYPES = {
+    "reconcile": ReconcileTask,
     "catalog": CatalogTask,
     "ingest": IngestTask,
     "train": TrainTask,
@@ -26,5 +28,6 @@ __all__ = [
     "InferenceTask",
     "SampleMLTask",
     "MonitorTask",
+    "ReconcileTask",
     "TASK_TYPES",
 ]

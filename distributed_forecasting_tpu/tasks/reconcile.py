@@ -1,0 +1,100 @@
+"""Hierarchical reconciliation task (BASELINE config #5 as a job).
+
+Takes the fine-grained forecast table (bottom level), builds the store x
+item hierarchy, and writes coherent forecasts at every level — total, per
+store, per item, per (store, item) — using bottom-up aggregation or top-down
+allocation by historical proportions (the reference's allocation method,
+``notebooks/prophet/02_training.py:237-247``, generalized).  MinT-WLS is
+available through the library API when callers supply base forecasts at
+every level (``reconcile.reconcile_forecasts``).
+
+Conf::
+
+    input:
+      table: hackathon.sales.finegrain_forecasts
+      history_table: hackathon.sales.raw    # for top-down proportions
+    output:
+      table: hackathon.sales.reconciled_forecasts
+    reconcile:
+      method: bottom_up                     # or top_down
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+
+import jax.numpy as jnp
+
+from distributed_forecasting_tpu.reconcile import Hierarchy, aggregate_bottom_up
+from distributed_forecasting_tpu.reconcile.hierarchy import top_down_allocate
+from distributed_forecasting_tpu.tasks.common import Task
+
+
+class ReconcileTask(Task):
+    def launch(self) -> dict:
+        inp = self.conf.get("input", {})
+        out = self.conf.get("output", {})
+        rc = self.conf.get("reconcile", {})
+        method = rc.get("method", "bottom_up")
+
+        fc = self.catalog.read_table(
+            inp.get("table", "hackathon.sales.finegrain_forecasts")
+        )
+        fut = fc[fc["y"].isna()] if "y" in fc.columns else fc
+        if fut.empty:
+            fut = fc
+        pivot = fut.pivot_table(
+            index=["store", "item"], columns="ds", values="yhat", aggfunc="mean"
+        ).sort_index()
+        keys = np.asarray(list(pivot.index), dtype=np.int64)
+        bottom = jnp.asarray(pivot.to_numpy(dtype=np.float32))
+        h = Hierarchy.from_keys(keys)
+
+        if method == "bottom_up":
+            all_levels = aggregate_bottom_up(h, bottom)
+        elif method == "top_down":
+            hist = self.catalog.read_table(
+                inp.get("history_table", "hackathon.sales.raw")
+            )
+            totals = hist.groupby(["store", "item"])["sales"].sum()
+            props = jnp.asarray(
+                [totals.get((int(s), int(i)), 0.0) for s, i in keys],
+                dtype=jnp.float32,
+            )
+            total_fc = jnp.sum(bottom, axis=0)
+            all_levels = top_down_allocate(h, total_fc, props)
+        else:
+            raise ValueError(f"unknown reconcile method {method!r}")
+
+        labels = h.node_labels()
+        dates = list(pivot.columns)
+        vals = np.asarray(all_levels)
+        table = pd.DataFrame(
+            {
+                "ds": np.tile(np.asarray(dates), len(labels)),
+                "node": np.repeat(labels, len(dates)),
+                "yhat": vals.reshape(-1),
+                "method": method,
+            }
+        )
+        name = out.get("table", "hackathon.sales.reconciled_forecasts")
+        version = self.catalog.save_table(name, table)
+        self.logger.info(
+            "reconciled (%s): %d nodes x %d days -> %s v%s",
+            method, len(labels), len(dates), name, version,
+        )
+        return {
+            "method": method,
+            "n_nodes": len(labels),
+            "n_days": len(dates),
+            "table_version": version,
+        }
+
+
+def entrypoint():
+    ReconcileTask().launch()
+
+
+if __name__ == "__main__":
+    entrypoint()

@@ -1,0 +1,70 @@
+import numpy as np
+import pandas as pd
+import pytest
+
+import jax.numpy as jnp
+
+from distributed_forecasting_tpu.data import tensorize
+from distributed_forecasting_tpu.engine import fit_forecast
+from distributed_forecasting_tpu.models import CrostonConfig
+from distributed_forecasting_tpu.models import croston as C
+
+
+@pytest.fixture(scope="module")
+def intermittent_batch():
+    rng = np.random.default_rng(0)
+    T = 600
+    rows = []
+    for item, (p_demand, mean_size) in enumerate(
+        [(0.2, 10.0), (0.05, 40.0), (0.5, 4.0)], start=1
+    ):
+        occur = rng.random(T) < p_demand
+        size = rng.lognormal(np.log(mean_size), 0.2, T)
+        y = np.where(occur, size, 0.0)
+        rows.append(
+            pd.DataFrame(
+                {"date": pd.date_range("2020-01-01", periods=T), "store": 1,
+                 "item": item, "sales": y}
+            )
+        )
+    return tensorize(pd.concat(rows, ignore_index=True)), [
+        (0.2, 10.0), (0.05, 40.0), (0.5, 4.0)
+    ]
+
+
+def test_croston_recovers_demand_rate(intermittent_batch):
+    batch, specs = intermittent_batch
+    cfg = CrostonConfig(variant="croston", alpha=0.1)
+    params = C.fit(batch.y, batch.mask, batch.day, cfg)
+    day_all = jnp.arange(int(batch.day[-1]) + 1, int(batch.day[-1]) + 29,
+                         dtype=jnp.int32)
+    yhat, lo, hi = C.forecast(params, day_all, batch.day[-1].astype(jnp.float32),
+                              cfg)
+    for s, (p, m) in enumerate(specs):
+        true_rate = p * m * np.exp(0.5 * 0.2**2)
+        est = float(yhat[s, 0])
+        assert abs(est - true_rate) / true_rate < 0.35, (s, est, true_rate)
+        # forecast is flat
+        np.testing.assert_allclose(np.asarray(yhat[s]), est, rtol=1e-6)
+
+
+def test_sba_bias_correction_smaller(intermittent_batch):
+    batch, _ = intermittent_batch
+    p_c = C.fit(batch.y, batch.mask, batch.day, CrostonConfig(variant="croston"))
+    p_s = C.fit(batch.y, batch.mask, batch.day, CrostonConfig(variant="sba"))
+    day_all = jnp.asarray([int(batch.day[-1]) + 1], dtype=jnp.int32)
+    t_end = batch.day[-1].astype(jnp.float32)
+    y_c, *_ = C.forecast(p_c, day_all, t_end, CrostonConfig(variant="croston"))
+    y_s, *_ = C.forecast(p_s, day_all, t_end, CrostonConfig(variant="sba"))
+    assert np.all(np.asarray(y_s) < np.asarray(y_c))
+    np.testing.assert_allclose(
+        np.asarray(y_s), np.asarray(y_c) * (1 - 0.1 / 2), rtol=1e-5
+    )
+
+
+def test_croston_through_engine(intermittent_batch):
+    batch, _ = intermittent_batch
+    params, res = fit_forecast(batch, model="croston", horizon=28)
+    assert bool(res.ok.all())
+    assert np.isfinite(np.asarray(res.yhat)).all()
+    assert (np.asarray(res.lo) >= 0).all()  # demand can't go negative

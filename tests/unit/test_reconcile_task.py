@@ -1,0 +1,72 @@
+import numpy as np
+import pytest
+
+from distributed_forecasting_tpu.tasks import IngestTask, ReconcileTask, TrainTask
+
+
+@pytest.fixture()
+def trained_store(tmp_path):
+    env = {
+        "env": {
+            "warehouse": str(tmp_path / "wh"),
+            "tracking": str(tmp_path / "runs"),
+            "registry": str(tmp_path / "reg"),
+        }
+    }
+    IngestTask(
+        init_conf={
+            **env,
+            "input": {"synthetic": {"n_stores": 2, "n_items": 3, "n_days": 500,
+                                    "seed": 5}},
+            "output": {"table": "hackathon.sales.raw"},
+        }
+    ).launch()
+    TrainTask(
+        init_conf={
+            **env,
+            "input": {"table": "hackathon.sales.raw"},
+            "output": {"table": "hackathon.sales.finegrain_forecasts"},
+            "training": {"model": "holt_winters", "run_cross_validation": False,
+                         "horizon": 14},
+        }
+    ).launch()
+    return env
+
+
+def test_reconcile_bottom_up(trained_store):
+    task = ReconcileTask(
+        init_conf={
+            **trained_store,
+            "input": {"table": "hackathon.sales.finegrain_forecasts"},
+            "output": {"table": "hackathon.sales.reconciled_forecasts"},
+            "reconcile": {"method": "bottom_up"},
+        }
+    )
+    out = task.launch()
+    assert out["n_nodes"] == 1 + 2 + 3 + 6
+    assert out["n_days"] == 14
+    table = task.catalog.read_table("hackathon.sales.reconciled_forecasts")
+    # coherence: total row equals the sum of bottom rows per day
+    one_day = table[table.ds == table.ds.min()]
+    total = float(one_day[one_day.node == "total"].yhat.iloc[0])
+    bottom = one_day[one_day.node.str.contains("store_.*_item_")].yhat.sum()
+    np.testing.assert_allclose(total, bottom, rtol=1e-4)
+
+
+def test_reconcile_top_down(trained_store):
+    task = ReconcileTask(
+        init_conf={
+            **trained_store,
+            "input": {"table": "hackathon.sales.finegrain_forecasts",
+                      "history_table": "hackathon.sales.raw"},
+            "output": {"table": "hackathon.sales.reconciled_td"},
+            "reconcile": {"method": "top_down"},
+        }
+    )
+    out = task.launch()
+    table = task.catalog.read_table("hackathon.sales.reconciled_td")
+    one_day = table[table.ds == table.ds.min()]
+    total = float(one_day[one_day.node == "total"].yhat.iloc[0])
+    bottom = one_day[one_day.node.str.contains("store_.*_item_")].yhat.sum()
+    np.testing.assert_allclose(total, bottom, rtol=1e-4)
+    assert out["method"] == "top_down"
