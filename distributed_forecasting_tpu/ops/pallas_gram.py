@@ -39,15 +39,14 @@ def _gram_kernel(x_ref, w_ref, wy_ref, g_ref, b_ref):
     # all moment vectors of the tile in one MXU matmul
     b_ref[:] = jnp.dot(WY, X, preferred_element_type=jnp.float32)
 
-    def body(i, _):
+    # Static unroll over the (small) series tile: Mosaic cannot lower
+    # dynamic_slice on values/refs, so traced loop indices are out.
+    for i in range(W.shape[0]):
         Xw = X * W[i][:, None]  # (T, Fp) VPU broadcast-multiply
         g_ref[i] = jax.lax.dot_general(
             Xw, X, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        return 0
-
-    jax.lax.fori_loop(0, W.shape[0], body, 0)
 
 
 @functools.partial(jax.jit, static_argnames=("block_series", "interpret"))

@@ -26,6 +26,16 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# The ambient environment may have imported jax already (e.g. a sitecustomize
+# hook that registers an accelerator PJRT plugin at interpreter start), in
+# which case the env var above is read too late — force the platform through
+# the live config as well.  XLA_FLAGS is still honored because the CPU client
+# is only created on first device use, which happens after this point.
+if os.environ.get("DFTPU_TEST_PLATFORM", "cpu") == "cpu":
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 
