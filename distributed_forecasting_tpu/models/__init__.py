@@ -4,11 +4,13 @@ from distributed_forecasting_tpu.models import (  # noqa: F401 (registration)
     croston,
     holt_winters,
     prophet_glm,
+    theta,
 )
 from distributed_forecasting_tpu.models.prophet_glm import CurveModelConfig
 from distributed_forecasting_tpu.models.holt_winters import HoltWintersConfig
 from distributed_forecasting_tpu.models.arima import ArimaConfig
 from distributed_forecasting_tpu.models.croston import CrostonConfig
+from distributed_forecasting_tpu.models.theta import ThetaConfig
 
 __all__ = [
     "MODEL_REGISTRY",
@@ -17,4 +19,5 @@ __all__ = [
     "HoltWintersConfig",
     "ArimaConfig",
     "CrostonConfig",
+    "ThetaConfig",
 ]

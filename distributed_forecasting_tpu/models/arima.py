@@ -45,6 +45,11 @@ class ArimaConfig:
     interval_width: float = 0.95
     fit_steps: int = 200
     learning_rate: float = 0.05
+    # Gaussian prior on the unconstrained (atanh-PACF) parameters: keeps MAP
+    # solutions off the |pacf|->1 stationarity boundary, where predict-only
+    # propagation decays so slowly that integrated d=1 forecasts can wander
+    # thousands of sigma before settling (observed under vmapped CV fits)
+    prior_scale: float = 1.0
 
 
 @jax.tree_util.register_dataclass
@@ -56,8 +61,14 @@ class ArimaParams:
     mean: jax.Array       # (S,) mean of the differenced series
     a_last: jax.Array     # (S, r) final filtered state
     P_last: jax.Array     # (S, r, r) final state covariance
-    y_last: jax.Array     # (S,) last observed level (for integration, d=1)
+    level_end: jax.Array  # (S,) level at the fit-grid end: last observed y,
+                          # or the carried-forward predicted level if the
+                          # grid ends in an unobserved stretch (d=1)
+    var_end: jax.Array    # (S,) accumulated level variance at the grid end
+                          # (0 if the last grid day was observed)
     fitted: jax.Array     # (S, T) one-step fitted values on the ORIGINAL grid
+    fitted_var: jax.Array  # (S, T) predictive variance of `fitted` (widens
+                           # over unobserved stretches, e.g. CV eval windows)
     day0: jax.Array       # () first training day
     t_fit_end: jax.Array  # () last training day
 
@@ -156,8 +167,9 @@ def fit(y, mask, day, config: ArimaConfig) -> ArimaParams:
         theta = _pacf_to_coef(u[p : p + q]) if q else jnp.zeros((0,))
         ssq, ldet, n, *_ = _kalman_loglik(zs, ms, phi, theta, r)
         n = jnp.maximum(n, 1.0)
-        # concentrated Gaussian NLL: n/2 log(ssq/n) + ldet/2
-        return 0.5 * n * jnp.log(jnp.maximum(ssq / n, _EPS)) + 0.5 * ldet
+        # concentrated Gaussian NLL + MAP prior (see ArimaConfig.prior_scale)
+        prior = 0.5 * jnp.sum((u / config.prior_scale) ** 2)
+        return 0.5 * n * jnp.log(jnp.maximum(ssq / n, _EPS)) + 0.5 * ldet + prior
 
     u0 = jnp.zeros((y.shape[0], p + q))
     opt = optax.adam(config.learning_rate)
@@ -183,24 +195,44 @@ def fit(y, mask, day, config: ArimaConfig) -> ArimaParams:
     def final_one(zs, ms, ph, th):
         ssq, ldet, n, preds, Fs, a_T, P_T = _kalman_loglik(zs, ms, ph, th, r)
         sigma2 = ssq / jnp.maximum(n, 1.0)
-        return sigma2, preds, a_T, P_T
+        return sigma2, preds, Fs, a_T, P_T
 
-    sigma2, zpreds, a_T, P_T = jax.vmap(final_one)(zc, zmask, phi, theta)
+    sigma2, zpreds, Fs, a_T, P_T = jax.vmap(final_one)(zc, zmask, phi, theta)
 
-    # fitted values on the original scale: undiff one-step preds
+    # fitted values on the original scale: undiff one-step preds.  Integration
+    # must NOT read the actual y over unobserved stretches (mask==0: data
+    # gaps, and CV eval windows where it would leak the answer) — carry the
+    # fitted level forward instead, accumulating variance random-walk style.
     zhat = zpreds + mean[:, None]
     if d == 1:
-        prev = jnp.concatenate([y[:, :1], y[:, :-1]], axis=1)
-        fitted = prev + zhat
+        def integrate_one(ys, ms, zh, Fv, s2):
+            def step(carry, inp):
+                lvl, var = carry
+                yt, mt, zt, ft = inp
+                mean_t = lvl + zt
+                var_t = var + ft * s2
+                lvl_new = jnp.where(mt > 0, yt, mean_t)
+                var_new = jnp.where(mt > 0, 0.0 * var_t, var_t)
+                return (lvl_new, var_new), (mean_t, var_t)
+
+            zero = jnp.sum(ys) * 0.0
+            (lvl_T, var_T), (means, vars_) = jax.lax.scan(
+                step, (ys[0], zero), (ys, ms, zh, Fv)
+            )
+            return means, vars_, lvl_T, var_T
+
+        fitted, fitted_var, level_end, var_end = jax.vmap(integrate_one)(
+            y, mask, zhat, Fs, sigma2
+        )
     else:
         fitted = zhat
-    # last observed level per series (for integration)
-    T_len = y.shape[1]
-    last_idx = (T_len - 1) - jnp.argmax(mask[:, ::-1], axis=1)
-    y_last = jnp.take_along_axis(y, last_idx[:, None], axis=1)[:, 0]
+        fitted_var = Fs * sigma2[:, None]
+        level_end = jnp.zeros_like(sigma2)
+        var_end = jnp.zeros_like(sigma2)
     return ArimaParams(
         phi=phi, theta=theta, sigma2=sigma2, mean=mean,
-        a_last=a_T, P_last=P_T, y_last=y_last, fitted=fitted,
+        a_last=a_T, P_last=P_T, level_end=level_end, var_end=var_end,
+        fitted=fitted, fitted_var=fitted_var,
         day0=day[0].astype(jnp.float32),
         t_fit_end=day[-1].astype(jnp.float32),
     )
@@ -232,8 +264,11 @@ def _forecast_impl(params: ArimaParams, day_all, config: ArimaConfig, _r: int):
     )  # (S, H) forecast of centered differenced series + variances
     zf = zf + params.mean[:, None]
     if d == 1:
-        path = params.y_last[:, None] + jnp.cumsum(zf, axis=1)
-        var = jnp.cumsum(vf, axis=1)  # random-walk error accumulation
+        # integrate from the carried level/variance at the fit-grid end so
+        # the future path continues the fitted path without a jump when the
+        # grid ends in an unobserved stretch
+        path = params.level_end[:, None] + jnp.cumsum(zf, axis=1)
+        var = params.var_end[:, None] + jnp.cumsum(vf, axis=1)
     else:
         path, var = zf, vf
 
@@ -245,12 +280,14 @@ def _forecast_impl(params: ArimaParams, day_all, config: ArimaConfig, _r: int):
 
     T_fit = params.fitted.shape[1]
     fit_idx = jnp.clip((dayf - params.day0).astype(jnp.int32), 0, T_fit - 1)
-    hist = jnp.take_along_axis(
-        params.fitted, jnp.broadcast_to(fit_idx[None, :], (S, T_all)), axis=1
+    gath_fit = lambda M: jnp.take_along_axis(
+        M, jnp.broadcast_to(fit_idx[None, :], (S, T_all)), axis=1
     )
+    hist = gath_fit(params.fitted)
+    hist_var = gath_fit(params.fitted_var)
     is_future = (h > 0.0)[None, :]
     yhat = jnp.where(is_future, fut_mean, hist)
-    sd = jnp.sqrt(jnp.where(is_future, fut_var, params.sigma2[:, None]))
+    sd = jnp.sqrt(jnp.where(is_future, fut_var, hist_var))
     z = ndtri(0.5 + config.interval_width / 2.0)
     return yhat, yhat - z * sd, yhat + z * sd
 

@@ -22,7 +22,28 @@ from __future__ import annotations
 
 from typing import Callable, NamedTuple
 
+import jax.numpy as jnp
+
 MODEL_REGISTRY: dict = {}
+
+
+def history_splice(fitted, future, day_all, day0, h):
+    """Assemble the (S, T_all) forecast path over history + future days.
+
+    In-sample days (``h <= 0``) gather the one-step fitted path by day offset
+    from ``day0``; future days take ``future``.  Shared by every scan-family
+    model (holt_winters, croston, theta) so the day-grid indexing lives in
+    one place.
+    """
+    S, T_fit = fitted.shape
+    T_all = day_all.shape[0]
+    hist_idx = jnp.clip(
+        (day_all.astype(jnp.float32) - day0).astype(jnp.int32), 0, T_fit - 1
+    )
+    hist = jnp.take_along_axis(
+        fitted, jnp.broadcast_to(hist_idx[None, :], (S, T_all)), axis=1
+    )
+    return jnp.where((h > 0.0)[None, :], future, hist)
 
 
 class ModelFns(NamedTuple):

@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 from jax.scipy.special import ndtri
 
-from distributed_forecasting_tpu.models.base import register_model
+from distributed_forecasting_tpu.models.base import history_splice, register_model
 
 _EPS = 1e-6
 
@@ -93,19 +93,14 @@ def fit(y, mask, day, config: CrostonConfig) -> CrostonParams:
 @partial(jax.jit, static_argnames=("config",))
 def forecast(params: CrostonParams, day_all, t_end, config: CrostonConfig,
              key=None):
-    S = params.z_level.shape[0]
-    T_all = day_all.shape[0]
     dayf = day_all.astype(jnp.float32)
+    # splice origin = fit-grid end; the frozen rate makes the fitted path in
+    # a masked eval window equal the flat future forecast anyway
     h = dayf - params.t_fit_end
     rate = _rate(params.z_level, params.p_level, config.alpha, config.variant)
 
-    T_fit = params.fitted.shape[1]
-    hist_idx = jnp.clip((dayf - params.day0).astype(jnp.int32), 0, T_fit - 1)
-    hist = jnp.take_along_axis(
-        params.fitted, jnp.broadcast_to(hist_idx[None, :], (S, T_all)), axis=1
-    )
-    is_future = (h > 0.0)[None, :]
-    yhat = jnp.where(is_future, rate[:, None], hist)
+    fut = jnp.broadcast_to(rate[:, None], (rate.shape[0], day_all.shape[0]))
+    yhat = history_splice(params.fitted, fut, day_all, params.day0, h)
     z = ndtri(0.5 + config.interval_width / 2.0)
     sd = params.sigma[:, None]
     lo = jnp.maximum(yhat - z * sd, 0.0)  # demand is non-negative

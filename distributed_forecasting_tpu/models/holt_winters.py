@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 from jax.scipy.special import ndtri
 
-from distributed_forecasting_tpu.models.base import register_model
+from distributed_forecasting_tpu.models.base import history_splice, register_model
 
 _EPS = 1e-6
 
@@ -243,7 +243,14 @@ def forecast(params: HWParams, day_all, t_end, config: HoltWintersConfig, key=No
     S = params.level.shape[0]
     T_all = day_all.shape[0]
     dayf = day_all.astype(jnp.float32)
-    h = dayf - params.t_fit_end  # steps ahead; <= 0 in history
+    # Splice origin is the fit grid's end: the masked-scan branch advances
+    # the state (l += b) each unobserved step, so the fitted path through a
+    # CV eval window is already the honest h-step extrapolation — the final
+    # (level, trend) state belongs to t_fit_end, not the caller's cutoff.
+    h = dayf - params.t_fit_end  # steps past the fit grid; <= 0 in history
+    # Intervals widen from t_end (the caller's last observed day, e.g. a CV
+    # cutoff): uncertainty starts where observations stop.
+    h_unc = dayf - t_end.astype(jnp.float32)
 
     # future seasonal slot: training rows were indexed 0..T-1 => slot of day d
     # is (d - day0) mod m
@@ -256,13 +263,7 @@ def forecast(params: HWParams, day_all, t_end, config: HoltWintersConfig, key=No
         fut = base + s_at
 
     # in-sample: gather fitted by day offset
-    T_fit = params.fitted.shape[1]
-    hist_idx = jnp.clip((dayf - params.day0).astype(jnp.int32), 0, T_fit - 1)
-    hist = jnp.take_along_axis(
-        params.fitted, jnp.broadcast_to(hist_idx[None, :], (S, T_all)), axis=1
-    )
-    is_future = (h > 0.0)[None, :]
-    yhat = jnp.where(is_future, fut, hist)
+    yhat = history_splice(params.fitted, fut, day_all, params.day0, h)
 
     # class-1 variance: var(h) = sigma^2 (1 + sum_{j=1}^{h-1} c_j^2)
     j = jnp.arange(1, T_all + 1, dtype=jnp.float32)
@@ -273,11 +274,11 @@ def forecast(params: HWParams, day_all, t_end, config: HoltWintersConfig, key=No
     cum = jnp.concatenate(
         [jnp.zeros((S, 1)), jnp.cumsum(cj**2, axis=1)[:, :-1]], axis=1
     )
-    hclip = jnp.clip(h.astype(jnp.int32) - 1, 0, T_all - 1)
+    hclip = jnp.clip(h_unc.astype(jnp.int32) - 1, 0, T_all - 1)
     var_mult = 1.0 + jnp.take_along_axis(
         cum, jnp.broadcast_to(hclip[None, :], (S, T_all)), axis=1
     )
-    var_mult = jnp.where(is_future, var_mult, 1.0)
+    var_mult = jnp.where((h_unc > 0.0)[None, :], var_mult, 1.0)
     sd = params.sigma[:, None] * jnp.sqrt(var_mult)
     z = ndtri(0.5 + config.interval_width / 2.0)
     return yhat, yhat - z * sd, yhat + z * sd

@@ -1,0 +1,186 @@
+"""Batched Theta-method forecasting (Assimakopoulos & Nikolopoulos 2000).
+
+Beyond-parity model family: the Theta method won the M3 competition and is
+the standard "strong classical baseline" for retail demand.  Hyndman &
+Billah (2003) showed the classic two-line variant is SES with an added drift
+of half the linear-trend slope — which is exactly how it is computed here:
+
+    1. multiplicative weekly deseasonalization (index per day-of-week slot),
+    2. OLS linear trend ``a + b.t`` on the seasonally-adjusted series
+       (the theta=0 line),
+    3. SES on the theta=2 line ``Z = 2.y_sa - (a + b.t)`` with a per-series
+       grid-optimized smoothing constant,
+    4. forecast = mean of the flat SES forecast of Z and the extrapolated
+       trend line, reseasonalized.
+
+Everything is masked + fixed-shape: the deseasonalization and regression are
+weighted reductions, the SES recursion is a ``lax.scan`` whose level only
+updates where ``mask>0``, and the alpha grid is one more vmapped axis — the
+same one-compiled-program-for-all-series architecture that replaces the
+reference's per-(store,item) Prophet fan-out (reference
+``notebooks/prophet/02_training.py:282-307``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import ndtri
+
+from distributed_forecasting_tpu.models.base import history_splice, register_model
+
+_EPS = 1e-6
+
+
+@dataclasses.dataclass(frozen=True)
+class ThetaConfig:
+    theta: float = 2.0
+    season_length: int = 7
+    deseasonalize: bool = True
+    alphas: tuple = (0.02, 0.05, 0.1, 0.2, 0.3, 0.5, 0.8)
+    interval_width: float = 0.95
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class ThetaParams:
+    intercept: jax.Array   # (S,) trend intercept (seasonally-adjusted space)
+    slope: jax.Array       # (S,) trend slope per day
+    level: jax.Array       # (S,) final SES level of the theta line
+    alpha: jax.Array       # (S,) selected smoothing constant
+    seas: jax.Array        # (S, m) multiplicative seasonal indices
+    sigma: jax.Array       # (S,) one-step residual std (original space)
+    fitted: jax.Array      # (S, T) one-step-ahead fitted values (original space)
+    day0: jax.Array
+    t_fit_end: jax.Array
+
+
+def _seasonal_indices(y, mask, dow, m):
+    """Masked multiplicative index per seasonal slot, normalized to mean 1."""
+    onehot = jax.nn.one_hot(dow, m, dtype=y.dtype)          # (T, m)
+    w = mask[:, :, None] * onehot[None, :, :]               # (S, T, m)
+    slot_sum = jnp.sum(w * y[:, :, None], axis=1)           # (S, m)
+    slot_cnt = jnp.maximum(jnp.sum(w, axis=1), 1.0)
+    slot_mean = slot_sum / slot_cnt
+    overall = jnp.sum(y * mask, axis=1) / jnp.maximum(jnp.sum(mask, axis=1), 1.0)
+    idx = slot_mean / jnp.maximum(overall[:, None], _EPS)
+    idx = jnp.where(idx > _EPS, idx, 1.0)
+    # renormalize so indices average to 1 over slots
+    return idx / jnp.maximum(jnp.mean(idx, axis=1, keepdims=True), _EPS)
+
+
+def _ses_path(z, mask, alpha):
+    """Masked SES: returns (one-step preds, final level).
+
+    Level initialized to the mean of the first 7 observed values and updated
+    only where ``mask > 0``.
+    """
+    head = jnp.where(jnp.cumsum(mask) <= 7, mask, 0.0)
+    l0 = jnp.sum(jnp.where(mask > 0, z, 0.0) * head) / \
+        jnp.maximum(jnp.sum(head), 1.0)
+
+    def step(level, inp):
+        zt, mt = inp
+        pred = level
+        new = alpha * zt + (1 - alpha) * level
+        return jnp.where(mt > 0, new, level), pred
+
+    level, preds = jax.lax.scan(step, l0, (z, mask))
+    return preds, level
+
+
+@partial(jax.jit, static_argnames=("config",))
+def fit(y, mask, day, config: ThetaConfig) -> ThetaParams:
+    m = config.season_length
+    dow = jnp.mod(day, m).astype(jnp.int32)                 # (T,)
+    if config.deseasonalize:
+        seas = _seasonal_indices(y, mask, dow, m)           # (S, m)
+    else:
+        seas = jnp.ones((y.shape[0], m), dtype=y.dtype)
+    si = seas[:, dow]                                       # (S, T)
+    y_sa = y / jnp.maximum(si, _EPS)
+
+    # weighted OLS trend on the seasonally-adjusted series (theta=0 line)
+    t = (day - day[0]).astype(y.dtype)                      # (T,)
+    w = mask
+    sw = jnp.maximum(jnp.sum(w, axis=1), 1.0)
+    tm = jnp.sum(w * t[None, :], axis=1) / sw
+    ym = jnp.sum(w * y_sa, axis=1) / sw
+    tc = t[None, :] - tm[:, None]
+    cov = jnp.sum(w * tc * (y_sa - ym[:, None]), axis=1)
+    var = jnp.maximum(jnp.sum(w * tc * tc, axis=1), _EPS)
+    slope = cov / var
+    intercept = ym - slope * tm
+
+    trend = intercept[:, None] + slope[:, None] * t[None, :]  # (S, T)
+    th = config.theta
+    zline = th * y_sa + (1.0 - th) * trend
+
+    # per-series alpha grid: run SES for each candidate, pick masked-SSE
+    # argmin.  Inverting Z = th*y_sa + (1-th)*trend gives
+    # E[y_sa] = (1/th)*Z + (1-1/th)*trend — the classic 0.5/0.5 mean of the
+    # two theta lines only at the default th=2.
+    alphas = jnp.asarray(config.alphas, dtype=y.dtype)
+    w_ses = 1.0 / th  # line-combination weight (distinct from the OLS mask w)
+
+    def per_series(zs, ms, tr, sis, ys):
+        def one_alpha(a):
+            # score on (sse, level) only; the winner's fitted path is
+            # recomputed once below rather than materialized per candidate
+            preds, level = _ses_path(zs, ms, a)
+            fitted = (w_ses * preds + (1.0 - w_ses) * tr) * sis
+            err = (ys - fitted) * ms
+            return jnp.sum(err * err), level
+        sses, levels = jax.vmap(one_alpha)(alphas)
+        k = jnp.argmin(sses)
+        best_alpha = alphas[k]
+        preds, _ = _ses_path(zs, ms, best_alpha)
+        fitted = (w_ses * preds + (1.0 - w_ses) * tr) * sis
+        n = jnp.maximum(jnp.sum(ms), 1.0)
+        sigma = jnp.sqrt(sses[k] / n)
+        return best_alpha, levels[k], fitted, sigma
+
+    alpha, level, fitted, sigma = jax.vmap(per_series)(zline, mask, trend, si, y)
+    return ThetaParams(
+        intercept=intercept, slope=slope, level=level, alpha=alpha,
+        seas=seas, sigma=sigma, fitted=fitted,
+        day0=day[0].astype(jnp.float32),
+        t_fit_end=day[-1].astype(jnp.float32),
+    )
+
+
+@partial(jax.jit, static_argnames=("config",))
+def forecast(params: ThetaParams, day_all, t_end, config: ThetaConfig, key=None):
+    m = config.season_length
+    dayf = day_all.astype(jnp.float32)
+    # Splice origin = fit-grid end (the frozen SES level inside a masked CV
+    # eval window makes the fitted path equal the future formula there);
+    # intervals widen from t_end, where observations actually stop.
+    h = dayf - params.t_fit_end                             # >0 past the grid
+    h_unc = dayf - t_end.astype(jnp.float32)
+    t = (dayf - params.day0)
+
+    trend = params.intercept[:, None] + params.slope[:, None] * t[None, :]
+    # flat SES forecast of the theta line combined with the trend line at the
+    # same 1/th weight as in fit
+    w_ses = 1.0 / config.theta
+    fut_sa = w_ses * params.level[:, None] + (1.0 - w_ses) * trend
+    dow = jnp.mod(day_all, m).astype(jnp.int32)
+    si = params.seas[:, dow]
+    fut = fut_sa * si
+
+    yhat = history_splice(params.fitted, fut, day_all, params.day0, h)
+
+    # SES h-step variance: sigma^2 (1 + (h-1) alpha^2); history uses 1-step
+    steps = jnp.maximum(h_unc, 1.0)[None, :]
+    sd = params.sigma[:, None] * jnp.sqrt(
+        1.0 + (steps - 1.0) * (params.alpha[:, None] ** 2)
+    )
+    z = ndtri(0.5 + config.interval_width / 2.0)
+    return yhat, yhat - z * sd, yhat + z * sd
+
+
+register_model("theta", fit, forecast, ThetaConfig)

@@ -1,0 +1,91 @@
+import numpy as np
+import pandas as pd
+import pytest
+
+import jax.numpy as jnp
+
+from distributed_forecasting_tpu.data import tensorize
+from distributed_forecasting_tpu.engine import fit_forecast
+from distributed_forecasting_tpu.models import ThetaConfig
+from distributed_forecasting_tpu.models import theta as TH
+
+
+@pytest.fixture(scope="module")
+def trend_seasonal_batch():
+    """Three series: pure trend, trend+weekly seasonality, noisy flat."""
+    rng = np.random.default_rng(7)
+    T = 730
+    dates = pd.date_range("2020-01-01", periods=T)
+    t = np.arange(T, dtype=float)
+    dow = dates.dayofweek.values
+    seas = 1.0 + 0.3 * np.sin(2 * np.pi * dow / 7)
+    specs = {
+        1: 100.0 + 0.5 * t,
+        2: (50.0 + 0.2 * t) * seas,
+        3: 80.0 + rng.normal(0, 2.0, T),
+    }
+    rows = [
+        pd.DataFrame({"date": dates, "store": 1, "item": item, "sales": y})
+        for item, y in specs.items()
+    ]
+    return tensorize(pd.concat(rows, ignore_index=True))
+
+
+def test_theta_recovers_trend_slope(trend_seasonal_batch):
+    batch = trend_seasonal_batch
+    cfg = ThetaConfig()
+    params = TH.fit(batch.y, batch.mask, batch.day, cfg)
+    # series 0: slope 0.5/day, no seasonality
+    assert abs(float(params.slope[0]) - 0.5) < 0.02
+    # series 2: flat
+    assert abs(float(params.slope[2])) < 0.02
+    # seasonal indices ~1 for the non-seasonal series
+    np.testing.assert_allclose(np.asarray(params.seas[0]), 1.0, atol=0.02)
+
+
+def test_theta_forecast_tracks_trend_and_season(trend_seasonal_batch):
+    batch = trend_seasonal_batch
+    params, res = fit_forecast(batch, model="theta", horizon=90)
+    assert bool(res.ok.all())
+    T = batch.n_time
+    fut = np.asarray(res.yhat[:, T:])
+    # series 0 ground truth continues 100 + 0.5 t
+    t_fut = np.arange(T, T + 90, dtype=float)
+    truth0 = 100.0 + 0.5 * t_fut
+    mape0 = np.mean(np.abs(fut[0] - truth0) / truth0)
+    assert mape0 < 0.03, mape0
+    # series 1: seasonal pattern must persist in the forecast (weekly CoV)
+    week = fut[1][:84].reshape(12, 7)
+    cov = week.std(axis=1).mean() / week.mean()
+    assert cov > 0.1, cov
+    # intervals are ordered and widen with horizon
+    lo, hi = np.asarray(res.lo[:, T:]), np.asarray(res.hi[:, T:])
+    assert (lo <= fut + 1e-5).all() and (fut <= hi + 1e-5).all()
+    assert (hi[:, -1] - lo[:, -1] >= hi[:, 0] - lo[:, 0] - 1e-5).all()
+
+
+def test_theta_masked_gaps_do_not_break_fit(trend_seasonal_batch):
+    batch = trend_seasonal_batch
+    # knock out a 30-day hole in every series
+    mask = np.asarray(batch.mask).copy()
+    mask[:, 100:130] = 0.0
+    params = TH.fit(batch.y, jnp.asarray(mask), batch.day, ThetaConfig())
+    assert np.isfinite(np.asarray(params.level)).all()
+    assert abs(float(params.slope[0]) - 0.5) < 0.03
+
+
+def test_theta_in_engine_cv():
+    from distributed_forecasting_tpu.engine import cross_validate
+
+    rng = np.random.default_rng(0)
+    T = 1100
+    dates = pd.date_range("2019-01-01", periods=T)
+    t = np.arange(T, dtype=float)
+    rows = []
+    for item in (1, 2):
+        y = 60 + 0.1 * t + rng.normal(0, 1.0, T)
+        rows.append(pd.DataFrame(
+            {"date": dates, "store": 1, "item": item, "sales": y}))
+    batch = tensorize(pd.concat(rows, ignore_index=True))
+    metrics = cross_validate(batch, model="theta")
+    assert float(np.nanmean(np.asarray(metrics["mape"]))) < 0.05
