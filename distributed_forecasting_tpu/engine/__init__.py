@@ -11,8 +11,16 @@ from distributed_forecasting_tpu.engine.hyper import (
     TuneResult,
     tune_curve_model,
 )
+from distributed_forecasting_tpu.engine.select import (
+    SelectionResult,
+    fit_forecast_auto,
+    select_model,
+)
 
 __all__ = [
+    "SelectionResult",
+    "fit_forecast_auto",
+    "select_model",
     "HyperSearchConfig",
     "TuneResult",
     "tune_curve_model",

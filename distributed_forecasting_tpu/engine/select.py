@@ -1,0 +1,144 @@
+"""Per-series automatic model selection — best-of across model families.
+
+The reference's AutoML path tunes *within* one family (Prophet hyperparams
+per series, ``notebooks/automl/22-09-26...py:107-125``).  This module goes
+one level up, at the same per-series granularity: run rolling-origin CV for
+several model families (each family is one compiled batched program —
+``engine/cv.py``), pick each series' winner by the CV-mean selection metric
+(default smape, the reference AutoML's ``val_smape``), then refit every
+family on full history and assemble the final forecast by gathering each
+series' row from its winning family.
+
+Fault tolerance follows ``train_with_fail_safe`` semantics: a family whose
+CV metric is non-finite for a series can never win it, and the engine-level
+seasonal-naive fallback still applies to the combined result.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from distributed_forecasting_tpu.data.tensorize import SeriesBatch
+from distributed_forecasting_tpu.engine.cv import CVConfig, cross_validate
+from distributed_forecasting_tpu.engine.fit import ForecastResult, fit_forecast
+from distributed_forecasting_tpu.models.base import get_model
+
+DEFAULT_FAMILIES = ("prophet", "holt_winters", "theta", "croston")
+
+# metrics where larger is better; everything else is argmin'd
+_HIGHER_BETTER = frozenset({"coverage"})
+
+
+@dataclasses.dataclass
+class SelectionResult:
+    models: Tuple[str, ...]       # candidate family names, index space below
+    assignment: np.ndarray        # (S,) winning family index per series
+    best_score: np.ndarray        # (S,) winning CV-mean selection metric
+    scores: pd.DataFrame          # (S, len(models)) per-family scores
+    metric: str
+    valid: np.ndarray = None      # (S,) bool — at least one family scored
+                                  # finite; invalid series keep assignment 0
+                                  # and rely on the engine's fail-safe path
+
+    @property
+    def chosen(self) -> np.ndarray:
+        """(S,) winning family name per series."""
+        return np.asarray(self.models, dtype=object)[self.assignment]
+
+    def counts(self) -> Dict[str, int]:
+        names, cnt = np.unique(self.chosen, return_counts=True)
+        return dict(zip(names.tolist(), cnt.tolist()))
+
+
+def select_model(
+    batch: SeriesBatch,
+    models: Sequence[str] = DEFAULT_FAMILIES,
+    configs: Optional[Dict[str, object]] = None,
+    metric: str = "smape",
+    cv: CVConfig = CVConfig(),
+    key: Optional[jax.Array] = None,
+) -> SelectionResult:
+    """CV every family, argmin the selection metric per series."""
+    configs = configs or {}
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    cols = {}
+    for i, name in enumerate(models):
+        get_model(name)  # fail fast on unknown family
+        res = cross_validate(
+            batch, model=name, config=configs.get(name), cv=cv,
+            key=jax.random.fold_in(key, i),
+        )
+        cols[name] = np.asarray(res[metric], dtype=np.float64)
+    table = np.stack([cols[n] for n in models], axis=1)  # (S, M)
+    # orient so smaller-is-better, and non-finite scores can never win
+    # (fail-safe semantics)
+    oriented = -table if metric in _HIGHER_BETTER else table
+    guarded = np.where(np.isfinite(oriented), oriented, np.inf)
+    assignment = np.argmin(guarded, axis=1)
+    valid = np.isfinite(guarded).any(axis=1)
+    best = np.take_along_axis(table, assignment[:, None], axis=1)[:, 0]
+    return SelectionResult(
+        models=tuple(models),
+        assignment=assignment,
+        best_score=best,
+        scores=pd.DataFrame(cols),
+        metric=metric,
+        valid=valid,
+    )
+
+
+def fit_forecast_auto(
+    batch: SeriesBatch,
+    models: Sequence[str] = DEFAULT_FAMILIES,
+    configs: Optional[Dict[str, object]] = None,
+    metric: str = "smape",
+    cv: CVConfig = CVConfig(),
+    horizon: int = 90,
+    key: Optional[jax.Array] = None,
+    selection: Optional[SelectionResult] = None,
+) -> Tuple[Dict[str, object], SelectionResult, ForecastResult]:
+    """Select per series, refit every family on full history, and gather the
+    combined forecast.  Returns ``(params_by_family, selection, result)``;
+    ``params_by_family`` feeds ``serving.MultiModelForecaster``."""
+    configs = configs or {}
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    if selection is None:
+        selection = select_model(
+            batch, models=models, configs=configs, metric=metric, cv=cv, key=key
+        )
+
+    # refit only families that won at least one series — a family with zero
+    # wins can never be dispatched at serving time either
+    winners = sorted(set(selection.assignment.tolist()))
+    params_by_family: Dict[str, object] = {}
+    yhat = lo = hi = ok = day_all = None
+    assign = jnp.asarray(selection.assignment)
+    for i in winners:
+        name = selection.models[i]
+        params, res = fit_forecast(
+            batch, model=name, config=configs.get(name), horizon=horizon,
+            key=jax.random.fold_in(key, 1000 + i),
+        )
+        params_by_family[name] = params
+        pick = (assign == i)[:, None]
+        if yhat is None:
+            yhat, lo, hi = res.yhat, res.lo, res.hi
+            ok, day_all = res.ok, res.day_all
+        else:
+            yhat = jnp.where(pick, res.yhat, yhat)
+            lo = jnp.where(pick, res.lo, lo)
+            hi = jnp.where(pick, res.hi, hi)
+            ok = jnp.where(pick[:, 0], res.ok, ok)
+    # series with no finite CV score anywhere are not trustworthy even if
+    # the full-history fit succeeded — surface them through `ok`
+    ok = ok & jnp.asarray(selection.valid)
+    result = ForecastResult(yhat=yhat, lo=lo, hi=hi, ok=ok, day_all=day_all)
+    return params_by_family, selection, result

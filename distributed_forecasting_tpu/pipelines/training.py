@@ -47,8 +47,14 @@ _METRICS = ("mse", "rmse", "mae", "mape", "smape", "mdape", "coverage")
 
 
 def _config_from_conf(model: str, model_conf: Optional[Dict[str, Any]]):
+    from distributed_forecasting_tpu.serving.predictor import _freeze
+
     fns = get_model(model)
-    return fns.config_cls(**(model_conf or {}))
+    # YAML sequences arrive as lists; configs are static jit args and must be
+    # hashable (e.g. ThetaConfig.alphas, CurveModelConfig tuples)
+    return fns.config_cls(
+        **{k: _freeze(v) for k, v in (model_conf or {}).items()}
+    )
 
 
 class TrainingPipeline:
@@ -78,6 +84,11 @@ class TrainingPipeline:
             return self._fine_grained_tuned(
                 source_table, output_table, model_conf, cv_conf, tuning,
                 experiment, horizon, key_cols,
+            )
+        if model == "auto":
+            return self._fine_grained_auto(
+                source_table, output_table, model_conf, cv_conf,
+                experiment, horizon, key_cols, seed,
             )
         from distributed_forecasting_tpu.utils.profiling import PhaseTimer, device_trace
 
@@ -297,6 +308,103 @@ class TrainingPipeline:
             "n_failed": int((~np.asarray(result.ok)).sum()),
             "fit_seconds": fit_seconds,
             "metrics": {f"val_{search.metric}": float(np.mean(tuned.best_score))},
+        }
+
+    # ---------------------------------------------------------- auto select
+    def _fine_grained_auto(
+        self,
+        source_table: str,
+        output_table: str,
+        model_conf: Optional[Dict[str, Any]],
+        cv_conf: Optional[Dict[str, Any]],
+        experiment: str,
+        horizon: int,
+        key_cols,
+        seed: int,
+    ) -> Dict[str, Any]:
+        """Per-series best-of across model families (``engine/select.py``) —
+        the cross-family analogue of the AutoML path's per-series tuning.
+        ``model_conf`` here may carry ``{"families": [...], "metric": ...,
+        "configs": {family: {...}}}``."""
+        from distributed_forecasting_tpu.engine.select import (
+            DEFAULT_FAMILIES,
+            fit_forecast_auto,
+        )
+        from distributed_forecasting_tpu.serving.ensemble import MultiModelForecaster
+
+        mc = model_conf or {}
+        families = tuple(mc.get("families", DEFAULT_FAMILIES))
+        metric = mc.get("metric", "smape")
+        configs = {
+            name: _config_from_conf(name, c)
+            for name, c in (mc.get("configs") or {}).items()
+        }
+        cv = CVConfig(**(cv_conf or {}))
+
+        df = self.catalog.read_table(source_table)
+        batch = tensorize(df, key_cols=key_cols)
+        t_start = time.time()
+        params_by_family, selection, result = fit_forecast_auto(
+            batch, models=families, configs=configs, metric=metric, cv=cv,
+            horizon=horizon, key=jax.random.PRNGKey(seed),
+        )
+        jax.block_until_ready(result.yhat)
+        fit_seconds = time.time() - t_start
+
+        eid = self.tracker.create_experiment(experiment)
+        with self.tracker.start_run(
+            eid, run_name="auto_select_fit",
+            tags={"model": "auto", "families": ",".join(families)},
+        ) as run:
+            run.log_params(
+                {
+                    "families": list(families),
+                    "selection_metric": metric,
+                    "n_series": batch.n_series,
+                    "horizon": horizon,
+                }
+            )
+            counts = selection.counts()
+            valid = selection.valid
+            run.log_metrics(
+                {
+                    # mean over series with at least one finite CV score
+                    f"val_{metric}": float(np.mean(selection.best_score[valid]))
+                    if valid.any() else float("nan"),
+                    "n_invalid_series": float((~valid).sum()),
+                    "fit_seconds": fit_seconds,
+                    **{f"n_chosen_{name}": float(counts.get(name, 0))
+                       for name in families},
+                }
+            )
+            series_table = batch.key_frame()
+            series_table["chosen_model"] = selection.chosen
+            series_table[f"best_{metric}"] = selection.best_score
+            for name in families:
+                series_table[f"{metric}_{name}"] = selection.scores[name].to_numpy()
+            run.log_table("series_metrics.parquet", series_table)
+            mm = MultiModelForecaster.from_fit(
+                batch, params_by_family, configs, selection
+            )
+            mm.save(run.artifact_path("forecaster"))
+            run_id = run.run_id
+
+        table_df = forecast_frame(batch, result)
+        version = self.catalog.save_table(output_table, table_df)
+        self.logger.info(
+            "auto-select fit: %d series over %s in %.2fs (chosen: %s) -> %s v%s",
+            batch.n_series, list(families), fit_seconds, counts,
+            output_table, version,
+        )
+        return {
+            "experiment_id": eid,
+            "run_id": run_id,
+            "table_version": version,
+            "n_series": batch.n_series,
+            "n_failed": int((~np.asarray(result.ok)).sum()),
+            "fit_seconds": fit_seconds,
+            "chosen_counts": counts,
+            "metrics": {f"val_{metric}": float(np.mean(selection.best_score))},
         }
 
     def _log_per_series_runs(self, eid: str, series_table: pd.DataFrame, parent: str):

@@ -1,3 +1,4 @@
 from distributed_forecasting_tpu.serving.predictor import BatchForecaster
+from distributed_forecasting_tpu.serving.ensemble import MultiModelForecaster
 
-__all__ = ["BatchForecaster"]
+__all__ = ["BatchForecaster", "MultiModelForecaster"]

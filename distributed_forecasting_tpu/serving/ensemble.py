@@ -1,0 +1,114 @@
+"""Mixed-family batched serving: one artifact, per-series winning model.
+
+Companion to ``engine/select.py``: serving-side object that holds one
+``BatchForecaster`` per model family plus the per-series assignment vector,
+and dispatches each requested series to its winning family — still one
+compiled predict call *per family present in the request*, never per series
+(the anti-pattern this framework exists to fix, reference
+``notebooks/prophet/model_wrapper.py:57-58``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+import pandas as pd
+
+from distributed_forecasting_tpu.serving.predictor import BatchForecaster
+
+_META_FILE = "ensemble.json"
+
+
+class MultiModelForecaster:
+    def __init__(
+        self,
+        forecasters: Dict[str, BatchForecaster],
+        assignment: np.ndarray,
+    ):
+        if not forecasters:
+            raise ValueError("need at least one family forecaster")
+        self.forecasters = dict(forecasters)
+        self.models = tuple(sorted(self.forecasters))
+        first = self.forecasters[self.models[0]]
+        self.keys = first.keys
+        self.key_names = first.key_names
+        self.assignment = np.asarray(assignment)
+        if self.assignment.shape[0] != self.keys.shape[0]:
+            raise ValueError(
+                f"assignment covers {self.assignment.shape[0]} series, "
+                f"params cover {self.keys.shape[0]}"
+            )
+
+    @classmethod
+    def from_fit(cls, batch, params_by_family, configs, selection
+                 ) -> "MultiModelForecaster":
+        """Build from ``engine.fit_forecast_auto`` outputs.  ``configs`` maps
+        family name -> config (missing names use the family default).
+        ``params_by_family`` holds only families that won >=1 series."""
+        from distributed_forecasting_tpu.models.base import get_model
+
+        fcs = {}
+        for name, params in params_by_family.items():
+            cfg = (configs or {}).get(name) or get_model(name).config_cls()
+            fcs[name] = BatchForecaster.from_fit(batch, params, name, cfg)
+        # store assignment as family-name indices into self.models (sorted),
+        # independent of selection.models ordering
+        name_per_series = selection.chosen
+        order = {n: j for j, n in enumerate(sorted(fcs))}
+        assignment = np.asarray([order[n] for n in name_per_series])
+        return cls(fcs, assignment)
+
+    # -- persistence --------------------------------------------------------
+    def save(self, directory: str) -> None:
+        os.makedirs(directory, exist_ok=True)
+        for name, fc in self.forecasters.items():
+            fc.save(os.path.join(directory, name))
+        with open(os.path.join(directory, _META_FILE), "w") as f:
+            json.dump(
+                {"models": list(self.models),
+                 "assignment": self.assignment.tolist()}, f
+            )
+
+    @classmethod
+    def load(cls, directory: str) -> "MultiModelForecaster":
+        with open(os.path.join(directory, _META_FILE)) as f:
+            meta = json.load(f)
+        fcs = {
+            name: BatchForecaster.load(os.path.join(directory, name))
+            for name in meta["models"]
+        }
+        return cls(fcs, np.asarray(meta["assignment"]))
+
+    # -- inference ----------------------------------------------------------
+    def predict(
+        self,
+        request: pd.DataFrame,
+        horizon: int = 90,
+        include_history: bool = False,
+        key: Optional[jax.Array] = None,
+        on_missing: str = "raise",
+    ) -> pd.DataFrame:
+        """One batched predict per family present in the request."""
+        first = self.forecasters[self.models[0]]
+        sidx = first.series_indices(request, on_missing=on_missing)
+        if sidx.size == 0:
+            return pd.DataFrame(
+                columns=["ds", *self.key_names, "yhat", "yhat_upper",
+                         "yhat_lower", "model"]
+            )
+        parts = []
+        for j, name in enumerate(self.models):
+            sub = sidx[self.assignment[sidx] == j]
+            if sub.size == 0:
+                continue
+            req = pd.DataFrame(self.keys[sub], columns=list(self.key_names))
+            out = self.forecasters[name].predict(
+                req, horizon=horizon, include_history=include_history, key=key
+            )
+            out["model"] = name
+            parts.append(out)
+        return pd.concat(parts, ignore_index=True)

@@ -9,7 +9,8 @@ Librarized equivalent of the reference's training notebook entry point
     output:
       table: hackathon.sales.finegrain_forecasts
     training:
-      model: prophet                # prophet | holt_winters | arima
+      model: prophet                # prophet | holt_winters | arima | theta
+                                    #   | croston | auto (per-series best-of)
       model_conf: {...}             # fields of the model's config dataclass
       cv: {initial: 730, period: 360, horizon: 90}
       horizon: 90

@@ -1,0 +1,118 @@
+import numpy as np
+import pandas as pd
+import pytest
+
+from distributed_forecasting_tpu.data import tensorize
+from distributed_forecasting_tpu.engine import (
+    CVConfig,
+    fit_forecast_auto,
+    select_model,
+)
+from distributed_forecasting_tpu.serving import MultiModelForecaster
+
+
+@pytest.fixture(scope="module")
+def mixed_batch():
+    """Series with deliberately different winning families: smooth
+    trend+season (curve/theta territory) and intermittent demand
+    (croston territory)."""
+    rng = np.random.default_rng(3)
+    T = 1100
+    dates = pd.date_range("2020-01-01", periods=T)
+    t = np.arange(T, dtype=float)
+    dow = dates.dayofweek.values
+    seas = 1.0 + 0.25 * np.sin(2 * np.pi * dow / 7)
+    rows = []
+    # items 1-2: smooth seasonal with trend
+    for item in (1, 2):
+        y = (80.0 + 0.05 * t) * seas + rng.normal(0, 2.0, T)
+        rows.append(pd.DataFrame(
+            {"date": dates, "store": 1, "item": item, "sales": y}))
+    # items 3-4: intermittent (95% zeros)
+    for item in (3, 4):
+        occur = rng.random(T) < 0.05
+        y = np.where(occur, rng.lognormal(np.log(30.0), 0.2, T), 0.0)
+        rows.append(pd.DataFrame(
+            {"date": dates, "store": 1, "item": item, "sales": y}))
+    return tensorize(pd.concat(rows, ignore_index=True))
+
+
+CV = CVConfig(initial=730, period=180, horizon=90)
+
+
+def test_select_model_picks_per_series_argmin(mixed_batch):
+    sel = select_model(mixed_batch, cv=CV)
+    chosen = sel.chosen
+    # smooth trending series should not be assigned the intermittent model
+    assert chosen[0] != "croston" and chosen[1] != "croston", chosen
+    # assignment is exactly the per-series argmin of the score table
+    table = sel.scores[list(sel.models)].to_numpy()
+    np.testing.assert_array_equal(
+        sel.assignment, np.argmin(np.where(np.isfinite(table), table, np.inf), axis=1)
+    )
+    np.testing.assert_allclose(
+        sel.best_score, np.min(table, axis=1), rtol=1e-6
+    )
+    assert sel.scores.shape == (4, 4)
+    assert np.isfinite(sel.best_score).all()
+    assert sum(sel.counts().values()) == 4
+
+
+def test_fit_forecast_auto_combines_per_series(mixed_batch):
+    params_by_family, sel, res = fit_forecast_auto(
+        mixed_batch, cv=CV, horizon=30
+    )
+    # only families that won >=1 series are refit and persisted
+    assert set(params_by_family) == set(sel.chosen)
+    assert bool(res.ok.all())
+    T = mixed_batch.n_time
+    fut = np.asarray(res.yhat[:, T:])
+    # intermittent series forecast must be a small flat rate, not seasonal
+    assert fut[2].max() < 10.0
+    # smooth series forecast stays near its end-of-history level (~135)
+    assert 100.0 < fut[0].mean() < 170.0
+    assert (np.asarray(res.lo) <= np.asarray(res.hi) + 1e-5).all()
+
+
+def test_multi_model_forecaster_roundtrip(tmp_path, mixed_batch):
+    params_by_family, sel, _ = fit_forecast_auto(mixed_batch, cv=CV, horizon=30)
+    mm = MultiModelForecaster.from_fit(mixed_batch, params_by_family, None, sel)
+    d = str(tmp_path / "ens")
+    mm.save(d)
+    mm2 = MultiModelForecaster.load(d)
+    req = pd.DataFrame({"store": [1, 1], "item": [1, 3]})
+    out = mm2.predict(req, horizon=14)
+    assert set(out["model"].unique()) == {sel.chosen[0], sel.chosen[2]}
+    assert len(out) == 2 * 14
+    # per-series dispatch matches the selection
+    m_item3 = out.loc[out["item"] == 3, "model"].unique().tolist()
+    assert m_item3 == [sel.chosen[2]]
+
+
+def test_select_higher_better_metric_uses_argmax(mixed_batch):
+    sel = select_model(mixed_batch, cv=CV, metric="coverage")
+    table = sel.scores[list(sel.models)].to_numpy()
+    np.testing.assert_array_equal(
+        sel.assignment,
+        np.argmax(np.where(np.isfinite(table), table, -np.inf), axis=1),
+    )
+    # best_score reports the original (unnegated) metric value
+    np.testing.assert_allclose(sel.best_score, np.max(table, axis=1), rtol=1e-6)
+    assert sel.valid.all()
+
+
+def test_config_from_conf_freezes_yaml_lists():
+    from distributed_forecasting_tpu.pipelines.training import _config_from_conf
+
+    cfg = _config_from_conf("theta", {"alphas": [0.1, 0.3]})
+    assert cfg.alphas == (0.1, 0.3)
+    hash(cfg)  # static jit arg must be hashable
+
+
+def test_multi_model_unknown_series_raises(mixed_batch):
+    from distributed_forecasting_tpu.serving.predictor import UnknownSeriesError
+
+    params_by_family, sel, _ = fit_forecast_auto(mixed_batch, cv=CV, horizon=14)
+    mm = MultiModelForecaster.from_fit(mixed_batch, params_by_family, None, sel)
+    with pytest.raises(UnknownSeriesError):
+        mm.predict(pd.DataFrame({"store": [9], "item": [99]}))

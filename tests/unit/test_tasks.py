@@ -126,6 +126,37 @@ def test_train_deploy_infer_chain(env_conf):
     )
 
 
+def test_train_task_auto_select(env_conf):
+    IngestTask(init_conf={**env_conf, **_synth_conf(n_days=900)}).launch()
+    train = TrainTask(
+        init_conf={
+            **env_conf,
+            "input": {"table": "hackathon.sales.raw"},
+            "output": {"table": "hackathon.sales.finegrain_forecasts"},
+            "training": {
+                "model": "auto",
+                "model_conf": {"families": ["holt_winters", "theta"]},
+                "cv": {"initial": 500, "period": 180, "horizon": 60},
+                "horizon": 30,
+            },
+        }
+    )
+    summary = train.launch()
+    assert summary["n_series"] == 6
+    assert sum(summary["chosen_counts"].values()) == 6
+    assert set(summary["chosen_counts"]) <= {"holt_winters", "theta"}
+    run = train.tracker.get_run(summary["experiment_id"], summary["run_id"])
+    assert "val_smape" in run.metrics()
+    # the saved artifact is a mixed-family forecaster that round-trips
+    from distributed_forecasting_tpu.serving import MultiModelForecaster
+
+    mm = MultiModelForecaster.load(run.artifact_path("forecaster"))
+    import pandas as pd
+
+    out = mm.predict(pd.DataFrame({"store": [1], "item": [1]}), horizon=7)
+    assert len(out) == 7 and np.isfinite(out.yhat).all()
+
+
 def test_train_task_allocated_path(env_conf):
     IngestTask(init_conf={**env_conf, **_synth_conf()}).launch()
     train = TrainTask(
