@@ -46,6 +46,12 @@ class SelectionResult:
                                   # finite; invalid series keep assignment 0
                                   # and rely on the engine's fail-safe path
 
+    def __post_init__(self):
+        if self.valid is None:
+            # caller-constructed selections (forced assignments) default to
+            # trusting every series
+            self.valid = np.ones(self.assignment.shape[0], dtype=bool)
+
     @property
     def chosen(self) -> np.ndarray:
         """(S,) winning family name per series."""

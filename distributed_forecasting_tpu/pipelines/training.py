@@ -42,18 +42,17 @@ from distributed_forecasting_tpu.models.base import get_model
 from distributed_forecasting_tpu.serving import BatchForecaster
 from distributed_forecasting_tpu.tracking import FileTracker
 from distributed_forecasting_tpu.utils import get_logger
+from distributed_forecasting_tpu.utils.config import freeze
 
 _METRICS = ("mse", "rmse", "mae", "mape", "smape", "mdape", "coverage")
 
 
 def _config_from_conf(model: str, model_conf: Optional[Dict[str, Any]]):
-    from distributed_forecasting_tpu.serving.predictor import _freeze
-
     fns = get_model(model)
     # YAML sequences arrive as lists; configs are static jit args and must be
     # hashable (e.g. ThetaConfig.alphas, CurveModelConfig tuples)
     return fns.config_cls(
-        **{k: _freeze(v) for k, v in (model_conf or {}).items()}
+        **{k: freeze(v) for k, v in (model_conf or {}).items()}
     )
 
 
@@ -366,11 +365,15 @@ class TrainingPipeline:
             )
             counts = selection.counts()
             valid = selection.valid
+            # mean over series with at least one finite CV score — the same
+            # value is logged to the tracker and returned in the summary
+            val_metric = (
+                float(np.mean(selection.best_score[valid]))
+                if valid.any() else float("nan")
+            )
             run.log_metrics(
                 {
-                    # mean over series with at least one finite CV score
-                    f"val_{metric}": float(np.mean(selection.best_score[valid]))
-                    if valid.any() else float("nan"),
+                    f"val_{metric}": val_metric,
                     "n_invalid_series": float((~valid).sum()),
                     "fit_seconds": fit_seconds,
                     **{f"n_chosen_{name}": float(counts.get(name, 0))
@@ -404,7 +407,7 @@ class TrainingPipeline:
             "n_failed": int((~np.asarray(result.ok)).sum()),
             "fit_seconds": fit_seconds,
             "chosen_counts": counts,
-            "metrics": {f"val_{metric}": float(np.mean(selection.best_score))},
+            "metrics": {f"val_{metric}": val_metric},
         }
 
     def _log_per_series_runs(self, eid: str, series_table: pd.DataFrame, parent: str):

@@ -50,12 +50,9 @@ def save_params_npz(path: str, params) -> str:
     return f"{cls.__module__}:{cls.__qualname__}"
 
 
-def _freeze(value):
-    """JSON round-trips tuples as lists; config dataclasses must stay
-    hashable (they are static jit args), so re-freeze recursively."""
-    if isinstance(value, list):
-        return tuple(_freeze(v) for v in value)
-    return value
+# JSON round-trips tuples as lists; configs are static jit args and must
+# stay hashable — shared freeze() restores tuples recursively
+from distributed_forecasting_tpu.utils.config import freeze as _freeze
 
 
 def load_params_npz(path: str, params_type: str):

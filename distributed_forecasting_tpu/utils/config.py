@@ -25,6 +25,19 @@ def load_conf(path: str) -> Dict[str, Any]:
         return yaml.safe_load(f) or {}
 
 
+def freeze(value):
+    """Recursively turn lists into tuples.
+
+    YAML and JSON both deliver sequences as lists, but model config
+    dataclasses are static jit arguments and must stay hashable — every
+    config constructed from conf files or persisted metadata goes through
+    this (training pipeline, serving artifact load).
+    """
+    if isinstance(value, list):
+        return tuple(freeze(v) for v in value)
+    return value
+
+
 def parse_conf_args(argv: Optional[List[str]] = None) -> Dict[str, Any]:
     p = argparse.ArgumentParser(add_help=False)
     p.add_argument("--conf-file", dest="conf_file", default=None)
