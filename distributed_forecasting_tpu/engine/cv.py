@@ -14,6 +14,7 @@ all series x all cutoffs fit in one compiled program.
 from __future__ import annotations
 
 import dataclasses
+from functools import partial
 from typing import Dict, List, Optional
 
 import jax
@@ -51,6 +52,45 @@ def cutoff_indices(n_time: int, cv: CVConfig) -> List[int]:
     return cuts
 
 
+def cv_windows(mask, day, cuts, horizon):
+    """Rolling-origin window tensors, built entirely on device (per-cutoff
+    scalar pulls cost tens of ms on remote-attached TPUs).
+
+    Returns ``(train_masks, eval_masks, t_ends)`` with shapes
+    ``((C, S, T), (C, S, T), (C,))`` for cutoff row indices ``cuts``:
+    train covers rows [0, c], eval covers (c, c + horizon].
+    """
+    T = day.shape[0]
+    idx = jnp.arange(T)
+    cuts_arr = jnp.asarray(cuts)
+    within = idx[None, :] <= cuts_arr[:, None]              # (C, T)
+    train_masks = mask[None] * within[:, None, :]           # (C, S, T)
+    in_eval = (~within) & (idx[None, :] <= cuts_arr[:, None] + horizon)
+    eval_masks = mask[None] * in_eval[:, None, :]
+    t_ends = day[cuts_arr].astype(jnp.float32)
+    return train_masks, eval_masks, t_ends
+
+
+@partial(jax.jit, static_argnames=("model", "config", "cuts", "horizon"))
+def _cv_impl(y, mask, day, key, model, config, cuts, horizon):
+    """Whole CV pass as ONE compiled program: mask construction, every
+    cutoff's fit+forecast (cutoffs vmapped), metric reductions.  No host
+    round trips inside — device scalar pulls cost tens of ms on
+    remote-attached TPUs (see engine/fit._fit_forecast_impl)."""
+    fns = get_model(model)
+    train_masks, eval_masks, t_ends = cv_windows(mask, day, cuts, horizon)
+    keys = jax.random.split(key, len(cuts))
+
+    def one_cutoff(train_mask, t_end, k):
+        params = fns.fit(y, train_mask, day, config)
+        return fns.forecast(params, day, t_end, config, k)
+
+    yhat, lo, hi = jax.vmap(one_cutoff)(train_masks, t_ends, keys)  # (C, S, T)
+    y_b = jnp.broadcast_to(y[None], yhat.shape)
+    per_cut = metrics_ops.compute_all(y_b, yhat, eval_masks, lo=lo, hi=hi)
+    return {name: jnp.mean(v, axis=0) for name, v in per_cut.items()}  # (S,)
+
+
 def cross_validate(
     batch: SeriesBatch,
     model: str = "prophet",
@@ -69,28 +109,12 @@ def cross_validate(
     config = config if config is not None else fns.config_cls()
     if key is None:
         key = jax.random.PRNGKey(0)
-
-    T = batch.n_time
-    cuts = cutoff_indices(T, cv)
-    idx = jnp.arange(T)
-    train_masks = jnp.stack(
-        [batch.mask * (idx <= c)[None, :] for c in cuts]
-    )  # (C, S, T)
-    eval_masks = jnp.stack(
-        [batch.mask * ((idx > c) & (idx <= c + cv.horizon))[None, :] for c in cuts]
+    cuts = cutoff_indices(batch.n_time, cv)
+    out = dict(
+        _cv_impl(
+            batch.y, batch.mask, batch.day, key,
+            model=model, config=config, cuts=tuple(cuts), horizon=cv.horizon,
+        )
     )
-    t_ends = jnp.asarray([batch.day[c] for c in cuts], dtype=jnp.float32)
-    keys = jax.random.split(key, len(cuts))
-
-    def one_cutoff(train_mask, t_end, k):
-        params = fns.fit(batch.y, train_mask, batch.day, config)
-        yhat, lo, hi = fns.forecast(params, batch.day, t_end, config, k)
-        return yhat, lo, hi
-
-    yhat, lo, hi = jax.vmap(one_cutoff)(train_masks, t_ends, keys)  # (C, S, T)
-
-    y = jnp.broadcast_to(batch.y[None], yhat.shape)
-    per_cut = metrics_ops.compute_all(y, yhat, eval_masks, lo=lo, hi=hi)
-    out = {name: jnp.mean(v, axis=0) for name, v in per_cut.items()}  # (S,)
     out["_n_cutoffs"] = len(cuts)
     return out

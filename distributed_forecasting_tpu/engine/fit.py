@@ -60,6 +60,16 @@ def seasonal_naive(y, mask, horizon: int, season: int = 7):
     return jnp.concatenate([y, fut], axis=1)
 
 
+def day_grid(day, horizon: int):
+    """History + horizon day grid, built on device.
+
+    Encodes the single place where the ``day`` axis is assumed contiguous
+    daily (tensorize guarantees it — ``data/tensorize.py`` builds the grid
+    with ``arange``).
+    """
+    return day[0] + jnp.arange(day.shape[0] + horizon, dtype=day.dtype)
+
+
 @partial(
     jax.jit, static_argnames=("model", "config", "horizon", "min_points")
 )
@@ -69,10 +79,8 @@ def _fit_forecast_impl(y, mask, day, key, model, config, horizon, min_points):
     at the 500-series scale)."""
     fns = get_model(model)
     params = fns.fit(y, mask, day, config)
-    T = day.shape[0]
-    # contiguous daily grid (tensorize guarantees it): history + horizon
-    day_all = day[0] + jnp.arange(T + horizon, dtype=day.dtype)
-    t_end = day[T - 1].astype(jnp.float32)
+    day_all = day_grid(day, horizon)
+    t_end = day[day.shape[0] - 1].astype(jnp.float32)
     yhat, lo, hi = fns.forecast(params, day_all, t_end, config, key)
 
     finite = (

@@ -30,7 +30,11 @@ import numpy as np
 import pandas as pd
 
 from distributed_forecasting_tpu.data.tensorize import SeriesBatch
-from distributed_forecasting_tpu.engine.cv import CVConfig, cutoff_indices
+from distributed_forecasting_tpu.engine.cv import (
+    CVConfig,
+    cutoff_indices,
+    cv_windows,
+)
 from distributed_forecasting_tpu.models import prophet_glm
 from distributed_forecasting_tpu.models.prophet_glm import CurveModelConfig, CurveParams
 from distributed_forecasting_tpu.ops import metrics as metrics_ops
@@ -66,14 +70,10 @@ def _log_uniform(key, lo, hi, n):
 def _cv_scores(batch: SeriesBatch, config: CurveModelConfig, cv: CVConfig,
                cp_scales, seas_scales, metric: str):
     """CV-mean metric for every (trial, series).  Returns (C_trials, S)."""
-    T = batch.n_time
-    cuts = cutoff_indices(T, cv)
-    idx = jnp.arange(T)
-    train_masks = jnp.stack([batch.mask * (idx <= c)[None, :] for c in cuts])
-    eval_masks = jnp.stack(
-        [batch.mask * ((idx > c) & (idx <= c + cv.horizon))[None, :] for c in cuts]
+    cuts = cutoff_indices(batch.n_time, cv)
+    train_masks, eval_masks, t_ends = cv_windows(
+        batch.mask, batch.day, cuts, cv.horizon
     )
-    t_ends = jnp.asarray([batch.day[c] for c in cuts], dtype=jnp.float32)
     fn = metrics_ops.METRIC_FNS[metric]
 
     def one_trial(cp, seas):

@@ -233,9 +233,10 @@ class TrainingPipeline:
         tuned = tune_curve_model(batch, base_config=base, search=search, cv=cv)
 
         # per-mode forecasts over history+horizon, combined by winning mode
-        day_all = _jnp.arange(
-            int(batch.day[0]), int(batch.day[-1]) + horizon + 1, dtype=_jnp.int32
-        )
+        # (day grid built on device — no scalar pulls)
+        from distributed_forecasting_tpu.engine.fit import day_grid
+
+        day_all = day_grid(batch.day, horizon)
         t_end = batch.day[-1].astype(_jnp.float32)
         import dataclasses as _dc
 

@@ -30,6 +30,9 @@ import numpy as np
 import pandas as pd
 
 from distributed_forecasting_tpu.models.base import get_model
+# JSON round-trips tuples as lists; configs are static jit args and must
+# stay hashable — shared freeze() restores tuples recursively
+from distributed_forecasting_tpu.utils.config import freeze as _freeze
 
 _PARAMS_FILE = "params.npz"
 _META_FILE = "forecaster.json"
@@ -48,11 +51,6 @@ def save_params_npz(path: str, params) -> str:
     np.savez(path, **fields)
     cls = type(params)
     return f"{cls.__module__}:{cls.__qualname__}"
-
-
-# JSON round-trips tuples as lists; configs are static jit args and must
-# stay hashable — shared freeze() restores tuples recursively
-from distributed_forecasting_tpu.utils.config import freeze as _freeze
 
 
 def load_params_npz(path: str, params_type: str):
@@ -92,14 +90,16 @@ class BatchForecaster:
     # -- construction -------------------------------------------------------
     @classmethod
     def from_fit(cls, batch, params, model: str, config) -> "BatchForecaster":
+        # one host pull for both grid endpoints (meta needs python ints)
+        day0, day1 = np.asarray(batch.day[jnp.asarray([0, -1])]).tolist()
         return cls(
             model=model,
             config=config,
             params=params,
             keys=batch.keys,
             key_names=batch.key_names,
-            day0=int(batch.day[0]),
-            day1=int(batch.day[-1]),
+            day0=day0,
+            day1=day1,
         )
 
     # -- persistence --------------------------------------------------------
