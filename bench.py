@@ -25,6 +25,8 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 
@@ -40,8 +42,87 @@ N_WARM_BATCHES = 7
 N_TIMED_RUNS = 6
 
 
+# Run a tiny device computation, not just devices(): round 1 failed at
+# backend *init*, but a tunnel that initializes and then can't execute would
+# be just as fatal to the timed runs.
+_PROBE_CODE = """
+import os
+import jax
+_force = os.environ.get("DFTPU_FORCE_PLATFORM")
+if _force:
+    # NOTE: jax.config.update, not JAX_PLATFORMS — a sitecustomize hook may
+    # import jax (and pin an accelerator platform) before the env var is read
+    jax.config.update("jax_platforms", _force)
+d = jax.devices()[0]
+import jax.numpy as jnp
+assert float(jnp.ones((8, 8)).sum()) == 64.0
+print("PLATFORM=" + d.platform)
+"""
+
+
+def _probe_backend(force_platform: str | None, timeout: float) -> str | None:
+    """Try to init JAX + run one op in a subprocess; return platform or None.
+
+    Backend init on a remote-attached TPU can *raise* (round-1 failure mode:
+    UNAVAILABLE at bench.py:54) or *hang* (observed: jax.devices() blocked
+    >120 s).  A subprocess probe with a hard timeout handles both without
+    poisoning this process's (not-yet-initialized) JAX backend cache.
+    """
+    env = dict(os.environ)
+    if force_platform:
+        env["DFTPU_FORCE_PLATFORM"] = force_platform
+        env["JAX_PLATFORMS"] = force_platform
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", _PROBE_CODE],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        print(f"[bench] backend probe timed out ({timeout:.0f}s) "
+              f"(force={force_platform})", file=sys.stderr)
+        return None
+    for line in p.stdout.splitlines():
+        if line.startswith("PLATFORM="):
+            return line.split("=", 1)[1]
+    tail = (p.stderr or "").strip().splitlines()
+    print(f"[bench] backend probe failed (rc={p.returncode}, "
+          f"force={force_platform}): {tail[-1] if tail else '?'}",
+          file=sys.stderr)
+    return None
+
+
+def choose_backend() -> tuple[str, str | None]:
+    """Pick a working JAX backend BEFORE importing jax in this process.
+
+    Order: ambient (TPU on the driver) with a generous first-init timeout,
+    then forced CPU.  Returns (platform, force_platform_or_None).  Raises
+    only if even CPU fails — per VERDICT r1 #1, the bench must always emit
+    its JSON line unless nothing at all works.
+    """
+    ambient_timeout = float(os.environ.get("DFTPU_BENCH_PROBE_TIMEOUT", "300"))
+    plat = _probe_backend(None, timeout=ambient_timeout)
+    if plat is not None:
+        return plat, None
+    plat = _probe_backend("cpu", timeout=120.0)
+    if plat is not None:
+        return plat, "cpu"
+    raise RuntimeError("no JAX backend available (ambient and CPU both failed)")
+
+
 def main() -> None:
+    platform, force = choose_backend()
+    print(f"[bench] chosen backend: {platform}"
+          + (f" (forced: {force})" if force else " (ambient)"), file=sys.stderr)
+
     import jax
+
+    force = force or os.environ.get("DFTPU_FORCE_PLATFORM")
+    if force:
+        jax.config.update("jax_platforms", force)
+
     import jax.numpy as jnp
 
     from distributed_forecasting_tpu.data import (
@@ -97,10 +178,8 @@ def main() -> None:
         file=sys.stderr,
     )
 
-    # secondary probes (stderr only): pallas gram kernel + 5k-series scale
+    # secondary probes (stderr only): pallas gram kernel
     try:
-        import os
-
         from distributed_forecasting_tpu.engine.fit import _fit_forecast_impl
         from distributed_forecasting_tpu.models import prophet_glm
 
@@ -126,8 +205,6 @@ def main() -> None:
         print(f"[bench] pallas probe failed: {type(e).__name__}: {e}",
               file=sys.stderr)
     finally:
-        import os
-
         os.environ.pop("DFTPU_GRAM_BACKEND", None)
         from distributed_forecasting_tpu.engine.fit import _fit_forecast_impl
         from distributed_forecasting_tpu.models import prophet_glm
@@ -135,26 +212,100 @@ def main() -> None:
         prophet_glm.fit.clear_cache()
         _fit_forecast_impl.clear_cache()
 
+    # ---- ARIMA probe (BASELINE config #3: 500 series, same envelope) ------
     try:
+        def run_arima(b):
+            params, res = fit_forecast(b, model="arima", horizon=HORIZON, key=key)
+            float(res.yhat.sum())
+
+        t0 = time.perf_counter()
+        run_arima(batches[0])
+        arima_compile = time.perf_counter() - t0
+        arima_times = []
+        for i in range(2):
+            t0 = time.perf_counter()
+            run_arima(batches[1 + i])
+            arima_times.append(time.perf_counter() - t0)
+        arima_steady = min(arima_times)
+        print(
+            f"[bench] arima 500x{N_DAYS}: {arima_steady:.3f}s steady "
+            f"({S / arima_steady:.0f} series/s; compile {arima_compile:.1f}s; "
+            f"<10s envelope: {'YES' if arima_steady < 10.0 else 'NO'})",
+            file=sys.stderr,
+        )
+    except Exception as e:
+        print(f"[bench] arima probe failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+
+    # ---- scale probe (BASELINE config #4): 50k series on TPU, 5k on CPU ---
+    try:
+        from distributed_forecasting_tpu.data import synthetic_series_batch
+        from distributed_forecasting_tpu.engine import fit_forecast_chunked
+
+        n_stores_big = 100 if dev.platform == "cpu" else 1000
         big = []
         for s in (10, 11):
-            df5k = synthetic_store_item_sales(
-                n_stores=100, n_items=50, n_days=N_DAYS, seed=s
+            b_big = synthetic_series_batch(
+                n_stores=n_stores_big, n_items=50, n_days=N_DAYS, seed=s
             )
-            b5k = tensorize(df5k)
-            float(b5k.y.sum())
-            big.append(b5k)
-        run(big[0])  # compile for the 5k shape
+            float(b_big.y.sum())
+            big.append(b_big)
+        S_big = big[0].n_series
+        chunk = 8192
+
+        def run_big(b):
+            params, res = fit_forecast_chunked(
+                b, model="prophet", horizon=HORIZON, key=key, chunk_size=chunk
+            )
+            float(res.yhat.sum())
+
+        run_big(big[0])  # compile for the chunk shape
         t0 = time.perf_counter()
-        run(big[1])
+        run_big(big[1])
         dt = time.perf_counter() - t0
         print(
-            f"[bench] scale probe: {big[1].n_series} series in {dt:.3f}s "
-            f"({big[1].n_series / dt:.0f} series/s)",
+            f"[bench] scale probe: {S_big} series (chunk {chunk}) in {dt:.3f}s "
+            f"({S_big / dt:.0f} series/s)",
             file=sys.stderr,
         )
     except Exception as e:
         print(f"[bench] scale probe failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+
+    # ---- long-T probe: HW sequential scan vs associative pscan ------------
+    try:
+        import dataclasses as _dc
+
+        from distributed_forecasting_tpu.models import holt_winters as hw
+
+        T_long = 20000
+        S_long = 8
+        b_long = synthetic_series_batch(
+            n_stores=1, n_items=S_long, n_days=T_long, seed=21
+        )
+        float(b_long.y.sum())
+        cfg_scan = hw.HoltWintersConfig(seasonality_mode="additive",
+                                        n_alpha=3, n_beta=2, n_gamma=2)
+        cfg_ps = _dc.replace(cfg_scan, filter="pscan")
+        out = {}
+        for label, cfg in (("scan", cfg_scan), ("pscan", cfg_ps)):
+            p = hw.fit(b_long.y, b_long.mask, b_long.day, cfg)
+            float(p.level.sum())  # compile + barrier
+            ts = []
+            for _ in range(2):
+                t0 = time.perf_counter()
+                p = hw.fit(b_long.y, b_long.mask, b_long.day, cfg)
+                float(p.level.sum())
+                ts.append(time.perf_counter() - t0)
+            out[label] = min(ts)
+        print(
+            f"[bench] HW long-T (S={S_long}, T={T_long}): "
+            f"scan {out['scan']:.3f}s vs pscan {out['pscan']:.3f}s "
+            f"(speedup x{out['scan'] / out['pscan']:.2f})",
+            file=sys.stderr,
+        )
+    except Exception as e:
+        print(f"[bench] long-T probe failed: {type(e).__name__}: {e}",
               file=sys.stderr)
 
     print(
@@ -164,6 +315,7 @@ def main() -> None:
                 "value": round(series_per_s, 1),
                 "unit": "series/s",
                 "vs_baseline": round(series_per_s / TARGET_SERIES_PER_S, 2),
+                "device": f"{dev.platform}:{dev.device_kind}",
             }
         )
     )

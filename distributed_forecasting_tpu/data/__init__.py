@@ -2,6 +2,7 @@ from distributed_forecasting_tpu.data.tensorize import SeriesBatch, tensorize
 from distributed_forecasting_tpu.data.dataset import (
     load_sales_csv,
     load_sales_parquet,
+    synthetic_series_batch,
     synthetic_store_item_sales,
 )
 from distributed_forecasting_tpu.data.catalog import DatasetCatalog
@@ -11,6 +12,7 @@ __all__ = [
     "tensorize",
     "load_sales_csv",
     "load_sales_parquet",
+    "synthetic_series_batch",
     "synthetic_store_item_sales",
     "DatasetCatalog",
 ]

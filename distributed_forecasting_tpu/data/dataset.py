@@ -38,8 +38,45 @@ def _coerce_sales_frame(df: pd.DataFrame) -> pd.DataFrame:
 
 
 def load_sales_csv(path: str) -> pd.DataFrame:
-    """Read the reference's ``train.csv``/``test.csv`` long format."""
+    """Read the reference's ``train.csv``/``test.csv`` long format.
+
+    Uses the native C++ parser (``native/dftpu_native.cpp``) when available —
+    the default ingest flow's replacement for the JVM CSV reader the
+    reference uses (``02_training.py:30-35``) — falling back to pandas.
+    """
+    from distributed_forecasting_tpu.data import native
+
+    if native.is_available() and _native_csv_layout_ok(path):
+        try:
+            day, store, item, sales = native.parse_sales_csv(path)
+        except (ValueError, IOError):
+            return _coerce_sales_frame(pd.read_csv(path))  # odd schema/layout
+        return pd.DataFrame(
+            {
+                "date": np.datetime64("1970-01-01", "D") + day.astype("timedelta64[D]"),
+                "store": store,
+                "item": item,
+                "sales": sales,
+            }
+        )
     return _coerce_sales_frame(pd.read_csv(path))
+
+
+def _native_csv_layout_ok(path: str) -> bool:
+    """The C parser is positional (date,store,item,sales); the pandas path
+    selects by name.  Only hand a file to the native parser when its header
+    states exactly that order (or there is no header) — a by-name-valid
+    reordering like date,item,store,sales would otherwise parse rc=0 with
+    the keys silently swapped."""
+    try:
+        with open(path, "r") as f:
+            first = f.readline().strip().lstrip("﻿")
+    except OSError:
+        return False
+    cols = [c.strip().strip('"').lower() for c in first.split(",")]
+    if cols and cols[0] and not any(ch.isalpha() for ch in "".join(cols)):
+        return True  # headerless numeric/date first row: positional by spec
+    return cols == ["date", "store", "item", "sales"]
 
 
 def load_sales_parquet(path: str) -> pd.DataFrame:
@@ -62,6 +99,28 @@ def synthetic_store_item_sales(
     reference fits with Prophet (multiplicative seasonality, weekly+yearly,
     linear growth — reference ``02_training.py:162-169``).
     """
+    dates, sales = _synthetic_sales_matrix(n_stores, n_items, n_days, start, seed)
+    S = n_stores * n_items
+    stores = np.repeat(np.arange(1, n_stores + 1), n_items)
+    items = np.tile(np.arange(1, n_items + 1), n_stores)
+    df = pd.DataFrame(
+        {
+            "date": np.tile(dates.values, S),
+            "store": np.repeat(stores, n_days),
+            "item": np.repeat(items, n_days),
+            "sales": np.round(sales.reshape(-1), 2),
+        }
+    )
+    if missing_rate > 0.0:
+        rng = np.random.default_rng(seed + 1)
+        keep = rng.random(len(df)) >= missing_rate
+        df = df[keep].reset_index(drop=True)
+    return df
+
+
+def _synthetic_sales_matrix(n_stores, n_items, n_days, start, seed):
+    """Dense (S, n_days) sales matrix shared by the long-table and direct
+    tensor generators."""
     rng = np.random.default_rng(seed)
     dates = pd.date_range(start, periods=n_days, freq="D")
     t = np.arange(n_days, dtype=np.float64)
@@ -96,18 +155,37 @@ def synthetic_store_item_sales(
     )
     noise = rng.lognormal(mean=0.0, sigma=0.08, size=(S, n_days))
     sales = np.maximum(trend * weekly * yearly * noise, 0.0)
+    return dates, sales
 
+
+def synthetic_series_batch(
+    n_stores: int = 10,
+    n_items: int = 50,
+    n_days: int = 1826,
+    start: str = "2013-01-01",
+    seed: int = 0,
+):
+    """Same synthetic workload as :func:`synthetic_store_item_sales`, built
+    directly as a :class:`SeriesBatch` — no intermediate long table.
+
+    At the 50k-series regime (BASELINE config #4) the long format would be
+    ~91M rows of pandas overhead just to be re-grouped; the fit engine only
+    needs the dense (S, T) tensor, so build that straight away.
+    """
+    import jax.numpy as jnp
+
+    from distributed_forecasting_tpu.data.tensorize import SeriesBatch
+
+    dates, sales = _synthetic_sales_matrix(n_stores, n_items, n_days, start, seed)
     stores = np.repeat(np.arange(1, n_stores + 1), n_items)
     items = np.tile(np.arange(1, n_items + 1), n_stores)
-    df = pd.DataFrame(
-        {
-            "date": np.tile(dates.values, S),
-            "store": np.repeat(stores, n_days),
-            "item": np.repeat(items, n_days),
-            "sales": np.round(sales.reshape(-1), 2),
-        }
+    d0 = (dates.values[0].astype("datetime64[D]")
+          - np.datetime64("1970-01-01", "D")).astype(np.int64)
+    return SeriesBatch(
+        y=jnp.asarray(sales, dtype=jnp.float32),
+        mask=jnp.ones(sales.shape, dtype=jnp.float32),
+        day=jnp.arange(d0, d0 + n_days, dtype=jnp.int32),
+        keys=np.stack([stores, items], axis=1).astype(np.int64),
+        key_names=("store", "item"),
+        start_date=str(dates[0].date()),
     )
-    if missing_rate > 0.0:
-        keep = rng.random(len(df)) >= missing_rate
-        df = df[keep].reset_index(drop=True)
-    return df

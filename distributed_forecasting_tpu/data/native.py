@@ -31,7 +31,12 @@ _SO_NAME = "libdftpu_native.so"
 def _build_and_load() -> Optional[ctypes.CDLL]:
     so_path = os.path.abspath(os.path.join(_NATIVE_DIR, _SO_NAME))
     src_path = os.path.abspath(os.path.join(_NATIVE_DIR, "dftpu_native.cpp"))
-    if not os.path.exists(so_path):
+    stale = (
+        os.path.exists(so_path)
+        and os.path.exists(src_path)
+        and os.path.getmtime(src_path) > os.path.getmtime(so_path)
+    )  # source newer than binary: rebuild, or an ABI change loads a stale .so
+    if not os.path.exists(so_path) or stale:
         if not os.path.exists(src_path):
             return None
         try:
@@ -72,7 +77,7 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
         np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
         np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
         i64, ctypes.c_int32, i64, i64,
-        np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
         np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
     ]
     lib.dftpu_scatter.restype = ctypes.c_int
@@ -134,16 +139,18 @@ def tensorize_arrays(
     keys = keys_buf[: 2 * S].reshape(S, 2).copy()
     d0, d1 = int(day.min()), int(day.max())
     T = d1 - d0 + 1
-    y = np.zeros((S, T), np.float32)
+    # float64 accumulation plane (duplicates sum exactly as the numpy path's
+    # np.add.at on float64), cast to float32 once at the end
+    y64 = np.zeros((S, T), np.float64)
     mask = np.zeros((S, T), np.float32)
     rc = lib.dftpu_scatter(
         series_idx, np.ascontiguousarray(day, np.int32),
-        np.ascontiguousarray(sales, np.float64), n, d0, S, T, y, mask,
+        np.ascontiguousarray(sales, np.float64), n, d0, S, T, y64, mask,
     )
     if rc != 0:
         raise RuntimeError(f"scatter failed (rc={rc})")
     day_grid = np.arange(d0, d1 + 1, dtype=np.int32)
-    return y, mask, day_grid, keys
+    return y64.astype(np.float32), mask, day_grid, keys
 
 
 def load_and_tensorize_csv(path: str):

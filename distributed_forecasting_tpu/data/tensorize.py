@@ -95,12 +95,51 @@ class SeriesBatch:
         )
 
 
+def resolved_backend(n_keys: int = 2, backend: str = "auto") -> str:
+    """Decide which tensorize data plane will run: 'native' or 'pandas'.
+
+    'auto' (or the ``DFTPU_TENSORIZE_BACKEND`` env override) picks native
+    only when the library is available AND the key layout is the 2-key
+    (store, item) one the C ABI supports.  An *explicit* 'native' request
+    that can't be honored raises instead of silently degrading — callers
+    isolating or benchmarking the native path must not get numpy results
+    labeled native.  The training pipeline logs this same resolution as the
+    ``tensorize_backend`` run param.
+    """
+    import os
+
+    if backend == "auto":
+        backend = os.environ.get("DFTPU_TENSORIZE_BACKEND", "auto")
+    if backend not in ("auto", "native", "pandas"):
+        raise ValueError(f"unknown tensorize backend {backend!r}")
+    if backend == "pandas":
+        return "pandas"
+    from distributed_forecasting_tpu.data import native
+
+    supported = n_keys == 2
+    available = native.is_available()
+    if backend == "native":
+        if not supported:
+            raise RuntimeError(
+                f"tensorize backend 'native' requested but the native data "
+                f"plane supports 2 key columns, got {n_keys}"
+            )
+        if not available:
+            raise RuntimeError(
+                "tensorize backend 'native' requested but the native library "
+                "is unavailable (no prebuilt .so and no compiler)"
+            )
+        return "native"
+    return "native" if (supported and available) else "pandas"
+
+
 def tensorize(
     df: pd.DataFrame,
     key_cols: Sequence[str] = ("store", "item"),
     date_col: str = "date",
     value_col: str = "sales",
     dtype=jnp.float32,
+    backend: str = "auto",
 ) -> SeriesBatch:
     """Long table ``(date, *keys, value)`` -> :class:`SeriesBatch`.
 
@@ -108,6 +147,14 @@ def tensorize(
     done once on the host.  Duplicate (key, date) rows are summed, matching
     SQL ``GROUP BY`` aggregation semantics of the reference's history queries
     (reference ``02_training.py:225-231``).
+
+    ``backend``: 'native' = C++ group+scatter (``native/dftpu_native.cpp`` —
+    the default flow's fast path, where the reference leans on Arrow C++ /
+    the Spark JVM), 'pandas' = pure numpy, 'auto' (default) = native when the
+    library is available and the key layout supports it, else numpy.  The
+    ``DFTPU_TENSORIZE_BACKEND`` env var overrides 'auto'.  Both paths produce
+    identical batches (keys lexicographically sorted, duplicates summed) —
+    equivalence is tested in ``tests/unit/test_native.py``.
     """
     df = df[[date_col, *key_cols, value_col]].copy()
     dates = pd.to_datetime(df[date_col])
@@ -118,13 +165,32 @@ def tensorize(
     T = d1 - d0 + 1
 
     keys_df = df[list(key_cols)].astype(np.int64)
+    vals = df[value_col].to_numpy(dtype=np.float64)
+
+    if resolved_backend(n_keys=len(key_cols), backend=backend) == "native":
+        from distributed_forecasting_tpu.data import native
+
+        y32, m, day_grid, uniq = native.tensorize_arrays(
+            day.astype(np.int32),
+            keys_df.iloc[:, 0].to_numpy(np.int64),
+            keys_df.iloc[:, 1].to_numpy(np.int64),
+            vals,
+        )
+        return SeriesBatch(
+            y=jnp.asarray(y32, dtype=dtype),
+            mask=jnp.asarray(m, dtype=dtype),
+            day=jnp.asarray(day_grid),
+            keys=uniq,
+            key_names=tuple(key_cols),
+            start_date=str(np.datetime64(d0, "D")),
+        )
+
     uniq, series_idx = np.unique(keys_df.values, axis=0, return_inverse=True)
     S = uniq.shape[0]
 
     y = np.zeros((S, T), dtype=np.float64)
     m = np.zeros((S, T), dtype=np.float32)
     tpos = (day - d0).astype(np.int64)
-    vals = df[value_col].to_numpy(dtype=np.float64)
     np.add.at(y, (series_idx, tpos), vals)
     m[series_idx, tpos] = 1.0
 

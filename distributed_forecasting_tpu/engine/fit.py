@@ -60,6 +60,31 @@ def seasonal_naive(y, mask, horizon: int, season: int = 7):
     return jnp.concatenate([y, fut], axis=1)
 
 
+def seasonal_naive_sigma(y, mask, season: int = 7):
+    """Per-series residual scale of the seasonal-naive predictor.
+
+    In-sample seasonal-naive predicts y[t] = y[t-season]; the RMS of those
+    lag-``season`` differences over observed pairs is the honest noise scale
+    for the fallback band.  Degenerate series (no observed pair) fall back to
+    the masked std of y, then to 1.0, so the band is never zero-width.
+    """
+    d = y[:, season:] - y[:, :-season]
+    m = mask[:, season:] * mask[:, :-season]
+    n = jnp.sum(m, axis=1)
+    ssq = jnp.sum((d * m) ** 2, axis=1)
+    sigma = jnp.sqrt(ssq / jnp.maximum(n, 1.0))
+    # fallback of the fallback: masked std, then unit scale
+    mean = jnp.sum(y * mask, axis=1) / jnp.maximum(jnp.sum(mask, axis=1), 1.0)
+    var = jnp.sum(((y - mean[:, None]) * mask) ** 2, axis=1) / jnp.maximum(
+        jnp.sum(mask, axis=1), 1.0
+    )
+    sigma = jnp.where(n > 0, sigma, jnp.sqrt(var))
+    # no lag pairs AND no spread at all (e.g. a single observed point):
+    # unit scale, so the band is genuinely never zero-width for the
+    # too-little-history population this fallback serves
+    return jnp.where((n > 0) | (var > 0), jnp.maximum(sigma, 1e-6), 1.0)
+
+
 def day_grid(day, horizon: int):
     """History + horizon day grid, built on device.
 
@@ -91,10 +116,22 @@ def _fit_forecast_impl(y, mask, day, key, model, config, horizon, min_points):
     enough = jnp.sum(mask, axis=1) >= min_points
     ok = finite & enough
 
+    # fallback splice: seasonal-naive path with a NON-degenerate 95% band.
+    # Seasonal-naive h-step error variance compounds one innovation per
+    # seasonal cycle ahead: var(h) = ceil(h/season) * sigma^2 — the band
+    # widens with lead time instead of staying at the 1-step width.
+    season = 7
     fb = seasonal_naive(y, mask, horizon)
+    fb_sigma = seasonal_naive_sigma(y, mask, season=season)
+    T = y.shape[1]
+    h_fut = jnp.arange(1, horizon + 1, dtype=jnp.float32)
+    widen = jnp.concatenate(
+        [jnp.ones((T,)), jnp.sqrt(jnp.ceil(h_fut / season))]
+    )  # (T + horizon,)
+    band = 1.96 * fb_sigma[:, None] * widen[None, :]
     yhat = jnp.where(ok[:, None], yhat, fb)
-    lo = jnp.where(ok[:, None], lo, fb)
-    hi = jnp.where(ok[:, None], hi, fb)
+    lo = jnp.where(ok[:, None], lo, fb - band)
+    hi = jnp.where(ok[:, None], hi, fb + band)
     return params, yhat, lo, hi, ok, day_all
 
 

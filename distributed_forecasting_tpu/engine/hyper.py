@@ -46,6 +46,10 @@ class HyperSearchConfig:
     metric: str = "smape"  # selection metric (reference automl: val_smape)
     cp_scale_range: Tuple[float, float] = (0.001, 0.5)
     seas_scale_range: Tuple[float, float] = (0.01, 10.0)
+    # reference automl sweeps holidays_prior_scale log-uniform alongside the
+    # other two scales (notebooks/automl/22-09-26...py:111-123); a no-op
+    # when the model config has no holiday features
+    hol_scale_range: Tuple[float, float] = (0.01, 10.0)
     modes: Tuple[str, ...] = ("additive", "multiplicative")
     seed: int = 0
 
@@ -56,6 +60,7 @@ class TuneResult:
     config: CurveModelConfig     # config used for the refit/serving
     best_cp_scale: np.ndarray    # (S,)
     best_seas_scale: np.ndarray  # (S,)
+    best_hol_scale: np.ndarray   # (S,)
     best_mode: np.ndarray        # (S,) str
     best_score: np.ndarray       # (S,) CV-mean selection metric
     trials: pd.DataFrame         # trial table (mode, scales, mean score)
@@ -68,7 +73,7 @@ def _log_uniform(key, lo, hi, n):
 
 
 def _cv_scores(batch: SeriesBatch, config: CurveModelConfig, cv: CVConfig,
-               cp_scales, seas_scales, metric: str):
+               cp_scales, seas_scales, hol_scales, metric: str):
     """CV-mean metric for every (trial, series).  Returns (C_trials, S)."""
     cuts = cutoff_indices(batch.n_time, cv)
     train_masks, eval_masks, t_ends = cv_windows(
@@ -76,10 +81,11 @@ def _cv_scores(batch: SeriesBatch, config: CurveModelConfig, cv: CVConfig,
     )
     fn = metrics_ops.METRIC_FNS[metric]
 
-    def one_trial(cp, seas):
+    def one_trial(cp, seas, hol):
         def one_cutoff(train_mask, eval_mask, t_end):
             params = prophet_glm.fit(
-                batch.y, train_mask, batch.day, config, prior_scales=(cp, seas)
+                batch.y, train_mask, batch.day, config,
+                prior_scales=(cp, seas, hol),
             )
             yhat, _, _ = prophet_glm.forecast(
                 params, batch.day, t_end, config, jax.random.PRNGKey(0)
@@ -90,7 +96,7 @@ def _cv_scores(batch: SeriesBatch, config: CurveModelConfig, cv: CVConfig,
         score = jnp.mean(per_cut, axis=0)
         return jnp.where(jnp.isfinite(score), score, jnp.inf)
 
-    return jax.vmap(one_trial)(cp_scales, seas_scales)
+    return jax.vmap(one_trial)(cp_scales, seas_scales, hol_scales)
 
 
 def tune_curve_model(
@@ -101,16 +107,18 @@ def tune_curve_model(
 ) -> TuneResult:
     base_config = base_config or CurveModelConfig()
     key = jax.random.PRNGKey(search.seed)
-    k_cp, k_seas = jax.random.split(key)
+    k_cp, k_seas, k_hol = jax.random.split(key, 3)
     cp_scales = _log_uniform(k_cp, *search.cp_scale_range, search.n_trials)
     seas_scales = _log_uniform(k_seas, *search.seas_scale_range, search.n_trials)
+    hol_scales = _log_uniform(k_hol, *search.hol_scale_range, search.n_trials)
 
     S = batch.n_series
     all_scores = []  # list of (n_trials, S) per mode
     trial_rows = []
     for mode in search.modes:
         cfg = dataclasses.replace(base_config, seasonality_mode=mode)
-        scores = _cv_scores(batch, cfg, cv, cp_scales, seas_scales, search.metric)
+        scores = _cv_scores(batch, cfg, cv, cp_scales, seas_scales, hol_scales,
+                            search.metric)
         all_scores.append(np.asarray(scores))
         for t in range(search.n_trials):
             trial_rows.append(
@@ -118,6 +126,7 @@ def tune_curve_model(
                     "mode": mode,
                     "changepoint_prior_scale": float(cp_scales[t]),
                     "seasonality_prior_scale": float(seas_scales[t]),
+                    "holidays_prior_scale": float(hol_scales[t]),
                     f"mean_{search.metric}": float(np.mean(all_scores[-1][t])),
                 }
             )
@@ -129,8 +138,10 @@ def tune_curve_model(
     best_trial_idx = best_flat % search.n_trials
     cp_np = np.asarray(cp_scales)
     seas_np = np.asarray(seas_scales)
+    hol_np = np.asarray(hol_scales)
     best_cp = cp_np[best_trial_idx]
     best_seas = seas_np[best_trial_idx]
+    best_hol = hol_np[best_trial_idx]
     best_mode = np.asarray(search.modes)[best_mode_idx]
     best_score = flat[best_flat, np.arange(S)]
 
@@ -141,7 +152,8 @@ def tune_curve_model(
         cfg = dataclasses.replace(base_config, seasonality_mode=mode)
         mode_params[mode] = prophet_glm.fit(
             batch.y, batch.mask, batch.day, cfg,
-            prior_scales=(jnp.asarray(best_cp), jnp.asarray(best_seas)),
+            prior_scales=(jnp.asarray(best_cp), jnp.asarray(best_seas),
+                          jnp.asarray(best_hol)),
         )
 
     # primary params: majority mode (used where a single CurveParams is needed)
@@ -152,6 +164,7 @@ def tune_curve_model(
         config=dataclasses.replace(base_config, seasonality_mode=major),
         best_cp_scale=best_cp,
         best_seas_scale=best_seas,
+        best_hol_scale=best_hol,
         best_mode=best_mode,
         best_score=best_score,
         trials=pd.DataFrame(trial_rows),

@@ -111,7 +111,7 @@ def _init_cov(T, RRt, n_iter=30):
 
 def _kalman_loglik(z, mask, phi, theta, r):
     """Filter one differenced series; unit innovation variance (sigma2 is
-    concentrated out).  Returns (ssq, n, preds, a_T, P_T, F_path)."""
+    concentrated out).  Returns (ssq, ldet, n, preds, Fs, a_T, P_T)."""
     T_mat, Rv = _build_ssm(phi, theta, r)
     RRt = jnp.outer(Rv, Rv)
     P0 = _init_cov(T_mat, RRt)
@@ -216,8 +216,12 @@ def fit(y, mask, day, config: ArimaConfig) -> ArimaParams:
                 return (lvl_new, var_new), (mean_t, var_t)
 
             zero = jnp.sum(ys) * 0.0
+            # seed the level from the FIRST OBSERVED value, not ys[0]: a
+            # leading padded stretch (mask==0) would otherwise anchor the
+            # fitted path at the padding zero until the first real day
+            y_first = ys[jnp.argmax(ms)]
             (lvl_T, var_T), (means, vars_) = jax.lax.scan(
-                step, (ys[0], zero), (ys, ms, zh, Fv)
+                step, (y_first, zero), (ys, ms, zh, Fv)
             )
             return means, vars_, lvl_T, var_T
 

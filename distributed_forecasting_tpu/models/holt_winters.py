@@ -43,6 +43,12 @@ class HoltWintersConfig:
     n_alpha: int = 6
     n_beta: int = 4
     n_gamma: int = 4
+    # time-dimension solver: 'scan' = sequential lax.scan (serial depth T);
+    # 'pscan' = associative parallel prefix over affine maps (O(log T) depth,
+    # additive mode only) — the long-series regime where the scan's serial
+    # chain, not the series axis, bounds wall time.  See docs/parallelism.md
+    # for the measured crossover.
+    filter: str = "scan"  # 'scan' | 'pscan'
 
 
 @jax.tree_util.register_dataclass
@@ -212,15 +218,27 @@ def fit(y, mask, day, config: HoltWintersConfig) -> HWParams:
     mode = config.seasonality_mode
     A, B, G = _candidate_grid(config)
 
+    if config.filter == "pscan":
+        if mode != "additive":
+            raise ValueError(
+                "filter='pscan' supports additive seasonality only "
+                "(the multiplicative update is not affine in the state)"
+            )
+        filt = lambda ys, ms, a, b, g: parallel_filter(ys, ms, a, b, g, m)
+    elif config.filter == "scan":
+        filt = lambda ys, ms, a, b, g: _filter(ys, ms, a, b, g, m, mode)
+    else:
+        raise ValueError(f"unknown filter {config.filter!r}; 'scan' or 'pscan'")
+
     def per_series(ys, ms):
         def score(a, b, g):
-            _, mse, _ = _filter(ys, ms, a, b, g, m, mode)
+            _, mse, _ = filt(ys, ms, a, b, g)
             return mse
 
         msec = jax.vmap(score)(A, B, G)  # (C,)
         best = jnp.argmin(msec)
         a, b, g = A[best], B[best], G[best]
-        (l, bb, s), mse, preds = _filter(ys, ms, a, b, g, m, mode)
+        (l, bb, s), mse, preds = filt(ys, ms, a, b, g)
         return a, b, g, l, bb, s, jnp.sqrt(mse), preds
 
     a, b, g, l, t, s, sig, fitted = jax.vmap(per_series)(y, mask)

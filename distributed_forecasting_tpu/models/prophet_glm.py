@@ -118,21 +118,24 @@ def _feature_masks(layout):
             jnp.asarray(slope), jnp.asarray(hol))
 
 
-def _prior_precision(layout, cfg: CurveModelConfig, cp_scale=None, seas_scale=None):
+def _prior_precision(layout, cfg: CurveModelConfig, cp_scale=None, seas_scale=None,
+                     hol_scale=None):
     """Per-feature ridge precision: flat prior on intercept/slope, Laplace->
     ridge surrogate 1/scale^2 on changepoint deltas and seasonality.
 
-    ``cp_scale`` / ``seas_scale`` may be traced scalars or (S,)/(S,1) arrays —
-    the hyperparameter-search path (engine/hyper.py) sweeps them WITHOUT
-    recompiling, the analogue of the reference AutoML's per-series hyperopt
-    over changepoint/seasonality prior scales
+    ``cp_scale`` / ``seas_scale`` / ``hol_scale`` may be traced scalars or
+    (S,)/(S,1) arrays — the hyperparameter-search path (engine/hyper.py)
+    sweeps them WITHOUT recompiling, the analogue of the reference AutoML's
+    per-series hyperopt over changepoint/seasonality/holiday prior scales
     (``notebooks/automl/22-09-26...py:111-123``).  Result broadcasts to
     (F,) or (S, F).
     """
     cp_scale = cfg.changepoint_prior_scale if cp_scale is None else cp_scale
     seas_scale = cfg.seasonality_prior_scale if seas_scale is None else seas_scale
+    hol_scale = cfg.holiday_prior_scale if hol_scale is None else hol_scale
     cp_scale = jnp.asarray(cp_scale)[..., None]  # (...,1) broadcasts over F
     seas_scale = jnp.asarray(seas_scale)[..., None]
+    hol_scale = jnp.asarray(hol_scale)[..., None]
     cp_m, seas_m, fixed_m, slope_m, hol_m = _feature_masks(layout)
     # flat growth = no trend at all: clamp the slope AND the changepoint
     # hinges (which would otherwise reintroduce a piecewise trend)
@@ -144,7 +147,7 @@ def _prior_precision(layout, cfg: CurveModelConfig, cp_scale=None, seas_scale=No
         + seas_m * (1.0 / seas_scale**2)
         + fixed_m * 1e-8
         + slope_m * slope_prec
-        + hol_m * (1.0 / cfg.holiday_prior_scale**2)
+        + hol_m * (1.0 / hol_scale**2)
     )
     return lam
 
@@ -166,9 +169,10 @@ def _design(day, t0, t1, cfg: CurveModelConfig):
 def fit(y, mask, day, config: CurveModelConfig, prior_scales=None) -> CurveParams:
     """Fit all series at once.  y, mask: (S, T); day: (T,) absolute days.
 
-    ``prior_scales``: optional (changepoint_scale, seasonality_scale)
-    overrides — traced scalars or per-series (S,) arrays (hyper-search path);
-    ``None`` uses the static config values.
+    ``prior_scales``: optional (changepoint_scale, seasonality_scale) or
+    (changepoint_scale, seasonality_scale, holiday_scale) overrides — traced
+    scalars or per-series (S,) arrays (hyper-search path); ``None`` uses the
+    static config values.
     """
     t0 = day[0].astype(jnp.float32)
     t1 = day[-1].astype(jnp.float32)
@@ -188,8 +192,13 @@ def fit(y, mask, day, config: CurveModelConfig, prior_scales=None) -> CurveParam
             y_scale = jnp.maximum(jnp.max(jnp.abs(z) * mask, axis=1), 1.0)
     zn = z / y_scale[:, None]
     X, layout = _design(day, t0, t1, config)
-    cp_s, seas_s = (None, None) if prior_scales is None else prior_scales
-    lam = _prior_precision(layout, config, cp_s, seas_s)
+    if prior_scales is None:
+        cp_s = seas_s = hol_s = None
+    elif len(prior_scales) == 2:
+        (cp_s, seas_s), hol_s = prior_scales, None
+    else:
+        cp_s, seas_s, hol_s = prior_scales
+    lam = _prior_precision(layout, config, cp_s, seas_s, hol_s)
     beta = ridge_solve_batch(X, zn, mask, lam)
     sigma = weighted_residual_scale(X, zn, mask, beta)
     return CurveParams(beta=beta, sigma=sigma, y_scale=y_scale, cap=cap,

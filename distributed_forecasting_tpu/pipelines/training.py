@@ -141,12 +141,18 @@ class TrainingPipeline:
                 import dataclasses as _dc
 
                 run.log_params(_dc.asdict(config))
+            from distributed_forecasting_tpu.data.tensorize import resolved_backend
+
             run.log_params(
                 {
                     "n_series": batch.n_series,
                     "n_time": batch.n_time,
                     "horizon": horizon,
                     "n_failed_series": n_failed,
+                    # which host data plane produced the tensor (the
+                    # phase_tensorize_seconds metric is comparable across
+                    # backends; see data/tensorize.py)
+                    "tensorize_backend": resolved_backend(n_keys=len(key_cols)),
                 }
             )
             agg = {"fit_seconds": fit_seconds,
@@ -246,20 +252,21 @@ class TrainingPipeline:
             outs[mode] = prophet_glm.forecast(
                 params, day_all, t_end, cfg_m, _jax.random.PRNGKey(0)
             )
+        # per-series winning-mode gather stays ON DEVICE: stack per-mode
+        # outputs (M, S, T) and index with the (S,) mode-pick vector — only
+        # the pick indices (strings, inherently host data) cross the boundary
         modes = list(tuned.mode_params)
         sel = np.asarray(tuned.best_mode)
-        pick = np.asarray([modes.index(m) for m in sel])  # (S,)
-        stack = {
-            i: np.stack([np.asarray(outs[m][i]) for m in modes]) for i in range(3)
-        }
-        yhat = stack[0][pick, np.arange(len(pick))]
-        lo = stack[1][pick, np.arange(len(pick))]
-        hi = stack[2][pick, np.arange(len(pick))]
+        pick = _jnp.asarray([modes.index(m) for m in sel])  # (S,)
+        arange_s = _jnp.arange(pick.shape[0])
+        yhat = _jnp.stack([outs[m][0] for m in modes])[pick, arange_s]
+        lo = _jnp.stack([outs[m][1] for m in modes])[pick, arange_s]
+        hi = _jnp.stack([outs[m][2] for m in modes])[pick, arange_s]
         fit_seconds = time.time() - t_start
 
         result = ForecastResult(
-            yhat=_jnp.asarray(yhat), lo=_jnp.asarray(lo), hi=_jnp.asarray(hi),
-            ok=_jnp.asarray(np.isfinite(yhat).all(axis=1)), day_all=day_all,
+            yhat=yhat, lo=lo, hi=hi,
+            ok=_jnp.isfinite(yhat).all(axis=1), day_all=day_all,
         )
 
         eid = self.tracker.create_experiment(experiment)
@@ -285,6 +292,7 @@ class TrainingPipeline:
             series_table["best_mode"] = sel
             series_table["best_changepoint_prior_scale"] = tuned.best_cp_scale
             series_table["best_seasonality_prior_scale"] = tuned.best_seas_scale
+            series_table["best_holidays_prior_scale"] = tuned.best_hol_scale
             series_table[f"best_{search.metric}"] = tuned.best_score
             run.log_table("series_metrics.parquet", series_table)
             forecaster = BatchForecaster.from_fit(
@@ -412,11 +420,28 @@ class TrainingPipeline:
         }
 
     def _log_per_series_runs(self, eid: str, series_table: pd.DataFrame, parent: str):
-        for row in series_table.itertuples(index=False):
+        """Optional reference-shaped drill-down: one run per series, named
+        ``run_item_{item}_store_{store}`` (reference ``02_training.py:160-161``).
+
+        Where the reference logs one serialized Prophet model per series run
+        (``02_training.py:193-196``), the model here is ONE batched artifact
+        on the parent run — so each per-series run links its slice: the
+        parent run id, the artifact path, and the series' row index into
+        every leading-S parameter array (``serving/predictor.py`` loads the
+        pytree; ``gather_params([row])`` extracts exactly this slice).
+        """
+        for i, row in enumerate(series_table.itertuples(index=False)):
             d = row._asdict()
             name = f"run_item_{d.get('item')}_store_{d.get('store')}"
             with self.tracker.start_run(
-                eid, run_name=name, tags={"parent_run_id": parent}
+                eid,
+                run_name=name,
+                tags={
+                    "parent_run_id": parent,
+                    "artifact_run_id": parent,
+                    "artifact_path": "forecaster",
+                    "series_index": str(i),
+                },
             ) as r:
                 r.log_metrics(
                     {k: float(v) for k, v in d.items()

@@ -58,6 +58,12 @@ class MultiModelForecaster:
         # store assignment as family-name indices into self.models (sorted),
         # independent of selection.models ordering
         name_per_series = selection.chosen
+        unknown = sorted(set(name_per_series) - set(fcs))
+        if unknown:
+            raise ValueError(
+                f"selection assigns series to famil{'ies' if len(unknown) > 1 else 'y'} "
+                f"{unknown} absent from params_by_family (has {sorted(fcs)})"
+            )
         order = {n: j for j, n in enumerate(sorted(fcs))}
         assignment = np.asarray([order[n] for n in name_per_series])
         return cls(fcs, assignment)

@@ -38,6 +38,12 @@ _PARAMS_FILE = "params.npz"
 _META_FILE = "forecaster.json"
 
 
+def _to_jsonable(x):
+    from distributed_forecasting_tpu.utils.config import to_jsonable
+
+    return to_jsonable(x, strict=True)
+
+
 def save_params_npz(path: str, params) -> str:
     """Serialize a flat-dataclass param pytree (fields = arrays/scalars) to a
     single .npz — the one-artifact-for-all-series persistence this framework
@@ -123,7 +129,10 @@ class BatchForecaster:
             + ", yhat double, yhat_upper double, yhat_lower double",
         }
         with open(os.path.join(directory, _META_FILE), "w") as f:
-            json.dump(meta, f, indent=2)
+            # dataclasses.asdict does not recurse into FrozenMap (a Mapping,
+            # not a dict): dict-valued config fields serialize here, and
+            # load() re-freezes them
+            json.dump(meta, f, indent=2, default=_to_jsonable)
 
     @classmethod
     def load(cls, directory: str) -> "BatchForecaster":
@@ -164,6 +173,27 @@ class BatchForecaster:
             # on_missing == 'skip': drop silently
         return np.asarray(idx, dtype=np.int64)
 
+    def gather_params(self, sidx: np.ndarray):
+        """Row-gather the requested series out of the param pytree.
+
+        Leaves whose leading axis is the series axis (shape[0] == S) are
+        indexed down to the request; scalars and global leaves pass through.
+        This is what makes ``predict`` cost O(k) for a k-series request
+        instead of O(S_trained) — the scale regime (50k-series artifacts,
+        BASELINE #4) where forecasting everything and row-selecting after
+        would reintroduce the reference's serve-everything cost profile.
+        """
+        S = self.keys.shape[0]
+        take = jnp.asarray(sidx)
+
+        def g(leaf):
+            leaf = jnp.asarray(leaf)
+            if leaf.ndim >= 1 and leaf.shape[0] == S:
+                return leaf[take]
+            return leaf
+
+        return jax.tree_util.tree_map(g, self.params)
+
     def predict(
         self,
         request: pd.DataFrame,
@@ -184,13 +214,20 @@ class BatchForecaster:
         fns = get_model(self.model)
         start = self.day0 if include_history else self.day1 + 1
         day_all = jnp.arange(start, self.day1 + horizon + 1, dtype=jnp.int32)
-        params = jax.tree_util.tree_map(jnp.asarray, self.params)
+        # bucket the request size to the next power of two (capped at S) so a
+        # serving process sees O(log S) compiled shapes, not one per distinct
+        # request size; padding rows repeat sidx[0] and are dropped after
+        k = int(sidx.size)
+        bucket = min(1 << (k - 1).bit_length(), self.keys.shape[0])
+        bucket = max(bucket, k)  # k == S but S not a power of two
+        padded = np.concatenate([sidx, np.full(bucket - k, sidx[0], sidx.dtype)])
+        params = self.gather_params(padded)
         yhat, lo, hi = fns.forecast(
             params, day_all, jnp.float32(self.day1), self.config, key
         )
-        yhat = np.asarray(yhat)[sidx]
-        lo = np.asarray(lo)[sidx]
-        hi = np.asarray(hi)[sidx]
+        yhat = np.asarray(yhat)[:k]
+        lo = np.asarray(lo)[:k]
+        hi = np.asarray(hi)[:k]
 
         T = day_all.shape[0]
         dates = pd.to_datetime(np.asarray(day_all, dtype="int64"), unit="D")

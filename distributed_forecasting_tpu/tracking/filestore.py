@@ -50,11 +50,9 @@ def _write_json(path: str, obj) -> None:
 
 
 def _jsonable(x):
-    if isinstance(x, (np.floating, np.integer)):
-        return x.item()
-    if isinstance(x, np.ndarray):
-        return x.tolist()
-    return str(x)
+    from distributed_forecasting_tpu.utils.config import to_jsonable
+
+    return to_jsonable(x, strict=False)
 
 
 def _read_json(path: str, default=None):

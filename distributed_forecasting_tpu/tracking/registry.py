@@ -97,11 +97,12 @@ class ModelRegistry:
         d = self._model_dir(name)
         if not os.path.isdir(d):
             return []
-        out = []
-        for entry in sorted(os.listdir(d)):
-            if entry.startswith("v") and entry[1:].isdigit():
-                out.append(self.get_version(name, int(entry[1:])))
-        return out
+        versions = sorted(
+            int(entry[1:])
+            for entry in os.listdir(d)
+            if entry.startswith("v") and entry[1:].isdigit()
+        )  # numeric sort: lexical would put v10 before v2 (latest == wrong)
+        return [self.get_version(name, v) for v in versions]
 
     def latest_version(
         self, name: str, stage: Optional[str] = None
@@ -142,6 +143,27 @@ class ModelRegistry:
         return sorted(
             d for d in os.listdir(base) if os.path.isdir(os.path.join(base, d))
         )
+
+    # -- cleanup (reference 05_monitoring_wip.py:40-59 archives every version
+    # then deletes the registered model) ------------------------------------
+    def archive_version(self, name: str, version: int) -> ModelVersion:
+        """Stage transition to Archived — the reference's pre-delete step."""
+        return self.transition_stage(name, version, "Archived")
+
+    def delete_version(self, name: str, version: int) -> None:
+        vdir = os.path.join(self._model_dir(name), f"v{version}")
+        if not os.path.isdir(vdir):
+            raise KeyError(f"model {name} version {version} not found")
+        shutil.rmtree(vdir)
+
+    def delete_model(self, name: str) -> None:
+        """Archive-and-delete every version, then the model itself."""
+        d = self._model_dir(name)
+        if not os.path.isdir(d):
+            raise KeyError(f"model {name} not found")
+        for v in self.list_versions(name):
+            self.archive_version(name, v.version)
+        shutil.rmtree(d)
 
     @staticmethod
     def _read(path: str):

@@ -15,6 +15,7 @@ YAML under an ``engine:`` key — the third tier the reference implements as
 from __future__ import annotations
 
 import argparse
+from collections.abc import Mapping
 from typing import Any, Dict, List, Optional
 
 import yaml
@@ -25,16 +26,79 @@ def load_conf(path: str) -> Dict[str, Any]:
         return yaml.safe_load(f) or {}
 
 
-def freeze(value):
-    """Recursively turn lists into tuples.
+class FrozenMap(Mapping):
+    """Immutable, hashable mapping for dict-valued config fields.
 
-    YAML and JSON both deliver sequences as lists, but model config
-    dataclasses are static jit arguments and must stay hashable — every
-    config constructed from conf files or persisted metadata goes through
-    this (training pipeline, serving artifact load).
+    Reads like a dict (so ``**cfg`` / ``cfg[key]`` consumers keep working)
+    but hashes, so a config dataclass holding one stays a valid static jit
+    argument.  Values must already be frozen (``freeze`` guarantees this).
+    """
+
+    __slots__ = ("_d",)
+
+    def __init__(self, d):
+        object.__setattr__(self, "_d", dict(d))
+
+    def __getitem__(self, k):
+        return self._d[k]
+
+    def __iter__(self):
+        return iter(self._d)
+
+    def __len__(self):
+        return len(self._d)
+
+    def __hash__(self):
+        return hash(tuple(sorted(self._d.items())))
+
+    def __eq__(self, other):
+        if isinstance(other, Mapping):
+            return dict(self._d) == dict(other)
+        return NotImplemented
+
+    def __repr__(self):
+        return f"FrozenMap({self._d!r})"
+
+
+def to_jsonable(x, strict: bool = False):
+    """Coerce frozen-config / numpy values to plain JSON types.
+
+    The single coercion rule shared by the tracker param store
+    (``tracking/filestore.py``) and the forecaster artifact meta
+    (``serving/predictor.py``) — one place to extend when a new config value
+    type appears, so the two serializations cannot diverge.  ``strict=True``
+    raises on unknown types (artifact meta must round-trip); the default
+    degrades to ``str(x)`` (tracker params are display-oriented).
+    """
+    import numpy as np
+
+    if isinstance(x, (str, int, float, bool)) or x is None:
+        return x
+    if isinstance(x, (np.floating, np.integer)):
+        return x.item()
+    if isinstance(x, np.ndarray):
+        return x.tolist()
+    if isinstance(x, Mapping):  # e.g. FrozenMap
+        return {k: to_jsonable(v, strict) for k, v in x.items()}
+    if isinstance(x, (tuple, list)):
+        return [to_jsonable(v, strict) for v in x]
+    if strict:
+        raise TypeError(f"not JSON serializable: {type(x).__name__}")
+    return str(x)
+
+
+def freeze(value):
+    """Recursively turn lists into tuples and dicts into hashable maps.
+
+    YAML and JSON both deliver sequences as lists and mappings as dicts, but
+    model config dataclasses are static jit arguments and must stay hashable
+    — every config constructed from conf files or persisted metadata goes
+    through this (training pipeline, serving artifact load).
     """
     if isinstance(value, list):
         return tuple(freeze(v) for v in value)
+    if isinstance(value, dict):
+        return FrozenMap({k: freeze(v) for k, v in value.items()})
     return value
 
 

@@ -194,14 +194,16 @@ int dftpu_group_keys(const int64_t* store, const int64_t* item, int64_t n,
 // Fused scatter-add tensorization: rows -> dense float32 (S, T) value and
 // mask planes (duplicates summed — SQL GROUP BY semantics).  y/mask must be
 // zero-initialized by the caller.
+// Accumulates into a double plane (duplicate rows sum in float64, matching
+// the numpy reference path exactly); the caller casts to float32 once.
 int dftpu_scatter(const int64_t* series_idx, const int32_t* day,
                   const double* sales, int64_t n, int32_t day0, int64_t S,
-                  int64_t T, float* y, float* mask) {
+                  int64_t T, double* y, float* mask) {
   for (int64_t i = 0; i < n; ++i) {
     const int64_t s = series_idx[i];
     const int64_t t = static_cast<int64_t>(day[i]) - day0;
     if (s < 0 || s >= S || t < 0 || t >= T) return 3;
-    y[s * T + t] += static_cast<float>(sales[i]);
+    y[s * T + t] += sales[i];
     mask[s * T + t] = 1.0f;
   }
   return 0;

@@ -83,3 +83,28 @@ def test_sharded_cv_matches_unsharded(batch_small, mesh):
 def test_mesh_too_many_devices_errors():
     with pytest.raises(ValueError, match="devices"):
         make_mesh(1024)
+
+
+def test_uneven_shard_fit_and_metrics(mesh):
+    """S=50 over 8 devices (pad to 56): fit equals single-device and the
+    psum metric means are unaffected by the 6 padding rows (the uneven-shard
+    regime of BASELINE config #4, VERDICT r1 #5)."""
+    from distributed_forecasting_tpu.data import synthetic_series_batch
+
+    b = synthetic_series_batch(n_stores=10, n_items=5, n_days=500, seed=3)
+    assert b.n_series == 50 and b.n_series % 8 != 0
+
+    _, res_single = fit_forecast(b, model="prophet", horizon=30)
+    _, res_shard = sharded_fit_forecast(b, model="prophet", horizon=30, mesh=mesh)
+    assert res_shard.yhat.shape[0] == 56  # padded to the mesh multiple
+    np.testing.assert_allclose(
+        np.asarray(res_shard.yhat)[:50], np.asarray(res_single.yhat),
+        rtol=2e-3, atol=1e-2,
+    )
+    ok = np.asarray(res_shard.ok)
+    assert ok[:50].all() and not ok[50:].any()
+
+    # global means over the sharded result must ignore padding rows exactly
+    vals = {"err": jnp.where(res_shard.ok[:, None], 1.0, 100.0).mean(axis=1)}
+    means = global_metric_means(vals, res_shard.ok, mesh)
+    np.testing.assert_allclose(float(means["err"]), 1.0, rtol=1e-6)

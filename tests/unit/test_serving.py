@@ -63,3 +63,32 @@ def test_unseen_series_raises_clearly(forecaster):
     # or skips on request (vs the reference's bare IndexError, SURVEY §2.3-3)
     out = forecaster.predict(req, horizon=5, on_missing="skip")
     assert len(out) == 0
+
+
+def test_predict_is_request_proportional(forecaster):
+    """A k-series request gathers params to leading axis k BEFORE the
+    compiled forecast — O(k) work, not O(S_trained) then row-select
+    (VERDICT r1 weak-#5: don't reintroduce the reference's serve-everything
+    cost at 50k-artifact scale)."""
+    import dataclasses
+
+    sidx = np.asarray([1, 4])
+    sub = forecaster.gather_params(sidx)
+    S = forecaster.keys.shape[0]
+    k = len(sidx)
+    for f in dataclasses.fields(sub):
+        leaf = getattr(sub, f.name)
+        if getattr(leaf, "ndim", 0) >= 1 and leaf.shape[0] in (S, k):
+            assert leaf.shape[0] == k, f"{f.name} not gathered: {leaf.shape}"
+
+    # gathered-request prediction == the same rows of a full-batch request
+    req = pd.DataFrame(forecaster.keys[sidx], columns=list(forecaster.key_names))
+    out_small = forecaster.predict(req, horizon=9)
+    req_all = pd.DataFrame(forecaster.keys, columns=list(forecaster.key_names))
+    out_all = forecaster.predict(req_all, horizon=9)
+    merged = out_small.merge(
+        out_all, on=["ds", *forecaster.key_names], suffixes=("", "_all")
+    )
+    assert len(merged) == len(out_small)
+    np.testing.assert_allclose(merged.yhat, merged.yhat_all, rtol=1e-5)
+    np.testing.assert_allclose(merged.yhat_lower, merged.yhat_lower_all, rtol=1e-5)

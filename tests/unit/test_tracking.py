@@ -91,3 +91,93 @@ def test_registry_lifecycle(tmp_path, tracker):
     assert reg.models() == ["ForecastingBatchModel"]
     with pytest.raises(KeyError):
         reg.latest_version("Nope")
+
+
+def test_registry_cleanup_helpers(tmp_path):
+    """archive/delete version + delete model — reference's monitoring-notebook
+    cleanup semantics (05_monitoring_wip.py:40-59)."""
+    import pytest
+
+    from distributed_forecasting_tpu.tracking import ModelRegistry
+
+    art = tmp_path / "art"
+    art.mkdir()
+    (art / "params.npz").write_bytes(b"x")
+    reg = ModelRegistry(str(tmp_path / "registry"))
+    reg.register_model("m", str(art))
+    reg.register_model("m", str(art))
+    assert [v.version for v in reg.list_versions("m")] == [1, 2]
+
+    assert reg.archive_version("m", 1).stage == "Archived"
+    reg.delete_version("m", 1)
+    assert [v.version for v in reg.list_versions("m")] == [2]
+    with pytest.raises(KeyError):
+        reg.delete_version("m", 1)
+
+    reg.delete_model("m")
+    assert reg.models() == []
+    with pytest.raises(KeyError):
+        reg.delete_model("m")
+
+
+def test_mlflow_registry_adapter_gated(tmp_path):
+    """MlflowRegistry mirrors ModelRegistry's surface; gated on the optional
+    mlflow dependency exactly like the tracker adapter."""
+    import pytest
+
+    from distributed_forecasting_tpu.tracking import ModelRegistry
+    from distributed_forecasting_tpu.tracking.mlflow_compat import (
+        MlflowRegistry,
+        get_registry,
+        mlflow_available,
+    )
+
+    # interface parity regardless of mlflow presence
+    surface = [
+        "register_model", "get_version", "list_versions", "latest_version",
+        "transition_stage", "set_version_tag", "models",
+        "archive_version", "delete_version", "delete_model",
+    ]
+    for name in surface:
+        assert callable(getattr(MlflowRegistry, name, None)), name
+        assert callable(getattr(ModelRegistry, name, None)), name
+
+    if mlflow_available():  # pragma: no cover - not in this image
+        art = tmp_path / "art"
+        art.mkdir()
+        (art / "params.npz").write_bytes(b"x")
+        reg = get_registry(str(tmp_path / "registry.db"), kind="mlflow")
+        v = reg.register_model("m", str(art), tags={"reviewed": "false"})
+        assert v.version == 1
+        assert reg.latest_version("m").version == 1
+        reg.transition_stage("m", 1, "Staging")
+        assert reg.latest_version("m", stage="Staging").version == 1
+        reg.delete_model("m")
+    else:
+        with pytest.raises(ImportError, match="mlflow"):
+            get_registry(str(tmp_path / "registry.db"), kind="mlflow")
+        assert isinstance(get_registry(str(tmp_path / "r"), kind="auto"),
+                          ModelRegistry)
+
+
+def test_frozen_map_config_roundtrip():
+    """Dict-valued config fields (possible from YAML model_conf) freeze to a
+    hashable FrozenMap that still JSON-serializes through both the tracker
+    param store and the forecaster artifact meta."""
+    import json
+
+    from distributed_forecasting_tpu.serving.predictor import _to_jsonable
+    from distributed_forecasting_tpu.tracking.filestore import _jsonable
+    from distributed_forecasting_tpu.utils.config import FrozenMap, freeze
+
+    raw = {"a": [1, 2], "b": {"c": 3, "d": [4, 5]}}
+    fz = freeze(raw)
+    assert isinstance(fz, FrozenMap) and isinstance(fz["b"], FrozenMap)
+    hash(fz)  # static jit arg requirement
+    assert fz == freeze(raw) and fz["a"] == (1, 2)
+
+    # artifact meta path (strict default=)
+    s = json.dumps(fz, default=_to_jsonable)
+    assert json.loads(s) == {"a": [1, 2], "b": {"c": 3, "d": [4, 5]}}
+    # tracker param path (lossy-tolerant _jsonable) keeps structure, not str()
+    assert _jsonable(fz) == {"a": [1, 2], "b": {"c": 3, "d": [4, 5]}}
