@@ -19,7 +19,9 @@ every timed run ends with a host scalar pull of a reduction over the output
 — the only reliable completion barrier on remote-attached devices, where
 ``block_until_ready`` can return before the computation actually finishes.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "device"}
+("device" records which backend actually ran, e.g. "tpu:..." or "cpu:cpu"
+after the fallback described in choose_backend).
 """
 
 from __future__ import annotations

@@ -28,14 +28,29 @@ _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
 _SO_NAME = "libdftpu_native.so"
 
 
+def _src_digest(src_path: str) -> str:
+    import hashlib
+
+    with open(src_path, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()
+
+
 def _build_and_load() -> Optional[ctypes.CDLL]:
     so_path = os.path.abspath(os.path.join(_NATIVE_DIR, _SO_NAME))
     src_path = os.path.abspath(os.path.join(_NATIVE_DIR, "dftpu_native.cpp"))
-    stale = (
-        os.path.exists(so_path)
-        and os.path.exists(src_path)
-        and os.path.getmtime(src_path) > os.path.getmtime(so_path)
-    )  # source newer than binary: rebuild, or an ABI change loads a stale .so
+    sha_path = so_path + ".src.sha256"
+    # Staleness = the .so was built from DIFFERENT source (content hash in a
+    # committed sidecar — mtimes are meaningless after checkout).  A stale
+    # binary must never load: the ctypes signatures below describe the
+    # CURRENT source's ABI, and a silently mismatched .so corrupts memory
+    # instead of erroring.  No compiler + stale -> no native path.
+    stale = False
+    if os.path.exists(so_path) and os.path.exists(src_path):
+        recorded = None
+        if os.path.exists(sha_path):
+            with open(sha_path) as f:
+                recorded = f.read().strip()
+        stale = recorded != _src_digest(src_path)
     if not os.path.exists(so_path) or stale:
         if not os.path.exists(src_path):
             return None
@@ -45,6 +60,8 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
                  src_path],
                 check=True, capture_output=True, timeout=120,
             )
+            with open(sha_path, "w") as f:
+                f.write(_src_digest(src_path))
         except (subprocess.SubprocessError, FileNotFoundError, OSError):
             return None
     try:

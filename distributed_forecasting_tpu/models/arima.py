@@ -4,9 +4,17 @@ BASELINE config #3: "500 series, batched ARIMA(p,d,q) state-space Kalman
 filter (vmap)".  The reference has no ARIMA itself — it is in the driver
 target set as the state-space member of the model zoo; the native-kernel
 analogy still holds: where Prophet's fits run Stan's C++ L-BFGS per series
-(reference ``notebooks/prophet/02_training.py:172``), here the exact Gaussian
-likelihood is evaluated by a Kalman recursion compiled by XLA and maximized
-with a fixed-iteration optax Adam loop — static shapes, vmapped over series.
+(reference ``notebooks/prophet/02_training.py:172``), here estimation is
+batched linear algebra.
+
+Two fit methods (``ArimaConfig.method``):
+  * ``'hr'`` (default): closed-form Hannan-Rissanen — Yule-Walker long-AR,
+    innovation extraction, one ridge regression; three MXU-shaped solves
+    with zero optimizer serial depth (500x1826 fits: 0.28s vs 30.8s for
+    'mle' on CPU), then ONE Kalman pass for sigma2/states/fitted path.
+  * ``'mle'``: exact Gaussian likelihood evaluated by the Kalman recursion
+    and maximized with a fixed-iteration optax Adam loop — tighter
+    estimates, serial depth fit_steps x T.
 
 Implementation notes:
   * Harvey representation of ARMA(p, q): state dim r = max(p, q+1),
@@ -43,6 +51,14 @@ class ArimaConfig:
     d: int = 1
     q: int = 1
     interval_width: float = 0.95
+    # 'hr' (default): closed-form Hannan-Rissanen — long-AR Yule-Walker +
+    # two batched ridge solves, all MXU matmuls, no optimizer loop.  'mle':
+    # fixed-iteration Adam on the exact Kalman likelihood (tighter estimates,
+    # ~2 orders of magnitude more serial depth: fit_steps x T sequential
+    # scan steps — measured 30.8s vs <1s at 500x1826 on CPU).
+    method: str = "hr"  # 'hr' | 'mle'
+    # long-AR order for the HR innovation estimate
+    hr_ar_order: int = 20
     fit_steps: int = 200
     learning_rate: float = 0.05
     # Gaussian prior on the unconstrained (atanh-PACF) parameters: keeps MAP
@@ -73,18 +89,51 @@ class ArimaParams:
     t_fit_end: jax.Array  # () last training day
 
 
-def _pacf_to_coef(u: jnp.ndarray) -> jnp.ndarray:
-    """Monahan map: unconstrained (k,) -> stationary AR coefficients via
-    tanh -> PACF -> Durbin-Levinson.  k is static and tiny, so a Python loop
-    unrolls fine under jit."""
-    r = jnp.tanh(u)
-    k = u.shape[0]
-    coef = jnp.zeros_like(u)
+def _pacf_stack(r: jnp.ndarray) -> jnp.ndarray:
+    """Durbin-Levinson: PACF sequence (k,) in (-1,1) -> AR coefficients.
+    k is static and tiny, so a Python loop unrolls fine under jit."""
+    k = r.shape[0]
+    coef = jnp.zeros_like(r)
     for j in range(k):
         prev = coef[:j]
         new = prev - r[j] * prev[::-1]
         coef = coef.at[:j].set(new).at[j].set(r[j])
     return coef
+
+
+def _pacf_to_coef(u: jnp.ndarray) -> jnp.ndarray:
+    """Monahan map: unconstrained (k,) -> stationary AR coefficients via
+    tanh -> PACF -> Durbin-Levinson."""
+    return _pacf_stack(jnp.tanh(u))
+
+
+def _coef_to_pacf(c: jnp.ndarray) -> jnp.ndarray:
+    """Inverse Durbin-Levinson: AR coefficients (k,) -> PACF sequence.
+
+    The reverse recursion divides by (1 - pac_j^2); clamped so a numerically
+    non-stationary input degrades instead of producing inf/nan.
+    """
+    k = c.shape[0]
+    pac = jnp.zeros_like(c)
+    cur = c
+    for j in range(k - 1, -1, -1):
+        pj = cur[j]
+        pac = pac.at[j].set(pj)
+        if j > 0:
+            prev = cur[:j]
+            denom = jnp.maximum(1.0 - pj**2, 1e-6)
+            cur = (prev + pj * prev[::-1]) / denom
+    return pac
+
+
+def _stabilize(c: jnp.ndarray, limit: float = 0.97) -> jnp.ndarray:
+    """Project coefficients to the stationary/invertible region by clipping
+    their PACF representation — identity for interior points, a gentle
+    shrink for boundary/exterior ones (unlike naive |coef|-sum scaling,
+    which would distort legitimate near-unit-root AR fits)."""
+    if c.shape[0] == 0:
+        return c
+    return _pacf_stack(jnp.clip(_coef_to_pacf(c), -limit, limit))
 
 
 def _build_ssm(phi, theta, r):
@@ -143,6 +192,72 @@ def _kalman_loglik(z, mask, phi, theta, r):
     return ssq, ldet, n, preds, Fs, a_T, P_T
 
 
+def _lag(x, k: int):
+    """Time shift: out[:, t] = x[:, t-k], zero-filled at the front."""
+    if k == 0:
+        return x
+    return jnp.pad(x, ((0, 0), (k, 0)))[:, : x.shape[1]]
+
+
+def _hannan_rissanen(z, m, p: int, q: int, K: int, ridge: float = 1e-4):
+    """Closed-form batched ARMA(p, q) estimation (Hannan-Rissanen).
+
+    The TPU-first fit: where the 'mle' path runs fit_steps sequential Adam
+    iterations of a T-step Kalman scan (serial depth fit_steps x T), this is
+    three batched linear-algebra steps, all MXU-shaped:
+
+      1. long-AR(K) by Yule-Walker on masked pairwise autocorrelations —
+         one (S, K, K) Toeplitz solve;
+      2. innovations e_t = z_t - sum_i a_i z_{t-i} from K static lag shifts;
+      3. regression of z_t on p AR lags + q innovation lags — one
+         (S, p+q, p+q) ridge solve;
+
+    followed by a PACF-clip projection into the stationary/invertible
+    region.  Returns (phi (S, p), theta (S, q)).
+    """
+    S, T = z.shape
+    zm = z * m
+    g0 = jnp.sum(zm * zm, axis=1) / jnp.maximum(jnp.sum(m, axis=1), 1.0)
+    g0 = jnp.maximum(g0, _EPS)
+    rho = [jnp.ones_like(g0)]
+    for k in range(1, K + 1):
+        num = jnp.sum(zm[:, k:] * zm[:, :-k], axis=1)
+        den = jnp.maximum(jnp.sum(m[:, k:] * m[:, :-k], axis=1), 1.0)
+        rho.append((num / den) / g0)
+    rho = jnp.stack(rho, axis=1)  # (S, K+1), rho_0 = 1
+    idx = jnp.abs(jnp.arange(K)[:, None] - jnp.arange(K)[None, :])
+    Rm = rho[:, idx] + ridge * jnp.eye(K)[None]
+    a = jnp.linalg.solve(Rm, rho[:, 1 : K + 1][..., None])[..., 0]  # (S, K)
+
+    e = zm
+    evalid = m
+    for i in range(1, K + 1):
+        e = e - a[:, i - 1 : i] * _lag(zm, i)
+        evalid = evalid * _lag(m, i)
+    e = e * evalid
+
+    F = p + q
+    if F == 0:
+        return jnp.zeros((S, 0)), jnp.zeros((S, 0))
+    feats = [_lag(zm, i) for i in range(1, p + 1)]
+    feats += [_lag(e, j) for j in range(1, q + 1)]
+    valid = m
+    for i in range(1, p + 1):
+        valid = valid * _lag(m, i)
+    for j in range(1, q + 1):
+        valid = valid * _lag(evalid, j)
+    X = jnp.stack(feats, axis=2) * valid[..., None]  # (S, T, F)
+    zv = zm * valid
+    n_valid = jnp.maximum(jnp.sum(valid, axis=1), 1.0)
+    G = jnp.einsum("stf,stg->sfg", X, X, optimize=True)
+    G = G + (ridge * g0 * n_valid)[:, None, None] * jnp.eye(F)[None]
+    b = jnp.einsum("stf,st->sf", X, zv, optimize=True)
+    coef = jnp.linalg.solve(G, b[..., None])[..., 0]
+    phi = jax.vmap(_stabilize)(coef[:, :p]) if p else coef[:, :0]
+    theta = jax.vmap(_stabilize)(coef[:, p:]) if q else coef[:, :0]
+    return phi, theta
+
+
 def _difference(y, mask, d):
     if d == 0:
         return y, mask
@@ -162,35 +277,40 @@ def fit(y, mask, day, config: ArimaConfig) -> ArimaParams:
     mean = (z * zmask).sum(axis=1) / n_obs
     zc = (z - mean[:, None]) * zmask
 
-    def nll_one(u, zs, ms):
-        phi = _pacf_to_coef(u[:p]) if p else jnp.zeros((0,))
-        theta = _pacf_to_coef(u[p : p + q]) if q else jnp.zeros((0,))
-        ssq, ldet, n, *_ = _kalman_loglik(zs, ms, phi, theta, r)
-        n = jnp.maximum(n, 1.0)
-        # concentrated Gaussian NLL + MAP prior (see ArimaConfig.prior_scale)
-        prior = 0.5 * jnp.sum((u / config.prior_scale) ** 2)
-        return 0.5 * n * jnp.log(jnp.maximum(ssq / n, _EPS)) + 0.5 * ldet + prior
+    if config.method == "hr":
+        phi, theta = _hannan_rissanen(zc, zmask, p, q, config.hr_ar_order)
+    elif config.method == "mle":
+        def nll_one(u, zs, ms):
+            phi = _pacf_to_coef(u[:p]) if p else jnp.zeros((0,))
+            theta = _pacf_to_coef(u[p : p + q]) if q else jnp.zeros((0,))
+            ssq, ldet, n, *_ = _kalman_loglik(zs, ms, phi, theta, r)
+            n = jnp.maximum(n, 1.0)
+            # concentrated Gaussian NLL + MAP prior (see ArimaConfig.prior_scale)
+            prior = 0.5 * jnp.sum((u / config.prior_scale) ** 2)
+            return 0.5 * n * jnp.log(jnp.maximum(ssq / n, _EPS)) + 0.5 * ldet + prior
 
-    u0 = jnp.zeros((y.shape[0], p + q))
-    opt = optax.adam(config.learning_rate)
+        u0 = jnp.zeros((y.shape[0], p + q))
+        opt = optax.adam(config.learning_rate)
 
-    def fit_one(u, zs, ms):
-        state = opt.init(u)
-        grad_fn = jax.value_and_grad(nll_one)
+        def fit_one(u, zs, ms):
+            state = opt.init(u)
+            grad_fn = jax.value_and_grad(nll_one)
 
-        def step_fn(carry, _):
-            u, state = carry
-            val, g = grad_fn(u, zs, ms)
-            g = jnp.where(jnp.isfinite(g), g, 0.0)
-            updates, state = opt.update(g, state)
-            return (optax.apply_updates(u, updates), state), val
+            def step_fn(carry, _):
+                u, state = carry
+                val, g = grad_fn(u, zs, ms)
+                g = jnp.where(jnp.isfinite(g), g, 0.0)
+                updates, state = opt.update(g, state)
+                return (optax.apply_updates(u, updates), state), val
 
-        (u, _), _ = jax.lax.scan(step_fn, (u, state), None, length=config.fit_steps)
-        return u
+            (u, _), _ = jax.lax.scan(step_fn, (u, state), None, length=config.fit_steps)
+            return u
 
-    u = jax.vmap(fit_one)(u0, zc, zmask)
-    phi = jax.vmap(lambda uu: _pacf_to_coef(uu[:p]) if p else jnp.zeros((0,)))(u)
-    theta = jax.vmap(lambda uu: _pacf_to_coef(uu[p : p + q]) if q else jnp.zeros((0,)))(u)
+        u = jax.vmap(fit_one)(u0, zc, zmask)
+        phi = jax.vmap(lambda uu: _pacf_to_coef(uu[:p]) if p else jnp.zeros((0,)))(u)
+        theta = jax.vmap(lambda uu: _pacf_to_coef(uu[p : p + q]) if q else jnp.zeros((0,)))(u)
+    else:
+        raise ValueError(f"unknown ARIMA fit method {config.method!r}; 'hr' or 'mle'")
 
     def final_one(zs, ms, ph, th):
         ssq, ldet, n, preds, Fs, a_T, P_T = _kalman_loglik(zs, ms, ph, th, r)

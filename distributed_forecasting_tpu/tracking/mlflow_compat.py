@@ -140,8 +140,11 @@ class MlflowRegistry:
             source = source[len("file://"):]
         tags = dict(mv.tags or {})
         # registry stages were removed in MLflow 3.x; fall back to the
-        # stage-as-tag emulation transition_stage() writes there
-        stage = getattr(mv, "current_stage", None) or tags.get(
+        # stage-as-tag emulation transition_stage() writes there.  The
+        # legacy API's "nothing set" value is the STRING "None" (truthy!),
+        # which must also defer to the tag.
+        cur = getattr(mv, "current_stage", None)
+        stage = cur if cur not in (None, "", "None") else tags.get(
             _STAGE_TAG, "None"
         )
         return ModelVersion(

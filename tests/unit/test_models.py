@@ -168,3 +168,65 @@ def test_extract_params_loggable():
     p = prophet_glm.extract_params(None, cfg)
     assert p["seasonality_mode"] == "multiplicative"
     assert p["interval_width"] == 0.95
+
+
+def test_arima_hr_recovers_arma_and_matches_mle_quality():
+    """The closed-form Hannan-Rissanen fit (default method) recovers ARMA
+    coefficients and forecasts comparably to the 200-step Kalman-MLE path it
+    replaces as default (VERDICT r1 weak-#6: ARIMA inside the envelope)."""
+    import dataclasses
+
+    import pandas as pd
+
+    rng = np.random.default_rng(11)
+    T = 800
+    e = rng.normal(0, 1.0, T)
+    y = np.zeros(T)
+    for i in range(2, T):
+        y[i] = 0.55 * y[i - 1] - 0.15 * y[i - 2] + e[i] + 0.4 * e[i - 1]
+    df = pd.DataFrame(
+        {"date": pd.date_range("2020-01-01", periods=T), "store": 1,
+         "item": 1, "sales": y + 50.0}
+    )
+    b = tensorize(df)
+    from distributed_forecasting_tpu.models import arima as A
+
+    cfg_hr = ArimaConfig(p=2, d=0, q=1, method="hr")
+    p_hr = A.fit(b.y, b.mask, b.day, cfg_hr)
+    phi = np.asarray(p_hr.phi)[0]
+    theta = np.asarray(p_hr.theta)[0]
+    assert abs(phi[0] - 0.55) < 0.2, phi
+    assert abs(phi[1] + 0.15) < 0.2, phi
+    assert abs(theta[0] - 0.4) < 0.25, theta
+
+    # one-step fit quality within 10% of the MLE path's
+    cfg_mle = dataclasses.replace(cfg_hr, method="mle", fit_steps=300)
+    p_mle = A.fit(b.y, b.mask, b.day, cfg_mle)
+    mask = np.asarray(b.mask)[0] > 0
+    err_hr = np.mean((np.asarray(p_hr.fitted)[0] - y - 50.0)[mask][5:] ** 2)
+    err_mle = np.mean((np.asarray(p_mle.fitted)[0] - y - 50.0)[mask][5:] ** 2)
+    assert err_hr < err_mle * 1.1, (err_hr, err_mle)
+
+
+def test_arima_stabilize_projection():
+    """PACF-clip projection: identity for stationary coefficients (incl.
+    near-unit-root AR(2) whose |coef| sum exceeds 1), shrink for exterior."""
+    import jax.numpy as jnp
+
+    from distributed_forecasting_tpu.models.arima import (
+        _coef_to_pacf,
+        _pacf_stack,
+        _stabilize,
+    )
+
+    # stationary AR(2) with sum |phi| = 2.06: must pass through unchanged
+    c = jnp.asarray([1.5, -0.56])
+    np.testing.assert_allclose(np.asarray(_stabilize(c)), [1.5, -0.56], rtol=1e-5)
+    # roundtrip identity
+    pac = jnp.asarray([0.5, -0.3, 0.2])
+    np.testing.assert_allclose(
+        np.asarray(_coef_to_pacf(_pacf_stack(pac))), np.asarray(pac), rtol=1e-5
+    )
+    # random-walk boundary coefficient shrinks strictly inside
+    out = np.asarray(_stabilize(jnp.asarray([1.0])))
+    assert abs(out[0]) <= 0.97 + 1e-6
