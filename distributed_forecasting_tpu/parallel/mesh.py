@@ -62,10 +62,23 @@ def initialize_distributed(
     single-host run to a pod-slice run from conf; no-op when already
     initialized or when running single-process (the common case).
     """
+    global _DISTRIBUTED_UP
     if num_processes in (None, 0, 1):
         return
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-    )
+    if _DISTRIBUTED_UP:
+        return  # idempotent: workflows construct one Task per node, and each
+        # may carry the same `distributed:` conf section
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError as e:
+        # initialized outside this wrapper (e.g. directly by user code)
+        if "already initialized" not in str(e).lower():
+            raise
+    _DISTRIBUTED_UP = True
+
+
+_DISTRIBUTED_UP = False

@@ -1,4 +1,19 @@
 from distributed_forecasting_tpu.serving.predictor import BatchForecaster
 from distributed_forecasting_tpu.serving.ensemble import MultiModelForecaster
+from distributed_forecasting_tpu.serving.server import (
+    ForecastServer,
+    load_forecaster,
+    resolve_from_registry,
+    serve,
+    start_server,
+)
 
-__all__ = ["BatchForecaster", "MultiModelForecaster"]
+__all__ = [
+    "BatchForecaster",
+    "MultiModelForecaster",
+    "ForecastServer",
+    "load_forecaster",
+    "resolve_from_registry",
+    "serve",
+    "start_server",
+]

@@ -1,0 +1,195 @@
+"""Online inference endpoint: the registered model behind an HTTP surface.
+
+The reference's serving story is its PyFunc model deployed behind Databricks
+model serving / dispatched by a Spark UDF (reference
+``notebooks/prophet/03_deploy.py:20-36``, ``04_inference.py:4-16``) — every
+request pays registry resolution, artifact download, and a per-series model
+load.  Here the registered artifact is loaded ONCE into device memory and
+every request runs the request-proportional batched predict
+(``serving/predictor.py``): a k-series request is one compiled forecast of
+leading axis ~k.
+
+Endpoints (JSON over HTTP, stdlib http.server — no web framework in the
+image, and none needed for a single-model scorer):
+
+  GET  /health            -> {"status": "ok", "model": ..., "n_series": N}
+  GET  /schema            -> serving schema + key names (the tag the
+                             reference stores on the model version,
+                             03_deploy.py:44-58)
+  POST /invocations       -> {"inputs": [{"store": 1, "item": 2}, ...],
+                              "horizon": 90, "include_history": false}
+                          -> {"predictions": [...]} (records of the output
+                             frame; unknown series -> 404 unless
+                             "on_missing": "skip")
+
+``serve`` blocks; ``start_server`` returns the live server for tests/
+embedding.  Model resolution goes through the registry exactly like the
+reference's ``predict_udf`` (latest version, optionally stage-filtered).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+import pandas as pd
+
+from distributed_forecasting_tpu.serving.ensemble import MultiModelForecaster
+from distributed_forecasting_tpu.serving.predictor import (
+    BatchForecaster,
+    UnknownSeriesError,
+)
+from distributed_forecasting_tpu.utils import get_logger
+
+_ENSEMBLE_META = "ensemble.json"
+_MAX_HORIZON = 3650  # 10 years daily — beyond any sane scoring request
+
+
+def load_forecaster(artifact_dir: str):
+    """Load whichever serving artifact lives in ``artifact_dir`` — a single
+    BatchForecaster or a mixed-family MultiModelForecaster."""
+    if os.path.exists(os.path.join(artifact_dir, _ENSEMBLE_META)):
+        return MultiModelForecaster.load(artifact_dir)
+    return BatchForecaster.load(artifact_dir)
+
+
+def resolve_from_registry(registry, model_name: str, stage: Optional[str] = None):
+    """Registry -> loaded forecaster, the reference's latest-version rule
+    (``04_inference.py:10-13``) done once at startup instead of per group."""
+    version = registry.latest_version(model_name, stage=stage)
+    sub = os.path.join(version.artifact_dir, "forecaster")
+    return load_forecaster(sub if os.path.isdir(sub) else version.artifact_dir), version
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "dftpu-serve/1.0"
+
+    # the forecaster and metadata ride on the server object
+    def _send(self, code: int, payload: dict) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt, *args):  # route through framework logging
+        self.server.logger.info("%s " + fmt, self.address_string(), *args)
+
+    def do_GET(self):
+        fc = self.server.forecaster
+        if self.path == "/health":
+            self._send(
+                200,
+                {
+                    "status": "ok",
+                    "model": getattr(fc, "model", "ensemble"),
+                    "n_series": int(fc.keys.shape[0]),
+                    "version": self.server.model_version,
+                },
+            )
+        elif self.path == "/schema":
+            self._send(
+                200,
+                {
+                    "key_names": list(fc.key_names),
+                    "serving_schema": "ds date, "
+                    + ", ".join(f"{k} int" for k in fc.key_names)
+                    + ", yhat double, yhat_upper double, yhat_lower double",
+                },
+            )
+        else:
+            self._send(404, {"error": f"no route {self.path}"})
+
+    def do_POST(self):
+        if self.path not in ("/invocations", "/predict"):
+            self._send(404, {"error": f"no route {self.path}"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            req = json.loads(self.rfile.read(length) or b"{}")
+            if not isinstance(req, dict):
+                self._send(400, {"error": "body must be a JSON object with 'inputs'"})
+                return
+            inputs = req.get("inputs")
+            if not inputs:
+                self._send(400, {"error": "body needs a non-empty 'inputs' list"})
+                return
+            horizon = int(req.get("horizon", 90))
+            if not 1 <= horizon <= _MAX_HORIZON:
+                # unbounded request-controlled horizons would let one call
+                # allocate GB-scale outputs in a long-lived scorer
+                self._send(
+                    400,
+                    {"error": f"horizon must be in [1, {_MAX_HORIZON}], got {horizon}"},
+                )
+                return
+            frame = pd.DataFrame(inputs)
+            missing_cols = set(self.server.forecaster.key_names) - set(frame.columns)
+            if missing_cols:
+                self._send(
+                    400, {"error": f"inputs missing key columns {sorted(missing_cols)}"}
+                )
+                return
+            out = self.server.forecaster.predict(
+                frame,
+                horizon=horizon,
+                include_history=bool(req.get("include_history", False)),
+                on_missing=req.get("on_missing", "raise"),
+            )
+            out["ds"] = out["ds"].astype(str)
+            keys = list(self.server.forecaster.key_names)
+            n_series = int(out[keys].drop_duplicates().shape[0]) if len(out) else 0
+            self._send(
+                200,
+                {
+                    "predictions": out.to_dict(orient="records"),
+                    "n_series": n_series,
+                },
+            )
+        except UnknownSeriesError as e:
+            self._send(404, {"error": str(e)})
+        except (ValueError, KeyError, json.JSONDecodeError) as e:
+            self._send(400, {"error": f"{type(e).__name__}: {e}"})
+        except Exception as e:  # noqa: BLE001 — scorer must not die mid-request
+            self.server.logger.exception("invocation failed")
+            self._send(500, {"error": f"{type(e).__name__}: {e}"})
+
+
+class ForecastServer(ThreadingHTTPServer):
+    daemon_threads = True
+
+    def __init__(self, addr, forecaster, model_version: Optional[str] = None):
+        super().__init__(addr, _Handler)
+        self.forecaster = forecaster
+        self.model_version = model_version
+        self.logger = get_logger("ForecastServer")
+
+
+def start_server(
+    forecaster,
+    host: str = "127.0.0.1",
+    port: int = 0,
+    model_version: Optional[str] = None,
+) -> ForecastServer:
+    """Start serving on a background thread; returns the server (its
+    ``server_address[1]`` is the bound port — port=0 picks a free one)."""
+    srv = ForecastServer((host, port), forecaster, model_version)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv
+
+
+def serve(
+    forecaster,
+    host: str = "0.0.0.0",
+    port: int = 8080,
+    model_version: Optional[str] = None,
+) -> None:
+    srv = ForecastServer((host, port), forecaster, model_version)
+    srv.logger.info("serving on %s:%d", host, port)
+    srv.serve_forever()

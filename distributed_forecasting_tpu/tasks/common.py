@@ -55,6 +55,23 @@ class Task(ABC):
             "tracking": env.get("tracking", os.path.join(root, "mlruns")),
             "registry": env.get("registry", os.path.join(root, "registry")),
         }
+        # multi-host bring-up from conf (BASELINE #4 path): the analogue of
+        # the reference's cluster spec living in deployment YAML
+        # (conf/deployment.yml:3-11) rather than in task code.
+        #
+        #     distributed:
+        #       num_processes: 4
+        #       coordinator_address: host0:1234
+        #       process_id: 0            # usually injected per host
+        dist = self.conf.get("distributed") if isinstance(self.conf, dict) else None
+        if dist:
+            from distributed_forecasting_tpu.parallel import initialize_distributed
+
+            initialize_distributed(
+                coordinator_address=dist.get("coordinator_address"),
+                num_processes=dist.get("num_processes"),
+                process_id=dist.get("process_id"),
+            )
 
     # lazy infra handles ----------------------------------------------------
     @property

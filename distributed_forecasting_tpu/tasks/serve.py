@@ -1,0 +1,49 @@
+"""Serve task: registered model -> HTTP scoring endpoint.
+
+The deployment-side counterpart of ``tasks/inference.py``'s batch path: where
+the reference hands its registered PyFunc to Databricks model serving (the
+serving-schema version tags exist exactly for that hand-off, reference
+``notebooks/prophet/03_deploy.py:44-58``), this task resolves the latest
+(optionally stage-filtered) version from the registry, loads the batched
+artifact once, and serves ``/invocations`` (``serving/server.py``).
+
+Conf::
+
+    serving:
+      model_name: ForecastingBatchModel
+      stage: Staging          # optional latest-version filter
+      host: 0.0.0.0
+      port: 8080
+"""
+
+from __future__ import annotations
+
+from distributed_forecasting_tpu.serving.server import resolve_from_registry, serve
+from distributed_forecasting_tpu.tasks.common import Task
+
+
+class ServeTask(Task):
+    def launch(self) -> None:
+        conf = self.conf.get("serving", {})
+        name = conf.get("model_name", "ForecastingBatchModel")
+        stage = conf.get("stage")
+        forecaster, version = resolve_from_registry(self.registry, name, stage=stage)
+        self.logger.info(
+            "serving %s v%s (%d series) on %s:%s",
+            name, version.version, forecaster.keys.shape[0],
+            conf.get("host", "0.0.0.0"), conf.get("port", 8080),
+        )
+        serve(
+            forecaster,
+            host=conf.get("host", "0.0.0.0"),
+            port=int(conf.get("port", 8080)),
+            model_version=str(version.version),
+        )
+
+
+def entrypoint():
+    ServeTask().launch()
+
+
+if __name__ == "__main__":
+    entrypoint()

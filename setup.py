@@ -38,6 +38,7 @@ setup(
             "dftpu-train=distributed_forecasting_tpu.tasks.train:entrypoint",
             "dftpu-deploy=distributed_forecasting_tpu.tasks.deploy:entrypoint",
             "dftpu-infer=distributed_forecasting_tpu.tasks.inference:entrypoint",
+            "dftpu-serve=distributed_forecasting_tpu.tasks.serve:entrypoint",
             "dftpu-ml=distributed_forecasting_tpu.tasks.sample_ml:entrypoint",
             "dftpu-workflow=distributed_forecasting_tpu.workflows.runner:main",
         ],

@@ -1,0 +1,152 @@
+"""HTTP serving endpoint tests: the one-load, request-proportional scorer
+standing where the reference's PyFunc + per-group model loads stood
+(reference notebooks/prophet/04_inference.py:4-16)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from distributed_forecasting_tpu.engine import fit_forecast
+from distributed_forecasting_tpu.models import CurveModelConfig
+from distributed_forecasting_tpu.serving import (
+    BatchForecaster,
+    load_forecaster,
+    resolve_from_registry,
+    start_server,
+)
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    from distributed_forecasting_tpu.data import synthetic_store_item_sales, tensorize
+
+    df = synthetic_store_item_sales(n_stores=2, n_items=3, n_days=760, seed=4)
+    batch = tensorize(df)
+    cfg = CurveModelConfig()
+    params, _ = fit_forecast(batch, model="prophet", config=cfg, horizon=30)
+    fc = BatchForecaster.from_fit(batch, params, "prophet", cfg)
+    srv = start_server(fc, model_version="3")
+    yield srv
+    srv.shutdown()
+
+
+def _call(srv, path, payload=None):
+    url = f"http://127.0.0.1:{srv.server_address[1]}{path}"
+    if payload is None:
+        req = urllib.request.Request(url)
+    else:
+        req = urllib.request.Request(
+            url, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_health_and_schema(server):
+    code, health = _call(server, "/health")
+    assert code == 200
+    assert health["status"] == "ok"
+    assert health["n_series"] == 6
+    assert health["version"] == "3"
+    code, schema = _call(server, "/schema")
+    assert schema["key_names"] == ["store", "item"]
+    assert schema["serving_schema"].startswith("ds date, store int, item int")
+
+
+def test_invocations_batched(server):
+    code, out = _call(
+        server, "/invocations",
+        {"inputs": [{"store": 1, "item": 2}, {"store": 2, "item": 3}],
+         "horizon": 14},
+    )
+    assert code == 200
+    assert out["n_series"] == 2
+    preds = pd.DataFrame(out["predictions"])
+    assert len(preds) == 2 * 14
+    assert set(preds.columns) == {"ds", "store", "item", "yhat",
+                                  "yhat_upper", "yhat_lower"}
+    assert np.isfinite(preds.yhat).all()
+
+
+def test_invocations_errors(server):
+    # unknown series -> 404 with a clear message (vs the reference's
+    # IndexError deep in a UDF, SURVEY §2.3-3)
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _call(server, "/invocations",
+              {"inputs": [{"store": 99, "item": 1}], "horizon": 5})
+    assert e.value.code == 404
+    assert "training set" in json.loads(e.value.read())["error"]
+
+    # or skipped on request
+    code, out = _call(
+        server, "/invocations",
+        {"inputs": [{"store": 99, "item": 1}], "horizon": 5,
+         "on_missing": "skip"},
+    )
+    assert code == 200 and out["predictions"] == []
+
+    # malformed bodies -> 400
+    for bad in ({}, {"inputs": []}, {"inputs": [{"store": 1}]}):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _call(server, "/invocations", bad)
+        assert e.value.code == 400
+
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _call(server, "/nope")
+    assert e.value.code == 404
+
+
+def test_registry_resolution_and_serve_task(tmp_path):
+    """Registry -> endpoint: register the artifact, resolve latest by stage,
+    serve, score — the reference's deploy->inference loop over HTTP."""
+    from distributed_forecasting_tpu.data import synthetic_store_item_sales, tensorize
+    from distributed_forecasting_tpu.tracking import ModelRegistry
+
+    df = synthetic_store_item_sales(n_stores=1, n_items=2, n_days=760, seed=6)
+    batch = tensorize(df)
+    cfg = CurveModelConfig()
+    params, _ = fit_forecast(batch, model="prophet", config=cfg, horizon=14)
+    fc = BatchForecaster.from_fit(batch, params, "prophet", cfg)
+    art = tmp_path / "artifacts" / "forecaster"
+    fc.save(str(art))
+
+    reg = ModelRegistry(str(tmp_path / "registry"))
+    reg.register_model("M", str(tmp_path / "artifacts"))
+    reg.transition_stage("M", 1, "Staging")
+
+    loaded, version = resolve_from_registry(reg, "M", stage="Staging")
+    assert version.version == 1
+    assert loaded.keys.shape[0] == 2
+
+    srv = start_server(loaded, model_version=str(version.version))
+    try:
+        code, out = _call(
+            srv, "/invocations",
+            {"inputs": [{"store": 1, "item": 1}], "horizon": 7},
+        )
+        assert code == 200 and len(out["predictions"]) == 7
+    finally:
+        srv.shutdown()
+
+    # load_forecaster picks the ensemble loader when the meta says so
+    assert isinstance(load_forecaster(str(art)), BatchForecaster)
+
+
+def test_invocations_rejects_hostile_bodies(server):
+    """Non-object JSON and absurd horizons are 400s, not 500s/OOM."""
+    for bad, frag in (
+        ([{"store": 1, "item": 2}], "JSON object"),        # top-level list
+        ({"inputs": [{"store": 1, "item": 2}],
+          "horizon": 100_000_000}, "horizon"),             # memory bomb
+        ({"inputs": [{"store": 1, "item": 2}],
+          "horizon": 0}, "horizon"),
+    ):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _call(server, "/invocations", bad)
+        assert e.value.code == 400
+        assert frag in json.loads(e.value.read())["error"]

@@ -242,3 +242,29 @@ def test_conf_file_parsing(tmp_path, env_conf):
     assert task.conf["output"]["catalog_name"] == "cat2"
     task.launch()
     assert "cat2" in task.catalog.catalogs()
+
+
+def test_task_distributed_conf_plumbing(monkeypatch, tmp_path):
+    """A `distributed:` conf section brings up the JAX multi-host runtime;
+    absent or single-process confs touch nothing."""
+    import jax
+
+    from distributed_forecasting_tpu.parallel import mesh as mesh_mod
+    from distributed_forecasting_tpu.tasks.catalog import CatalogTask
+
+    calls = []
+    monkeypatch.setattr(jax.distributed, "initialize", lambda **kw: calls.append(kw))
+    monkeypatch.setattr(mesh_mod, "_DISTRIBUTED_UP", False)
+    env = {"root": str(tmp_path)}
+
+    CatalogTask(init_conf={"env": env})
+    assert calls == []
+
+    CatalogTask(init_conf={
+        "env": env,
+        "distributed": {"num_processes": 2,
+                        "coordinator_address": "h0:9999",
+                        "process_id": 1},
+    })
+    assert calls == [{"coordinator_address": "h0:9999",
+                      "num_processes": 2, "process_id": 1}]
