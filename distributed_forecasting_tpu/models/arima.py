@@ -50,6 +50,13 @@ class ArimaConfig:
     p: int = 2
     d: int = 1
     q: int = 1
+    # Seasonal (SARMA) terms: AR/MA lags at multiples of ``m`` — the subset
+    # form phi_1..phi_p plus Phi_1 B^m..Phi_P B^{Pm} (additive, not the
+    # multiplicative Box-Jenkins product; estimated for free by the HR
+    # regression as extra lag features).  Requires method='hr'.
+    P: int = 0
+    Q: int = 0
+    m: int = 7  # seasonal period (daily data: weekly)
     interval_width: float = 0.95
     # 'hr' (default): closed-form Hannan-Rissanen — long-AR Yule-Walker +
     # two batched ridge solves, all MXU matmuls, no optimizer loop.  'mle':
@@ -199,8 +206,30 @@ def _lag(x, k: int):
     return jnp.pad(x, ((0, 0), (k, 0)))[:, : x.shape[1]]
 
 
-def _hannan_rissanen(z, m, p: int, q: int, K: int, ridge: float = 1e-4):
-    """Closed-form batched ARMA(p, q) estimation (Hannan-Rissanen).
+def _lag_sets(config: ArimaConfig):
+    """AR / MA lag sets incl. seasonal terms, deduplicated and sorted, plus
+    the effective (dense) polynomial orders they scatter into."""
+    ar = sorted(
+        set(range(1, config.p + 1))
+        | {config.m * i for i in range(1, config.P + 1)}
+    )
+    ma = sorted(
+        set(range(1, config.q + 1))
+        | {config.m * j for j in range(1, config.Q + 1)}
+    )
+    p_eff = ar[-1] if ar else 0
+    q_eff = ma[-1] if ma else 0
+    return ar, ma, p_eff, q_eff
+
+
+def _effective_r(config: ArimaConfig) -> int:
+    _, _, p_eff, q_eff = _lag_sets(config)
+    return max(p_eff, q_eff + 1, 1)
+
+
+def _hannan_rissanen(z, m, ar_lags, ma_lags, p_eff: int, q_eff: int, K: int,
+                     ridge: float = 1e-4):
+    """Closed-form batched (S)ARMA estimation (Hannan-Rissanen).
 
     The TPU-first fit: where the 'mle' path runs fit_steps sequential Adam
     iterations of a T-step Kalman scan (serial depth fit_steps x T), this is
@@ -209,11 +238,13 @@ def _hannan_rissanen(z, m, p: int, q: int, K: int, ridge: float = 1e-4):
       1. long-AR(K) by Yule-Walker on masked pairwise autocorrelations —
          one (S, K, K) Toeplitz solve;
       2. innovations e_t = z_t - sum_i a_i z_{t-i} from K static lag shifts;
-      3. regression of z_t on p AR lags + q innovation lags — one
-         (S, p+q, p+q) ridge solve;
+      3. regression of z_t on the AR lag set + innovation lag set — one
+         (S, F, F) ridge solve.  Seasonal (SARMA) terms are just more lags
+         in the sets (``_lag_sets``), at zero extra structure;
 
     followed by a PACF-clip projection into the stationary/invertible
-    region.  Returns (phi (S, p), theta (S, q)).
+    region.  Returns dense polynomials (phi (S, p_eff), theta (S, q_eff))
+    with the non-lag positions zero.
     """
     S, T = z.shape
     zm = z * m
@@ -236,15 +267,14 @@ def _hannan_rissanen(z, m, p: int, q: int, K: int, ridge: float = 1e-4):
         evalid = evalid * _lag(m, i)
     e = e * evalid
 
-    F = p + q
+    F = len(ar_lags) + len(ma_lags)
     if F == 0:
         return jnp.zeros((S, 0)), jnp.zeros((S, 0))
-    feats = [_lag(zm, i) for i in range(1, p + 1)]
-    feats += [_lag(e, j) for j in range(1, q + 1)]
+    feats = [_lag(zm, i) for i in ar_lags] + [_lag(e, j) for j in ma_lags]
     valid = m
-    for i in range(1, p + 1):
+    for i in ar_lags:
         valid = valid * _lag(m, i)
-    for j in range(1, q + 1):
+    for j in ma_lags:
         valid = valid * _lag(evalid, j)
     X = jnp.stack(feats, axis=2) * valid[..., None]  # (S, T, F)
     zv = zm * valid
@@ -253,8 +283,20 @@ def _hannan_rissanen(z, m, p: int, q: int, K: int, ridge: float = 1e-4):
     G = G + (ridge * g0 * n_valid)[:, None, None] * jnp.eye(F)[None]
     b = jnp.einsum("stf,st->sf", X, zv, optimize=True)
     coef = jnp.linalg.solve(G, b[..., None])[..., 0]
-    phi = jax.vmap(_stabilize)(coef[:, :p]) if p else coef[:, :0]
-    theta = jax.vmap(_stabilize)(coef[:, p:]) if q else coef[:, :0]
+
+    # scatter the lag-set coefficients into dense polynomials
+    nar = len(ar_lags)
+    phi = jnp.zeros((S, p_eff))
+    for col, lag in enumerate(ar_lags):
+        phi = phi.at[:, lag - 1].set(coef[:, col])
+    theta = jnp.zeros((S, q_eff))
+    for col, lag in enumerate(ma_lags):
+        theta = theta.at[:, lag - 1].set(coef[:, nar + col])
+    # PACF-clip projection (identity for interior points, sparsity included)
+    if p_eff:
+        phi = jax.vmap(_stabilize)(phi)
+    if q_eff:
+        theta = jax.vmap(_stabilize)(theta)
     return phi, theta
 
 
@@ -271,15 +313,24 @@ def _difference(y, mask, d):
 @partial(jax.jit, static_argnames=("config",))
 def fit(y, mask, day, config: ArimaConfig) -> ArimaParams:
     p, d, q = config.p, config.d, config.q
-    r = max(p, q + 1)
+    ar_lags, ma_lags, p_eff, q_eff = _lag_sets(config)
+    r = _effective_r(config)
     z, zmask = _difference(y, mask, d)
     n_obs = jnp.maximum(zmask.sum(axis=1), 1.0)
     mean = (z * zmask).sum(axis=1) / n_obs
     zc = (z - mean[:, None]) * zmask
 
     if config.method == "hr":
-        phi, theta = _hannan_rissanen(zc, zmask, p, q, config.hr_ar_order)
+        K = max(config.hr_ar_order, p_eff + q_eff + config.m)
+        phi, theta = _hannan_rissanen(
+            zc, zmask, ar_lags, ma_lags, p_eff, q_eff, K
+        )
     elif config.method == "mle":
+        if config.P or config.Q:
+            raise ValueError(
+                "seasonal (P, Q) terms require method='hr' — the MLE path's "
+                "PACF parameterization is dense in the lag order"
+            )
         def nll_one(u, zs, ms):
             phi = _pacf_to_coef(u[:p]) if p else jnp.zeros((0,))
             theta = _pacf_to_coef(u[p : p + q]) if q else jnp.zeros((0,))
@@ -417,8 +468,7 @@ def _forecast_impl(params: ArimaParams, day_all, config: ArimaConfig, _r: int):
 
 
 def forecast(params: ArimaParams, day_all, t_end, config: ArimaConfig, key=None):
-    r = max(config.p, config.q + 1)
-    return _forecast_impl(params, day_all, config, r)
+    return _forecast_impl(params, day_all, config, _effective_r(config))
 
 
 register_model("arima", fit, forecast, ArimaConfig)

@@ -68,6 +68,11 @@ class MultiModelForecaster:
         assignment = np.asarray([order[n] for n in name_per_series])
         return cls(fcs, assignment)
 
+    @property
+    def serving_schema(self) -> str:
+        """Ensemble output adds the winning-family column to the base schema."""
+        return self.forecasters[self.models[0]].serving_schema + ", model string"
+
     # -- persistence --------------------------------------------------------
     def save(self, directory: str) -> None:
         os.makedirs(directory, exist_ok=True)

@@ -122,11 +122,7 @@ class BatchForecaster:
             "keys": self.keys.tolist(),
             "day0": self.day0,
             "day1": self.day1,
-            # serving-schema string, the tag the reference sets on its model
-            # version (03_deploy.py:44-58)
-            "serving_schema": "ds date, "
-            + ", ".join(f"{k} int" for k in self.key_names)
-            + ", yhat double, yhat_upper double, yhat_lower double",
+            "serving_schema": self.serving_schema,
         }
         with open(os.path.join(directory, _META_FILE), "w") as f:
             # dataclasses.asdict does not recurse into FrozenMap (a Mapping,
@@ -156,9 +152,25 @@ class BatchForecaster:
         )
 
     # -- inference ----------------------------------------------------------
+    @property
+    def serving_schema(self) -> str:
+        """The schema string the reference stores as a model-version tag
+        (``03_deploy.py:44-58``) — single source for artifact meta and the
+        /schema endpoint."""
+        return (
+            "ds date, "
+            + ", ".join(f"{k} int" for k in self.key_names)
+            + ", yhat double, yhat_upper double, yhat_lower double"
+        )
+
     def series_indices(
         self, request: pd.DataFrame, on_missing: str = "raise"
     ) -> np.ndarray:
+        if on_missing not in ("raise", "skip"):
+            # a typo like "Raise" must not silently become skip-and-drop
+            raise ValueError(
+                f"on_missing must be 'raise' or 'skip', got {on_missing!r}"
+            )
         req = request[list(self.key_names)].drop_duplicates().astype(np.int64)
         idx = []
         for row in req.itertuples(index=False):

@@ -97,9 +97,9 @@ class _Handler(BaseHTTPRequestHandler):
                 200,
                 {
                     "key_names": list(fc.key_names),
-                    "serving_schema": "ds date, "
-                    + ", ".join(f"{k} int" for k in fc.key_names)
-                    + ", yhat double, yhat_upper double, yhat_lower double",
+                    # the forecaster's own schema (ensembles add a model
+                    # column) — not re-derived here, so it can't drift
+                    "serving_schema": fc.serving_schema,
                 },
             )
         else:
@@ -153,7 +153,9 @@ class _Handler(BaseHTTPRequestHandler):
             )
         except UnknownSeriesError as e:
             self._send(404, {"error": str(e)})
-        except (ValueError, KeyError, json.JSONDecodeError) as e:
+        except (ValueError, TypeError, KeyError, json.JSONDecodeError) as e:
+            # TypeError covers JSON-legal but wrong-typed fields, e.g.
+            # "horizon": null / [90]
             self._send(400, {"error": f"{type(e).__name__}: {e}"})
         except Exception as e:  # noqa: BLE001 — scorer must not die mid-request
             self.server.logger.exception("invocation failed")
