@@ -160,6 +160,31 @@ def fit_forecast(
     return params, ForecastResult(yhat=yhat, lo=lo, hi=hi, ok=ok, day_all=day_all)
 
 
+@partial(
+    jax.jit, static_argnames=("model", "config", "horizon", "min_points")
+)
+def _fit_forecast_scan_impl(y, mask, day, key, model, config, horizon, min_points):
+    """All chunks in ONE dispatch: ``lax.scan`` over the chunk axis.
+
+    y, mask: (n_chunks, chunk, T).  The scan body is the same compiled
+    engine pass as ``_fit_forecast_impl``; XLA emits its HLO once and loops,
+    so peak HBM holds one chunk's intermediates — but unlike the host-side
+    loop there is a single launch, which matters on remote-attached devices
+    where every dispatch costs a ~66 ms round trip (bench.py measures the
+    floor).
+    """
+    def step(c, ym):
+        yc, mc = ym
+        params, yhat, lo, hi, ok, _ = _fit_forecast_impl(
+            yc, mc, day, jax.random.fold_in(key, c),
+            model=model, config=config, horizon=horizon, min_points=min_points,
+        )
+        return c + 1, (params, yhat, lo, hi, ok)
+
+    _, (params, yhat, lo, hi, ok) = jax.lax.scan(step, 0, (y, mask))
+    return params, yhat, lo, hi, ok, day_grid(day, horizon)
+
+
 def fit_forecast_chunked(
     batch: SeriesBatch,
     model: str = "prophet",
@@ -168,25 +193,63 @@ def fit_forecast_chunked(
     key: Optional[jax.Array] = None,
     chunk_size: int = 4096,
     min_points: int = 14,
+    dispatch: str = "scan",
 ) -> Tuple[object, ForecastResult]:
     """Memory-bounded fit for very large batches (the 50k-series regime).
 
     Splits the series axis into equal ``chunk_size`` blocks (last block
     padded), so HBM holds one block's intermediates at a time and every
-    chunk reuses the SAME compiled executable — the series-count analogue of
-    the reference scaling executors, without recompiles.  Params come back
-    concatenated along axis 0.
+    chunk reuses the SAME compiled program body — the series-count analogue
+    of the reference scaling executors, without recompiles.  Params come
+    back concatenated along axis 0.
+
+    ``dispatch='scan'`` (default) runs every chunk inside one compiled
+    ``lax.scan`` — one device launch for the whole batch.  ``'loop'`` keeps
+    the host-side chunk loop (one launch per chunk); use it when chunks
+    should stream results back incrementally.
     """
+    if dispatch not in ("scan", "loop"):
+        raise ValueError(f"unknown dispatch {dispatch!r}; 'scan' or 'loop'")
     S = batch.n_series
     if S <= chunk_size:
         return fit_forecast(
             batch, model=model, config=config, horizon=horizon, key=key,
             min_points=min_points,
         )
+    fns = get_model(model)
+    config = config if config is not None else fns.config_cls()
     if key is None:
         key = jax.random.PRNGKey(0)
     n_chunks = -(-S // chunk_size)
     padded = batch.pad_series_to(n_chunks * chunk_size)
+
+    if dispatch == "scan":
+        yc = padded.y.reshape(n_chunks, chunk_size, -1)
+        mc = padded.mask.reshape(n_chunks, chunk_size, -1)
+        params, yhat, lo, hi, ok, day_all = _fit_forecast_scan_impl(
+            yc, mc, padded.day, key,
+            model=model, config=config, horizon=horizon,
+            min_points=min_points,
+        )
+        # scanned leaves lead with (n_chunks, chunk_size, ...): flatten the
+        # per-series ones back to the series axis, keep shared leaves from
+        # any one chunk (they are identical across chunks by construction)
+        params = jax.tree_util.tree_map(
+            lambda x: x.reshape(n_chunks * chunk_size, *x.shape[2:])[:S]
+            if getattr(x, "ndim", 0) >= 2 and x.shape[:2] == (n_chunks, chunk_size)
+            else (x[0] if getattr(x, "ndim", 0) >= 1 and x.shape[0] == n_chunks
+                  else x),
+            params,
+        )
+        result = ForecastResult(
+            yhat=yhat.reshape(n_chunks * chunk_size, -1)[:S],
+            lo=lo.reshape(n_chunks * chunk_size, -1)[:S],
+            hi=hi.reshape(n_chunks * chunk_size, -1)[:S],
+            ok=ok.reshape(n_chunks * chunk_size)[:S],
+            day_all=day_all,
+        )
+        return params, result
+
     params_list, yhat, lo, hi, ok = [], [], [], [], []
     for c in range(n_chunks):
         sl = slice(c * chunk_size, (c + 1) * chunk_size)

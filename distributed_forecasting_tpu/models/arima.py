@@ -209,6 +209,14 @@ def _lag(x, k: int):
 def _lag_sets(config: ArimaConfig):
     """AR / MA lag sets incl. seasonal terms, deduplicated and sorted, plus
     the effective (dense) polynomial orders they scatter into."""
+    if (config.P > 0 or config.Q > 0) and config.m < 1:
+        # m=0 would make the seasonal term a lag-0 regressor (the target
+        # regressing on itself) and scatter its coefficient to index -1 —
+        # a silently corrupt fit rather than an error
+        raise ValueError(
+            f"seasonal orders P={config.P}/Q={config.Q} require a seasonal "
+            f"period m >= 1, got m={config.m}"
+        )
     ar = sorted(
         set(range(1, config.p + 1))
         | {config.m * i for i in range(1, config.P + 1)}

@@ -53,7 +53,8 @@ def test_shard_summary_balance():
     assert imbalance < 1.05, imbalance  # near-uniform hash split
 
 
-def test_fit_forecast_chunked_matches_unchunked(batch_small):
+@pytest.mark.parametrize("dispatch", ["scan", "loop"])
+def test_fit_forecast_chunked_matches_unchunked(batch_small, dispatch):
     import jax.numpy as jnp
 
     from distributed_forecasting_tpu.engine import (
@@ -63,15 +64,53 @@ def test_fit_forecast_chunked_matches_unchunked(batch_small):
 
     _, ref = fit_forecast(batch_small, model="prophet", horizon=30)
     params, out = fit_forecast_chunked(
-        batch_small, model="prophet", horizon=30, chunk_size=4
+        batch_small, model="prophet", horizon=30, chunk_size=4,
+        dispatch=dispatch,
     )
     # per-series fits are independent, so chunking is exact for yhat
     np.testing.assert_allclose(
         np.asarray(out.yhat), np.asarray(ref.yhat), rtol=2e-3, atol=1e-2
     )
     assert out.yhat.shape == ref.yhat.shape
+    assert out.ok.shape == (batch_small.n_series,)
     assert params.beta.shape[0] == batch_small.n_series
     assert bool(jnp.all(out.ok))
+
+
+def test_fit_forecast_chunked_rejects_unknown_dispatch(batch_small):
+    """Typos must raise even when the batch fits in one chunk (the early
+    single-chunk return used to skip validation)."""
+    from distributed_forecasting_tpu.engine import fit_forecast_chunked
+
+    with pytest.raises(ValueError, match="dispatch"):
+        fit_forecast_chunked(
+            batch_small, model="prophet", horizon=30, chunk_size=10**6,
+            dispatch="stream",
+        )
+
+
+def test_fit_forecast_chunked_scan_matches_loop(batch_small):
+    """The single-dispatch lax.scan path and the host-side loop produce the
+    same params and forecasts (same per-chunk fold_in keys)."""
+    from distributed_forecasting_tpu.engine import fit_forecast_chunked
+
+    p1, o1 = fit_forecast_chunked(
+        batch_small, model="prophet", horizon=30, chunk_size=4,
+        dispatch="scan",
+    )
+    p2, o2 = fit_forecast_chunked(
+        batch_small, model="prophet", horizon=30, chunk_size=4,
+        dispatch="loop",
+    )
+    np.testing.assert_allclose(
+        np.asarray(o1.yhat), np.asarray(o2.yhat), rtol=1e-5, atol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(o1.lo), np.asarray(o2.lo), rtol=1e-4, atol=1e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(p1.beta), np.asarray(p2.beta), rtol=1e-5, atol=1e-5
+    )
 
 
 def test_mlflow_adapter_gated():

@@ -208,6 +208,16 @@ def test_arima_hr_recovers_arma_and_matches_mle_quality():
     assert err_hr < err_mle * 1.1, (err_hr, err_mle)
 
 
+def test_arima_seasonal_orders_require_period():
+    """P/Q > 0 with m < 1 must raise, not silently fit a lag-0 regressor."""
+    from distributed_forecasting_tpu.models.arima import ArimaConfig, _lag_sets
+
+    with pytest.raises(ValueError, match="seasonal period"):
+        _lag_sets(ArimaConfig(p=2, d=0, q=0, P=1, m=0))
+    with pytest.raises(ValueError, match="seasonal period"):
+        _lag_sets(ArimaConfig(p=0, d=0, q=1, Q=1, m=-7))
+
+
 def test_arima_stabilize_projection():
     """PACF-clip projection: identity for stationary coefficients (incl.
     near-unit-root AR(2) whose |coef| sum exceeds 1), shrink for exterior."""

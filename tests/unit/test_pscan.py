@@ -35,6 +35,22 @@ def test_affine_scan_batched_shapes():
     )
 
 
+def test_affine_scan_blocked_matches_flat():
+    # T > block_size and NOT a multiple of it: exercises the identity-map
+    # padding and the cross-block carry of the blocked path
+    rng = np.random.default_rng(4)
+    T, d, bs = 205, 3, 64
+    A = jnp.asarray(rng.normal(0, 0.4, (T, d, d)))
+    c = jnp.asarray(rng.normal(0, 1.0, (T, d)))
+    x0 = jnp.asarray(rng.normal(0, 1.0, d))
+    flat = affine_scan(A, c, x0, block_size=T)
+    blocked = affine_scan(A, c, x0, block_size=bs)
+    assert blocked.shape == (T, d)
+    np.testing.assert_allclose(
+        np.asarray(blocked), np.asarray(flat), rtol=1e-4, atol=1e-5
+    )
+
+
 @pytest.mark.parametrize("missing", [0.0, 0.15])
 def test_parallel_hw_filter_matches_sequential(missing):
     rng = np.random.default_rng(2)
