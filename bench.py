@@ -21,9 +21,10 @@ dispatch-cost-cancelled slope protocol:
     cancels every constant cost (dispatch round trips, host overhead,
     result-fetch latency) and divides out the scan.
 
-Inputs are distinct per scan step so no step can reuse a prior result; each
-timed call ends with a host scalar pull — a correct completion barrier for
-the whole scan.  Per-dispatch latency and the tunnel round-trip floor are
+Inputs are distinct within each rep of the staged batches (the long scan
+tiles them; lax.scan executes every step regardless, so tiling cannot skip
+work); each timed call ends with a host scalar pull — a correct completion
+barrier for the whole scan.  Per-dispatch latency and the tunnel round-trip floor are
 printed to stderr so the gap between "chip throughput" and "one remote call"
 stays visible.
 
@@ -196,29 +197,24 @@ def main() -> None:
                 chunk_size=S, dispatch="scan",
             )
             float(res.yhat.sum())  # completion barrier for the whole scan
-            return time.perf_counter() - t0, res
+            return time.perf_counter() - t0
 
-        dt, res = run()  # includes compile
-        compile_s = dt
-        ts = []
-        for _ in range(n_rep):
-            dt, res = run()
-            ts.append(dt)
-        return min(ts), compile_s, res
+        compile_s = run()  # includes compile
+        return min(run() for _ in range(n_rep)), compile_s
 
-    def slope_series_per_s(model, cfg=None, reps_long=16, label=""):
+    def slope_series_per_s(big_s, big_l, model, cfg=None, label=""):
         """Device-side per-batch time via the two-length slope protocol.
 
-        reps_long=16 puts ~90 batches between the two scan lengths, so the
-        ~20 ms run-to-run jitter of the tunnel contributes <0.3 ms/batch to
-        the slope — small against the ~4 ms signal.  (reps_long=4 was tried
-        first and produced unstable, even sign-flipping, comparisons.)
+        The default big_l below (16 reps) puts ~90 batches between the two
+        scan lengths, so the ~20 ms run-to-run jitter of the tunnel
+        contributes <0.3 ms/batch to the slope — small against the ~4 ms
+        signal.  (4 reps was tried first and produced unstable, even
+        sign-flipping, comparisons.)
         """
-        big_s = stacked(1)
-        big_l = stacked(reps_long)
-        t_s, compile_s, res = timed_scan(big_s, model, cfg)
-        t_l, compile_l, _ = timed_scan(big_l, model, cfg)
-        k_s, k_l = N_STAGED, N_STAGED * reps_long
+        t_s, compile_s = timed_scan(big_s, model, cfg)
+        t_l, compile_l = timed_scan(big_l, model, cfg)
+        k_s = big_s.n_series // S
+        k_l = big_l.n_series // S
         per_batch = (t_l - t_s) / (k_l - k_s)
         if per_batch <= 0:
             # jitter ate the slope: report the conservative upper bound
@@ -238,10 +234,12 @@ def main() -> None:
             f"{compile_l:.1f}s)",
             file=sys.stderr,
         )
-        return S / per_batch, res
+        return S / per_batch
 
-    series_per_s, res_big = slope_series_per_s(
-        "prophet", label="prophet 500x1826 slope"
+    big_1 = stacked(1)
+    big_16 = stacked(16)
+    series_per_s = slope_series_per_s(
+        big_1, big_16, "prophet", label="prophet 500x1826 slope"
     )
 
     # per-dispatch latency of ONE 500-series batch (what a single remote
@@ -283,7 +281,9 @@ def main() -> None:
 
         os.environ["DFTPU_GRAM_BACKEND"] = "pallas"
         clear_caches()
-        pallas_sps, _ = slope_series_per_s("prophet", label="pallas gram slope")
+        pallas_sps = slope_series_per_s(
+            big_1, big_16, "prophet", label="pallas gram slope"
+        )
         ratio = pallas_sps / series_per_s
         print(
             f"[bench] pallas/einsum throughput ratio: x{ratio:.2f} "
@@ -303,12 +303,12 @@ def main() -> None:
 
     # ---- ARIMA probe (BASELINE config #3: 500 series, same envelope) ------
     try:
-        arima_sps, _ = slope_series_per_s(
-            "arima", reps_long=2, label="arima 500x1826 slope"
+        arima_sps = slope_series_per_s(
+            big_1, stacked(2), "arima", label="arima 500x1826 slope"
         )
-        env_s = 500.0 / arima_sps
+        env_s = S / arima_sps  # per-batch device time for the S-series config
         print(
-            f"[bench] arima 500-series device time: {env_s:.3f}s "
+            f"[bench] arima {S}-series device time: {env_s:.3f}s "
             f"(<10s envelope: {'YES' if env_s < 10.0 else 'NO'})",
             file=sys.stderr,
         )
