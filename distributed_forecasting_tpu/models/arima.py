@@ -73,6 +73,16 @@ class ArimaConfig:
     # propagation decays so slowly that integrated d=1 forecasts can wander
     # thousands of sigma before settling (observed under vmapped CV fits)
     prior_scale: float = 1.0
+    # Final filtering pass: 'scan' (default) = sequential lax.scan Kalman
+    # filter; 'pscan' = associative-scan parallel filter (ops/pkalman.py) —
+    # O(log T) parallel depth instead of T sequential steps, results match
+    # to float tolerance (tests/unit/test_pkalman.py).  The default follows
+    # the measurement policy (docs/parallelism.md): 'scan' stays default
+    # until a TPU run shows 'pscan' ahead end-to-end, compile cost included
+    # (the first attempt coincided with a degraded remote-compile service
+    # and could not be measured).  The MLE path's likelihood loop keeps the
+    # sequential filter regardless.
+    kalman: str = "scan"  # 'scan' | 'pscan'
 
 
 @jax.tree_util.register_dataclass
@@ -371,8 +381,22 @@ def fit(y, mask, day, config: ArimaConfig) -> ArimaParams:
     else:
         raise ValueError(f"unknown ARIMA fit method {config.method!r}; 'hr' or 'mle'")
 
+    if config.kalman == "pscan":
+        from distributed_forecasting_tpu.ops.pkalman import parallel_kalman_filter
+
+        def filt(zs, ms, ph, th):
+            T_mat, Rv = _build_ssm(ph, th, r)
+            RRt = jnp.outer(Rv, Rv)
+            return parallel_kalman_filter(zs, ms, T_mat, RRt, _init_cov(T_mat, RRt))
+    elif config.kalman == "scan":
+        filt = lambda zs, ms, ph, th: _kalman_loglik(zs, ms, ph, th, r)
+    else:
+        raise ValueError(
+            f"unknown ArimaConfig.kalman {config.kalman!r}; 'scan' or 'pscan'"
+        )
+
     def final_one(zs, ms, ph, th):
-        ssq, ldet, n, preds, Fs, a_T, P_T = _kalman_loglik(zs, ms, ph, th, r)
+        ssq, ldet, n, preds, Fs, a_T, P_T = filt(zs, ms, ph, th)
         sigma2 = ssq / jnp.maximum(n, 1.0)
         return sigma2, preds, Fs, a_T, P_T
 
@@ -428,7 +452,18 @@ def _forecast_impl(params: ArimaParams, day_all, config: ArimaConfig, _r: int):
     T_all = day_all.shape[0]
     dayf = day_all.astype(jnp.float32)
     h = dayf - params.t_fit_end
-    H = T_all  # compute a full-length forecast path, then gather
+    # Forecast-path length (static).  CONTRACT: day_all is a contiguous
+    # daily grid, and any grid LONGER than the fit grid must start at day0
+    # (i.e. cover history+future — every in-repo caller does: the engine
+    # uses day_grid and the serving predictor always forecasts the full grid
+    # and trims, serving/predictor.py).  Under that contract the max lead is
+    # T_all - T_fit for long grids and at most T_all for short (future-only)
+    # ones.  Scanning the full T_all for a history+future grid (the hot
+    # engine path) would spend ~20x the steps on leads the gather below
+    # clips away — at 500x1826 that was ~20 ms of pure serial scan depth
+    # per batch.
+    T_fit = params.fitted.shape[1]
+    H = T_all - T_fit + 1 if T_all > T_fit else T_all
 
     def fc_one(ph, th, a0, P0, s2):
         T_mat, Rv = _build_ssm(ph, th, _r)
@@ -461,7 +496,6 @@ def _forecast_impl(params: ArimaParams, day_all, config: ArimaConfig, _r: int):
     )
     fut_mean, fut_var = gath(path), gath(var)
 
-    T_fit = params.fitted.shape[1]
     fit_idx = jnp.clip((dayf - params.day0).astype(jnp.int32), 0, T_fit - 1)
     gath_fit = lambda M: jnp.take_along_axis(
         M, jnp.broadcast_to(fit_idx[None, :], (S, T_all)), axis=1

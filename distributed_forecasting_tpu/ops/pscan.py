@@ -36,10 +36,66 @@ def _compose(left, right):
     return A2 @ A1, (A2 @ c1[..., None])[..., 0] + c2
 
 
-def _affine_scan_flat(A, c, x0):
-    # cumulative maps: (Â_t, ĉ_t) with x_t = Â_t x0 + ĉ_t
-    A_cum, c_cum = jax.lax.associative_scan(_compose, (A, c))
-    return (A_cum @ x0[None, :, None])[..., 0] + c_cum
+def blocked_prefix(compose, elems, identity, block_size: int, project=None):
+    """All prefix compositions ``e_1 (x) ... (x) e_t`` of an associative
+    operator, blocked over the leading (time) axis.
+
+    ``elems`` is a pytree of arrays with leading axis T; ``identity`` is a
+    pytree of the same structure with leading axis 1 holding the operator's
+    identity element (used both to pad T to a block multiple and as the
+    initial cross-block carry).  ``project`` (optional) maps the full prefix
+    elements of one block to the per-step OUTPUT actually wanted — the
+    stacked result then holds only the projection while the cross-block
+    carry stays a full element, so e.g. (T, d, d) cumulative maps never
+    materialize across all T when only (T, d) states are needed.
+
+    Why blocked: a flat ``associative_scan`` over all T keeps ~log2(T) live
+    (T, ...) temporaries — at T=20k x 96 batch lanes that is >10 GB of HLO
+    temp and the TPU compiler refuses the allocation (observed round 2).
+    Blocking bounds the working set at O(block_size * elem) per lane while
+    keeping parallel depth log2(block_size) + T/block_size.  Used by
+    ``affine_scan`` (affine pairs, projected to states) and ``ops/pkalman``
+    (5-tuple Kalman filtering elements, projected to mean/cov).
+    """
+    if project is None:
+        project = lambda full: full
+    leaves = jax.tree_util.tree_leaves(elems)
+    T = leaves[0].shape[0]
+    if T <= block_size:
+        return project(jax.lax.associative_scan(compose, elems))
+    nb = -(-T // block_size)
+    pad = nb * block_size - T
+    if pad:
+        # identity elements: padded steps compose to a no-op, and the padded
+        # tail is sliced off below
+        elems = jax.tree_util.tree_map(
+            lambda e, i: jnp.concatenate(
+                [e, jnp.broadcast_to(i, (pad, *e.shape[1:]))]
+            ),
+            elems, identity,
+        )
+    blocked = jax.tree_util.tree_map(
+        lambda e: e.reshape(nb, block_size, *e.shape[1:]), elems
+    )
+
+    def block_step(carry, blk):
+        pref = jax.lax.associative_scan(compose, blk)
+        # left-compose the carried prefix of all earlier blocks into each
+        # within-block prefix (carry broadcasts over the block axis)
+        full = compose(
+            jax.tree_util.tree_map(
+                lambda c, p: jnp.broadcast_to(c, p.shape), carry, pref
+            ),
+            pref,
+        )
+        new_carry = jax.tree_util.tree_map(lambda f: f[-1], full)
+        return new_carry, project(full)
+
+    carry0 = jax.tree_util.tree_map(lambda i: i[0], identity)
+    _, out = jax.lax.scan(block_step, carry0, blocked)
+    return jax.tree_util.tree_map(
+        lambda f: f.reshape(nb * block_size, *f.shape[2:])[:T], out
+    )
 
 
 def affine_scan(
@@ -51,40 +107,23 @@ def affine_scan(
     """All states of ``x_t = A_t x_{t-1} + c_t`` for t = 1..T.
 
     A: (T, d, d); c: (T, d); x0: (d,) initial state (= x_0).
-    Returns (T, d): states AFTER each step.
-
-    Long T runs BLOCKED: a sequential ``lax.scan`` over T/block_size blocks,
-    each block evaluated by a within-block associative scan.  A flat
-    ``associative_scan`` over all T keeps ~log2(T) live (T, d, d) temporaries
-    — at T=20k x 96 batch lanes that is >10 GB of HLO temp and the TPU
-    compiler refuses the allocation (observed round 2).  Blocking bounds the
-    working set at O(block_size * d^2) per lane while keeping parallel depth
-    log2(block_size) + T/block_size, which at block_size=1024 is still ~100x
-    shallower than the sequential filter at T=100k.
+    Returns (T, d): states AFTER each step.  Long T runs blocked — see
+    ``blocked_prefix``; the projection applies x0 per block, so only (T, d)
+    states are stacked, never (T, d, d) cumulative maps.
     """
     T, d = c.shape
-    if T <= block_size:
-        return _affine_scan_flat(A, c, x0)
-    nb = -(-T // block_size)
-    pad = nb * block_size - T
-    if pad:
-        # identity affine maps: padded steps carry the state through, and the
-        # padded tail is sliced off below
-        A = jnp.concatenate(
-            [A, jnp.broadcast_to(jnp.eye(d, dtype=A.dtype), (pad, d, d))]
-        )
-        c = jnp.concatenate([c, jnp.zeros((pad, d), c.dtype)])
-    A = A.reshape(nb, block_size, d, d)
-    c = c.reshape(nb, block_size, d)
+    identity = (
+        jnp.eye(d, dtype=A.dtype)[None],
+        jnp.zeros((1, d), c.dtype),
+    )
 
-    def block_step(x, blk):
-        Ab, cb = blk
-        A_cum, c_cum = jax.lax.associative_scan(_compose, (Ab, cb))
-        states = (A_cum @ x[None, :, None])[..., 0] + c_cum
-        return states[-1], states
+    def to_states(full):
+        # x_t = Â_t x0 + ĉ_t from the cumulative map (Â_t, ĉ_t)
+        A_cum, c_cum = full
+        return (A_cum @ x0[None, :, None])[..., 0] + c_cum
 
-    _, states = jax.lax.scan(block_step, x0, (A, c))
-    return states.reshape(nb * block_size, d)[:T]
+    return blocked_prefix(_compose, (A, c), identity, block_size,
+                          project=to_states)
 
 
 def affine_scan_batched(A, c, x0):

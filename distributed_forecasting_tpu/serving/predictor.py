@@ -224,8 +224,17 @@ class BatchForecaster:
                 columns=["ds", *self.key_names, "yhat", "yhat_upper", "yhat_lower"]
             )
         fns = get_model(self.model)
-        start = self.day0 if include_history else self.day1 + 1
-        day_all = jnp.arange(start, self.day1 + horizon + 1, dtype=jnp.int32)
+        # ALWAYS forecast over the full history+future grid, then trim: the
+        # model forecast contract (see arima._forecast_impl) sizes its static
+        # forecast-path length as T_all - T_fit for grids longer than the fit
+        # grid, which is only exact when such grids start at day0.  A
+        # future-only grid with horizon > T_fit would silently saturate its
+        # tail (flat forecast past lead T_all - T_fit); the history part is
+        # a cheap gather of precomputed fitted values, so the full grid costs
+        # almost nothing and keeps every request pattern exact.
+        day_all = jnp.arange(
+            self.day0, self.day1 + horizon + 1, dtype=jnp.int32
+        )
         # bucket the request size to the next power of two (capped at S) so a
         # serving process sees O(log S) compiled shapes, not one per distinct
         # request size; padding rows repeat sidx[0] and are dropped after
@@ -237,6 +246,9 @@ class BatchForecaster:
         yhat, lo, hi = fns.forecast(
             params, day_all, jnp.float32(self.day1), self.config, key
         )
+        if not include_history:
+            day_all = day_all[-horizon:]
+            yhat, lo, hi = yhat[:, -horizon:], lo[:, -horizon:], hi[:, -horizon:]
         yhat = np.asarray(yhat)[:k]
         lo = np.asarray(lo)[:k]
         hi = np.asarray(hi)[:k]

@@ -1,0 +1,168 @@
+"""Parallel (associative-scan) Kalman filter vs the sequential filter."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_forecasting_tpu.models.arima import (
+    _build_ssm,
+    _init_cov,
+    _kalman_loglik,
+)
+from distributed_forecasting_tpu.ops.pkalman import parallel_kalman_filter
+
+
+def _simulate_arma(rng, T, phi, theta):
+    p, q = len(phi), len(theta)
+    eps = rng.normal(0, 1.0, T + 50)
+    z = np.zeros(T + 50)
+    for t in range(max(p, q + 1), T + 50):
+        z[t] = sum(phi[i] * z[t - 1 - i] for i in range(p)) + eps[t]
+        z[t] += sum(theta[j] * eps[t - 1 - j] for j in range(q))
+    return z[50:]
+
+
+@pytest.mark.parametrize(
+    "phi,theta,missing",
+    [
+        ((0.6, -0.2), (0.3,), 0.0),
+        ((0.6, -0.2), (0.3,), 0.2),
+        ((0.9,), (), 0.0),
+        ((), (0.5, 0.2), 0.15),
+    ],
+)
+def test_parallel_kalman_matches_sequential(phi, theta, missing):
+    rng = np.random.default_rng(7)
+    T = 300
+    z = jnp.asarray(_simulate_arma(rng, T, phi, theta).astype(np.float32))
+    mask = jnp.asarray((rng.random(T) >= missing).astype(np.float32))
+    phi_j = jnp.asarray(phi, dtype=jnp.float32)
+    theta_j = jnp.asarray(theta, dtype=jnp.float32)
+    r = max(len(phi), len(theta) + 1, 1)
+
+    ssq1, ldet1, n1, preds1, Fs1, aT1, PT1 = _kalman_loglik(
+        z, mask, phi_j, theta_j, r
+    )
+    T_mat, Rv = _build_ssm(phi_j, theta_j, r)
+    RRt = jnp.outer(Rv, Rv)
+    P0 = _init_cov(T_mat, RRt)
+    ssq2, ldet2, n2, preds2, Fs2, aT2, PT2 = parallel_kalman_filter(
+        z, mask, T_mat, RRt, P0
+    )
+
+    assert float(n1) == float(n2)
+    np.testing.assert_allclose(float(ssq1), float(ssq2), rtol=1e-3)
+    np.testing.assert_allclose(float(ldet1), float(ldet2), rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(
+        np.asarray(preds1), np.asarray(preds2), rtol=1e-3, atol=1e-3
+    )
+    np.testing.assert_allclose(
+        np.asarray(Fs1), np.asarray(Fs2), rtol=1e-3, atol=1e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(aT1), np.asarray(aT2), rtol=1e-3, atol=1e-3
+    )
+    np.testing.assert_allclose(
+        np.asarray(PT1), np.asarray(PT2), rtol=1e-3, atol=1e-4
+    )
+
+
+def test_parallel_kalman_blocked_matches_flat():
+    """Blocked prefix (T > block_size, non-multiple) == flat prefix."""
+    rng = np.random.default_rng(8)
+    T = 205
+    z = jnp.asarray(_simulate_arma(rng, T, (0.7, -0.1), (0.4,)).astype(np.float32))
+    mask = jnp.asarray((rng.random(T) >= 0.1).astype(np.float32))
+    phi = jnp.asarray([0.7, -0.1], dtype=jnp.float32)
+    theta = jnp.asarray([0.4], dtype=jnp.float32)
+    T_mat, Rv = _build_ssm(phi, theta, 3)
+    RRt = jnp.outer(Rv, Rv)
+    P0 = _init_cov(T_mat, RRt)
+    out_flat = parallel_kalman_filter(z, mask, T_mat, RRt, P0, block_size=T)
+    out_blk = parallel_kalman_filter(z, mask, T_mat, RRt, P0, block_size=64)
+    for a, b in zip(out_flat, out_blk):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+        )
+
+
+def test_arima_fit_kalman_flag_equivalence():
+    """ArimaConfig(kalman='pscan') is a production code path: same fit as the
+    sequential filter, to float tolerance."""
+    from distributed_forecasting_tpu.data import (
+        synthetic_store_item_sales,
+        tensorize,
+    )
+    from distributed_forecasting_tpu.models import arima
+
+    df = synthetic_store_item_sales(n_stores=1, n_items=4, n_days=400, seed=3)
+    b = tensorize(df)
+    p1 = arima.fit(b.y, b.mask, b.day, arima.ArimaConfig(kalman="scan"))
+    p2 = arima.fit(b.y, b.mask, b.day, arima.ArimaConfig(kalman="pscan"))
+    np.testing.assert_allclose(
+        np.asarray(p1.phi), np.asarray(p2.phi), rtol=1e-4, atol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(p1.sigma2), np.asarray(p2.sigma2), rtol=1e-3
+    )
+    np.testing.assert_allclose(
+        np.asarray(p1.fitted), np.asarray(p2.fitted), rtol=1e-3, atol=1e-2
+    )
+    np.testing.assert_allclose(
+        np.asarray(p1.a_last), np.asarray(p2.a_last), rtol=1e-3, atol=1e-3
+    )
+    with pytest.raises(ValueError, match="kalman"):
+        arima.fit(b.y, b.mask, b.day, arima.ArimaConfig(kalman="bogus"))
+
+
+def test_serving_horizon_longer_than_training_not_flat():
+    """Regression: a future-only request with horizon > training length must
+    keep moving/widening, not saturate at lead T_all - T_fit (the forecast
+    path length is static; serving always passes the full grid and trims)."""
+    import pandas as pd
+
+    from distributed_forecasting_tpu.data import (
+        synthetic_store_item_sales,
+        tensorize,
+    )
+    from distributed_forecasting_tpu.engine import fit_forecast
+    from distributed_forecasting_tpu.models.arima import ArimaConfig
+    from distributed_forecasting_tpu.serving import BatchForecaster
+
+    df = synthetic_store_item_sales(n_stores=1, n_items=3, n_days=40, seed=5)
+    b = tensorize(df)
+    cfg = ArimaConfig(hr_ar_order=10)
+    params, _ = fit_forecast(b, model="arima", config=cfg, horizon=5,
+                             min_points=5)
+    bf = BatchForecaster.from_fit(b, params, model="arima", config=cfg)
+    out = bf.predict(pd.DataFrame({"store": [1], "item": [1]}), horizon=80)
+    assert len(out) == 80
+    width = (out.yhat_upper - out.yhat_lower).to_numpy()
+    # intervals keep widening deep past lead T_fit=40
+    assert width[79] > width[45] > width[10]
+    # the point forecast is not frozen on the tail
+    tail = out.yhat.to_numpy()[45:]
+    assert np.ptp(tail) > 0.0
+
+
+def test_parallel_kalman_vmaps():
+    rng = np.random.default_rng(9)
+    S, T = 4, 120
+    zs = jnp.asarray(
+        np.stack([_simulate_arma(rng, T, (0.5,), (0.2,)) for _ in range(S)])
+        .astype(np.float32)
+    )
+    masks = jnp.ones((S, T))
+    phi = jnp.asarray([0.5], dtype=jnp.float32)
+    theta = jnp.asarray([0.2], dtype=jnp.float32)
+    T_mat, Rv = _build_ssm(phi, theta, 2)
+    RRt = jnp.outer(Rv, Rv)
+    P0 = _init_cov(T_mat, RRt)
+    fn = jax.vmap(
+        lambda z, m: parallel_kalman_filter(z, m, T_mat, RRt, P0)
+    )
+    ssq, ldet, n, preds, Fs, aT, PT = fn(zs, masks)
+    assert preds.shape == (S, T) and aT.shape == (S, 2)
+    ref = _kalman_loglik(zs[2], masks[2], phi, theta, 2)
+    np.testing.assert_allclose(float(ssq[2]), float(ref[0]), rtol=1e-3)
