@@ -316,6 +316,62 @@ def main() -> None:
         print(f"[bench] arima probe failed: {type(e).__name__}: {e}",
               file=sys.stderr)
 
+    # ---- CV probe: the reference's hottest loop (500 series x 3 cutoffs) --
+    try:
+        from distributed_forecasting_tpu.engine.cv import (
+            CVConfig,
+            _cv_impl,
+            cutoff_indices,
+        )
+        from distributed_forecasting_tpu.models.base import get_model
+
+        cv = CVConfig()
+        cuts = tuple(cutoff_indices(batches[0].n_time, cv))
+        cv_cfg = get_model("prophet").config_cls()
+
+        def run_cv_scan(Y, Mm):
+            def step(c, ym):
+                yb, mb = ym
+                out = _cv_impl(
+                    yb, mb, batches[0].day, key, model="prophet",
+                    config=cv_cfg, cuts=cuts, horizon=cv.horizon,
+                )
+                return c + out["mape"].sum(), None
+
+            tot, _ = jax.lax.scan(step, 0.0, (Y, Mm))
+            return tot
+
+        run_cv = jax.jit(run_cv_scan)
+        Ys = jnp.stack([b.y for b in batches])
+        Ms = jnp.stack([b.mask for b in batches])
+        Yl = jnp.concatenate([Ys] * 4)
+        Ml = jnp.concatenate([Ms] * 4)
+
+        def timed_cv(Yk, Mk):
+            def run():
+                t0 = time.perf_counter()
+                float(run_cv(Yk, Mk))
+                return time.perf_counter() - t0
+
+            run()  # compile
+            return min(run() for _ in range(3))
+
+        t_s = timed_cv(Ys, Ms)
+        t_l = timed_cv(Yl, Ml)
+        k_s, k_l = N_STAGED, 4 * N_STAGED
+        per_cv = (t_l - t_s) / (k_l - k_s)
+        if per_cv <= 0:  # jitter ate the slope — same fallback as the fit slope
+            per_cv = t_l / k_l
+        print(
+            f"[bench] CV probe ({len(cuts)} cutoffs x {S} series, fused): "
+            f"{per_cv * 1e3:.2f}ms/batch device ({S / per_cv:.0f} series/s "
+            f"full rolling-origin CV)",
+            file=sys.stderr,
+        )
+    except Exception as e:
+        print(f"[bench] CV probe failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+
     # ---- scale probe (BASELINE config #4): 50k series on TPU, 5k on CPU ---
     try:
         from distributed_forecasting_tpu.data import synthetic_series_batch

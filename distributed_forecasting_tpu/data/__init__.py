@@ -1,4 +1,8 @@
-from distributed_forecasting_tpu.data.tensorize import SeriesBatch, tensorize
+from distributed_forecasting_tpu.data.tensorize import (
+    SeriesBatch,
+    bucket_by_span,
+    tensorize,
+)
 from distributed_forecasting_tpu.data.dataset import (
     load_sales_csv,
     load_sales_parquet,
@@ -9,6 +13,7 @@ from distributed_forecasting_tpu.data.catalog import DatasetCatalog
 
 __all__ = [
     "SeriesBatch",
+    "bucket_by_span",
     "tensorize",
     "load_sales_csv",
     "load_sales_parquet",

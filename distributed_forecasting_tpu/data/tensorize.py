@@ -95,6 +95,66 @@ class SeriesBatch:
         )
 
 
+def bucket_by_span(batch: SeriesBatch, max_buckets: int = 4):
+    """Split a ragged batch into length buckets with TRIMMED time grids.
+
+    The shared-grid design (module docstring) pads every series to the full
+    min..max date span; a series that starts late (a new item) carries a
+    leading masked stretch that still costs full compute in every fit.  This
+    is the "bucketed padding by length" step of the build plan (SURVEY.md
+    §7.1): series are grouped by observed span rounded UP to a power of two
+    (so at most log2(T) distinct compiled shapes, capped at ``max_buckets``
+    by merging the shortest buckets upward), and each bucket's grid is
+    trimmed to its rounded span — the dropped leading region is fully
+    masked, so no observation is lost.
+
+    Returns a list of ``(indices, sub_batch)`` with indices into the
+    original series axis; the union of indices covers every series exactly
+    once.  Fitting each sub-batch on its shorter grid does proportionally
+    less work; trend normalization and the changepoint grid then also span
+    the observed window rather than the global one (for late-starting
+    series that is Prophet's own behavior — changepoints belong in the
+    observed history).
+    """
+    if max_buckets < 1:
+        raise ValueError(f"max_buckets must be >= 1, got {max_buckets}")
+    mask_np = np.asarray(batch.mask) > 0
+    T = batch.n_time
+    any_obs = mask_np.any(axis=1)
+    first = np.where(any_obs, mask_np.argmax(axis=1), T - 1)
+    span = T - first  # observed window length incl. the masked gaps inside
+    # round spans up to powers of two, capped at T
+    pow2 = np.minimum(
+        np.power(2, np.ceil(np.log2(np.maximum(span, 1)))).astype(np.int64), T
+    )
+    lengths = sorted(set(pow2.tolist()))
+    while len(lengths) > max_buckets:
+        # merge the two shortest buckets (short grids are cheap anyway)
+        lengths = lengths[1:]
+    buckets = []
+    assigned = np.zeros(batch.n_series, dtype=bool)
+    for L in lengths:
+        sel = (pow2 <= L) & ~assigned
+        if L == lengths[-1]:
+            sel = ~assigned  # last bucket absorbs everything left
+        idx = np.nonzero(sel)[0]
+        if idx.size == 0:
+            continue
+        assigned[idx] = True
+        sub = dataclasses.replace(
+            batch,
+            y=batch.y[idx, T - L:],
+            mask=batch.mask[idx, T - L:],
+            day=batch.day[T - L:],
+            keys=batch.keys[idx],
+            start_date=str(
+                (pd.Timestamp(batch.start_date) + pd.Timedelta(days=T - L)).date()
+            ),
+        )
+        buckets.append((idx, sub))
+    return buckets
+
+
 def resolved_backend(n_keys: int = 2, backend: str = "auto") -> str:
     """Decide which tensorize data plane will run: 'native' or 'pandas'.
 

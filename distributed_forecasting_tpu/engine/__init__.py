@@ -1,6 +1,7 @@
 from distributed_forecasting_tpu.engine.fit import (
     ForecastResult,
     fit_forecast,
+    fit_forecast_bucketed,
     fit_forecast_chunked,
     forecast_frame,
     seasonal_naive,
@@ -26,6 +27,7 @@ __all__ = [
     "tune_curve_model",
     "ForecastResult",
     "fit_forecast",
+    "fit_forecast_bucketed",
     "fit_forecast_chunked",
     "forecast_frame",
     "seasonal_naive",

@@ -283,6 +283,61 @@ def fit_forecast_chunked(
     return params, result
 
 
+def fit_forecast_bucketed(
+    batch: SeriesBatch,
+    model: str = "prophet",
+    config=None,
+    horizon: int = 90,
+    key: Optional[jax.Array] = None,
+    min_points: int = 14,
+    max_buckets: int = 4,
+):
+    """Fit a RAGGED batch in span buckets (SURVEY.md §7.1 bucketed padding).
+
+    Series are grouped by observed span (``data.tensorize.bucket_by_span``)
+    and each bucket fits on its trimmed grid — a batch where most series
+    started recently does proportionally less work than the shared-grid
+    ``fit_forecast``.  Returns ``(bucket_params, result)``:
+
+    * ``bucket_params``: list of ``(indices, params)`` per bucket (params
+      are per-bucket pytrees — their time-shaped leaves have bucket length);
+    * ``result``: a full-grid ``ForecastResult`` over history + horizon;
+      rows before a bucket's trimmed window (fully masked by construction)
+      carry that series' earliest in-window value.
+    """
+    from distributed_forecasting_tpu.data.tensorize import bucket_by_span
+
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    buckets = bucket_by_span(batch, max_buckets=max_buckets)
+    S, T = batch.n_series, batch.n_time
+    T_all = T + horizon
+    yhat = jnp.zeros((S, T_all))
+    lo = jnp.zeros((S, T_all))
+    hi = jnp.zeros((S, T_all))
+    ok = jnp.zeros((S,), bool)
+    bucket_params = []
+    for i, (idx, sub) in enumerate(buckets):
+        p, r = fit_forecast(
+            sub, model=model, config=config, horizon=horizon,
+            key=jax.random.fold_in(key, i), min_points=min_points,
+        )
+        L_all = int(r.yhat.shape[1])
+        lead = T_all - L_all
+        fill = lambda M: jnp.concatenate(
+            [jnp.broadcast_to(M[:, :1], (len(idx), lead)), M], axis=1
+        )
+        yhat = yhat.at[idx].set(fill(r.yhat))
+        lo = lo.at[idx].set(fill(r.lo))
+        hi = hi.at[idx].set(fill(r.hi))
+        ok = ok.at[idx].set(r.ok)
+        bucket_params.append((idx, p))
+    result = ForecastResult(
+        yhat=yhat, lo=lo, hi=hi, ok=ok, day_all=day_grid(batch.day, horizon)
+    )
+    return bucket_params, result
+
+
 def forecast_frame(
     batch: SeriesBatch,
     result: ForecastResult,
