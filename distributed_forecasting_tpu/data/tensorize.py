@@ -128,9 +128,9 @@ def bucket_by_span(batch: SeriesBatch, max_buckets: int = 4):
         np.power(2, np.ceil(np.log2(np.maximum(span, 1)))).astype(np.int64), T
     )
     lengths = sorted(set(pow2.tolist()))
-    while len(lengths) > max_buckets:
-        # merge the two shortest buckets (short grids are cheap anyway)
-        lengths = lengths[1:]
+    # cap the shape count by merging short buckets upward (short grids are
+    # cheap anyway): keep the max_buckets longest lengths
+    lengths = lengths[-max_buckets:]
     buckets = []
     assigned = np.zeros(batch.n_series, dtype=bool)
     for L in lengths:

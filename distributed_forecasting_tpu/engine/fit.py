@@ -297,10 +297,12 @@ def fit_forecast_bucketed(
     Series are grouped by observed span (``data.tensorize.bucket_by_span``)
     and each bucket fits on its trimmed grid — a batch where most series
     started recently does proportionally less work than the shared-grid
-    ``fit_forecast``.  Returns ``(bucket_params, result)``:
+    ``fit_forecast``.  Returns ``(buckets, result)``:
 
-    * ``bucket_params``: list of ``(indices, params)`` per bucket (params
-      are per-bucket pytrees — their time-shaped leaves have bucket length);
+    * ``buckets``: list of ``(indices, sub_batch, params)`` per bucket
+      (params are per-bucket pytrees — their time-shaped leaves have bucket
+      length; the sub_batch carries the trimmed grid the params were fit
+      on, which ``serving.BucketedForecaster`` needs to rebuild predictors);
     * ``result``: a full-grid ``ForecastResult`` over history + horizon;
       rows before a bucket's trimmed window (fully masked by construction)
       carry that series' earliest in-window value.
@@ -331,7 +333,7 @@ def fit_forecast_bucketed(
         lo = lo.at[idx].set(fill(r.lo))
         hi = hi.at[idx].set(fill(r.hi))
         ok = ok.at[idx].set(r.ok)
-        bucket_params.append((idx, p))
+        bucket_params.append((idx, sub, p))
     result = ForecastResult(
         yhat=yhat, lo=lo, hi=hi, ok=ok, day_all=day_grid(batch.day, horizon)
     )

@@ -78,13 +78,24 @@ class TrainingPipeline:
         tuning: Optional[Dict[str, Any]] = None,
         trace_dir: Optional[str] = None,
         seed: int = 0,
+        bucketed: bool = False,
     ) -> Dict[str, Any]:
         if tuning and tuning.get("enabled"):
+            if bucketed:
+                raise ValueError(
+                    "training.bucketed is not supported together with "
+                    "tuning.enabled — the tuned path fits on the shared grid"
+                )
             return self._fine_grained_tuned(
                 source_table, output_table, model_conf, cv_conf, tuning,
                 experiment, horizon, key_cols,
             )
         if model == "auto":
+            if bucketed:
+                raise ValueError(
+                    "training.bucketed is not supported together with "
+                    "model='auto' — auto-select fits on the shared grid"
+                )
             return self._fine_grained_auto(
                 source_table, output_table, model_conf, cv_conf,
                 experiment, horizon, key_cols, seed,
@@ -114,9 +125,24 @@ class TrainingPipeline:
                     )
                     jax.block_until_ready(cv_metrics["mape"])
             with timer.phase("fit_forecast"):
-                params, result = fit_forecast(
-                    batch, model=model, config=config, horizon=horizon, key=key
-                )
+                if bucketed:
+                    # ragged batches: span buckets on trimmed grids (CV above
+                    # stays on the shared grid — short buckets may not cover
+                    # the CV `initial` window, and masks keep it correct)
+                    from distributed_forecasting_tpu.engine import (
+                        fit_forecast_bucketed,
+                    )
+
+                    buckets, result = fit_forecast_bucketed(
+                        batch, model=model, config=config, horizon=horizon,
+                        key=key,
+                    )
+                    params = None
+                else:
+                    params, result = fit_forecast(
+                        batch, model=model, config=config, horizon=horizon,
+                        key=key,
+                    )
                 jax.block_until_ready(result.yhat)
         fit_seconds = time.time() - t_start
 
@@ -135,7 +161,12 @@ class TrainingPipeline:
         ) as run:
             from distributed_forecasting_tpu.models import prophet_glm
 
-            if model in ("prophet", "curve"):
+            if bucketed:
+                import dataclasses as _dc
+
+                run.log_params(_dc.asdict(config))
+                run.log_params({"n_buckets": len(buckets)})
+            elif model in ("prophet", "curve"):
                 run.log_params(prophet_glm.extract_params(params, config))
             else:
                 import dataclasses as _dc
@@ -169,7 +200,18 @@ class TrainingPipeline:
             run.log_metrics(agg)
             run.log_table("series_metrics.parquet", series_table)
 
-            forecaster = BatchForecaster.from_fit(batch, params, model, config)
+            if bucketed:
+                from distributed_forecasting_tpu.serving import (
+                    BucketedForecaster,
+                )
+
+                forecaster = BucketedForecaster.from_bucketed_fit(
+                    buckets, model, config
+                )
+            else:
+                forecaster = BatchForecaster.from_fit(
+                    batch, params, model, config
+                )
             forecaster.save(run.artifact_path("forecaster"))
 
             if per_series_runs:

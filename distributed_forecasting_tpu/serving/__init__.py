@@ -1,4 +1,5 @@
 from distributed_forecasting_tpu.serving.predictor import BatchForecaster
+from distributed_forecasting_tpu.serving.bucketed import BucketedForecaster
 from distributed_forecasting_tpu.serving.ensemble import MultiModelForecaster
 from distributed_forecasting_tpu.serving.server import (
     ForecastServer,
@@ -10,6 +11,7 @@ from distributed_forecasting_tpu.serving.server import (
 
 __all__ = [
     "BatchForecaster",
+    "BucketedForecaster",
     "MultiModelForecaster",
     "ForecastServer",
     "load_forecaster",

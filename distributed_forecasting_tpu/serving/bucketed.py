@@ -1,0 +1,128 @@
+"""Serving for length-bucketed fits: one BatchForecaster per span bucket.
+
+Companion to ``engine.fit_forecast_bucketed`` the way
+``serving.ensemble.MultiModelForecaster`` is the companion to the
+cross-family auto-select path: the buckets partition the series key space,
+each bucket keeps its own trimmed-grid predictor, and a request is routed
+to the buckets owning its keys — one compiled predict per bucket PRESENT in
+the request, never per series (the reference anti-pattern,
+``notebooks/prophet/model_wrapper.py:57-58``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional
+
+import jax
+import numpy as np
+import pandas as pd
+
+from distributed_forecasting_tpu.serving.predictor import (
+    BatchForecaster,
+    UnknownSeriesError,
+)
+
+_META_FILE = "buckets.json"
+
+
+class BucketedForecaster:
+    def __init__(self, forecasters: List[BatchForecaster]):
+        if not forecasters:
+            raise ValueError("need at least one bucket forecaster")
+        self.forecasters = list(forecasters)
+        self.key_names = self.forecasters[0].key_names
+        # host-side key -> bucket routing table; buckets partition the keys
+        self._route = {}
+        for j, fc in enumerate(self.forecasters):
+            for row in np.asarray(fc.keys):
+                k = tuple(int(v) for v in row)
+                if k in self._route:
+                    raise ValueError(f"series key {k} appears in two buckets")
+                self._route[k] = j
+
+    @classmethod
+    def from_bucketed_fit(cls, buckets, model: str, config=None
+                          ) -> "BucketedForecaster":
+        """Build from ``engine.fit_forecast_bucketed``'s ``buckets`` output
+        (``(indices, sub_batch, params)`` triples)."""
+        if config is None:
+            from distributed_forecasting_tpu.models.base import get_model
+
+            config = get_model(model).config_cls()
+        return cls([
+            BatchForecaster.from_fit(sub, params, model, config)
+            for _, sub, params in buckets
+        ])
+
+    @property
+    def n_series(self) -> int:
+        return len(self._route)
+
+    @property
+    def serving_schema(self) -> str:
+        return self.forecasters[0].serving_schema
+
+    # -- persistence --------------------------------------------------------
+    def save(self, directory: str) -> None:
+        os.makedirs(directory, exist_ok=True)
+        for j, fc in enumerate(self.forecasters):
+            fc.save(os.path.join(directory, f"bucket_{j}"))
+        with open(os.path.join(directory, _META_FILE), "w") as f:
+            json.dump({"n_buckets": len(self.forecasters)}, f)
+
+    @classmethod
+    def load(cls, directory: str) -> "BucketedForecaster":
+        with open(os.path.join(directory, _META_FILE)) as f:
+            meta = json.load(f)
+        return cls([
+            BatchForecaster.load(os.path.join(directory, f"bucket_{j}"))
+            for j in range(meta["n_buckets"])
+        ])
+
+    # -- inference ----------------------------------------------------------
+    def predict(
+        self,
+        request: pd.DataFrame,
+        horizon: int = 90,
+        include_history: bool = False,
+        key: Optional[jax.Array] = None,
+        on_missing: str = "raise",
+    ) -> pd.DataFrame:
+        """One batched predict per bucket present in the request."""
+        if on_missing not in ("raise", "skip"):
+            # same guard as BatchForecaster.series_indices: a typo like
+            # 'Raise' must not silently become skip-and-drop
+            raise ValueError(
+                f"on_missing must be 'raise' or 'skip', got {on_missing!r}"
+            )
+        names = list(self.key_names)
+        missing_cols = [c for c in names if c not in request.columns]
+        if missing_cols:
+            raise KeyError(f"request lacks key column(s) {missing_cols}")
+        req_keys = [tuple(int(v) for v in row)
+                    for row in request[names].itertuples(index=False)]
+        unknown = sorted(set(k for k in req_keys if k not in self._route))
+        if unknown and on_missing == "raise":
+            raise UnknownSeriesError(
+                f"{len(unknown)} requested series not in any bucket "
+                f"(first: {unknown[:3]})"
+            )
+        per_bucket = {}
+        for k in req_keys:
+            j = self._route.get(k)
+            if j is not None:
+                per_bucket.setdefault(j, []).append(k)
+        parts = []
+        for j in sorted(per_bucket):
+            sub_req = pd.DataFrame(per_bucket[j], columns=names)
+            parts.append(self.forecasters[j].predict(
+                sub_req, horizon=horizon, include_history=include_history,
+                key=key,
+            ))
+        if not parts:
+            return pd.DataFrame(
+                columns=["ds", *names, "yhat", "yhat_upper", "yhat_lower"]
+            )
+        return pd.concat(parts, ignore_index=True)

@@ -46,14 +46,22 @@ from distributed_forecasting_tpu.serving.predictor import (
 from distributed_forecasting_tpu.utils import get_logger
 
 _ENSEMBLE_META = "ensemble.json"
+_BUCKETS_META = "buckets.json"
 _MAX_HORIZON = 3650  # 10 years daily — beyond any sane scoring request
 
 
 def load_forecaster(artifact_dir: str):
     """Load whichever serving artifact lives in ``artifact_dir`` — a single
-    BatchForecaster or a mixed-family MultiModelForecaster."""
+    BatchForecaster, a mixed-family MultiModelForecaster, or a span-bucketed
+    BucketedForecaster."""
     if os.path.exists(os.path.join(artifact_dir, _ENSEMBLE_META)):
         return MultiModelForecaster.load(artifact_dir)
+    if os.path.exists(os.path.join(artifact_dir, _BUCKETS_META)):
+        from distributed_forecasting_tpu.serving.bucketed import (
+            BucketedForecaster,
+        )
+
+        return BucketedForecaster.load(artifact_dir)
     return BatchForecaster.load(artifact_dir)
 
 

@@ -16,6 +16,7 @@ Librarized equivalent of the reference's training notebook entry point
       horizon: 90
       experiment: finegrain_forecasting
       per_series_runs: false
+      bucketed: false               # span-bucketed fit for ragged batches
       path: fine_grained            # or 'allocated'
 """
 
@@ -52,6 +53,7 @@ class TrainTask(Task):
             run_cross_validation=bool(tr.get("run_cross_validation", True)),
             per_series_runs=bool(tr.get("per_series_runs", False)),
             tuning=tr.get("tuning"),
+            bucketed=bool(tr.get("bucketed", False)),
         )
 
 

@@ -51,7 +51,7 @@ def test_bucket_by_span_partitions_and_trims(ragged_batch):
 
 
 def test_bucketed_fit_covers_all_series(ragged_batch):
-    bucket_params, res = fit_forecast_bucketed(
+    buckets, res = fit_forecast_bucketed(
         ragged_batch, model="prophet", horizon=30, max_buckets=4
     )
     S, T = ragged_batch.n_series, ragged_batch.n_time
@@ -59,7 +59,92 @@ def test_bucketed_fit_covers_all_series(ragged_batch):
     assert res.day_all.shape == (T + 30,)
     assert bool(jnp.all(jnp.isfinite(res.yhat)))
     assert bool(res.ok.all())
-    assert sum(len(idx) for idx, _ in bucket_params) == S
+    assert sum(len(idx) for idx, _, _ in buckets) == S
+
+
+def test_bucketed_forecaster_roundtrip(ragged_batch, tmp_path):
+    """BucketedForecaster routes requests across buckets, survives
+    save/load, and the server loader auto-detects the artifact."""
+    import pandas as pd
+
+    from distributed_forecasting_tpu.serving import BucketedForecaster
+    from distributed_forecasting_tpu.serving.predictor import UnknownSeriesError
+    from distributed_forecasting_tpu.serving.server import load_forecaster
+
+    buckets, _ = fit_forecast_bucketed(ragged_batch, model="prophet",
+                                       horizon=30)
+    bf = BucketedForecaster.from_bucketed_fit(buckets, "prophet")
+    assert bf.n_series == ragged_batch.n_series
+    # one early-starting and one late-starting series in the same request
+    keys = ragged_batch.key_frame()
+    early = keys[keys["item"] < 5].iloc[0]
+    late = keys[keys["item"] >= 5].iloc[0]
+    req = pd.DataFrame([early, late]).reset_index(drop=True)
+    out = bf.predict(req, horizon=14)
+    assert len(out) == 2 * 14
+    assert set(out["item"]) == {int(early["item"]), int(late["item"])}
+    assert out["yhat"].notna().all()
+
+    with pytest.raises(UnknownSeriesError):
+        bf.predict(pd.DataFrame({"store": [99], "item": [99]}), horizon=7)
+
+    d = str(tmp_path / "art")
+    bf.save(d)
+    loaded = load_forecaster(d)
+    assert isinstance(loaded, BucketedForecaster)
+    out2 = loaded.predict(req, horizon=14)
+    np.testing.assert_allclose(
+        out["yhat"].to_numpy(), out2["yhat"].to_numpy(), rtol=1e-5
+    )
+
+
+def test_training_pipeline_bucketed(ragged_batch, tmp_path):
+    """training.bucketed=True produces a bucketed serving artifact and a
+    full-grid forecast table through the normal task pipeline."""
+    import pandas as pd
+
+    from distributed_forecasting_tpu.data.catalog import DatasetCatalog
+    from distributed_forecasting_tpu.pipelines.training import TrainingPipeline
+    from distributed_forecasting_tpu.serving import BucketedForecaster
+    from distributed_forecasting_tpu.serving.server import load_forecaster
+    from distributed_forecasting_tpu.tracking import FileTracker
+
+    catalog = DatasetCatalog(str(tmp_path / "catalog"))
+    tracker = FileTracker(str(tmp_path / "mlruns"))
+    catalog.create_catalog("hackathon")
+    catalog.create_schema("hackathon", "sales")
+    # long-format frame from the ragged batch
+    rows = []
+    mask = np.asarray(ragged_batch.mask) > 0
+    y = np.asarray(ragged_batch.y)
+    dates = ragged_batch.dates()
+    for s in range(ragged_batch.n_series):
+        store, item = ragged_batch.keys[s]
+        obs = np.nonzero(mask[s])[0]
+        rows.append(pd.DataFrame({
+            "date": dates[obs], "store": store, "item": item,
+            "sales": y[s, obs],
+        }))
+    df = pd.concat(rows, ignore_index=True)
+    catalog.save_table("hackathon.sales.raw_ragged", df)
+
+    pipe = TrainingPipeline(catalog, tracker)
+    summary = pipe.fine_grained(
+        "hackathon.sales.raw_ragged", "hackathon.sales.bucketed_forecasts",
+        model="prophet", horizon=14,
+        cv_conf={"initial": 300, "period": 180, "horizon": 60},
+        bucketed=True,
+    )
+    assert summary["n_failed"] == 0
+    run = tracker.get_run(summary["experiment_id"], summary["run_id"])
+    assert int(run.params()["n_buckets"]) >= 2
+    fc = load_forecaster(run.artifact_path("forecaster"))
+    assert isinstance(fc, BucketedForecaster)
+    late_key = ragged_batch.key_frame().query("item >= 5").iloc[[0]]
+    out = fc.predict(late_key.reset_index(drop=True), horizon=7)
+    assert len(out) == 7
+    table = catalog.read_table("hackathon.sales.bucketed_forecasts")
+    assert set(table["item"]) == set(int(i) for _, i in ragged_batch.keys)
 
 
 def test_bucketed_quality_matches_full_grid(ragged_batch):
