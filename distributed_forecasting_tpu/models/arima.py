@@ -77,11 +77,12 @@ class ArimaConfig:
     # filter; 'pscan' = associative-scan parallel filter (ops/pkalman.py) —
     # O(log T) parallel depth instead of T sequential steps, results match
     # to float tolerance (tests/unit/test_pkalman.py).  The default follows
-    # the measurement policy (docs/parallelism.md): 'scan' stays default
-    # until a TPU run shows 'pscan' ahead end-to-end, compile cost included
-    # (the first attempt coincided with a degraded remote-compile service
-    # and could not be measured).  The MLE path's likelihood loop keeps the
-    # sequential filter regardless.
+    # the measurement (docs/benchmarks.md): at 500 x 1826 on TPU v5e with
+    # the slope protocol, 'scan' runs the full fit in ~62 ms/batch vs
+    # ~1140 ms for 'pscan' — 500 series already fill the chip, so trading
+    # sequential depth for O(T log T) 3x3-matrix composition work loses
+    # ~18x.  'pscan' remains the few-series x very-long-T option.  The MLE
+    # path's likelihood loop keeps the sequential filter regardless.
     kalman: str = "scan"  # 'scan' | 'pscan'
 
 
