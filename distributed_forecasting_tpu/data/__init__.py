@@ -2,6 +2,7 @@ from distributed_forecasting_tpu.data.tensorize import (
     SeriesBatch,
     bucket_by_span,
     tensorize,
+    tensorize_regressors,
 )
 from distributed_forecasting_tpu.data.dataset import (
     load_sales_csv,
@@ -15,6 +16,7 @@ __all__ = [
     "SeriesBatch",
     "bucket_by_span",
     "tensorize",
+    "tensorize_regressors",
     "load_sales_csv",
     "load_sales_parquet",
     "synthetic_series_batch",

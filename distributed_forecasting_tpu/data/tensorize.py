@@ -95,6 +95,15 @@ class SeriesBatch:
         )
 
 
+def _epoch_days(dates) -> np.ndarray:
+    """Date-like column -> int64 days since the Unix epoch (the shared
+    absolute day index every grid in the package is built on)."""
+    d = pd.to_datetime(dates)
+    return (
+        d.values.astype("datetime64[D]") - np.datetime64("1970-01-01", "D")
+    ).astype(np.int64)
+
+
 def bucket_by_span(batch: SeriesBatch, max_buckets: int = 4):
     """Split a ragged batch into length buckets with TRIMMED time grids.
 
@@ -217,10 +226,7 @@ def tensorize(
     equivalence is tested in ``tests/unit/test_native.py``.
     """
     df = df[[date_col, *key_cols, value_col]].copy()
-    dates = pd.to_datetime(df[date_col])
-    day = (dates.values.astype("datetime64[D]") - np.datetime64("1970-01-01", "D")).astype(
-        np.int64
-    )
+    day = _epoch_days(df[date_col])
     d0, d1 = int(day.min()), int(day.max())
     T = d1 - d0 + 1
 
@@ -263,3 +269,86 @@ def tensorize(
         key_names=tuple(key_cols),
         start_date=start_date,
     )
+
+
+def _fill_time(a: np.ndarray) -> np.ndarray:
+    """Forward- then back-fill NaNs along the time axis (-2), rest -> 0."""
+    shp = a.shape
+    T = shp[-2]
+    flat = np.moveaxis(a, -2, -1).reshape(-1, T)  # (N, T)
+    filled = (
+        pd.DataFrame(flat).ffill(axis=1).bfill(axis=1).fillna(0.0).to_numpy()
+    )
+    out = filled.reshape(*shp[:-2], shp[-1], T)
+    return np.moveaxis(out, -1, -2)
+
+
+def tensorize_regressors(
+    df: pd.DataFrame,
+    batch: SeriesBatch,
+    regressor_cols: Sequence[str],
+    date_col: str = "date",
+    horizon: int = 0,
+    per_series: bool = False,
+    dtype=jnp.float32,
+) -> jnp.ndarray:
+    """Long-format covariate rows -> a dense regressor tensor on the batch grid.
+
+    The data-plane companion of the curve model's exogenous regressors
+    (Prophet's ``add_regressor`` — extra covariate columns such as price or
+    promotion flags joined onto the history frame).  Values are aligned onto
+    ``batch``'s day grid extended by ``horizon`` future days, so the result
+    feeds ``engine.fit_forecast(..., xreg=...)`` directly; rows dated past
+    the end of history supply the future covariate values Prophet requires.
+
+    * ``per_series=False`` (default): ``df`` holds one row per date —
+      a calendar shared by all series.  Returns ``(T+horizon, R)``.
+    * ``per_series=True``: ``df`` additionally carries the batch's key
+      columns (e.g. store, item); each series gets its own covariate path.
+      Unknown keys are ignored.  Returns ``(S, T+horizon, R)``.
+
+    Missing days are forward- then back-filled along time (a price stays in
+    force until changed); regressors never observed for a series fill 0.
+    """
+    regressor_cols = list(regressor_cols)
+    R = len(regressor_cols)
+    if R == 0:
+        raise ValueError("regressor_cols is empty")
+    T_all = batch.n_time + horizon
+    d0 = int(np.asarray(batch.day[0]))
+    day = _epoch_days(df[date_col])
+    tpos = day - d0
+    in_grid = (tpos >= 0) & (tpos < T_all)
+    vals = df[regressor_cols].to_numpy(dtype=np.float64)
+
+    if not per_series:
+        # duplicate dates mean the frame is keyed per series (or malformed);
+        # last-row-wins scatter would silently corrupt the shared calendar
+        uniq_days = np.unique(tpos[in_grid])
+        if uniq_days.size < int(in_grid.sum()):
+            raise ValueError(
+                "duplicate dates in the regressor frame — a shared calendar "
+                "has one row per date; for per-(store,item) covariates pass "
+                "per_series=True with the key columns present"
+            )
+        arr = np.full((T_all, R), np.nan)
+        arr[tpos[in_grid]] = vals[in_grid]
+        return jnp.asarray(_fill_time(arr), dtype=dtype)
+
+    key_df = df[list(batch.key_names)].astype(np.int64)
+    index = {tuple(k): i for i, k in enumerate(batch.keys.tolist())}
+    rows = np.array(
+        [index.get(tuple(k), -1) for k in key_df.values.tolist()], dtype=np.int64
+    )
+    keep = in_grid & (rows >= 0)
+    # same duplicate policy as the shared path: a (key, date) collision is a
+    # malformed frame (e.g. a fan-out join), not something to last-row-wins
+    slots = rows[keep] * np.int64(T_all) + tpos[keep]
+    if np.unique(slots).size < slots.size:
+        raise ValueError(
+            "duplicate (key, date) rows in the regressor frame — one row "
+            "per series per date; aggregate duplicates before tensorizing"
+        )
+    arr = np.full((batch.n_series, T_all, R), np.nan)
+    arr[rows[keep], tpos[keep]] = vals[keep]
+    return jnp.asarray(_fill_time(arr), dtype=dtype)

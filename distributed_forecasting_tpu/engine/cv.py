@@ -72,16 +72,29 @@ def cv_windows(mask, day, cuts, horizon):
 
 
 @partial(jax.jit, static_argnames=("model", "config", "cuts", "horizon"))
-def _cv_impl(y, mask, day, key, model, config, cuts, horizon):
+def _cv_impl(y, mask, day, key, model, config, cuts, horizon, xreg=None):
     """Whole CV pass as ONE compiled program: mask construction, every
     cutoff's fit+forecast (cutoffs vmapped), metric reductions.  No host
     round trips inside — device scalar pulls cost tens of ms on
-    remote-attached TPUs (see engine/fit._fit_forecast_impl)."""
+    remote-attached TPUs (see engine/fit._fit_forecast_impl).
+
+    ``xreg``: regressor values over the HISTORY grid — (T, R) or (S, T, R);
+    CV never forecasts past the history end, so no future values needed.
+    Per-series xreg re-standardizes under each cutoff's train mask exactly
+    as a real fit at that cutoff would.  A shared (T, R) calendar
+    standardizes over the full grid at every cutoff — a deliberate scope:
+    standardization is an affine reparameterization, so fits differ from a
+    true at-cutoff fit only through the ridge prior's effective scale on
+    the regressor columns (second-order at the default prior scales).
+    """
     fns = get_model(model)
     train_masks, eval_masks, t_ends = cv_windows(mask, day, cuts, horizon)
     keys = jax.random.split(key, len(cuts))
 
     def one_cutoff(train_mask, t_end, k):
+        if xreg is not None:
+            params = fns.fit(y, train_mask, day, config, xreg=xreg)
+            return fns.forecast(params, day, t_end, config, k, xreg=xreg)
         params = fns.fit(y, train_mask, day, config)
         return fns.forecast(params, day, t_end, config, k)
 
@@ -97,10 +110,16 @@ def cross_validate(
     config=None,
     cv: CVConfig = CVConfig(),
     key: Optional[jax.Array] = None,
+    xreg=None,
 ) -> Dict[str, jax.Array]:
     """Per-series CV-mean metrics: mse, rmse, mae, mape, smape, mdape,
     coverage — each an (S,) array (the reference logs the first three per
     series, ``02_training.py:187-192``; the AutoML path adds the rest).
+
+    ``xreg``: regressor values for a config with ``n_regressors > 0`` —
+    (T, R)/(S, T, R) over the history grid; a longer (T+horizon) tensor
+    from the fit_forecast flow is accepted and trimmed (CV scores inside
+    history only).
 
     Returns the dict plus ``"n_cutoffs"`` (python int) under key
     ``"_n_cutoffs"`` for logging parity.
@@ -109,11 +128,23 @@ def cross_validate(
     config = config if config is not None else fns.config_cls()
     if key is None:
         key = jax.random.PRNGKey(0)
+    from distributed_forecasting_tpu.engine.fit import validate_xreg
+
+    xreg = validate_xreg(fns, model, config, xreg, None, "cross_validate")
+    if xreg is not None:
+        T = batch.n_time
+        if xreg.shape[-2] < T:
+            raise ValueError(
+                f"xreg time axis is {xreg.shape[-2]}, expected at least the "
+                f"history length {T}"
+            )
+        xreg = xreg[:T] if xreg.ndim == 2 else xreg[:, :T]
     cuts = cutoff_indices(batch.n_time, cv)
     out = dict(
         _cv_impl(
             batch.y, batch.mask, batch.day, key,
             model=model, config=config, cuts=tuple(cuts), horizon=cv.horizon,
+            xreg=xreg,
         )
     )
     out["_n_cutoffs"] = len(cuts)

@@ -85,6 +85,36 @@ def seasonal_naive_sigma(y, mask, season: int = 7):
     return jnp.where((n > 0) | (var > 0), jnp.maximum(sigma, 1e-6), 1.0)
 
 
+def validate_xreg(fns, model: str, config, xreg, expected_T, what: str):
+    """Shared entry-point validation for exogenous-regressor tensors.
+
+    One implementation for every engine entry (fit_forecast, chunked,
+    bucketed, cross_validate) so coverage and messages cannot drift.
+    Returns the float32-cast tensor, or None when no regressors are in
+    play.  ``expected_T``: required time-axis length (None skips the check
+    — CV trims instead).
+    """
+    if xreg is None:
+        if config is not None and getattr(config, "n_regressors", 0):
+            raise ValueError(
+                f"config.n_regressors={config.n_regressors} but no xreg "
+                f"was passed to {what}"
+            )
+        return None
+    if not fns.supports_xreg:
+        raise ValueError(
+            f"model {model!r} does not accept exogenous regressors; "
+            f"use the curve model ('prophet')"
+        )
+    xreg = jnp.asarray(xreg, jnp.float32)
+    if expected_T is not None and xreg.shape[-2] != expected_T:
+        raise ValueError(
+            f"xreg time axis is {xreg.shape[-2]}, expected history + "
+            f"horizon = {expected_T} (future regressor values must be known)"
+        )
+    return xreg
+
+
 def day_grid(day, horizon: int):
     """History + horizon day grid, built on device.
 
@@ -98,15 +128,30 @@ def day_grid(day, horizon: int):
 @partial(
     jax.jit, static_argnames=("model", "config", "horizon", "min_points")
 )
-def _fit_forecast_impl(y, mask, day, key, model, config, horizon, min_points):
+def _fit_forecast_impl(y, mask, day, key, model, config, horizon, min_points,
+                       xreg=None):
     """Whole engine pass — fit, forecast, health checks, fallback splice —
     as ONE compiled program (separate dispatches cost ~40% extra wall time
-    at the 500-series scale)."""
+    at the 500-series scale).
+
+    ``xreg``: exogenous regressor values over history + horizon — (T+H, R)
+    shared or (S, T+H, R) per-series; only for models registered with
+    ``supports_xreg`` (the curve model).  The fit sees the history slice,
+    the forecast the full window (future covariates must be known, as with
+    Prophet's ``add_regressor``).
+    """
     fns = get_model(model)
-    params = fns.fit(y, mask, day, config)
     day_all = day_grid(day, horizon)
     t_end = day[day.shape[0] - 1].astype(jnp.float32)
-    yhat, lo, hi = fns.forecast(params, day_all, t_end, config, key)
+    if xreg is not None:
+        T = day.shape[0]
+        xreg_hist = xreg[:T] if xreg.ndim == 2 else xreg[:, :T]
+        params = fns.fit(y, mask, day, config, xreg=xreg_hist)
+        yhat, lo, hi = fns.forecast(params, day_all, t_end, config, key,
+                                    xreg=xreg)
+    else:
+        params = fns.fit(y, mask, day, config)
+        yhat, lo, hi = fns.forecast(params, day_all, t_end, config, key)
 
     finite = (
         jnp.all(jnp.isfinite(yhat), axis=1)
@@ -142,20 +187,30 @@ def fit_forecast(
     horizon: int = 90,
     key: Optional[jax.Array] = None,
     min_points: int = 14,
+    xreg=None,
 ) -> Tuple[object, ForecastResult]:
     """Fit every series and forecast ``horizon`` days past the end of history.
 
     Equivalent of the whole fine-grained training fan-out plus
     ``make_future_dataframe(periods=90, include_history=True)`` + ``predict``
     (reference ``02_training.py:201-205,260-313``) in one compiled call.
+
+    ``xreg``: optional exogenous regressor values covering history AND the
+    forecast horizon — (T+horizon, R) shared across series or
+    (S, T+horizon, R) per-series (see ``data.tensorize.tensorize_regressors``
+    to build them from long-format rows).  Requires a model registered with
+    ``supports_xreg`` and ``config.n_regressors == R``.
     """
     fns = get_model(model)
     config = config if config is not None else fns.config_cls()
     if key is None:
         key = jax.random.PRNGKey(0)
+    xreg = validate_xreg(fns, model, config, xreg, batch.n_time + horizon,
+                         "fit_forecast")
     params, yhat, lo, hi, ok, day_all = _fit_forecast_impl(
         batch.y, batch.mask, batch.day, key,
         model=model, config=config, horizon=horizon, min_points=min_points,
+        xreg=xreg,
     )
     return params, ForecastResult(yhat=yhat, lo=lo, hi=hi, ok=ok, day_all=day_all)
 
@@ -163,7 +218,8 @@ def fit_forecast(
 @partial(
     jax.jit, static_argnames=("model", "config", "horizon", "min_points")
 )
-def _fit_forecast_scan_impl(y, mask, day, key, model, config, horizon, min_points):
+def _fit_forecast_scan_impl(y, mask, day, key, model, config, horizon,
+                            min_points, xreg=None, xreg_chunks=None):
     """All chunks in ONE dispatch: ``lax.scan`` over the chunk axis.
 
     y, mask: (n_chunks, chunk, T).  The scan body is the same compiled
@@ -172,16 +228,23 @@ def _fit_forecast_scan_impl(y, mask, day, key, model, config, horizon, min_point
     loop there is a single launch, which matters on remote-attached devices
     where every dispatch costs a ~66 ms round trip (bench.py measures the
     floor).
+
+    Regressors: ``xreg`` is a shared (T+H, R) calendar closed over by every
+    chunk; ``xreg_chunks`` is per-series (n_chunks, chunk, T+H, R), scanned
+    alongside y/mask.  At most one is set.
     """
     def step(c, ym):
-        yc, mc = ym
+        yc, mc = ym[0], ym[1]
+        xr = ym[2] if len(ym) == 3 else xreg
         params, yhat, lo, hi, ok, _ = _fit_forecast_impl(
             yc, mc, day, jax.random.fold_in(key, c),
             model=model, config=config, horizon=horizon, min_points=min_points,
+            xreg=xr,
         )
         return c + 1, (params, yhat, lo, hi, ok)
 
-    _, (params, yhat, lo, hi, ok) = jax.lax.scan(step, 0, (y, mask))
+    xs = (y, mask) if xreg_chunks is None else (y, mask, xreg_chunks)
+    _, (params, yhat, lo, hi, ok) = jax.lax.scan(step, 0, xs)
     return params, yhat, lo, hi, ok, day_grid(day, horizon)
 
 
@@ -194,6 +257,7 @@ def fit_forecast_chunked(
     chunk_size: int = 4096,
     min_points: int = 14,
     dispatch: str = "scan",
+    xreg=None,
 ) -> Tuple[object, ForecastResult]:
     """Memory-bounded fit for very large batches (the 50k-series regime).
 
@@ -214,22 +278,36 @@ def fit_forecast_chunked(
     if S <= chunk_size:
         return fit_forecast(
             batch, model=model, config=config, horizon=horizon, key=key,
-            min_points=min_points,
+            min_points=min_points, xreg=xreg,
         )
     fns = get_model(model)
     config = config if config is not None else fns.config_cls()
     if key is None:
         key = jax.random.PRNGKey(0)
+    xreg = validate_xreg(fns, model, config, xreg, batch.n_time + horizon,
+                         "fit_forecast_chunked")
     n_chunks = -(-S // chunk_size)
     padded = batch.pad_series_to(n_chunks * chunk_size)
+    xreg_padded = None
+    if xreg is not None and xreg.ndim == 3:
+        pad = n_chunks * chunk_size - S
+        xreg_padded = jnp.concatenate(
+            [xreg, jnp.zeros((pad,) + xreg.shape[1:], xreg.dtype)]
+        )
 
     if dispatch == "scan":
         yc = padded.y.reshape(n_chunks, chunk_size, -1)
         mc = padded.mask.reshape(n_chunks, chunk_size, -1)
+        xc = (
+            None if xreg_padded is None
+            else xreg_padded.reshape(n_chunks, chunk_size, *xreg.shape[1:])
+        )
         params, yhat, lo, hi, ok, day_all = _fit_forecast_scan_impl(
             yc, mc, padded.day, key,
             model=model, config=config, horizon=horizon,
             min_points=min_points,
+            xreg=None if xreg_padded is not None else xreg,
+            xreg_chunks=xc,
         )
         # scanned leaves lead with (n_chunks, chunk_size, ...): flatten the
         # per-series ones back to the series axis, keep shared leaves from
@@ -260,6 +338,7 @@ def fit_forecast_chunked(
         p, r = fit_forecast(
             sub, model=model, config=config, horizon=horizon,
             key=jax.random.fold_in(key, c), min_points=min_points,
+            xreg=xreg_padded[sl] if xreg_padded is not None else xreg,
         )
         params_list.append(p)
         yhat.append(r.yhat)
@@ -291,6 +370,7 @@ def fit_forecast_bucketed(
     key: Optional[jax.Array] = None,
     min_points: int = 14,
     max_buckets: int = 4,
+    xreg=None,
 ):
     """Fit a RAGGED batch in span buckets (SURVEY.md §7.1 bucketed padding).
 
@@ -314,15 +394,27 @@ def fit_forecast_bucketed(
     buckets = bucket_by_span(batch, max_buckets=max_buckets)
     S, T = batch.n_series, batch.n_time
     T_all = T + horizon
+    fns = get_model(model)
+    xreg = validate_xreg(
+        fns, model, config if config is not None else fns.config_cls(),
+        xreg, T_all, "fit_forecast_bucketed",
+    )
     yhat = jnp.zeros((S, T_all))
     lo = jnp.zeros((S, T_all))
     hi = jnp.zeros((S, T_all))
     ok = jnp.zeros((S,), bool)
     bucket_params = []
     for i, (idx, sub) in enumerate(buckets):
+        xr = None
+        if xreg is not None:
+            # bucket grid = last L history days + horizon: a contiguous
+            # tail slice of the full (T+H) window
+            L = sub.n_time
+            xr = xreg[T - L:] if xreg.ndim == 2 else xreg[idx][:, T - L:]
         p, r = fit_forecast(
             sub, model=model, config=config, horizon=horizon,
             key=jax.random.fold_in(key, i), min_points=min_points,
+            xreg=xr,
         )
         L_all = int(r.yhat.shape[1])
         lead = T_all - L_all

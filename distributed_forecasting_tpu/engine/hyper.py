@@ -106,6 +106,12 @@ def tune_curve_model(
     cv: CVConfig = CVConfig(),
 ) -> TuneResult:
     base_config = base_config or CurveModelConfig()
+    if base_config.n_regressors:
+        raise ValueError(
+            "hyperparameter search does not support exogenous regressors "
+            "yet — tune prior scales without regressors, then fit the tuned "
+            "config with n_regressors/xreg set"
+        )
     key = jax.random.PRNGKey(search.seed)
     k_cp, k_seas, k_hol = jax.random.split(key, 3)
     cp_scales = _log_uniform(k_cp, *search.cp_scale_range, search.n_trials)

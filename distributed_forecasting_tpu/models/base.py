@@ -50,10 +50,16 @@ class ModelFns(NamedTuple):
     fit: Callable
     forecast: Callable
     config_cls: type
+    # whether fit/forecast accept an ``xreg`` keyword (exogenous regressor
+    # values; the curve model's Prophet ``add_regressor`` equivalent)
+    supports_xreg: bool = False
 
 
-def register_model(name: str, fit: Callable, forecast: Callable, config_cls: type):
-    MODEL_REGISTRY[name] = ModelFns(fit=fit, forecast=forecast, config_cls=config_cls)
+def register_model(name: str, fit: Callable, forecast: Callable, config_cls: type,
+                   supports_xreg: bool = False):
+    MODEL_REGISTRY[name] = ModelFns(fit=fit, forecast=forecast,
+                                    config_cls=config_cls,
+                                    supports_xreg=supports_xreg)
 
 
 def get_model(name: str) -> ModelFns:

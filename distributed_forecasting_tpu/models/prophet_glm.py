@@ -37,7 +37,11 @@ import jax.numpy as jnp
 from jax.scipy.special import ndtri
 
 from distributed_forecasting_tpu.models.base import register_model
-from distributed_forecasting_tpu.ops.features import curve_design_matrix, scaled_time
+from distributed_forecasting_tpu.ops.features import (
+    curve_design_matrix,
+    scaled_time,
+    with_regressors,
+)
 from distributed_forecasting_tpu.ops.solve import ridge_solve_batch, weighted_residual_scale
 
 _LOG_EPS = 1e-3
@@ -66,6 +70,17 @@ class CurveModelConfig:
     # changepoint process — deterministic and compile-cheap, the default);
     # >0 = Prophet-faithful Monte-Carlo quantiles over that many paths.
     uncertainty_samples: int = 0
+    # Exogenous regressors (Prophet's ``add_regressor``): static column
+    # count; values arrive as the ``xreg`` argument to fit/forecast —
+    # (T, R) shared across series (promo calendar, weather) or (S, T, R)
+    # per-series (each store-item's price).  Like Prophet, future values
+    # must be supplied at forecast time.  Regressors enter the fit space
+    # additively, i.e. they act multiplicatively on y under
+    # seasonality_mode='multiplicative' (Prophet's mode default too).
+    n_regressors: int = 0
+    regressor_prior_scale: float = 10.0
+    regressor_standardize: bool = True
+    regressor_names: tuple = ()  # optional, for logging/plots
 
 
 @jax.tree_util.register_dataclass
@@ -79,6 +94,18 @@ class CurveParams:
     cap: jax.Array         # (S,) carrying capacity (logistic growth; else 1)
     t0: jax.Array          # () scalar: first training day (absolute)
     t1: jax.Array          # () scalar: last training day (absolute)
+    # regressor standardization learned at fit time — ALWAYS (S, R), even
+    # when the fit regressors were a shared calendar (stats broadcast per
+    # series), so every param leaf keeps the lead-with-S invariant that
+    # serving's gather_params relies on; (0, 0) when n_regressors == 0.
+    # Forecast must map future xreg through the SAME affine transform the
+    # coefficients were fit in.
+    reg_mu: jax.Array = dataclasses.field(
+        default_factory=lambda: jnp.zeros((0, 0), jnp.float32)
+    )
+    reg_sd: jax.Array = dataclasses.field(
+        default_factory=lambda: jnp.ones((0, 0), jnp.float32)
+    )
 
 
 def _fit_space(y, mask, mode, cap=None):
@@ -114,8 +141,11 @@ def _feature_masks(layout):
     hol = _np.zeros(F, _np.float32)
     if "holidays" in layout:
         hol[layout["holidays"]] = 1.0
+    reg = _np.zeros(F, _np.float32)
+    if "regressors" in layout:
+        reg[layout["regressors"]] = 1.0
     return (jnp.asarray(cp), jnp.asarray(seas), jnp.asarray(fixed),
-            jnp.asarray(slope), jnp.asarray(hol))
+            jnp.asarray(slope), jnp.asarray(hol), jnp.asarray(reg))
 
 
 def _prior_precision(layout, cfg: CurveModelConfig, cp_scale=None, seas_scale=None,
@@ -136,7 +166,7 @@ def _prior_precision(layout, cfg: CurveModelConfig, cp_scale=None, seas_scale=No
     cp_scale = jnp.asarray(cp_scale)[..., None]  # (...,1) broadcasts over F
     seas_scale = jnp.asarray(seas_scale)[..., None]
     hol_scale = jnp.asarray(hol_scale)[..., None]
-    cp_m, seas_m, fixed_m, slope_m, hol_m = _feature_masks(layout)
+    cp_m, seas_m, fixed_m, slope_m, hol_m, reg_m = _feature_masks(layout)
     # flat growth = no trend at all: clamp the slope AND the changepoint
     # hinges (which would otherwise reintroduce a piecewise trend)
     slope_prec = 1e8 if cfg.growth == "flat" else 1e-8
@@ -148,6 +178,7 @@ def _prior_precision(layout, cfg: CurveModelConfig, cp_scale=None, seas_scale=No
         + fixed_m * 1e-8
         + slope_m * slope_prec
         + hol_m * (1.0 / hol_scale**2)
+        + reg_m * (1.0 / cfg.regressor_prior_scale**2)
     )
     return lam
 
@@ -165,14 +196,66 @@ def _design(day, t0, t1, cfg: CurveModelConfig):
     )
 
 
+def _standardize_xreg(xreg, mask, config: CurveModelConfig):
+    """Standardize regressor columns for conditioning; returns (xs, mu, sd).
+
+    Per-series (S, T, R) regressors standardize under the observation mask
+    (padded days carry arbitrary fill); shared (T, R) regressors over the
+    whole grid.  A near-constant column (e.g. a promo flag never active in
+    history) keeps sd=1 instead of exploding to 1/eps.
+    """
+    if not config.regressor_standardize:
+        R = xreg.shape[-1]
+        return xreg, jnp.zeros((R,), jnp.float32), jnp.ones((R,), jnp.float32)
+    if xreg.ndim == 3:
+        w = mask[:, :, None]
+        n = jnp.maximum(w.sum(axis=1), 1.0)  # (S, 1->R broadcast)
+        mu = (xreg * w).sum(axis=1) / n  # (S, R)
+        var = (((xreg - mu[:, None, :]) ** 2) * w).sum(axis=1) / n
+        sd_raw = jnp.sqrt(var)
+        sd = jnp.where(sd_raw > 1e-6, sd_raw, 1.0)
+        return (xreg - mu[:, None, :]) / sd[:, None, :], mu, sd
+    mu = xreg.mean(axis=0)  # (R,)
+    sd_raw = xreg.std(axis=0)
+    sd = jnp.where(sd_raw > 1e-6, sd_raw, 1.0)
+    return (xreg - mu) / sd, mu, sd
+
+
+def _check_xreg(xreg, config: CurveModelConfig, what: str):
+    if config.n_regressors == 0:
+        if xreg is not None:
+            raise ValueError(
+                "xreg passed but config.n_regressors == 0 — set "
+                "CurveModelConfig(n_regressors=R) so the design and priors "
+                "include the regressor columns"
+            )
+        return False
+    if xreg is None:
+        raise ValueError(
+            f"config.n_regressors={config.n_regressors} but no xreg values "
+            f"were passed to {what} (like Prophet, regressor values must be "
+            f"supplied for fitting AND for the forecast window)"
+        )
+    if xreg.shape[-1] != config.n_regressors:
+        raise ValueError(
+            f"xreg has {xreg.shape[-1]} columns, config.n_regressors="
+            f"{config.n_regressors}"
+        )
+    return True
+
+
 @partial(jax.jit, static_argnames=("config",))
-def fit(y, mask, day, config: CurveModelConfig, prior_scales=None) -> CurveParams:
+def fit(y, mask, day, config: CurveModelConfig, prior_scales=None,
+        xreg=None) -> CurveParams:
     """Fit all series at once.  y, mask: (S, T); day: (T,) absolute days.
 
     ``prior_scales``: optional (changepoint_scale, seasonality_scale) or
     (changepoint_scale, seasonality_scale, holiday_scale) overrides — traced
     scalars or per-series (S,) arrays (hyper-search path); ``None`` uses the
     static config values.
+
+    ``xreg``: exogenous regressor values over the SAME day grid — (T, R)
+    shared or (S, T, R) per-series; required iff config.n_regressors > 0.
     """
     t0 = day[0].astype(jnp.float32)
     t1 = day[-1].astype(jnp.float32)
@@ -192,6 +275,18 @@ def fit(y, mask, day, config: CurveModelConfig, prior_scales=None) -> CurveParam
             y_scale = jnp.maximum(jnp.max(jnp.abs(z) * mask, axis=1), 1.0)
     zn = z / y_scale[:, None]
     X, layout = _design(day, t0, t1, config)
+    if _check_xreg(xreg, config, "fit"):
+        xs, reg_mu, reg_sd = _standardize_xreg(
+            jnp.asarray(xreg, jnp.float32), mask, config
+        )
+        X, layout = with_regressors(X, layout, xs)
+        if reg_mu.ndim == 1:  # shared calendar: broadcast stats per series
+            S = y.shape[0]
+            reg_mu = jnp.broadcast_to(reg_mu[None], (S, reg_mu.shape[0]))
+            reg_sd = jnp.broadcast_to(reg_sd[None], (S, reg_sd.shape[0]))
+    else:
+        reg_mu = jnp.zeros((0, 0), jnp.float32)
+        reg_sd = jnp.ones((0, 0), jnp.float32)
     if prior_scales is None:
         cp_s = seas_s = hol_s = None
     elif len(prior_scales) == 2:
@@ -202,7 +297,7 @@ def fit(y, mask, day, config: CurveModelConfig, prior_scales=None) -> CurveParam
     beta = ridge_solve_batch(X, zn, mask, lam)
     sigma = weighted_residual_scale(X, zn, mask, beta)
     return CurveParams(beta=beta, sigma=sigma, y_scale=y_scale, cap=cap,
-                       t0=t0, t1=t1)
+                       t0=t0, t1=t1, reg_mu=reg_mu, reg_sd=reg_sd)
 
 
 _FUTURE_CP_GRID = 25  # static count of candidate future changepoint sites
@@ -264,17 +359,42 @@ def forecast(
     t_end,
     config: CurveModelConfig,
     key=None,
+    xreg=None,
 ):
     """Predict over ``day_all`` (history+future), intervals included.
 
     Mirrors ``make_future_dataframe(periods=90, freq='d',
     include_history=True)`` -> ``model.predict`` (reference
     ``02_training.py:201-205``).  Returns (yhat, lo, hi): (S, T_all).
+
+    ``xreg``: regressor values over ``day_all`` — (T_all, R) or
+    (S, T_all, R); required iff config.n_regressors > 0 (future covariate
+    values must be known, exactly as with Prophet's ``add_regressor``).
     """
     if key is None:
         key = jax.random.PRNGKey(0)
-    X, _ = _design(day_all, params.t0, params.t1, config)
-    zhat = (params.beta @ X.T) * params.y_scale[:, None]  # (S, T_all), fit space
+    X, layout = _design(day_all, params.t0, params.t1, config)
+    # base design stays SHARED (T_all, F0) even with per-series regressors:
+    # the regressor contribution is a rank-R inner product added on top, so
+    # the (S, T_all, F) per-series design the fit needs for its Gram never
+    # materializes here (at serving scale that tensor would be tens of GB)
+    F0 = layout["n_features"]
+    zhat = (params.beta[:, :F0] @ X.T) * params.y_scale[:, None]  # (S, T_all)
+    if _check_xreg(xreg, config, "forecast"):
+        xreg = jnp.asarray(xreg, jnp.float32)
+        # affine identity: beta.(x - mu)/sd = (beta/sd).x - sum(beta.mu/sd),
+        # so the standardized (S, T_all, R) intermediate never materializes
+        # — a shared calendar stays (T_all, R) through the einsum even when
+        # the standardization stats are per-series
+        beta_reg = params.beta[:, F0:]  # (S, R)
+        w = beta_reg / params.reg_sd  # (S, R)
+        offset = jnp.sum(w * params.reg_mu, axis=-1)[:, None]  # (S, 1)
+        contrib = (
+            jnp.einsum("sr,str->st", w, xreg, optimize=True)
+            if xreg.ndim == 3
+            else jnp.einsum("sr,tr->st", w, xreg, optimize=True)
+        ) - offset
+        zhat = zhat + contrib * params.y_scale[:, None]
     t_all = scaled_time(day_all, params.t0, params.t1)
     t_end_scaled = (t_end - params.t0) / jnp.maximum(params.t1 - params.t0, 1.0)
 
@@ -322,8 +442,10 @@ def extract_params(params: CurveParams, config: CurveModelConfig) -> dict:
         "uncertainty_samples": config.uncertainty_samples,
         "n_holidays": len(config.holidays),
         "holiday_prior_scale": config.holiday_prior_scale,
+        "n_regressors": config.n_regressors,
+        "regressor_prior_scale": config.regressor_prior_scale,
     }
 
 
-register_model("prophet", fit, forecast, CurveModelConfig)
-register_model("curve", fit, forecast, CurveModelConfig)
+register_model("prophet", fit, forecast, CurveModelConfig, supports_xreg=True)
+register_model("curve", fit, forecast, CurveModelConfig, supports_xreg=True)

@@ -74,6 +74,30 @@ def holiday_features(day: jnp.ndarray, holidays: tuple) -> jnp.ndarray:
     return jnp.stack(cols, axis=1)
 
 
+def with_regressors(X: jnp.ndarray, layout: dict, xreg: jnp.ndarray):
+    """Append exogenous-regressor columns to a design matrix.
+
+    The curve model's equivalent of Prophet's ``add_regressor``: extra
+    covariate columns (price, promotion flags, weather, ...) entering the
+    same penalized least-squares fit.  ``X`` is the shared (T, F) base
+    design; ``xreg`` is (T, R) for regressors shared by all series (e.g. a
+    promo calendar) or (S, T, R) for per-series covariates (e.g. each
+    store-item's price), already standardized by the caller.  A per-series
+    ``xreg`` promotes the result to an (S, T, F+R) per-series design —
+    ``ops.solve`` handles both layouts.
+
+    Returns (X', layout') with layout gaining a ``regressors`` slice.
+    """
+    R = xreg.shape[-1]
+    F = layout["n_features"]
+    new_layout = dict(layout)
+    new_layout["regressors"] = slice(F, F + R)
+    new_layout["n_features"] = F + R
+    if xreg.ndim == 3 and X.ndim == 2:
+        X = jnp.broadcast_to(X[None], (xreg.shape[0],) + X.shape)
+    return jnp.concatenate([X, xreg], axis=-1), new_layout
+
+
 def curve_design_matrix(
     day: jnp.ndarray,
     t0,

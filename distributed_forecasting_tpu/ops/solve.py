@@ -63,12 +63,17 @@ def ridge_solve_batch(
 ) -> jnp.ndarray:
     """Solve the batched penalized normal equations.
 
-    X: (T, F); y, w: (S, T); lam: per-feature ridge precision, shape (F,)
-    shared or (S, F) per-series (the hyper-search refit path).
+    X: (T, F) shared design, or (S, T, F) per-series (the exogenous-regressor
+    path, where regressor columns differ across series); y, w: (S, T); lam:
+    per-feature ridge precision, shape (F,) shared or (S, F) per-series (the
+    hyper-search refit path).
     Returns beta: (S, F).  Uses Cholesky (SPD by construction).
     """
-    F = X.shape[1]
-    if _gram_backend() == "pallas":
+    F = X.shape[-1]
+    if X.ndim == 3:
+        G = jnp.einsum("st,stf,stg->sfg", w, X, X, optimize=True)
+        b = jnp.einsum("st,stf->sf", w * y, X, optimize=True)
+    elif _gram_backend() == "pallas":
         from distributed_forecasting_tpu.ops.pallas_gram import (
             masked_gram_moments_pallas,
         )
@@ -92,8 +97,14 @@ def ridge_solve_batch(
 def weighted_residual_scale(
     X: jnp.ndarray, y: jnp.ndarray, w: jnp.ndarray, beta: jnp.ndarray
 ) -> jnp.ndarray:
-    """Per-series residual standard deviation under the mask.  (S,)"""
-    yhat = beta @ X.T  # (S, T)
+    """Per-series residual standard deviation under the mask.  (S,)
+
+    X: (T, F) shared or (S, T, F) per-series (regressor path).
+    """
+    if X.ndim == 3:
+        yhat = jnp.einsum("sf,stf->st", beta, X, optimize=True)
+    else:
+        yhat = beta @ X.T  # (S, T)
     r2 = w * (y - yhat) ** 2
     n = jnp.maximum(jnp.sum(w, axis=1), 1.0)
     return jnp.sqrt(jnp.sum(r2, axis=1) / n)

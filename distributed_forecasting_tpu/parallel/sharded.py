@@ -66,6 +66,12 @@ def sharded_fit_forecast(
     and a sharded :class:`ForecastResult` (padding rows have ok=False)."""
     if mesh is None:
         raise ValueError("pass a Mesh (parallel.make_mesh())")
+    if config is not None and getattr(config, "n_regressors", 0):
+        raise ValueError(
+            "sharded_fit_forecast does not thread exogenous regressors yet "
+            "— shard the xreg tensor alongside the batch and call "
+            "engine.fit_forecast directly, or fit without regressors"
+        )
     sharded = shard_batch(batch, mesh)
     return fit_forecast(
         sharded, model=model, config=config, horizon=horizon, key=key,
@@ -121,6 +127,11 @@ def sharded_cv_metrics(
         raise ValueError("pass a Mesh (parallel.make_mesh())")
     fns = get_model(model)
     config = config if config is not None else fns.config_cls()
+    if getattr(config, "n_regressors", 0):
+        raise ValueError(
+            "sharded_cv_metrics does not thread exogenous regressors yet — "
+            "use engine.cross_validate(..., xreg=...) or CV without them"
+        )
     cv = cv or CVConfig()
     if key is None:
         key = jax.random.PRNGKey(0)

@@ -16,6 +16,7 @@ import os
 from typing import List, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import pandas as pd
 
@@ -89,8 +90,23 @@ class BucketedForecaster:
         include_history: bool = False,
         key: Optional[jax.Array] = None,
         on_missing: str = "raise",
+        xreg=None,
     ) -> pd.DataFrame:
-        """One batched predict per bucket present in the request."""
+        """One batched predict per bucket present in the request.
+
+        ``xreg``: a SHARED (T, R) regressor calendar over the union grid
+        ``min(bucket day0) .. day1 + horizon`` when the buckets were fit
+        with ``n_regressors > 0``; each bucket slices its own (trimmed-grid)
+        window out of it.  Per-series regressor tensors are not routable
+        here (buckets partition the key space with no global row order) —
+        serve those through the per-bucket ``BatchForecaster`` directly.
+        """
+        if xreg is not None and np.asarray(xreg).ndim != 2:
+            raise ValueError(
+                "BucketedForecaster.predict accepts only a shared (T, R) "
+                "xreg calendar; for per-series regressors predict through "
+                "the per-bucket BatchForecaster objects"
+            )
         if on_missing not in ("raise", "skip"):
             # same guard as BatchForecaster.series_indices: a typo like
             # 'Raise' must not silently become skip-and-drop
@@ -114,12 +130,28 @@ class BucketedForecaster:
             j = self._route.get(k)
             if j is not None:
                 per_bucket.setdefault(j, []).append(k)
+        d0_union = min(fc.day0 for fc in self.forecasters)
         parts = []
         for j in sorted(per_bucket):
+            fc = self.forecasters[j]
+            xr = None
+            if xreg is not None:
+                xr = jnp.asarray(xreg, jnp.float32)
+                T_need = fc.day1 + horizon - d0_union + 1
+                # exact length required: a longer calendar would be sliced
+                # from the wrong origin and silently serve time-shifted
+                # covariates
+                if xr.shape[0] != T_need:
+                    raise ValueError(
+                        f"xreg covers {xr.shape[0]} days, expected exactly "
+                        f"the union grid of {T_need} days "
+                        f"(min bucket day0 .. last day + horizon)"
+                    )
+                xr = xr[fc.day0 - d0_union: fc.day1 + horizon - d0_union + 1]
             sub_req = pd.DataFrame(per_bucket[j], columns=names)
-            parts.append(self.forecasters[j].predict(
+            parts.append(fc.predict(
                 sub_req, horizon=horizon, include_history=include_history,
-                key=key,
+                key=key, xreg=xr,
             ))
         if not parts:
             return pd.DataFrame(

@@ -102,8 +102,21 @@ class MultiModelForecaster:
         include_history: bool = False,
         key: Optional[jax.Array] = None,
         on_missing: str = "raise",
+        xreg=None,
     ) -> pd.DataFrame:
-        """One batched predict per family present in the request."""
+        """One batched predict per family present in the request.
+
+        ``xreg`` is forwarded to the families that support exogenous
+        regressors (the curve model); raises if no held family does.
+        """
+        from distributed_forecasting_tpu.models.base import get_model
+
+        if xreg is not None:
+            if not any(get_model(n).supports_xreg for n in self.models):
+                raise ValueError(
+                    f"none of the held families {self.models} accepts "
+                    f"exogenous regressors"
+                )
         first = self.forecasters[self.models[0]]
         sidx = first.series_indices(request, on_missing=on_missing)
         if sidx.size == 0:
@@ -117,8 +130,12 @@ class MultiModelForecaster:
             if sub.size == 0:
                 continue
             req = pd.DataFrame(self.keys[sub], columns=list(self.key_names))
+            kw = {}
+            if xreg is not None and get_model(name).supports_xreg:
+                kw["xreg"] = xreg
             out = self.forecasters[name].predict(
-                req, horizon=horizon, include_history=include_history, key=key
+                req, horizon=horizon, include_history=include_history, key=key,
+                **kw,
             )
             out["model"] = name
             parts.append(out)

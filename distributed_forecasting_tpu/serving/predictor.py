@@ -213,11 +213,17 @@ class BatchForecaster:
         include_history: bool = False,
         key: Optional[jax.Array] = None,
         on_missing: str = "raise",
+        xreg=None,
     ) -> pd.DataFrame:
         """Forecast every requested (store, item) ``horizon`` days past the
         end of training.  ``request`` needs the key columns only (extra
         columns — e.g. the history the reference ships to its UDF — are
-        ignored; the fitted params already encode history)."""
+        ignored; the fitted params already encode history).
+
+        ``xreg``: future-covering exogenous regressor values when the model
+        was fit with ``n_regressors > 0`` — (T_all, R) shared or
+        (S_trained, T_all, R) per-series over the FULL day0..day1+horizon
+        grid (per-series rows are gathered down to the request)."""
         sidx = self.series_indices(request, on_missing=on_missing)
         if sidx.size == 0:
             return pd.DataFrame(
@@ -243,8 +249,35 @@ class BatchForecaster:
         bucket = max(bucket, k)  # k == S but S not a power of two
         padded = np.concatenate([sidx, np.full(bucket - k, sidx[0], sidx.dtype)])
         params = self.gather_params(padded)
+        fc_kwargs = {}
+        if xreg is not None:
+            if not fns.supports_xreg:
+                raise ValueError(
+                    f"model {self.model!r} does not accept exogenous "
+                    f"regressors"
+                )
+            xreg = jnp.asarray(xreg, jnp.float32)
+            if xreg.shape[-2] != int(day_all.shape[0]):
+                raise ValueError(
+                    f"xreg time axis is {xreg.shape[-2]}, expected the full "
+                    f"history+horizon grid {int(day_all.shape[0])}"
+                )
+            if xreg.ndim == 3:
+                # the row gather below clamps out-of-bounds indices silently
+                # (JAX gather semantics) — a wrong leading dim would serve
+                # the wrong series' covariates, so check it explicitly
+                S = self.keys.shape[0]
+                if xreg.shape[0] != S:
+                    raise ValueError(
+                        f"per-series xreg leads with {xreg.shape[0]} rows, "
+                        f"expected all {S} trained series (rows are gathered "
+                        f"down to the request internally)"
+                    )
+                xreg = xreg[jnp.asarray(padded)]
+            fc_kwargs["xreg"] = xreg
         yhat, lo, hi = fns.forecast(
-            params, day_all, jnp.float32(self.day1), self.config, key
+            params, day_all, jnp.float32(self.day1), self.config, key,
+            **fc_kwargs,
         )
         if not include_history:
             day_all = day_all[-horizon:]

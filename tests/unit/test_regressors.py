@@ -1,0 +1,541 @@
+"""Exogenous regressors for the curve model — Prophet ``add_regressor`` parity.
+
+Prophet lets callers join covariate columns (price, promotions, weather) onto
+the history frame and requires their future values at predict time.  Here the
+values ride as an ``xreg`` tensor next to the batch: (T, R) shared across
+series or (S, T, R) per-series (the latter promotes the shared design matrix
+to a per-series one; ``ops/solve.py`` handles both).
+"""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_forecasting_tpu.data import tensorize, tensorize_regressors
+from distributed_forecasting_tpu.engine import fit_forecast
+from distributed_forecasting_tpu.models import prophet_glm
+from distributed_forecasting_tpu.models.prophet_glm import CurveModelConfig
+
+
+def _make_batch_with_regressor(per_series=False, S=4, T=730, horizon=60, seed=0):
+    """Series = smooth base + known regressor effect.  Returns
+    (y, mask, day, xreg_all, effect) where xreg_all covers T + horizon."""
+    rng = np.random.default_rng(seed)
+    day = np.arange(1000, 1000 + T + horizon, dtype=np.int32)
+    t = np.arange(T + horizon, dtype=np.float32)
+    # covariate: weekly promo pulse train + noise-free ramp, known future
+    x1 = ((t % 13) < 2).astype(np.float32)  # promo flag
+    x2 = np.sin(2 * np.pi * t / 50.0).astype(np.float32)  # smooth driver
+    xreg_all = np.stack([x1, x2], axis=1)  # (T+H, 2)
+    if per_series:
+        coef = rng.uniform(1.0, 3.0, size=(S, 2)).astype(np.float32)
+        xreg_all = np.broadcast_to(xreg_all[None], (S, T + horizon, 2)).copy()
+        # per-series scaling of the covariates themselves (e.g. local prices)
+        scale = rng.uniform(0.5, 2.0, size=(S, 1, 2)).astype(np.float32)
+        xreg_all = xreg_all * scale
+        effect = np.einsum("str,sr->st", xreg_all, coef)
+    else:
+        coef = rng.uniform(1.0, 3.0, size=(S, 2)).astype(np.float32)
+        effect = coef @ xreg_all.T  # (S, T+H)
+    base = 10.0 + 0.01 * t[None, :] + rng.normal(0, 0.1, size=(S, T + horizon))
+    y_full = base + effect
+    y = jnp.asarray(y_full[:, :T], jnp.float32)
+    mask = jnp.ones((S, T), jnp.float32)
+    return y, mask, jnp.asarray(day[:T]), jnp.asarray(xreg_all), y_full
+
+
+@pytest.mark.parametrize("per_series", [False, True])
+def test_regressor_improves_fit(per_series):
+    horizon = 60
+    y, mask, day, xreg_all, y_full = _make_batch_with_regressor(
+        per_series=per_series, horizon=horizon
+    )
+    cfg = CurveModelConfig(
+        seasonality_mode="additive", n_regressors=2, weekly_order=0,
+        yearly_order=0,
+    )
+    cfg0 = dataclasses.replace(cfg, n_regressors=0)
+    T = y.shape[1]
+    xreg_hist = xreg_all[:T] if xreg_all.ndim == 2 else xreg_all[:, :T]
+    day_all = jnp.arange(int(day[0]), int(day[0]) + T + horizon, dtype=jnp.int32)
+    t_end = jnp.float32(day[-1])
+
+    p = prophet_glm.fit(y, mask, day, cfg, xreg=xreg_hist)
+    yhat, lo, hi = prophet_glm.forecast(p, day_all, t_end, cfg, xreg=xreg_all)
+    p0 = prophet_glm.fit(y, mask, day, cfg0)
+    yhat0, _, _ = prophet_glm.forecast(p0, day_all, t_end, cfg0)
+
+    fut = slice(T, T + horizon)
+    err = float(np.mean(np.abs(np.asarray(yhat)[:, fut] - y_full[:, fut])))
+    err0 = float(np.mean(np.abs(np.asarray(yhat0)[:, fut] - y_full[:, fut])))
+    # the regressor effect is the dominant signal; using it must win big
+    assert err < 0.5 * err0
+    assert err < 0.5
+    # interval sanity
+    assert np.all(np.asarray(lo) <= np.asarray(hi))
+
+
+def test_regressor_validation_errors():
+    y, mask, day, xreg_all, _ = _make_batch_with_regressor()
+    cfg = CurveModelConfig(n_regressors=2)
+    T = y.shape[1]
+    with pytest.raises(ValueError, match="no xreg"):
+        prophet_glm.fit(y, mask, day, cfg)
+    with pytest.raises(ValueError, match="columns"):
+        prophet_glm.fit(y, mask, day, cfg, xreg=xreg_all[:T, :1])
+    with pytest.raises(ValueError, match="n_regressors == 0"):
+        prophet_glm.fit(
+            y, mask, day, CurveModelConfig(), xreg=xreg_all[:T]
+        )
+
+
+def test_engine_fit_forecast_with_xreg():
+    horizon = 60
+    y, mask, day, xreg_all, y_full = _make_batch_with_regressor(horizon=horizon)
+    from distributed_forecasting_tpu.data.tensorize import SeriesBatch
+
+    S, T = y.shape
+    batch = SeriesBatch(
+        y=y, mask=mask, day=day,
+        keys=np.stack([np.zeros(S, np.int64), np.arange(S)], axis=1),
+        key_names=("store", "item"),
+        start_date="1972-09-27",
+    )
+    cfg = CurveModelConfig(
+        seasonality_mode="additive", n_regressors=2, weekly_order=0,
+        yearly_order=0,
+    )
+    params, res = fit_forecast(
+        batch, model="prophet", config=cfg, horizon=horizon, xreg=xreg_all
+    )
+    assert res.yhat.shape == (S, T + horizon)
+    assert bool(res.ok.all())
+    err = float(
+        np.mean(np.abs(np.asarray(res.yhat)[:, T:] - y_full[:, T:]))
+    )
+    assert err < 0.5
+
+    # wrong time span is rejected with a clear message
+    with pytest.raises(ValueError, match="history \\+"):
+        fit_forecast(batch, model="prophet", config=cfg, horizon=horizon,
+                     xreg=xreg_all[:T])
+    # non-curve models refuse regressors instead of silently ignoring them
+    with pytest.raises(ValueError, match="does not accept"):
+        fit_forecast(batch, model="holt_winters", horizon=horizon,
+                     xreg=xreg_all)
+
+
+def test_tensorize_regressors_shared_and_future(sales_df_small):
+    batch = tensorize(sales_df_small)
+    dates = batch.dates()
+    horizon = 30
+    all_dates = dates.append(
+        __import__("pandas").date_range(
+            dates[-1] + __import__("pandas").Timedelta(days=1),
+            periods=horizon,
+        )
+    )
+    import pandas as pd
+
+    # sparse calendar: price only quoted every 7 days — must forward-fill
+    cal = pd.DataFrame(
+        {
+            "date": all_dates[::7],
+            "price": np.linspace(1.0, 2.0, len(all_dates[::7])),
+            "promo": (np.arange(len(all_dates[::7])) % 3 == 0).astype(float),
+        }
+    )
+    xr = tensorize_regressors(
+        cal, batch, ["price", "promo"], horizon=horizon
+    )
+    assert xr.shape == (batch.n_time + horizon, 2)
+    x = np.asarray(xr)
+    assert np.isfinite(x).all()
+    # forward-fill: day 1..6 carry day 0's quote
+    np.testing.assert_allclose(x[1:7, 0], x[0, 0])
+    # future days are populated (the last quotes extend forward)
+    assert np.all(x[-horizon:, 0] > 0)
+
+
+def test_tensorize_regressors_per_series(sales_df_small):
+    import pandas as pd
+
+    batch = tensorize(sales_df_small)
+    dates = batch.dates()
+    # per-(store,item) covariate rows for only the first two series; a
+    # row with an unknown key must be ignored, unseen series fill 0
+    k0, k1 = batch.keys[0], batch.keys[1]
+    rows = []
+    for d in dates[::10]:
+        rows.append({"date": d, "store": k0[0], "item": k0[1], "price": 2.0})
+        rows.append({"date": d, "store": k1[0], "item": k1[1], "price": 3.0})
+    rows.append({"date": dates[0], "store": 999, "item": 999, "price": 9.0})
+    df = pd.DataFrame(rows)
+    xr = tensorize_regressors(df, batch, ["price"], per_series=True)
+    assert xr.shape == (batch.n_series, batch.n_time, 1)
+    x = np.asarray(xr)
+    np.testing.assert_allclose(x[0, :, 0], 2.0)
+    np.testing.assert_allclose(x[1, :, 0], 3.0)
+    np.testing.assert_allclose(x[2:], 0.0)
+
+
+def test_serving_roundtrip_with_xreg(tmp_path):
+    from distributed_forecasting_tpu.data.tensorize import SeriesBatch
+    from distributed_forecasting_tpu.serving import BatchForecaster
+
+    horizon = 60
+    y, mask, day, xreg_all, y_full = _make_batch_with_regressor(
+        per_series=True, horizon=horizon
+    )
+    S, T = y.shape
+    batch = SeriesBatch(
+        y=y, mask=mask, day=day,
+        keys=np.stack([np.zeros(S, np.int64), np.arange(S)], axis=1),
+        key_names=("store", "item"),
+        start_date="1972-09-27",
+    )
+    cfg = CurveModelConfig(
+        seasonality_mode="additive", n_regressors=2, weekly_order=0,
+        yearly_order=0,
+    )
+    params, res = fit_forecast(
+        batch, model="prophet", config=cfg, horizon=horizon, xreg=xreg_all
+    )
+    fc = BatchForecaster.from_fit(batch, params, model="prophet", config=cfg)
+    fc.save(str(tmp_path / "artifact"))
+    fc2 = BatchForecaster.load(str(tmp_path / "artifact"))
+    # per-series standardization stats survive the npz roundtrip
+    np.testing.assert_allclose(
+        np.asarray(fc2.params.reg_mu), np.asarray(params.reg_mu), rtol=1e-6
+    )
+
+    import pandas as pd
+
+    req = pd.DataFrame({"store": [0], "item": [2]})
+    out = fc2.predict(req, horizon=horizon, xreg=xreg_all)
+    assert len(out) == horizon
+    err = float(np.mean(np.abs(out.yhat.to_numpy() - y_full[2, T:])))
+    assert err < 0.5
+
+    # missing xreg at predict time is a hard error, not a silent zero-fill
+    with pytest.raises(ValueError, match="no xreg"):
+        fc2.predict(req, horizon=horizon)
+
+
+def test_cross_validate_with_xreg():
+    from distributed_forecasting_tpu.engine import CVConfig, cross_validate
+
+    horizon = 60
+    y, mask, day, xreg_all, _ = _make_batch_with_regressor(
+        per_series=True, T=730, horizon=horizon
+    )
+    from distributed_forecasting_tpu.data.tensorize import SeriesBatch
+
+    S, T = y.shape
+    batch = SeriesBatch(
+        y=y, mask=mask, day=day,
+        keys=np.stack([np.zeros(S, np.int64), np.arange(S)], axis=1),
+        key_names=("store", "item"), start_date="1972-09-27",
+    )
+    cfg = CurveModelConfig(
+        seasonality_mode="additive", n_regressors=2, weekly_order=0,
+        yearly_order=0,
+    )
+    cv = CVConfig(initial=365, period=180, horizon=60)
+    # full (T+H) tensor from the fit flow is accepted and trimmed
+    out = cross_validate(batch, model="prophet", config=cfg, cv=cv,
+                         xreg=xreg_all)
+    cfg0 = dataclasses.replace(cfg, n_regressors=0)
+    out0 = cross_validate(batch, model="prophet", config=cfg0, cv=cv)
+    # the regressor effect dominates: CV must see a big accuracy gap
+    assert float(np.mean(np.asarray(out["mae"]))) < 0.5 * float(
+        np.mean(np.asarray(out0["mae"]))
+    )
+    # clear entry-level error instead of a deep trace failure
+    with pytest.raises(ValueError, match="no xreg"):
+        cross_validate(batch, model="prophet", config=cfg, cv=cv)
+
+
+def test_chunked_with_xreg_matches_unchunked():
+    from distributed_forecasting_tpu.engine import fit_forecast_chunked
+
+    horizon = 30
+    y, mask, day, xreg_all, _ = _make_batch_with_regressor(
+        per_series=True, S=6, T=365, horizon=horizon
+    )
+    from distributed_forecasting_tpu.data.tensorize import SeriesBatch
+
+    S, T = y.shape
+    batch = SeriesBatch(
+        y=y, mask=mask, day=day,
+        keys=np.stack([np.zeros(S, np.int64), np.arange(S)], axis=1),
+        key_names=("store", "item"), start_date="1972-09-27",
+    )
+    cfg = CurveModelConfig(
+        seasonality_mode="additive", n_regressors=2, weekly_order=0,
+        yearly_order=0,
+    )
+    _, ref = fit_forecast(batch, model="prophet", config=cfg,
+                          horizon=horizon, xreg=xreg_all)
+    for dispatch in ("scan", "loop"):
+        _, res = fit_forecast_chunked(
+            batch, model="prophet", config=cfg, horizon=horizon,
+            chunk_size=2, dispatch=dispatch, xreg=xreg_all,
+        )
+        np.testing.assert_allclose(
+            np.asarray(res.yhat), np.asarray(ref.yhat), rtol=2e-4, atol=2e-4
+        )
+    # shared xreg through the chunked path too
+    shared = xreg_all[0]
+    cfgs = cfg
+    _, ref_s = fit_forecast(batch, model="prophet", config=cfgs,
+                            horizon=horizon, xreg=shared)
+    _, res_s = fit_forecast_chunked(
+        batch, model="prophet", config=cfgs, horizon=horizon,
+        chunk_size=2, dispatch="scan", xreg=shared,
+    )
+    np.testing.assert_allclose(
+        np.asarray(res_s.yhat), np.asarray(ref_s.yhat), rtol=2e-4, atol=2e-4
+    )
+    with pytest.raises(ValueError, match="no xreg"):
+        fit_forecast_chunked(batch, model="prophet", config=cfg,
+                             horizon=horizon, chunk_size=2)
+
+
+def test_bucketed_with_xreg():
+    from distributed_forecasting_tpu.engine import fit_forecast_bucketed
+
+    horizon = 30
+    y, mask, day, xreg_all, y_full = _make_batch_with_regressor(
+        per_series=True, S=6, T=512, horizon=horizon
+    )
+    # make 4 of 6 series short-history so bucketing engages
+    m = np.array(mask)
+    yv = np.array(y)
+    m[2:, :384] = 0.0
+    yv[2:, :384] = 0.0
+    from distributed_forecasting_tpu.data.tensorize import SeriesBatch
+
+    S, T = y.shape
+    batch = SeriesBatch(
+        y=jnp.asarray(yv), mask=jnp.asarray(m), day=day,
+        keys=np.stack([np.zeros(S, np.int64), np.arange(S)], axis=1),
+        key_names=("store", "item"), start_date="1972-09-27",
+    )
+    cfg = CurveModelConfig(
+        seasonality_mode="additive", n_regressors=2, weekly_order=0,
+        yearly_order=0,
+    )
+    buckets, res = fit_forecast_bucketed(
+        batch, model="prophet", config=cfg, horizon=horizon, xreg=xreg_all
+    )
+    assert len(buckets) > 1  # bucketing actually engaged
+    assert bool(res.ok.all())
+    err = float(np.mean(np.abs(np.asarray(res.yhat)[:, T:] - y_full[:, T:])))
+    assert err < 1.0
+    with pytest.raises(ValueError, match="no xreg"):
+        fit_forecast_bucketed(batch, model="prophet", config=cfg,
+                              horizon=horizon)
+
+
+def test_serving_xreg_leading_dim_validated(tmp_path):
+    from distributed_forecasting_tpu.data.tensorize import SeriesBatch
+    from distributed_forecasting_tpu.serving import BatchForecaster
+
+    horizon = 30
+    y, mask, day, xreg_all, _ = _make_batch_with_regressor(
+        per_series=True, S=4, T=365, horizon=horizon
+    )
+    S, T = y.shape
+    batch = SeriesBatch(
+        y=y, mask=mask, day=day,
+        keys=np.stack([np.zeros(S, np.int64), np.arange(S)], axis=1),
+        key_names=("store", "item"), start_date="1972-09-27",
+    )
+    cfg = CurveModelConfig(
+        seasonality_mode="additive", n_regressors=2, weekly_order=0,
+        yearly_order=0,
+    )
+    params, _ = fit_forecast(batch, model="prophet", config=cfg,
+                             horizon=horizon, xreg=xreg_all)
+    fc = BatchForecaster.from_fit(batch, params, model="prophet", config=cfg)
+    import pandas as pd
+
+    req = pd.DataFrame({"store": [0], "item": [2]})
+    # a single-series xreg row would be silently clamp-gathered — must raise
+    with pytest.raises(ValueError, match="leads with 1"):
+        fc.predict(req, horizon=horizon, xreg=xreg_all[2:3])
+
+
+def test_tensorize_regressors_duplicate_dates_raise(sales_df_small):
+    import pandas as pd
+
+    batch = tensorize(sales_df_small)
+    d = batch.dates()[0]
+    df = pd.DataFrame(
+        {"date": [d, d], "price": [1.0, 2.0]}
+    )
+    with pytest.raises(ValueError, match="duplicate dates"):
+        tensorize_regressors(df, batch, ["price"])
+
+
+def test_bucketed_forecaster_serves_shared_xreg():
+    from distributed_forecasting_tpu.data.tensorize import SeriesBatch
+    from distributed_forecasting_tpu.engine import fit_forecast_bucketed
+    from distributed_forecasting_tpu.serving import BucketedForecaster
+
+    horizon = 30
+    y, mask, day, xreg_all, y_full = _make_batch_with_regressor(
+        per_series=False, S=6, T=512, horizon=horizon
+    )
+    m = np.array(mask)
+    yv = np.array(y)
+    m[2:, :384] = 0.0
+    yv[2:, :384] = 0.0
+    S, T = y.shape
+    batch = SeriesBatch(
+        y=jnp.asarray(yv), mask=jnp.asarray(m), day=day,
+        keys=np.stack([np.zeros(S, np.int64), np.arange(S)], axis=1),
+        key_names=("store", "item"), start_date="1972-09-27",
+    )
+    cfg = CurveModelConfig(
+        seasonality_mode="additive", n_regressors=2, weekly_order=0,
+        yearly_order=0,
+    )
+    buckets, _ = fit_forecast_bucketed(
+        batch, model="prophet", config=cfg, horizon=horizon, xreg=xreg_all
+    )
+    fc = BucketedForecaster.from_bucketed_fit(buckets, model="prophet",
+                                              config=cfg)
+    import pandas as pd
+
+    # one long-history and one short-history series in one request
+    req = pd.DataFrame({"store": [0, 0], "item": [0, 4]})
+    out = fc.predict(req, horizon=horizon, xreg=xreg_all)
+    assert len(out) == 2 * horizon
+    got = out[out.item == 4].yhat.to_numpy()
+    err = float(np.mean(np.abs(got - y_full[4, T:])))
+    assert err < 1.0
+
+    # per-series xreg is not routable through buckets — clear error
+    with pytest.raises(ValueError, match="per-series"):
+        fc.predict(req, horizon=horizon,
+                   xreg=np.zeros((S, T + horizon, 2), np.float32))
+    # too-short calendar is caught before the per-bucket slice
+    with pytest.raises(ValueError, match="union"):
+        fc.predict(req, horizon=horizon, xreg=xreg_all[: T // 2])
+
+
+def test_ensemble_forwards_xreg_to_supporting_family():
+    from distributed_forecasting_tpu.data.tensorize import SeriesBatch
+    from distributed_forecasting_tpu.serving import MultiModelForecaster
+    from distributed_forecasting_tpu.serving.predictor import BatchForecaster
+
+    horizon = 30
+    y, mask, day, xreg_all, y_full = _make_batch_with_regressor(
+        per_series=False, S=4, T=365, horizon=horizon
+    )
+    S, T = y.shape
+    batch = SeriesBatch(
+        y=y, mask=mask, day=day,
+        keys=np.stack([np.zeros(S, np.int64), np.arange(S)], axis=1),
+        key_names=("store", "item"), start_date="1972-09-27",
+    )
+    cfg = CurveModelConfig(
+        seasonality_mode="additive", n_regressors=2, weekly_order=0,
+        yearly_order=0,
+    )
+    params, _ = fit_forecast(batch, model="prophet", config=cfg,
+                             horizon=horizon, xreg=xreg_all)
+    fc = BatchForecaster.from_fit(batch, params, model="prophet", config=cfg)
+    ens = MultiModelForecaster({"prophet": fc}, np.zeros(S, np.int64))
+    import pandas as pd
+
+    req = pd.DataFrame({"store": [0], "item": [1]})
+    out = ens.predict(req, horizon=horizon, xreg=xreg_all)
+    assert len(out) == horizon
+    assert (out.model == "prophet").all()
+
+
+def test_chunked_rejects_history_only_xreg():
+    from distributed_forecasting_tpu.engine import fit_forecast_chunked
+
+    horizon = 30
+    y, mask, day, xreg_all, _ = _make_batch_with_regressor(
+        per_series=False, S=6, T=365, horizon=horizon
+    )
+    from distributed_forecasting_tpu.data.tensorize import SeriesBatch
+
+    S, T = y.shape
+    batch = SeriesBatch(
+        y=y, mask=mask, day=day,
+        keys=np.stack([np.zeros(S, np.int64), np.arange(S)], axis=1),
+        key_names=("store", "item"), start_date="1972-09-27",
+    )
+    cfg = CurveModelConfig(
+        seasonality_mode="additive", n_regressors=2, weekly_order=0,
+        yearly_order=0,
+    )
+    # a (T, R) history-only tensor must fail with the clear message even on
+    # the chunked path (S > chunk_size)
+    with pytest.raises(ValueError, match="history \\+"):
+        fit_forecast_chunked(batch, model="prophet", config=cfg,
+                             horizon=horizon, chunk_size=2,
+                             xreg=xreg_all[:T])
+
+
+def test_tensorize_regressors_per_series_duplicates_raise(sales_df_small):
+    import pandas as pd
+
+    batch = tensorize(sales_df_small)
+    d = batch.dates()[0]
+    k0 = batch.keys[0]
+    df = pd.DataFrame(
+        {
+            "date": [d, d],
+            "store": [k0[0], k0[0]],
+            "item": [k0[1], k0[1]],
+            "price": [10.0, 99.0],
+        }
+    )
+    with pytest.raises(ValueError, match="duplicate \\(key, date\\)"):
+        tensorize_regressors(df, batch, ["price"], per_series=True)
+
+
+def test_serving_shared_xreg_when_R_equals_S(tmp_path):
+    """R == S_trained must not confuse gather_params: reg stats always lead
+    with S (regression test for the shape-heuristic edge case)."""
+    from distributed_forecasting_tpu.data.tensorize import SeriesBatch
+    from distributed_forecasting_tpu.serving import BatchForecaster
+
+    horizon = 30
+    # exactly 2 series, 2 SHARED regressors
+    y, mask, day, xreg_all, y_full = _make_batch_with_regressor(
+        per_series=False, S=2, T=365, horizon=horizon
+    )
+    S, T = y.shape
+    batch = SeriesBatch(
+        y=y, mask=mask, day=day,
+        keys=np.stack([np.zeros(S, np.int64), np.arange(S)], axis=1),
+        key_names=("store", "item"), start_date="1972-09-27",
+    )
+    cfg = CurveModelConfig(
+        seasonality_mode="additive", n_regressors=2, weekly_order=0,
+        yearly_order=0,
+    )
+    params, _ = fit_forecast(batch, model="prophet", config=cfg,
+                             horizon=horizon, xreg=xreg_all)
+    assert params.reg_mu.shape == (S, 2)  # the lead-with-S invariant
+    fc = BatchForecaster.from_fit(batch, params, model="prophet", config=cfg)
+    import pandas as pd
+
+    # full-batch request (bucket == S == R) and a 1-series request: both
+    # must produce the accurate regressor-driven forecast, not permuted
+    # standardization stats
+    for req in (batch.key_frame(), pd.DataFrame({"store": [0], "item": [1]})):
+        out = fc.predict(req, horizon=horizon, xreg=xreg_all)
+        got = out[out.item == 1].yhat.to_numpy()
+        err = float(np.mean(np.abs(got - y_full[1, T:])))
+        assert err < 0.5
