@@ -1,6 +1,7 @@
 from distributed_forecasting_tpu.data.tensorize import (
     SeriesBatch,
     bucket_by_span,
+    regressors_for_grid,
     tensorize,
     tensorize_regressors,
 )
@@ -15,6 +16,7 @@ from distributed_forecasting_tpu.data.catalog import DatasetCatalog
 __all__ = [
     "SeriesBatch",
     "bucket_by_span",
+    "regressors_for_grid",
     "tensorize",
     "tensorize_regressors",
     "load_sales_csv",

@@ -22,7 +22,7 @@ Design choices for XLA friendliness:
 from __future__ import annotations
 
 import dataclasses
-from typing import Sequence
+from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -310,15 +310,45 @@ def tensorize_regressors(
     Missing days are forward- then back-filled along time (a price stays in
     force until changed); regressors never observed for a series fill 0.
     """
+    return regressors_for_grid(
+        df,
+        day0=int(np.asarray(batch.day[0])),
+        n_days=batch.n_time + horizon,
+        regressor_cols=regressor_cols,
+        date_col=date_col,
+        per_series=per_series,
+        keys=batch.keys,
+        key_names=batch.key_names,
+        dtype=dtype,
+    )
+
+
+def regressors_for_grid(
+    df: pd.DataFrame,
+    day0: int,
+    n_days: int,
+    regressor_cols: Sequence[str],
+    date_col: str = "date",
+    per_series: bool = False,
+    keys: Optional[np.ndarray] = None,
+    key_names: Sequence[str] = (),
+    dtype=jnp.float32,
+) -> jnp.ndarray:
+    """:func:`tensorize_regressors` on an explicit day grid.
+
+    The serving-side variant: at inference there is no SeriesBatch, only the
+    artifact's grid (``BatchForecaster.day0 .. day1 + horizon``) and key
+    table — this builds the xreg tensor ``predict`` expects from the same
+    long-format covariate rows.  ``keys``/``key_names`` are required for
+    ``per_series=True`` (rows are matched to the artifact's series order).
+    """
     regressor_cols = list(regressor_cols)
     R = len(regressor_cols)
     if R == 0:
         raise ValueError("regressor_cols is empty")
-    T_all = batch.n_time + horizon
-    d0 = int(np.asarray(batch.day[0]))
     day = _epoch_days(df[date_col])
-    tpos = day - d0
-    in_grid = (tpos >= 0) & (tpos < T_all)
+    tpos = day - day0
+    in_grid = (tpos >= 0) & (tpos < n_days)
     vals = df[regressor_cols].to_numpy(dtype=np.float64)
 
     if not per_series:
@@ -331,24 +361,27 @@ def tensorize_regressors(
                 "has one row per date; for per-(store,item) covariates pass "
                 "per_series=True with the key columns present"
             )
-        arr = np.full((T_all, R), np.nan)
+        arr = np.full((n_days, R), np.nan)
         arr[tpos[in_grid]] = vals[in_grid]
         return jnp.asarray(_fill_time(arr), dtype=dtype)
 
-    key_df = df[list(batch.key_names)].astype(np.int64)
-    index = {tuple(k): i for i, k in enumerate(batch.keys.tolist())}
+    if keys is None or not len(key_names):
+        raise ValueError("per_series=True needs the keys/key_names tables")
+    keys = np.asarray(keys)
+    key_df = df[list(key_names)].astype(np.int64)
+    index = {tuple(k): i for i, k in enumerate(keys.tolist())}
     rows = np.array(
         [index.get(tuple(k), -1) for k in key_df.values.tolist()], dtype=np.int64
     )
     keep = in_grid & (rows >= 0)
     # same duplicate policy as the shared path: a (key, date) collision is a
     # malformed frame (e.g. a fan-out join), not something to last-row-wins
-    slots = rows[keep] * np.int64(T_all) + tpos[keep]
+    slots = rows[keep] * np.int64(n_days) + tpos[keep]
     if np.unique(slots).size < slots.size:
         raise ValueError(
             "duplicate (key, date) rows in the regressor frame — one row "
             "per series per date; aggregate duplicates before tensorizing"
         )
-    arr = np.full((batch.n_series, T_all, R), np.nan)
+    arr = np.full((keys.shape[0], n_days, R), np.nan)
     arr[rows[keep], tpos[keep]] = vals[keep]
     return jnp.asarray(_fill_time(arr), dtype=dtype)

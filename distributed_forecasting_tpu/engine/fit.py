@@ -107,6 +107,11 @@ def validate_xreg(fns, model: str, config, xreg, expected_T, what: str):
             f"use the curve model ('prophet')"
         )
     xreg = jnp.asarray(xreg, jnp.float32)
+    if xreg.ndim not in (2, 3):
+        raise ValueError(
+            f"xreg must be (T, R) shared or (S, T, R) per-series, got "
+            f"{xreg.ndim}-D"
+        )
     if expected_T is not None and xreg.shape[-2] != expected_T:
         raise ValueError(
             f"xreg time axis is {xreg.shape[-2]}, expected history + "

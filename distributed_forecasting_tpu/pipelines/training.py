@@ -79,7 +79,22 @@ class TrainingPipeline:
         trace_dir: Optional[str] = None,
         seed: int = 0,
         bucketed: bool = False,
+        regressors: Optional[Dict[str, Any]] = None,
     ) -> Dict[str, Any]:
+        if regressors:
+            from distributed_forecasting_tpu.models.base import get_model
+
+            if model == "auto" or (tuning and tuning.get("enabled")):
+                raise ValueError(
+                    "training.regressors is not supported together with "
+                    "model='auto' or tuning.enabled — fit the curve model "
+                    "directly with regressors"
+                )
+            if not get_model(model).supports_xreg:
+                raise ValueError(
+                    f"model {model!r} does not accept exogenous regressors; "
+                    f"use the curve model ('prophet')"
+                )
         if tuning and tuning.get("enabled"):
             if bucketed:
                 raise ValueError(
@@ -108,9 +123,31 @@ class TrainingPipeline:
             df = self.catalog.read_table(source_table)
         with timer.phase("tensorize"):
             batch = tensorize(df, key_cols=key_cols)
+        xreg = None
+        if regressors:
+            # conf-driven covariates (Prophet add_regressor parity at the
+            # task layer): a catalog table with date (+ key cols when
+            # per_series) + the named columns, covering history AND horizon
+            import dataclasses as _dc
+
+            from distributed_forecasting_tpu.data.tensorize import (
+                tensorize_regressors,
+            )
+
+            cols = list(regressors["columns"])
+            with timer.phase("tensorize_regressors"):
+                reg_df = self.catalog.read_table(regressors["table"])
+                xreg = tensorize_regressors(
+                    reg_df, batch, cols, horizon=horizon,
+                    per_series=bool(regressors.get("per_series", False)),
+                )
+            config = _dc.replace(
+                config, n_regressors=len(cols), regressor_names=tuple(cols)
+            )
         self.logger.info(
-            "fine-grained fit: %d series x %d days, model=%s",
+            "fine-grained fit: %d series x %d days, model=%s%s",
             batch.n_series, batch.n_time, model,
+            f", {config.n_regressors} regressors" if xreg is not None else "",
         )
 
         t_start = time.time()
@@ -121,7 +158,8 @@ class TrainingPipeline:
                 cv = CVConfig(**(cv_conf or {}))
                 with timer.phase("cross_validation"):
                     cv_metrics = cross_validate(
-                        batch, model=model, config=config, cv=cv, key=key
+                        batch, model=model, config=config, cv=cv, key=key,
+                        xreg=xreg,
                     )
                     jax.block_until_ready(cv_metrics["mape"])
             with timer.phase("fit_forecast"):
@@ -135,13 +173,13 @@ class TrainingPipeline:
 
                     buckets, result = fit_forecast_bucketed(
                         batch, model=model, config=config, horizon=horizon,
-                        key=key,
+                        key=key, xreg=xreg,
                     )
                     params = None
                 else:
                     params, result = fit_forecast(
                         batch, model=model, config=config, horizon=horizon,
-                        key=key,
+                        key=key, xreg=xreg,
                     )
                 jax.block_until_ready(result.yhat)
         fit_seconds = time.time() - t_start

@@ -257,6 +257,11 @@ class BatchForecaster:
                     f"regressors"
                 )
             xreg = jnp.asarray(xreg, jnp.float32)
+            if xreg.ndim not in (2, 3):
+                raise ValueError(
+                    f"xreg must be (T_all, R) or (S_trained, T_all, R), got "
+                    f"{xreg.ndim}-D"
+                )
             if xreg.shape[-2] != int(day_all.shape[0]):
                 raise ValueError(
                     f"xreg time axis is {xreg.shape[-2]}, expected the full "

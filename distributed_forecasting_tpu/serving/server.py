@@ -143,11 +143,19 @@ class _Handler(BaseHTTPRequestHandler):
                     400, {"error": f"inputs missing key columns {sorted(missing_cols)}"}
                 )
                 return
+            xreg = req.get("xreg")
+            if xreg is not None:
+                # exogenous regressor values for models fit with
+                # n_regressors > 0: nested lists, (T_all, R) shared or
+                # (S_trained, T_all, R) per-series — shape/length checks
+                # live in BatchForecaster.predict
+                xreg = np.asarray(xreg, dtype=np.float32)
             out = self.server.forecaster.predict(
                 frame,
                 horizon=horizon,
                 include_history=bool(req.get("include_history", False)),
                 on_missing=req.get("on_missing", "raise"),
+                xreg=xreg,
             )
             out["ds"] = out["ds"].astype(str)
             keys = list(self.server.forecaster.key_names)

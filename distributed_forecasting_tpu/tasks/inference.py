@@ -23,6 +23,10 @@ Conf::
       horizon: 90
       promote_to: Staging   # stage transition after a successful batch
       on_missing: raise     # or 'skip' for unseen (store,item)
+      regressors:           # required when the model was fit with
+        table: hackathon.sales.promo_calendar   # n_regressors > 0: same
+        columns: [promo, price]                 # covariate table, covering
+        per_series: false                       # day0 .. day1 + horizon
 """
 
 from __future__ import annotations
@@ -46,10 +50,30 @@ class InferenceTask(Task):
         )
 
         request = self.catalog.read_table(inp.get("table", "hackathon.sales.test_raw"))
+        horizon = int(inf.get("horizon", 90))
+        xreg = None
+        reg = inf.get("regressors")
+        if reg:
+            # covariate values over the artifact's full grid (see
+            # data.tensorize.regressors_for_grid) — the future values the
+            # curve model needs, resolved from the catalog like the request
+            from distributed_forecasting_tpu.data import regressors_for_grid
+
+            reg_df = self.catalog.read_table(reg["table"])
+            xreg = regressors_for_grid(
+                reg_df,
+                day0=forecaster.day0,
+                n_days=forecaster.day1 + horizon - forecaster.day0 + 1,
+                regressor_cols=list(reg["columns"]),
+                per_series=bool(reg.get("per_series", False)),
+                keys=forecaster.keys,
+                key_names=forecaster.key_names,
+            )
         pred = forecaster.predict(
             request,
-            horizon=int(inf.get("horizon", 90)),
+            horizon=horizon,
             on_missing=inf.get("on_missing", "raise"),
+            xreg=xreg,
         )
         table = out.get("table", "hackathon.sales.test_finegrain_forecasts")
         tversion = self.catalog.save_table(table, pred)

@@ -18,6 +18,10 @@ Librarized equivalent of the reference's training notebook entry point
       per_series_runs: false
       bucketed: false               # span-bucketed fit for ragged batches
       path: fine_grained            # or 'allocated'
+      regressors:                   # optional exogenous covariates (curve
+        table: hackathon.sales.promo_calendar   # model only): catalog table
+        columns: [promo, price]     # with date (+ key cols if per_series)
+        per_series: false           # covering history AND horizon days
 """
 
 from __future__ import annotations
@@ -34,6 +38,12 @@ class TrainTask(Task):
         pipeline = TrainingPipeline(self.catalog, self.tracker)
         path = tr.get("path", "fine_grained")
         if path == "allocated":
+            if tr.get("regressors"):
+                raise ValueError(
+                    "training.regressors is not supported on the allocated "
+                    "path — covariates would be fit at item level and then "
+                    "ratio-scaled; use path: fine_grained"
+                )
             return pipeline.allocated(
                 source_table=inp.get("table", "hackathon.sales.raw"),
                 output_table=out.get("table", "hackathon.sales.allocated_forecasts"),
@@ -54,6 +64,7 @@ class TrainTask(Task):
             per_series_runs=bool(tr.get("per_series_runs", False)),
             tuning=tr.get("tuning"),
             bucketed=bool(tr.get("bucketed", False)),
+            regressors=tr.get("regressors"),
         )
 
 

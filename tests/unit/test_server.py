@@ -150,3 +150,52 @@ def test_invocations_rejects_hostile_bodies(server):
             _call(server, "/invocations", bad)
         assert e.value.code == 400
         assert frag in json.loads(e.value.read())["error"]
+
+
+def test_invocations_with_xreg(tmp_path_factory):
+    """The scorer forwards request-supplied regressor values to the model
+    (nested lists -> (T_all, R)); a regressor-fit model without xreg in the
+    body errors 400 instead of serving wrong numbers."""
+    from distributed_forecasting_tpu.data import (
+        synthetic_store_item_sales,
+        tensorize,
+    )
+
+    horizon = 30
+    df = synthetic_store_item_sales(n_stores=1, n_items=2, n_days=760, seed=4)
+    batch = tensorize(df)
+    T_all = batch.n_time + horizon
+    x = np.stack(
+        [(np.arange(T_all) % 13 < 2).astype(np.float32)], axis=1
+    )
+    cfg = CurveModelConfig(n_regressors=1, regressor_names=("promo",))
+    params, _ = fit_forecast(batch, model="prophet", config=cfg,
+                             horizon=horizon, xreg=x)
+    fc = BatchForecaster.from_fit(batch, params, "prophet", cfg)
+    srv = start_server(fc, model_version="7")
+    try:
+        inputs = [{"store": 1, "item": 1}]
+        code, body = _call(srv, "/invocations", {
+            "inputs": inputs, "horizon": horizon, "xreg": x.tolist(),
+        })
+        assert code == 200
+        assert body["n_series"] == 1
+        assert len(body["predictions"]) == horizon
+        assert all(np.isfinite(p["yhat"]) for p in body["predictions"])
+
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _call(srv, "/invocations", {"inputs": inputs, "horizon": horizon})
+        assert e.value.code == 400
+        assert "xreg" in json.loads(e.value.read())["error"]
+    finally:
+        srv.shutdown()
+
+
+def test_invocations_malformed_xreg_is_400(server):
+    """A scalar/1-D xreg is client error (400), not a 500 stack trace."""
+    for bad in (1.5, [1, 2, 3]):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _call(server, "/invocations",
+                  {"inputs": [{"store": 1, "item": 1}], "horizon": 5,
+                   "xreg": bad})
+        assert e.value.code == 400

@@ -268,3 +268,98 @@ def test_task_distributed_conf_plumbing(monkeypatch, tmp_path):
     })
     assert calls == [{"coordinator_address": "h0:9999",
                       "num_processes": 2, "process_id": 1}]
+
+
+def test_train_infer_chain_with_regressors(env_conf):
+    """Conf-driven covariates through the full task chain: a promo calendar
+    table in the catalog drives the curve model's exogenous regressors at
+    train AND inference time (Prophet add_regressor parity at the task
+    layer)."""
+    import pandas as pd
+
+    IngestTask(init_conf={**env_conf, **_synth_conf()}).launch()
+
+    # build the promo calendar in the catalog, covering history + horizon
+    boot = CatalogTask(init_conf={**env_conf, "output": {
+        "catalog_name": "hackathon", "schema_name": "sales"}})
+    boot.launch()
+    raw = boot.catalog.read_table("hackathon.sales.raw")
+    dates = pd.to_datetime(raw["date"]).sort_values().unique()
+    horizon = 60
+    all_dates = pd.DatetimeIndex(dates).append(
+        pd.date_range(pd.Timestamp(dates[-1]) + pd.Timedelta(days=1),
+                      periods=horizon)
+    )
+    promo = (np.arange(len(all_dates)) % 13 < 2).astype(float)
+    boot.catalog.save_table(
+        "hackathon.sales.promo_calendar",
+        pd.DataFrame({"date": all_dates, "promo": promo}),
+    )
+
+    train = TrainTask(
+        init_conf={
+            **env_conf,
+            "input": {"table": "hackathon.sales.raw"},
+            "output": {"table": "hackathon.sales.finegrain_forecasts"},
+            "training": {
+                "model": "prophet",
+                "cv": {"initial": 400, "period": 180, "horizon": 60},
+                "horizon": horizon,
+                "regressors": {"table": "hackathon.sales.promo_calendar",
+                               "columns": ["promo"]},
+            },
+        }
+    )
+    summary = train.launch()
+    assert summary["n_failed"] == 0
+    run = train.tracker.get_run(summary["experiment_id"], summary["run_id"])
+    assert int(run.params()["n_regressors"]) == 1
+
+    DeployTask(
+        init_conf={**env_conf,
+                   "deploy": {"experiment": "finegrain_forecasting",
+                              "model_name": "ForecastingBatchModel"}}
+    ).launch()
+
+    # without the regressor conf, inference must fail loudly (future
+    # covariates are required), and succeed once configured
+    infer_conf = {
+        **env_conf,
+        "input": {"table": "hackathon.sales.raw"},
+        "output": {"table": "hackathon.sales.test_finegrain_forecasts"},
+        "inference": {"model_name": "ForecastingBatchModel", "horizon": 30,
+                      "promote_to": None},
+    }
+    with pytest.raises(ValueError, match="no xreg"):
+        InferenceTask(init_conf=infer_conf).launch()
+
+    infer_conf["inference"]["regressors"] = {
+        "table": "hackathon.sales.promo_calendar", "columns": ["promo"]}
+    infer = InferenceTask(init_conf=infer_conf)
+    res = infer.launch()
+    assert res["rows"] == 6 * 30
+    out = infer.catalog.read_table("hackathon.sales.test_finegrain_forecasts")
+    assert np.isfinite(out.yhat).all()
+
+
+def test_regressor_conf_unsupported_combos(env_conf):
+    IngestTask(init_conf={**env_conf, **_synth_conf()}).launch()
+    base = {
+        **env_conf,
+        "input": {"table": "hackathon.sales.raw"},
+        "output": {"table": "hackathon.sales.finegrain_forecasts"},
+    }
+    reg = {"table": "hackathon.sales.promo_calendar", "columns": ["promo"]}
+    # non-curve family: clear error BEFORE any regressor table read
+    with pytest.raises(ValueError, match="does not accept"):
+        TrainTask(init_conf={**base, "training": {
+            "model": "holt_winters", "regressors": reg,
+            "run_cross_validation": False}}).launch()
+    # allocated path: loud error, not silently ignored covariates
+    with pytest.raises(ValueError, match="allocated"):
+        TrainTask(init_conf={**base, "training": {
+            "path": "allocated", "regressors": reg}}).launch()
+    # auto-select: unsupported combo
+    with pytest.raises(ValueError, match="auto"):
+        TrainTask(init_conf={**base, "training": {
+            "model": "auto", "regressors": reg}}).launch()
