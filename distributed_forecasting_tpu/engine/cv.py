@@ -130,15 +130,8 @@ def cross_validate(
         key = jax.random.PRNGKey(0)
     from distributed_forecasting_tpu.engine.fit import validate_xreg
 
-    xreg = validate_xreg(fns, model, config, xreg, None, "cross_validate")
-    if xreg is not None:
-        T = batch.n_time
-        if xreg.shape[-2] < T:
-            raise ValueError(
-                f"xreg time axis is {xreg.shape[-2]}, expected at least the "
-                f"history length {T}"
-            )
-        xreg = xreg[:T] if xreg.ndim == 2 else xreg[:, :T]
+    xreg = validate_xreg(fns, model, config, xreg, None, "cross_validate",
+                         trim_to=batch.n_time)
     cuts = cutoff_indices(batch.n_time, cv)
     out = dict(
         _cv_impl(

@@ -85,14 +85,16 @@ def seasonal_naive_sigma(y, mask, season: int = 7):
     return jnp.where((n > 0) | (var > 0), jnp.maximum(sigma, 1e-6), 1.0)
 
 
-def validate_xreg(fns, model: str, config, xreg, expected_T, what: str):
+def validate_xreg(fns, model: str, config, xreg, expected_T, what: str,
+                  trim_to=None):
     """Shared entry-point validation for exogenous-regressor tensors.
 
     One implementation for every engine entry (fit_forecast, chunked,
-    bucketed, cross_validate) so coverage and messages cannot drift.
-    Returns the float32-cast tensor, or None when no regressors are in
-    play.  ``expected_T``: required time-axis length (None skips the check
-    — CV trims instead).
+    bucketed, cross_validate, the sharded variants) so coverage and
+    messages cannot drift.  Returns the float32-cast tensor, or None when
+    no regressors are in play.  ``expected_T``: required time-axis length.
+    ``trim_to``: CV-style contract instead — require at least this many
+    time steps and trim down to them (pass ``expected_T=None`` with it).
     """
     if xreg is None:
         if config is not None and getattr(config, "n_regressors", 0):
@@ -117,6 +119,13 @@ def validate_xreg(fns, model: str, config, xreg, expected_T, what: str):
             f"xreg time axis is {xreg.shape[-2]}, expected history + "
             f"horizon = {expected_T} (future regressor values must be known)"
         )
+    if trim_to is not None:
+        if xreg.shape[-2] < trim_to:
+            raise ValueError(
+                f"xreg time axis is {xreg.shape[-2]}, expected at least the "
+                f"history length {trim_to}"
+            )
+        xreg = xreg[:trim_to] if xreg.ndim == 2 else xreg[:, :trim_to]
     return xreg
 
 

@@ -52,6 +52,28 @@ def shard_batch(batch: SeriesBatch, mesh: Mesh) -> SeriesBatch:
     )
 
 
+def _shard_xreg(xreg, orig_S: int, padded_S: int, mesh: Mesh):
+    """Place an xreg tensor on the mesh to match ``shard_batch``'s layout:
+    per-series (S, T, R) sharded on the series axis and zero-padded to the
+    sharded batch's ``padded_S`` (so the padding rule lives in shard_batch
+    alone), shared (T, R) replicated."""
+    if xreg.ndim == 3:
+        if xreg.shape[0] != orig_S:
+            raise ValueError(
+                f"per-series xreg leads with {xreg.shape[0]} rows, expected "
+                f"{orig_S} (the unsharded batch's series count)"
+            )
+        pad = padded_S - orig_S
+        if pad:
+            xreg = jnp.concatenate(
+                [xreg, jnp.zeros((pad,) + xreg.shape[1:], xreg.dtype)]
+            )
+        return jax.device_put(
+            xreg, NamedSharding(mesh, P(SERIES_AXIS, None, None))
+        )
+    return jax.device_put(xreg, NamedSharding(mesh, P(None, None)))
+
+
 def sharded_fit_forecast(
     batch: SeriesBatch,
     model: str = "prophet",
@@ -60,22 +82,31 @@ def sharded_fit_forecast(
     mesh: Optional[Mesh] = None,
     key: Optional[jax.Array] = None,
     min_points: int = 14,
+    xreg=None,
 ) -> Tuple[object, ForecastResult]:
     """Mesh-sharded ``engine.fit_forecast``: shard the batch, run the same
     compiled program, let the partitioner scale it.  Returns sharded params
-    and a sharded :class:`ForecastResult` (padding rows have ok=False)."""
+    and a sharded :class:`ForecastResult` (padding rows have ok=False).
+
+    ``xreg`` follows the batch: per-series tensors shard on the series axis
+    (zero cross-chip traffic — each chip fits its rows with its covariates),
+    shared calendars replicate like the day grid.
+    """
     if mesh is None:
         raise ValueError("pass a Mesh (parallel.make_mesh())")
-    if config is not None and getattr(config, "n_regressors", 0):
-        raise ValueError(
-            "sharded_fit_forecast does not thread exogenous regressors yet "
-            "— shard the xreg tensor alongside the batch and call "
-            "engine.fit_forecast directly, or fit without regressors"
-        )
+    from distributed_forecasting_tpu.engine.fit import validate_xreg
+
+    fns = get_model(model)
+    cfg = config if config is not None else fns.config_cls()
+    xreg = validate_xreg(fns, model, cfg, xreg, batch.n_time + horizon,
+                         "sharded_fit_forecast")
+    S = batch.n_series
     sharded = shard_batch(batch, mesh)
+    if xreg is not None:
+        xreg = _shard_xreg(xreg, S, sharded.n_series, mesh)
     return fit_forecast(
-        sharded, model=model, config=config, horizon=horizon, key=key,
-        min_points=min_points,
+        sharded, model=model, config=cfg, horizon=horizon, key=key,
+        min_points=min_points, xreg=xreg,
     )
 
 
@@ -116,43 +147,54 @@ def sharded_cv_metrics(
     cv=None,
     mesh: Optional[Mesh] = None,
     key: Optional[jax.Array] = None,
+    xreg=None,
 ) -> Dict[str, jax.Array]:
     """Rolling-origin CV with the series axis sharded via ``shard_map``:
     each chip fits/scores its local block for every cutoff; per-series means
-    come back sharded, ready for :func:`global_metric_means`."""
+    come back sharded, ready for :func:`global_metric_means`.
+
+    ``xreg`` (history-grid regressor values, longer tensors trimmed) shards
+    like the batch: per-series on the series axis, shared replicated.
+    """
     from distributed_forecasting_tpu.engine.cv import CVConfig, cutoff_indices
+    from distributed_forecasting_tpu.engine.fit import validate_xreg
     from distributed_forecasting_tpu.ops import metrics as metrics_ops
 
     if mesh is None:
         raise ValueError("pass a Mesh (parallel.make_mesh())")
     fns = get_model(model)
     config = config if config is not None else fns.config_cls()
-    if getattr(config, "n_regressors", 0):
-        raise ValueError(
-            "sharded_cv_metrics does not thread exogenous regressors yet — "
-            "use engine.cross_validate(..., xreg=...) or CV without them"
-        )
     cv = cv or CVConfig()
     if key is None:
         key = jax.random.PRNGKey(0)
 
     orig_n = batch.n_series
+    xreg = validate_xreg(fns, model, config, xreg, None, "sharded_cv_metrics",
+                         trim_to=batch.n_time)
     batch = shard_batch(batch, mesh)
     T = batch.n_time
+    if xreg is not None:
+        xreg = _shard_xreg(xreg, orig_n, batch.n_series, mesh)
     cuts = cutoff_indices(T, cv)
     idx = jnp.arange(T)
     cut_steps = jnp.asarray(cuts, dtype=jnp.int32)
     t_ends = batch.day[cut_steps].astype(jnp.float32)
     metric_names = sorted(list(metrics_ops.METRIC_FNS) + ["coverage"])
 
-    def local_cv(y, mask, day, cut_steps, t_ends, key):
+    def local_cv(y, mask, day, cut_steps, t_ends, key, *xr):
         k0 = jax.random.fold_in(key, jax.lax.axis_index(SERIES_AXIS))
+        xr = xr[0] if xr else None
 
         def one_cutoff(c, t_end, k):
             train_mask = mask * (idx <= c)[None, :]
             eval_mask = mask * ((idx > c) & (idx <= c + cv.horizon))[None, :]
-            params = fns.fit(y, train_mask, day, config)
-            yhat, lo, hi = fns.forecast(params, day, t_end, config, k)
+            if xr is not None:
+                params = fns.fit(y, train_mask, day, config, xreg=xr)
+                yhat, lo, hi = fns.forecast(params, day, t_end, config, k,
+                                            xreg=xr)
+            else:
+                params = fns.fit(y, train_mask, day, config)
+                yhat, lo, hi = fns.forecast(params, day, t_end, config, k)
             m = metrics_ops.compute_all(y, yhat, eval_mask, lo=lo, hi=hi)
             return jnp.stack([m[n] for n in metric_names])
 
@@ -160,15 +202,21 @@ def sharded_cv_metrics(
         per_cut = jax.vmap(one_cutoff)(cut_steps, t_ends, keys)  # (C, M, S_l)
         return jnp.mean(per_cut, axis=0)  # (M, S_local)
 
+    in_specs = [P(SERIES_AXIS, None), P(SERIES_AXIS, None), P(), P(), P(), P()]
+    args = [batch.y, batch.mask, batch.day, cut_steps, t_ends, key]
+    if xreg is not None:
+        in_specs.append(
+            P(SERIES_AXIS, None, None) if xreg.ndim == 3 else P(None, None)
+        )
+        args.append(xreg)
     out = jax.jit(
         jax.shard_map(
             local_cv,
             mesh=mesh,
-            in_specs=(P(SERIES_AXIS, None), P(SERIES_AXIS, None), P(), P(),
-                      P(), P()),
+            in_specs=tuple(in_specs),
             out_specs=P(None, SERIES_AXIS),
         )
-    )(batch.y, batch.mask, batch.day, cut_steps, t_ends, key)
+    )(*args)
 
     result = {name: out[i, :orig_n] for i, name in enumerate(metric_names)}
     result["_n_cutoffs"] = len(cuts)

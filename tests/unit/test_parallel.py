@@ -143,3 +143,60 @@ def test_initialize_distributed_plumbing(monkeypatch):
         coordinator_address="10.0.0.1:1234", num_processes=4, process_id=2
     )
     assert len(calls) == 1
+
+
+def test_sharded_fit_with_xreg_matches_single_device(batch_small, mesh):
+    from distributed_forecasting_tpu.models.prophet_glm import CurveModelConfig
+
+    T, H = batch_small.n_time, 30
+    S = batch_small.n_series
+    rng = np.random.default_rng(3)
+    shared = jnp.asarray(
+        np.stack([np.sin(np.arange(T + H) / 9.0),
+                  (np.arange(T + H) % 13 < 2).astype(float)], axis=1),
+        jnp.float32,
+    )
+    per_series = jnp.asarray(
+        np.broadcast_to(np.asarray(shared)[None], (S, T + H, 2))
+        * rng.uniform(0.5, 2.0, (S, 1, 2)),
+        jnp.float32,
+    )
+    cfg = CurveModelConfig(n_regressors=2)
+    for xr in (shared, per_series):
+        _, res_single = fit_forecast(
+            batch_small, model="prophet", config=cfg, horizon=H, xreg=xr
+        )
+        _, res_shard = sharded_fit_forecast(
+            batch_small, model="prophet", config=cfg, horizon=H, mesh=mesh,
+            xreg=xr,
+        )
+        np.testing.assert_allclose(
+            np.asarray(res_shard.yhat)[: batch_small.n_series],
+            np.asarray(res_single.yhat),
+            rtol=2e-4, atol=2e-4,
+        )
+    # wrong leading dim on the per-series tensor is a clear error
+    with pytest.raises(ValueError, match="leads with"):
+        sharded_fit_forecast(
+            batch_small, model="prophet", config=cfg, horizon=H, mesh=mesh,
+            xreg=per_series[:3],
+        )
+
+
+def test_sharded_cv_with_xreg_matches_unsharded(batch_small, mesh):
+    from distributed_forecasting_tpu.engine import cross_validate
+    from distributed_forecasting_tpu.models.prophet_glm import CurveModelConfig
+
+    T = batch_small.n_time
+    shared = jnp.asarray(
+        np.stack([(np.arange(T) % 13 < 2).astype(float)], axis=1), jnp.float32
+    )
+    cfg = CurveModelConfig(n_regressors=1)
+    cv = CVConfig(initial=500, period=250, horizon=60)
+    ref = cross_validate(batch_small, model="prophet", config=cfg, cv=cv,
+                         xreg=shared)
+    out = sharded_cv_metrics(batch_small, model="prophet", config=cfg, cv=cv,
+                             mesh=mesh, xreg=shared)
+    np.testing.assert_allclose(
+        np.asarray(out["mape"]), np.asarray(ref["mape"]), rtol=2e-4, atol=2e-4
+    )
