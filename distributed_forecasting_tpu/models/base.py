@@ -53,13 +53,17 @@ class ModelFns(NamedTuple):
     # whether fit/forecast accept an ``xreg`` keyword (exogenous regressor
     # values; the curve model's Prophet ``add_regressor`` equivalent)
     supports_xreg: bool = False
+    # optional probabilistic output: (params, day_all, t_end, config,
+    # quantiles, key=None[, xreg=None]) -> (S, Q, T_all) quantile paths
+    forecast_quantiles: Callable = None
 
 
 def register_model(name: str, fit: Callable, forecast: Callable, config_cls: type,
-                   supports_xreg: bool = False):
+                   supports_xreg: bool = False, forecast_quantiles: Callable = None):
     MODEL_REGISTRY[name] = ModelFns(fit=fit, forecast=forecast,
                                     config_cls=config_cls,
-                                    supports_xreg=supports_xreg)
+                                    supports_xreg=supports_xreg,
+                                    forecast_quantiles=forecast_quantiles)
 
 
 def get_model(name: str) -> ModelFns:

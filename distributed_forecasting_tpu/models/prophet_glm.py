@@ -352,27 +352,15 @@ def _trend_deviation_variance(params: CurveParams, t_all, t_end_scaled, cfg):
     return 2.0 * lam_scale[:, None] ** 2 * p_cp * lag2[None, :]
 
 
-@partial(jax.jit, static_argnames=("config",))
-def forecast(
-    params: CurveParams,
-    day_all,
-    t_end,
-    config: CurveModelConfig,
-    key=None,
-    xreg=None,
-):
-    """Predict over ``day_all`` (history+future), intervals included.
+def _predictive(params: CurveParams, day_all, t_end, config, key, xreg):
+    """Fit-space predictive distribution over ``day_all``.
 
-    Mirrors ``make_future_dataframe(periods=90, freq='d',
-    include_history=True)`` -> ``model.predict`` (reference
-    ``02_training.py:201-205``).  Returns (yhat, lo, hi): (S, T_all).
-
-    ``xreg``: regressor values over ``day_all`` — (T_all, R) or
-    (S, T_all, R); required iff config.n_regressors > 0 (future covariate
-    values must be known, exactly as with Prophet's ``add_regressor``).
+    Returns ``(zhat, sd, paths)``: point path (S, T_all) plus either the
+    analytic predictive sd (S, T_all) with ``paths=None`` (default), or
+    Monte-Carlo sample paths (S, N, T_all) with ``sd=None`` when
+    ``config.uncertainty_samples > 0``.  Shared by ``forecast`` (central
+    interval) and ``forecast_quantiles`` (arbitrary quantile grid).
     """
-    if key is None:
-        key = jax.random.PRNGKey(0)
     X, layout = _design(day_all, params.t0, params.t1, config)
     # base design stays SHARED (T_all, F0) even with per-series regressors:
     # the regressor contribution is a rank-R inner product added on top, so
@@ -405,24 +393,92 @@ def forecast(
             * (params.sigma * params.y_scale)[:, None, None]
         )
         paths = zhat[:, None, :] + dev * params.y_scale[:, None, None] + noise
+        return zhat, None, paths
+    var_dev = _trend_deviation_variance(params, t_all, t_end_scaled, config)
+    sd = jnp.sqrt(var_dev + params.sigma[:, None] ** 2) * params.y_scale[:, None]
+    return zhat, sd, None
+
+
+def _to_data_space(v, params: CurveParams, config):
+    """Map fit-space values back to data space.  Monotone transforms, so
+    quantiles in fit space ARE quantiles in data space.  Broadcasts over any
+    trailing axes (v leads with S)."""
+    if config.growth == "logistic":
+        cap = params.cap.reshape((-1,) + (1,) * (v.ndim - 1))
+        return cap * jax.nn.sigmoid(v)
+    if config.seasonality_mode == "multiplicative":
+        return jnp.exp(v)
+    return v
+
+
+@partial(jax.jit, static_argnames=("config",))
+def forecast(
+    params: CurveParams,
+    day_all,
+    t_end,
+    config: CurveModelConfig,
+    key=None,
+    xreg=None,
+):
+    """Predict over ``day_all`` (history+future), intervals included.
+
+    Mirrors ``make_future_dataframe(periods=90, freq='d',
+    include_history=True)`` -> ``model.predict`` (reference
+    ``02_training.py:201-205``).  Returns (yhat, lo, hi): (S, T_all).
+
+    ``xreg``: regressor values over ``day_all`` — (T_all, R) or
+    (S, T_all, R); required iff config.n_regressors > 0 (future covariate
+    values must be known, exactly as with Prophet's ``add_regressor``).
+    """
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    zhat, sd, paths = _predictive(params, day_all, t_end, config, key, xreg)
+    if paths is not None:
         alpha = (1.0 - config.interval_width) / 2.0
         qs = jnp.quantile(paths, jnp.asarray([alpha, 1.0 - alpha]), axis=1)
         lo, hi = qs[0], qs[1]
     else:
-        var_dev = _trend_deviation_variance(params, t_all, t_end_scaled, config)
-        sd = jnp.sqrt(var_dev + params.sigma[:, None] ** 2) * params.y_scale[:, None]
         z = ndtri(0.5 + config.interval_width / 2.0)
         lo = zhat - z * sd
         hi = zhat + z * sd
+    return (
+        _to_data_space(zhat, params, config),
+        _to_data_space(lo, params, config),
+        _to_data_space(hi, params, config),
+    )
 
-    if config.growth == "logistic":
-        sig = lambda v: params.cap[:, None] * jax.nn.sigmoid(v)
-        yhat, lo, hi = sig(zhat), sig(lo), sig(hi)
-    elif config.seasonality_mode == "multiplicative":
-        yhat, lo, hi = jnp.exp(zhat), jnp.exp(lo), jnp.exp(hi)
+
+@partial(jax.jit, static_argnames=("config", "quantiles"))
+def forecast_quantiles(
+    params: CurveParams,
+    day_all,
+    t_end,
+    config: CurveModelConfig,
+    quantiles: tuple = (0.1, 0.5, 0.9),
+    key=None,
+    xreg=None,
+):
+    """Arbitrary forecast quantiles (M5-style probabilistic output).
+
+    ``quantiles``: static tuple of levels in (0, 1).  Returns
+    (S, Q, T_all), non-decreasing along Q.  The analytic path prices every
+    quantile from the same closed-form predictive sd (one ndtri per level
+    — virtually free); the Monte-Carlo path (``uncertainty_samples > 0``)
+    takes empirical quantiles over the sampled trend+noise paths.  The
+    data-space transforms (exp / logistic) are monotone, so fit-space
+    quantiles map through exactly.
+    """
+    if not quantiles or not all(0.0 < q < 1.0 for q in quantiles):
+        raise ValueError(f"quantiles must lie in (0, 1), got {quantiles!r}")
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    zhat, sd, paths = _predictive(params, day_all, t_end, config, key, xreg)
+    qs = jnp.asarray(quantiles, jnp.float32)
+    if paths is not None:
+        zq = jnp.moveaxis(jnp.quantile(paths, qs, axis=1), 0, 1)  # (S, Q, T)
     else:
-        yhat = zhat
-    return yhat, lo, hi
+        zq = zhat[:, None, :] + ndtri(qs)[None, :, None] * sd[:, None, :]
+    return _to_data_space(zq, params, config)
 
 
 def extract_params(params: CurveParams, config: CurveModelConfig) -> dict:
@@ -447,5 +503,7 @@ def extract_params(params: CurveParams, config: CurveModelConfig) -> dict:
     }
 
 
-register_model("prophet", fit, forecast, CurveModelConfig, supports_xreg=True)
-register_model("curve", fit, forecast, CurveModelConfig, supports_xreg=True)
+register_model("prophet", fit, forecast, CurveModelConfig, supports_xreg=True,
+               forecast_quantiles=forecast_quantiles)
+register_model("curve", fit, forecast, CurveModelConfig, supports_xreg=True,
+               forecast_quantiles=forecast_quantiles)

@@ -74,6 +74,17 @@ def coverage(y, lo, hi, mask):
     return _mean(inside, mask)
 
 
+def pinball(y, yhat_q, mask, q: float):
+    """Pinball (quantile) loss at level ``q`` — the M5-uncertainty metric.
+
+    ``yhat_q``: the forecast of the q-quantile, same shape as y.  Masked
+    mean of q*(y - f) for under-forecasts and (1-q)*(f - y) for over.
+    """
+    diff = y - yhat_q
+    loss = jnp.maximum(q * diff, (q - 1.0) * diff)
+    return _mean(loss, mask)
+
+
 METRIC_FNS = {
     "mse": mse,
     "rmse": rmse,

@@ -206,44 +206,26 @@ class BatchForecaster:
 
         return jax.tree_util.tree_map(g, self.params)
 
-    def predict(
-        self,
-        request: pd.DataFrame,
-        horizon: int = 90,
-        include_history: bool = False,
-        key: Optional[jax.Array] = None,
-        on_missing: str = "raise",
-        xreg=None,
-    ) -> pd.DataFrame:
-        """Forecast every requested (store, item) ``horizon`` days past the
-        end of training.  ``request`` needs the key columns only (extra
-        columns — e.g. the history the reference ships to its UDF — are
-        ignored; the fitted params already encode history).
+    def _prepare_request(self, request, horizon, on_missing, xreg):
+        """Shared predict prologue: resolve series, bucket the request size,
+        gather params, validate/gather xreg.
 
-        ``xreg``: future-covering exogenous regressor values when the model
-        was fit with ``n_regressors > 0`` — (T_all, R) shared or
-        (S_trained, T_all, R) per-series over the FULL day0..day1+horizon
-        grid (per-series rows are gathered down to the request)."""
+        ALWAYS forecasts over the full history+future grid (callers trim):
+        the model forecast contract (see arima._forecast_impl) sizes its
+        static forecast-path length as T_all - T_fit for grids longer than
+        the fit grid, which is only exact when such grids start at day0; the
+        history part is a cheap gather, so the full grid costs almost
+        nothing and keeps every request pattern exact.  The request size is
+        bucketed to the next power of two (capped at S) so a serving
+        process sees O(log S) compiled shapes; padding rows repeat sidx[0]
+        and are dropped by the caller.
+        """
         sidx = self.series_indices(request, on_missing=on_missing)
         if sidx.size == 0:
-            return pd.DataFrame(
-                columns=["ds", *self.key_names, "yhat", "yhat_upper", "yhat_lower"]
-            )
-        fns = get_model(self.model)
-        # ALWAYS forecast over the full history+future grid, then trim: the
-        # model forecast contract (see arima._forecast_impl) sizes its static
-        # forecast-path length as T_all - T_fit for grids longer than the fit
-        # grid, which is only exact when such grids start at day0.  A
-        # future-only grid with horizon > T_fit would silently saturate its
-        # tail (flat forecast past lead T_all - T_fit); the history part is
-        # a cheap gather of precomputed fitted values, so the full grid costs
-        # almost nothing and keeps every request pattern exact.
+            return sidx, None, None, None
         day_all = jnp.arange(
             self.day0, self.day1 + horizon + 1, dtype=jnp.int32
         )
-        # bucket the request size to the next power of two (capped at S) so a
-        # serving process sees O(log S) compiled shapes, not one per distinct
-        # request size; padding rows repeat sidx[0] and are dropped after
         k = int(sidx.size)
         bucket = min(1 << (k - 1).bit_length(), self.keys.shape[0])
         bucket = max(bucket, k)  # k == S but S not a power of two
@@ -251,6 +233,7 @@ class BatchForecaster:
         params = self.gather_params(padded)
         fc_kwargs = {}
         if xreg is not None:
+            fns = get_model(self.model)
             if not fns.supports_xreg:
                 raise ValueError(
                     f"model {self.model!r} does not accept exogenous "
@@ -280,6 +263,46 @@ class BatchForecaster:
                     )
                 xreg = xreg[jnp.asarray(padded)]
             fc_kwargs["xreg"] = xreg
+        return sidx, params, day_all, fc_kwargs
+
+    def _frame_skeleton(self, sidx, day_all):
+        """ds + key columns for a long result frame over ``day_all`` —
+        shared by predict and predict_quantiles so the date/key assembly
+        cannot drift between them."""
+        T = day_all.shape[0]
+        dates = pd.to_datetime(np.asarray(day_all, dtype="int64"), unit="D")
+        frame = {"ds": np.tile(dates.values, len(sidx))}
+        for j, name in enumerate(self.key_names):
+            frame[name] = np.repeat(self.keys[sidx, j], T)
+        return frame
+
+    def predict(
+        self,
+        request: pd.DataFrame,
+        horizon: int = 90,
+        include_history: bool = False,
+        key: Optional[jax.Array] = None,
+        on_missing: str = "raise",
+        xreg=None,
+    ) -> pd.DataFrame:
+        """Forecast every requested (store, item) ``horizon`` days past the
+        end of training.  ``request`` needs the key columns only (extra
+        columns — e.g. the history the reference ships to its UDF — are
+        ignored; the fitted params already encode history).
+
+        ``xreg``: future-covering exogenous regressor values when the model
+        was fit with ``n_regressors > 0`` — (T_all, R) shared or
+        (S_trained, T_all, R) per-series over the FULL day0..day1+horizon
+        grid (per-series rows are gathered down to the request)."""
+        sidx, params, day_all, fc_kwargs = self._prepare_request(
+            request, horizon, on_missing, xreg
+        )
+        if sidx.size == 0:
+            return pd.DataFrame(
+                columns=["ds", *self.key_names, "yhat", "yhat_upper", "yhat_lower"]
+            )
+        fns = get_model(self.model)
+        k = int(sidx.size)
         yhat, lo, hi = fns.forecast(
             params, day_all, jnp.float32(self.day1), self.config, key,
             **fc_kwargs,
@@ -287,16 +310,50 @@ class BatchForecaster:
         if not include_history:
             day_all = day_all[-horizon:]
             yhat, lo, hi = yhat[:, -horizon:], lo[:, -horizon:], hi[:, -horizon:]
-        yhat = np.asarray(yhat)[:k]
-        lo = np.asarray(lo)[:k]
-        hi = np.asarray(hi)[:k]
+        frame = self._frame_skeleton(sidx, day_all)
+        frame["yhat"] = np.asarray(yhat)[:k].reshape(-1)
+        frame["yhat_upper"] = np.asarray(hi)[:k].reshape(-1)
+        frame["yhat_lower"] = np.asarray(lo)[:k].reshape(-1)
+        return pd.DataFrame(frame)
 
-        T = day_all.shape[0]
-        dates = pd.to_datetime(np.asarray(day_all, dtype="int64"), unit="D")
-        frame = {"ds": np.tile(dates.values, len(sidx))}
-        for j, name in enumerate(self.key_names):
-            frame[name] = np.repeat(self.keys[sidx, j], T)
-        frame["yhat"] = yhat.reshape(-1)
-        frame["yhat_upper"] = hi.reshape(-1)
-        frame["yhat_lower"] = lo.reshape(-1)
+    def predict_quantiles(
+        self,
+        request: pd.DataFrame,
+        quantiles=(0.1, 0.5, 0.9),
+        horizon: int = 90,
+        include_history: bool = False,
+        key: Optional[jax.Array] = None,
+        on_missing: str = "raise",
+        xreg=None,
+    ) -> pd.DataFrame:
+        """Probabilistic forecast: one column per requested quantile level
+        (``q0.1``, ``q0.5``, ...), M5-uncertainty style.  Only for model
+        families registered with a ``forecast_quantiles`` implementation
+        (the curve model); levels are priced from the same closed-form
+        predictive distribution the central interval uses."""
+        fns = get_model(self.model)
+        if fns.forecast_quantiles is None:
+            raise ValueError(
+                f"model {self.model!r} has no quantile forecast "
+                f"implementation; use the curve model ('prophet')"
+            )
+        quantiles = tuple(float(q) for q in quantiles)
+        sidx, params, day_all, fc_kwargs = self._prepare_request(
+            request, horizon, on_missing, xreg
+        )
+        qcols = [f"q{q:g}" for q in quantiles]
+        if sidx.size == 0:
+            return pd.DataFrame(columns=["ds", *self.key_names, *qcols])
+        k = int(sidx.size)
+        yq = fns.forecast_quantiles(
+            params, day_all, jnp.float32(self.day1), self.config,
+            quantiles, key, **fc_kwargs,
+        )  # (bucket, Q, T_all)
+        if not include_history:
+            day_all = day_all[-horizon:]
+            yq = yq[:, :, -horizon:]
+        yq = np.asarray(yq)[:k]
+        frame = self._frame_skeleton(sidx, day_all)
+        for qi, col in enumerate(qcols):
+            frame[col] = yq[:, qi, :].reshape(-1)
         return pd.DataFrame(frame)

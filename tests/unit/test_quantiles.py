@@ -1,0 +1,114 @@
+"""Quantile forecasts (M5-uncertainty-style probabilistic output) and the
+pinball metric.  The analytic path prices any level from the closed-form
+predictive sd; monotone data-space transforms preserve quantiles exactly.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_forecasting_tpu.engine import fit_forecast
+from distributed_forecasting_tpu.models import prophet_glm
+from distributed_forecasting_tpu.models.prophet_glm import CurveModelConfig
+from distributed_forecasting_tpu.ops import metrics as M
+
+LEVELS = (0.05, 0.25, 0.5, 0.75, 0.95)
+
+
+def _fit(batch_small, mode, samples=0):
+    cfg = CurveModelConfig(seasonality_mode=mode, uncertainty_samples=samples)
+    params, res = fit_forecast(batch_small, model="prophet", config=cfg,
+                               horizon=60)
+    day_all = res.day_all
+    t_end = jnp.float32(batch_small.day[-1])
+    return cfg, params, day_all, t_end, res
+
+
+@pytest.mark.parametrize("mode", ["additive", "multiplicative"])
+def test_quantiles_monotone_and_median_matches_point(batch_small, mode):
+    cfg, params, day_all, t_end, res = _fit(batch_small, mode)
+    yq = np.asarray(
+        prophet_glm.forecast_quantiles(params, day_all, t_end, cfg, LEVELS)
+    )
+    S = batch_small.n_series
+    assert yq.shape == (S, len(LEVELS), day_all.shape[0])
+    # non-decreasing along the quantile axis
+    assert (np.diff(yq, axis=1) >= -1e-5).all()
+    # the q=0.5 path IS the point forecast (symmetric fit-space predictive,
+    # monotone transform)
+    np.testing.assert_allclose(yq[:, 2], np.asarray(res.yhat), rtol=1e-5,
+                               atol=1e-5)
+    # the outer levels bracket the 90% of a calibrated interval config
+    cfg90 = CurveModelConfig(seasonality_mode=mode, interval_width=0.9)
+    _, lo, hi = prophet_glm.forecast(params, day_all, t_end, cfg90)
+    np.testing.assert_allclose(yq[:, 0], np.asarray(lo), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(yq[:, 4], np.asarray(hi), rtol=1e-5, atol=1e-5)
+
+
+def test_quantiles_mc_path(batch_small):
+    cfg, params, day_all, t_end, _ = _fit(batch_small, "additive", samples=300)
+    yq = np.asarray(
+        prophet_glm.forecast_quantiles(
+            params, day_all, t_end, cfg, (0.1, 0.9), key=jax.random.PRNGKey(1)
+        )
+    )
+    assert (yq[:, 1] >= yq[:, 0]).all()
+    # MC quantiles approximate the analytic band (same process)
+    cfg80 = CurveModelConfig(seasonality_mode="additive", interval_width=0.8)
+    _, lo, hi = prophet_glm.forecast(params, day_all, t_end, cfg80)
+    T_fit = batch_small.n_time
+    width_mc = (yq[:, 1] - yq[:, 0])[:, :T_fit].mean()
+    width_an = np.asarray(hi - lo)[:, :T_fit].mean()
+    assert 0.7 < width_mc / width_an < 1.3
+
+
+def test_quantile_validation():
+    with pytest.raises(ValueError, match="quantiles"):
+        prophet_glm.forecast_quantiles(
+            None, None, None, CurveModelConfig(), (0.0, 0.5)
+        )
+
+
+def test_pinball_metric_prefers_true_quantile():
+    rng = np.random.default_rng(0)
+    y = jnp.asarray(rng.normal(10.0, 2.0, size=(3, 4000)).astype(np.float32))
+    mask = jnp.ones_like(y)
+    q = 0.9
+    true_q = 10.0 + 2.0 * 1.2816  # N(10,2) 90th percentile
+    loss_true = float(M.pinball(y, jnp.full_like(y, true_q), mask, q).mean())
+    for wrong in (true_q - 1.5, true_q + 1.5):
+        loss_wrong = float(
+            M.pinball(y, jnp.full_like(y, wrong), mask, q).mean()
+        )
+        assert loss_true < loss_wrong
+
+
+def test_serving_predict_quantiles(batch_small):
+    from distributed_forecasting_tpu.serving import BatchForecaster
+
+    cfg, params, day_all, t_end, _ = _fit(batch_small, "multiplicative")
+    fc = BatchForecaster.from_fit(batch_small, params, "prophet", cfg)
+    req = batch_small.key_frame().head(2)
+    out = fc.predict_quantiles(req, quantiles=(0.1, 0.5, 0.9), horizon=30)
+    assert list(out.columns) == ["ds", "store", "item", "q0.1", "q0.5", "q0.9"]
+    assert len(out) == 2 * 30
+    assert (out["q0.1"] <= out["q0.5"]).all()
+    assert (out["q0.5"] <= out["q0.9"]).all()
+    # point predict's yhat equals the served median
+    point = fc.predict(req, horizon=30)
+    np.testing.assert_allclose(out["q0.5"], point["yhat"], rtol=1e-5)
+
+    # non-curve families refuse instead of silently approximating
+    from distributed_forecasting_tpu.models.holt_winters import (  # noqa: F401
+        HoltWintersConfig,
+    )
+
+    hw_params, _ = fit_forecast(batch_small, model="holt_winters", horizon=30)
+    fc_hw = BatchForecaster.from_fit(
+        batch_small, hw_params, "holt_winters",
+        __import__("distributed_forecasting_tpu.models.base",
+                   fromlist=["get_model"]).get_model("holt_winters").config_cls(),
+    )
+    with pytest.raises(ValueError, match="quantile"):
+        fc_hw.predict_quantiles(req, horizon=30)
