@@ -1,7 +1,8 @@
 from distributed_forecasting_tpu.monitoring.monitor import (
     MonitorConfig,
     MonitorRegistry,
+    detect_anomalies,
     run_monitor,
 )
 
-__all__ = ["MonitorConfig", "MonitorRegistry", "run_monitor"]
+__all__ = ["MonitorConfig", "MonitorRegistry", "detect_anomalies", "run_monitor"]

@@ -10,6 +10,8 @@ Conf::
       table: hackathon.sales.finegrain_forecasts
       granularities: ["1 day", "1 week"]
       slicing_cols: [store, item]
+      anomalies: true           # also score residual z-anomalies against
+      interval_width: 0.95      # the model's own band -> <table>_anomalies
 """
 
 from __future__ import annotations
@@ -17,6 +19,7 @@ from __future__ import annotations
 from distributed_forecasting_tpu.monitoring import (
     MonitorConfig,
     MonitorRegistry,
+    detect_anomalies,
     run_monitor,
 )
 from distributed_forecasting_tpu.tasks.common import Task
@@ -33,7 +36,9 @@ class MonitorTask(Task):
         )
         registry = MonitorRegistry(self._paths["warehouse"])
         registry.create_monitor(config)
-        profile = run_monitor(self.catalog, config)
+        # one read shared by the profile and anomaly passes
+        table_df = self.catalog.read_table(config.table)
+        profile = run_monitor(self.catalog, config, df=table_df)
         self.logger.info(
             "monitor %s: %d profile rows -> %s_profile_metrics",
             config.name, len(profile), config.table,
@@ -41,11 +46,24 @@ class MonitorTask(Task):
         overall = profile[
             (profile.slice_key == ":all") & (profile.granularity == "1 day")
         ]
-        return {
+        summary = {
             "monitor": config.name,
             "rows": len(profile),
             "daily_mape_mean": float(overall.mape.mean()),
         }
+        if mc.get("anomalies", False):
+            scored = detect_anomalies(
+                self.catalog, config.table,
+                interval_width=float(mc.get("interval_width", 0.95)),
+                df=table_df,
+            )
+            n_flag = int(scored.is_anomaly.sum())
+            self.logger.info(
+                "anomaly scan: %d/%d labeled rows flagged -> %s_anomalies",
+                n_flag, len(scored), config.table,
+            )
+            summary["n_anomalies"] = n_flag
+        return summary
 
 
 def entrypoint():

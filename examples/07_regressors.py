@@ -64,3 +64,9 @@ if __name__ == "__main__":
     req = batch.key_frame().head(3)
     out = fc.predict(req, horizon=HORIZON, xreg=xreg)
     print(out.head(3).to_string(index=False))
+
+    # probabilistic output: one column per quantile level, same request
+    qout = fc.predict_quantiles(
+        req, quantiles=(0.1, 0.5, 0.9), horizon=HORIZON, xreg=xreg
+    )
+    print(qout.head(3).to_string(index=False))

@@ -111,3 +111,68 @@ def test_phase_timer():
     m = t.metrics()
     assert m["phase_a_seconds"] >= 0.01
     assert set(m) == {"phase_a_seconds", "phase_b_seconds"}
+
+
+def test_detect_anomalies_flags_band_violations(catalog):
+    """Residual z-scores against the model's own band: injected spikes are
+    flagged, calibrated noise mostly is not; thresholds normalize across
+    series scale and lead-time band width."""
+    import numpy as np
+    import pandas as pd
+
+    from distributed_forecasting_tpu.monitoring import detect_anomalies
+
+    rng = np.random.default_rng(0)
+    n = 400
+    ds = pd.date_range("2024-01-01", periods=n)
+    rows = []
+    for store, scale in ((1, 1.0), (2, 50.0)):
+        yhat = 10.0 * scale + np.zeros(n)
+        sigma = 1.0 * scale
+        y = yhat + rng.normal(0, sigma, n)
+        y[100] = yhat[100] + 8 * sigma  # injected incident
+        y[200] = yhat[200] - 8 * sigma
+        rows.append(pd.DataFrame({
+            "ds": ds, "store": store, "item": 1, "y": y, "yhat": yhat,
+            "yhat_lower": yhat - 1.96 * sigma, "yhat_upper": yhat + 1.96 * sigma,
+        }))
+    catalog.save_table("hackathon.sales.fc", pd.concat(rows, ignore_index=True))
+
+    scored = detect_anomalies(catalog, "hackathon.sales.fc")
+    assert {"anomaly_score", "is_anomaly"} <= set(scored.columns)
+    # both injected spikes found in BOTH scales (z-normalization works)
+    for store in (1, 2):
+        sub = scored[scored.store == store]
+        flagged_days = set(sub[sub.is_anomaly].ds.dt.dayofyear)
+        assert {ds[100].dayofyear, ds[200].dayofyear} <= flagged_days
+    # calibrated noise: ~5% false-positive rate at the default threshold
+    assert scored.is_anomaly.mean() < 0.12
+    # flagged subset persisted
+    out = catalog.read_table("hackathon.sales.fc_anomalies")
+    assert len(out) == int(scored.is_anomaly.sum())
+    # scores of the spikes dominate
+    assert scored.nlargest(4, "anomaly_score").anomaly_score.min() > 5.0
+
+
+def test_monitor_task_with_anomalies(tmp_path):
+    import numpy as np
+
+    from distributed_forecasting_tpu.tasks import IngestTask, MonitorTask, TrainTask
+
+    env = {"env": {"warehouse": str(tmp_path / "wh"),
+                   "tracking": str(tmp_path / "ml"),
+                   "registry": str(tmp_path / "reg")}}
+    IngestTask(init_conf={**env, "input": {"synthetic": {
+        "n_stores": 1, "n_items": 2, "n_days": 800, "seed": 5}},
+        "output": {"table": "hackathon.sales.raw"}}).launch()
+    TrainTask(init_conf={**env,
+        "input": {"table": "hackathon.sales.raw"},
+        "output": {"table": "hackathon.sales.fc"},
+        "training": {"model": "prophet", "horizon": 30,
+                     "run_cross_validation": False}}).launch()
+    task = MonitorTask(init_conf={**env, "monitor": {
+        "name": "m", "table": "hackathon.sales.fc", "anomalies": True}})
+    res = task.launch()
+    assert "n_anomalies" in res
+    assert res["n_anomalies"] >= 0
+    assert task.catalog.read_table("hackathon.sales.fc_anomalies") is not None
