@@ -6,7 +6,7 @@ from distributed_forecasting_tpu.engine.fit import (
     forecast_frame,
     seasonal_naive,
 )
-from distributed_forecasting_tpu.engine.cv import CVConfig, cross_validate
+from distributed_forecasting_tpu.engine.cv import CVConfig, cross_validate, cv_forecast_frame
 from distributed_forecasting_tpu.engine.hyper import (
     HyperSearchConfig,
     TuneResult,
@@ -33,4 +33,5 @@ __all__ = [
     "seasonal_naive",
     "CVConfig",
     "cross_validate",
+    "cv_forecast_frame",
 ]

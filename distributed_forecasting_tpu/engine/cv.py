@@ -71,12 +71,24 @@ def cv_windows(mask, day, cuts, horizon):
     return train_masks, eval_masks, t_ends
 
 
-@partial(jax.jit, static_argnames=("model", "config", "cuts", "horizon"))
-def _cv_impl(y, mask, day, key, model, config, cuts, horizon, xreg=None):
-    """Whole CV pass as ONE compiled program: mask construction, every
-    cutoff's fit+forecast (cutoffs vmapped), metric reductions.  No host
-    round trips inside — device scalar pulls cost tens of ms on
-    remote-attached TPUs (see engine/fit._fit_forecast_impl).
+def _cv_entry(batch, model, config, key, xreg, what):
+    """Shared host-side preamble for every CV entry point: config/key
+    defaulting + the history-trimming xreg contract, in one place so
+    cross_validate and cv_forecast_frame cannot drift."""
+    fns = get_model(model)
+    config = config if config is not None else fns.config_cls()
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    from distributed_forecasting_tpu.engine.fit import validate_xreg
+
+    xreg = validate_xreg(fns, model, config, xreg, None, what,
+                         trim_to=batch.n_time)
+    return config, key, xreg
+
+
+def _cv_paths(y, mask, day, key, model, config, cuts, horizon, xreg):
+    """Shared trace body: every cutoff's fit+forecast (cutoffs vmapped).
+    Returns (yhat, lo, hi, eval_masks) each (C, S, T).
 
     ``xreg``: regressor values over the HISTORY grid — (T, R) or (S, T, R);
     CV never forecasts past the history end, so no future values needed.
@@ -99,9 +111,73 @@ def _cv_impl(y, mask, day, key, model, config, cuts, horizon, xreg=None):
         return fns.forecast(params, day, t_end, config, k)
 
     yhat, lo, hi = jax.vmap(one_cutoff)(train_masks, t_ends, keys)  # (C, S, T)
+    return yhat, lo, hi, eval_masks
+
+
+@partial(jax.jit, static_argnames=("model", "config", "cuts", "horizon"))
+def _cv_impl(y, mask, day, key, model, config, cuts, horizon, xreg=None):
+    """Whole CV pass as ONE compiled program: mask construction, every
+    cutoff's fit+forecast, metric reductions.  No host round trips inside
+    — device scalar pulls cost tens of ms on remote-attached TPUs (see
+    engine/fit._fit_forecast_impl)."""
+    yhat, lo, hi, eval_masks = _cv_paths(
+        y, mask, day, key, model, config, cuts, horizon, xreg
+    )
     y_b = jnp.broadcast_to(y[None], yhat.shape)
     per_cut = metrics_ops.compute_all(y_b, yhat, eval_masks, lo=lo, hi=hi)
     return {name: jnp.mean(v, axis=0) for name, v in per_cut.items()}  # (S,)
+
+
+@partial(jax.jit, static_argnames=("model", "config", "cuts", "horizon"))
+def _cv_paths_impl(y, mask, day, key, model, config, cuts, horizon, xreg=None):
+    """Jitted wrapper over the shared trace body — the raw material of the
+    Prophet-diagnostics-style frame below."""
+    return _cv_paths(y, mask, day, key, model, config, cuts, horizon, xreg)
+
+
+def cv_forecast_frame(
+    batch: SeriesBatch,
+    model: str = "prophet",
+    config=None,
+    cv: CVConfig = CVConfig(),
+    key: Optional[jax.Array] = None,
+    xreg=None,
+):
+    """Raw rolling-origin forecasts as a long frame — the shape Prophet's
+    ``diagnostics.cross_validation`` returns (one row per series per cutoff
+    per scored day: ``[ds, *keys, cutoff, y, yhat, yhat_lower,
+    yhat_upper]``), for residual plots and custom window metrics beyond the
+    per-series means :func:`cross_validate` reports.
+
+    Diagnostics-scale tool: materializes (C, S, T) paths on host — fine at
+    hundreds-of-series scale, not meant for the 50k regime.
+    """
+    import pandas as pd
+
+    config, key, xreg = _cv_entry(batch, model, config, key, xreg,
+                                  "cv_forecast_frame")
+    cuts = cutoff_indices(batch.n_time, cv)
+    yhat, lo, hi, eval_masks = _cv_paths_impl(
+        batch.y, batch.mask, batch.day, key,
+        model=model, config=config, cuts=tuple(cuts), horizon=cv.horizon,
+        xreg=xreg,
+    )
+    import numpy as np
+
+    em = np.asarray(eval_masks) > 0  # (C, S, T)
+    ci, si, ti = np.nonzero(em)
+    dates = batch.dates()
+    y_np = np.asarray(batch.y)
+    frame = {"ds": dates.values[ti]}
+    keys_np = np.asarray(batch.keys)
+    for j, name in enumerate(batch.key_names):
+        frame[name] = keys_np[si, j]
+    frame["cutoff"] = dates.values[np.asarray(cuts)[ci]]
+    frame["y"] = y_np[si, ti]
+    frame["yhat"] = np.asarray(yhat)[ci, si, ti]
+    frame["yhat_lower"] = np.asarray(lo)[ci, si, ti]
+    frame["yhat_upper"] = np.asarray(hi)[ci, si, ti]
+    return pd.DataFrame(frame)
 
 
 def cross_validate(
@@ -124,14 +200,8 @@ def cross_validate(
     Returns the dict plus ``"n_cutoffs"`` (python int) under key
     ``"_n_cutoffs"`` for logging parity.
     """
-    fns = get_model(model)
-    config = config if config is not None else fns.config_cls()
-    if key is None:
-        key = jax.random.PRNGKey(0)
-    from distributed_forecasting_tpu.engine.fit import validate_xreg
-
-    xreg = validate_xreg(fns, model, config, xreg, None, "cross_validate",
-                         trim_to=batch.n_time)
+    config, key, xreg = _cv_entry(batch, model, config, key, xreg,
+                                  "cross_validate")
     cuts = cutoff_indices(batch.n_time, cv)
     out = dict(
         _cv_impl(

@@ -73,7 +73,7 @@ def _log_uniform(key, lo, hi, n):
 
 
 def _cv_scores(batch: SeriesBatch, config: CurveModelConfig, cv: CVConfig,
-               cp_scales, seas_scales, hol_scales, metric: str):
+               cp_scales, seas_scales, hol_scales, metric: str, xreg=None):
     """CV-mean metric for every (trial, series).  Returns (C_trials, S)."""
     cuts = cutoff_indices(batch.n_time, cv)
     train_masks, eval_masks, t_ends = cv_windows(
@@ -85,10 +85,11 @@ def _cv_scores(batch: SeriesBatch, config: CurveModelConfig, cv: CVConfig,
         def one_cutoff(train_mask, eval_mask, t_end):
             params = prophet_glm.fit(
                 batch.y, train_mask, batch.day, config,
-                prior_scales=(cp, seas, hol),
+                prior_scales=(cp, seas, hol), xreg=xreg,
             )
             yhat, _, _ = prophet_glm.forecast(
-                params, batch.day, t_end, config, jax.random.PRNGKey(0)
+                params, batch.day, t_end, config, jax.random.PRNGKey(0),
+                xreg=xreg,
             )
             return fn(batch.y, yhat, eval_mask)
 
@@ -104,14 +105,18 @@ def tune_curve_model(
     base_config: Optional[CurveModelConfig] = None,
     search: HyperSearchConfig = HyperSearchConfig(),
     cv: CVConfig = CVConfig(),
+    xreg=None,
 ) -> TuneResult:
+    """``xreg``: history-grid regressor values (longer fit_forecast-style
+    tensors trimmed) when ``base_config.n_regressors > 0`` — the sweep holds
+    the covariates fixed and tunes the prior scales around them; the refit
+    uses them too, so ``TuneResult.mode_params`` serve with the same xreg."""
     base_config = base_config or CurveModelConfig()
-    if base_config.n_regressors:
-        raise ValueError(
-            "hyperparameter search does not support exogenous regressors "
-            "yet — tune prior scales without regressors, then fit the tuned "
-            "config with n_regressors/xreg set"
-        )
+    from distributed_forecasting_tpu.engine.fit import validate_xreg
+    from distributed_forecasting_tpu.models.base import get_model
+
+    xreg = validate_xreg(get_model("prophet"), "prophet", base_config, xreg,
+                         None, "tune_curve_model", trim_to=batch.n_time)
     key = jax.random.PRNGKey(search.seed)
     k_cp, k_seas, k_hol = jax.random.split(key, 3)
     cp_scales = _log_uniform(k_cp, *search.cp_scale_range, search.n_trials)
@@ -124,7 +129,7 @@ def tune_curve_model(
     for mode in search.modes:
         cfg = dataclasses.replace(base_config, seasonality_mode=mode)
         scores = _cv_scores(batch, cfg, cv, cp_scales, seas_scales, hol_scales,
-                            search.metric)
+                            search.metric, xreg=xreg)
         all_scores.append(np.asarray(scores))
         for t in range(search.n_trials):
             trial_rows.append(
@@ -160,6 +165,7 @@ def tune_curve_model(
             batch.y, batch.mask, batch.day, cfg,
             prior_scales=(jnp.asarray(best_cp), jnp.asarray(best_seas),
                           jnp.asarray(best_hol)),
+            xreg=xreg,
         )
 
     # primary params: majority mode (used where a single CurveParams is needed)

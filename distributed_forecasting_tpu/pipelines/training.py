@@ -87,8 +87,9 @@ class TrainingPipeline:
             if model == "auto" or (tuning and tuning.get("enabled")):
                 raise ValueError(
                     "training.regressors is not supported together with "
-                    "model='auto' or tuning.enabled — fit the curve model "
-                    "directly with regressors"
+                    "model='auto' or tuning.enabled in the pipeline — fit "
+                    "the curve model directly with regressors, or tune via "
+                    "engine.tune_curve_model(..., xreg=...)"
                 )
             if not get_model(model).supports_xreg:
                 raise ValueError(
