@@ -23,6 +23,7 @@ import pandas as pd
 from distributed_forecasting_tpu.serving.predictor import (
     BatchForecaster,
     UnknownSeriesError,
+    quantile_columns,
 )
 
 _META_FILE = "buckets.json"
@@ -83,29 +84,23 @@ class BucketedForecaster:
         ])
 
     # -- inference ----------------------------------------------------------
-    def predict(
-        self,
-        request: pd.DataFrame,
-        horizon: int = 90,
-        include_history: bool = False,
-        key: Optional[jax.Array] = None,
-        on_missing: str = "raise",
-        xreg=None,
-    ) -> pd.DataFrame:
-        """One batched predict per bucket present in the request.
+    def _route_request(self, request: pd.DataFrame, on_missing: str, xreg):
+        """Shared routing prologue for predict/predict_quantiles: validate
+        the request and xreg shape, map keys to buckets.  Returns
+        ``{bucket_index: [key tuples]}``.
 
         ``xreg``: a SHARED (T, R) regressor calendar over the union grid
         ``min(bucket day0) .. day1 + horizon`` when the buckets were fit
-        with ``n_regressors > 0``; each bucket slices its own (trimmed-grid)
-        window out of it.  Per-series regressor tensors are not routable
-        here (buckets partition the key space with no global row order) —
-        serve those through the per-bucket ``BatchForecaster`` directly.
+        with ``n_regressors > 0``.  Per-series regressor tensors are not
+        routable here (buckets partition the key space with no global row
+        order) — serve those through the per-bucket ``BatchForecaster``
+        directly.
         """
         if xreg is not None and np.asarray(xreg).ndim != 2:
             raise ValueError(
-                "BucketedForecaster.predict accepts only a shared (T, R) "
-                "xreg calendar; for per-series regressors predict through "
-                "the per-bucket BatchForecaster objects"
+                "BucketedForecaster accepts only a shared (T, R) xreg "
+                "calendar; for per-series regressors predict through the "
+                "per-bucket BatchForecaster objects"
             )
         if on_missing not in ("raise", "skip"):
             # same guard as BatchForecaster.series_indices: a typo like
@@ -130,31 +125,76 @@ class BucketedForecaster:
             j = self._route.get(k)
             if j is not None:
                 per_bucket.setdefault(j, []).append(k)
-        d0_union = min(fc.day0 for fc in self.forecasters)
+        return per_bucket
+
+    def _bucket_xreg(self, fc: BatchForecaster, xreg, horizon: int):
+        """Slice the union-grid calendar down to one bucket's window."""
+        if xreg is None:
+            return None
+        d0_union = min(f.day0 for f in self.forecasters)
+        xr = jnp.asarray(xreg, jnp.float32)
+        T_need = fc.day1 + horizon - d0_union + 1
+        # exact length required: a longer calendar would be sliced from the
+        # wrong origin and silently serve time-shifted covariates
+        if xr.shape[0] != T_need:
+            raise ValueError(
+                f"xreg covers {xr.shape[0]} days, expected exactly the "
+                f"union grid of {T_need} days "
+                f"(min bucket day0 .. last day + horizon)"
+            )
+        return xr[fc.day0 - d0_union: fc.day1 + horizon - d0_union + 1]
+
+    def predict(
+        self,
+        request: pd.DataFrame,
+        horizon: int = 90,
+        include_history: bool = False,
+        key: Optional[jax.Array] = None,
+        on_missing: str = "raise",
+        xreg=None,
+    ) -> pd.DataFrame:
+        """One batched predict per bucket present in the request (see
+        ``_route_request`` for the xreg calendar contract)."""
+        per_bucket = self._route_request(request, on_missing, xreg)
+        names = list(self.key_names)
         parts = []
         for j in sorted(per_bucket):
             fc = self.forecasters[j]
-            xr = None
-            if xreg is not None:
-                xr = jnp.asarray(xreg, jnp.float32)
-                T_need = fc.day1 + horizon - d0_union + 1
-                # exact length required: a longer calendar would be sliced
-                # from the wrong origin and silently serve time-shifted
-                # covariates
-                if xr.shape[0] != T_need:
-                    raise ValueError(
-                        f"xreg covers {xr.shape[0]} days, expected exactly "
-                        f"the union grid of {T_need} days "
-                        f"(min bucket day0 .. last day + horizon)"
-                    )
-                xr = xr[fc.day0 - d0_union: fc.day1 + horizon - d0_union + 1]
             sub_req = pd.DataFrame(per_bucket[j], columns=names)
             parts.append(fc.predict(
                 sub_req, horizon=horizon, include_history=include_history,
-                key=key, xreg=xr,
+                key=key, xreg=self._bucket_xreg(fc, xreg, horizon),
             ))
         if not parts:
             return pd.DataFrame(
                 columns=["ds", *names, "yhat", "yhat_upper", "yhat_lower"]
             )
+        return pd.concat(parts, ignore_index=True)
+
+    def predict_quantiles(
+        self,
+        request: pd.DataFrame,
+        quantiles=(0.1, 0.5, 0.9),
+        horizon: int = 90,
+        include_history: bool = False,
+        key: Optional[jax.Array] = None,
+        on_missing: str = "raise",
+        xreg=None,
+    ) -> pd.DataFrame:
+        """Per-bucket quantile forwarding (same routing and xreg contract
+        as ``predict``)."""
+        per_bucket = self._route_request(request, on_missing, xreg)
+        names = list(self.key_names)
+        parts = []
+        for j in sorted(per_bucket):
+            fc = self.forecasters[j]
+            sub_req = pd.DataFrame(per_bucket[j], columns=names)
+            parts.append(fc.predict_quantiles(
+                sub_req, quantiles=quantiles, horizon=horizon,
+                include_history=include_history, key=key,
+                xreg=self._bucket_xreg(fc, xreg, horizon),
+            ))
+        qcols = quantile_columns(quantiles)
+        if not parts:
+            return pd.DataFrame(columns=["ds", *names, *qcols])
         return pd.concat(parts, ignore_index=True)

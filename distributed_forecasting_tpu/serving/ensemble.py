@@ -18,7 +18,10 @@ import jax
 import numpy as np
 import pandas as pd
 
-from distributed_forecasting_tpu.serving.predictor import BatchForecaster
+from distributed_forecasting_tpu.serving.predictor import (
+    BatchForecaster,
+    quantile_columns,
+)
 
 _META_FILE = "ensemble.json"
 
@@ -135,6 +138,49 @@ class MultiModelForecaster:
                 kw["xreg"] = xreg
             out = self.forecasters[name].predict(
                 req, horizon=horizon, include_history=include_history, key=key,
+                **kw,
+            )
+            out["model"] = name
+            parts.append(out)
+        return pd.concat(parts, ignore_index=True)
+
+    def predict_quantiles(
+        self,
+        request: pd.DataFrame,
+        quantiles=(0.1, 0.5, 0.9),
+        horizon: int = 90,
+        include_history: bool = False,
+        key: Optional[jax.Array] = None,
+        on_missing: str = "raise",
+        xreg=None,
+    ) -> pd.DataFrame:
+        """Per-family quantile forwarding; every series' winning family must
+        provide a quantile implementation (else a clear error names it)."""
+        from distributed_forecasting_tpu.models.base import get_model
+
+        first = self.forecasters[self.models[0]]
+        sidx = first.series_indices(request, on_missing=on_missing)
+        qcols = quantile_columns(quantiles)
+        if sidx.size == 0:
+            return pd.DataFrame(
+                columns=["ds", *self.key_names, *qcols, "model"]
+            )
+        parts = []
+        for j, name in enumerate(self.models):
+            sub = sidx[self.assignment[sidx] == j]
+            if sub.size == 0:
+                continue
+            fns = get_model(name)
+            if fns.forecast_quantiles is None:
+                raise ValueError(
+                    f"requested series are assigned to family {name!r}, "
+                    f"which has no quantile forecast implementation"
+                )
+            req = pd.DataFrame(self.keys[sub], columns=list(self.key_names))
+            kw = {"xreg": xreg} if (xreg is not None and fns.supports_xreg) else {}
+            out = self.forecasters[name].predict_quantiles(
+                req, quantiles=quantiles, horizon=horizon,
+                include_history=include_history, key=key, on_missing=on_missing,
                 **kw,
             )
             out["model"] = name

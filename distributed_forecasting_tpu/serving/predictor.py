@@ -71,6 +71,16 @@ class UnknownSeriesError(KeyError):
     pass
 
 
+def quantile_columns(quantiles) -> list:
+    """Column names for quantile result frames (``q0.1``, ``q0.5``, ...).
+
+    Single source of the naming rule: BatchForecaster emits these and the
+    composite forecasters (bucketed/ensemble) must build matching empty
+    frames for on_missing='skip' requests.
+    """
+    return [f"q{float(q):g}" for q in quantiles]
+
+
 class BatchForecaster:
     """Loads once, predicts every requested series in one compiled call."""
 
@@ -341,7 +351,7 @@ class BatchForecaster:
         sidx, params, day_all, fc_kwargs = self._prepare_request(
             request, horizon, on_missing, xreg
         )
-        qcols = [f"q{q:g}" for q in quantiles]
+        qcols = quantile_columns(quantiles)
         if sidx.size == 0:
             return pd.DataFrame(columns=["ds", *self.key_names, *qcols])
         k = int(sidx.size)

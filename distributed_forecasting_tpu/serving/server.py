@@ -48,6 +48,7 @@ from distributed_forecasting_tpu.utils import get_logger
 _ENSEMBLE_META = "ensemble.json"
 _BUCKETS_META = "buckets.json"
 _MAX_HORIZON = 3650  # 10 years daily — beyond any sane scoring request
+_MAX_QUANTILES = 32  # more levels than any scorer needs; bounds compile count
 
 
 def load_forecaster(artifact_dir: str):
@@ -150,13 +151,55 @@ class _Handler(BaseHTTPRequestHandler):
                 # (S_trained, T_all, R) per-series — shape/length checks
                 # live in BatchForecaster.predict
                 xreg = np.asarray(xreg, dtype=np.float32)
-            out = self.server.forecaster.predict(
-                frame,
-                horizon=horizon,
-                include_history=bool(req.get("include_history", False)),
-                on_missing=req.get("on_missing", "raise"),
-                xreg=xreg,
-            )
+            quantiles = req.get("quantiles")
+            if quantiles is not None:
+                # probabilistic scoring: {"quantiles": [0.1, 0.5, 0.9]}
+                # returns q<level> columns instead of yhat/bounds
+                if (
+                    not isinstance(quantiles, list)
+                    or not quantiles
+                    or len(quantiles) > _MAX_QUANTILES
+                    or not all(
+                        isinstance(q, (int, float)) and 0.0 < q < 1.0
+                        for q in quantiles
+                    )
+                ):
+                    self._send(
+                        400,
+                        {"error": "quantiles must be a non-empty list of "
+                                  f"at most {_MAX_QUANTILES} levels in (0, 1)"},
+                    )
+                    return
+                # canonicalize to 3 decimals: levels are a STATIC jit arg,
+                # so every distinct tuple compiles — rounding bounds the
+                # compile-cache growth a hostile/naive client could force
+                # (same DoS class _MAX_HORIZON guards)
+                quantiles = tuple(
+                    sorted({round(float(q), 3) for q in quantiles})
+                )
+                if not all(0.0 < q < 1.0 for q in quantiles):
+                    self._send(
+                        400,
+                        {"error": "quantile levels round to the open "
+                                  "interval (0.001, 0.999)"},
+                    )
+                    return
+                out = self.server.forecaster.predict_quantiles(
+                    frame,
+                    quantiles=quantiles,
+                    horizon=horizon,
+                    include_history=bool(req.get("include_history", False)),
+                    on_missing=req.get("on_missing", "raise"),
+                    xreg=xreg,
+                )
+            else:
+                out = self.server.forecaster.predict(
+                    frame,
+                    horizon=horizon,
+                    include_history=bool(req.get("include_history", False)),
+                    on_missing=req.get("on_missing", "raise"),
+                    xreg=xreg,
+                )
             out["ds"] = out["ds"].astype(str)
             keys = list(self.server.forecaster.key_names)
             n_series = int(out[keys].drop_duplicates().shape[0]) if len(out) else 0

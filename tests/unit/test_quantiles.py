@@ -112,3 +112,37 @@ def test_serving_predict_quantiles(batch_small):
     )
     with pytest.raises(ValueError, match="quantile"):
         fc_hw.predict_quantiles(req, horizon=30)
+
+
+def test_bucketed_and_ensemble_quantiles(batch_small):
+    """Quantile forwarding through both composite forecasters."""
+    import pandas as pd
+
+    from distributed_forecasting_tpu.engine import fit_forecast_bucketed
+    from distributed_forecasting_tpu.serving import (
+        BatchForecaster,
+        BucketedForecaster,
+        MultiModelForecaster,
+    )
+
+    cfg = CurveModelConfig(seasonality_mode="additive")
+    buckets, _ = fit_forecast_bucketed(
+        batch_small, model="prophet", config=cfg, horizon=30
+    )
+    bfc = BucketedForecaster.from_bucketed_fit(buckets, "prophet", cfg)
+    req = batch_small.key_frame().head(2)
+    out = bfc.predict_quantiles(req, quantiles=(0.2, 0.8), horizon=30)
+    assert list(out.columns) == ["ds", "store", "item", "q0.2", "q0.8"]
+    assert len(out) == 2 * 30
+    assert (out["q0.2"] <= out["q0.8"]).all()
+
+    params, _ = fit_forecast(batch_small, model="prophet", config=cfg,
+                             horizon=30)
+    fc = BatchForecaster.from_fit(batch_small, params, "prophet", cfg)
+    ens = MultiModelForecaster(
+        {"prophet": fc}, np.zeros(batch_small.n_series, np.int64)
+    )
+    out = ens.predict_quantiles(req, quantiles=(0.2, 0.8), horizon=30)
+    assert list(out.columns) == ["ds", "store", "item", "q0.2", "q0.8",
+                                 "model"]
+    assert (out.model == "prophet").all()

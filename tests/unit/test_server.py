@@ -199,3 +199,25 @@ def test_invocations_malformed_xreg_is_400(server):
                   {"inputs": [{"store": 1, "item": 1}], "horizon": 5,
                    "xreg": bad})
         assert e.value.code == 400
+
+
+def test_invocations_quantiles(server):
+    """{"quantiles": [...]} switches the scorer to probabilistic output."""
+    code, out = _call(
+        server, "/invocations",
+        {"inputs": [{"store": 1, "item": 2}], "horizon": 7,
+         "quantiles": [0.1, 0.5, 0.9]},
+    )
+    assert code == 200
+    preds = pd.DataFrame(out["predictions"])
+    assert {"q0.1", "q0.5", "q0.9"} <= set(preds.columns)
+    assert len(preds) == 7
+    assert (preds["q0.1"] <= preds["q0.9"]).all()
+
+    # malformed levels are 400s
+    for bad in ([], [0.0], [1.5], "0.5", list(np.linspace(0.01, 0.99, 50))):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _call(server, "/invocations",
+                  {"inputs": [{"store": 1, "item": 2}], "horizon": 7,
+                   "quantiles": bad})
+        assert e.value.code == 400
