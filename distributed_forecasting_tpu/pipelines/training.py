@@ -56,6 +56,28 @@ def _config_from_conf(model: str, model_conf: Optional[Dict[str, Any]]):
     )
 
 
+def _load_regressors(catalog, regressors: Dict[str, Any], batch, horizon: int,
+                     config):
+    """Conf-driven covariate loading shared by the plain and tuned training
+    paths: read the catalog table, tensorize onto the batch grid extended by
+    ``horizon``, stamp column count/names into the config.  Returns
+    ``(xreg, config)``."""
+    import dataclasses
+
+    from distributed_forecasting_tpu.data.tensorize import tensorize_regressors
+
+    cols = list(regressors["columns"])
+    reg_df = catalog.read_table(regressors["table"])
+    xreg = tensorize_regressors(
+        reg_df, batch, cols, horizon=horizon,
+        per_series=bool(regressors.get("per_series", False)),
+    )
+    config = dataclasses.replace(
+        config, n_regressors=len(cols), regressor_names=tuple(cols)
+    )
+    return xreg, config
+
+
 class TrainingPipeline:
     def __init__(self, catalog: DatasetCatalog, tracker: FileTracker):
         self.catalog = catalog
@@ -84,13 +106,16 @@ class TrainingPipeline:
         if regressors:
             from distributed_forecasting_tpu.models.base import get_model
 
-            if model == "auto" or (tuning and tuning.get("enabled")):
+            if model == "auto":
                 raise ValueError(
                     "training.regressors is not supported together with "
-                    "model='auto' or tuning.enabled in the pipeline — fit "
-                    "the curve model directly with regressors, or tune via "
-                    "engine.tune_curve_model(..., xreg=...)"
+                    "model='auto' — the non-curve families in the selection "
+                    "pool cannot use covariates; fit the curve model "
+                    "directly with regressors"
                 )
+            # unconditional: the tuned path is curve-only, but a conf naming
+            # a non-curve model with regressors must still fail loudly
+            # rather than silently training a different family
             if not get_model(model).supports_xreg:
                 raise ValueError(
                     f"model {model!r} does not accept exogenous regressors; "
@@ -104,7 +129,7 @@ class TrainingPipeline:
                 )
             return self._fine_grained_tuned(
                 source_table, output_table, model_conf, cv_conf, tuning,
-                experiment, horizon, key_cols,
+                experiment, horizon, key_cols, regressors=regressors,
             )
         if model == "auto":
             if bucketed:
@@ -129,22 +154,10 @@ class TrainingPipeline:
             # conf-driven covariates (Prophet add_regressor parity at the
             # task layer): a catalog table with date (+ key cols when
             # per_series) + the named columns, covering history AND horizon
-            import dataclasses as _dc
-
-            from distributed_forecasting_tpu.data.tensorize import (
-                tensorize_regressors,
-            )
-
-            cols = list(regressors["columns"])
             with timer.phase("tensorize_regressors"):
-                reg_df = self.catalog.read_table(regressors["table"])
-                xreg = tensorize_regressors(
-                    reg_df, batch, cols, horizon=horizon,
-                    per_series=bool(regressors.get("per_series", False)),
+                xreg, config = _load_regressors(
+                    self.catalog, regressors, batch, horizon, config
                 )
-            config = _dc.replace(
-                config, n_regressors=len(cols), regressor_names=tuple(cols)
-            )
         self.logger.info(
             "fine-grained fit: %d series x %d days, model=%s%s",
             batch.n_series, batch.n_time, model,
@@ -289,6 +302,7 @@ class TrainingPipeline:
         experiment: str,
         horizon: int,
         key_cols,
+        regressors: Optional[Dict[str, Any]] = None,
     ) -> Dict[str, Any]:
         """Per-series hyperparameter-tuned curve-model training (AutoML-path
         parity, ``notebooks/automl/22-09-26...py:107-178``): vectorized
@@ -309,6 +323,11 @@ class TrainingPipeline:
         df = self.catalog.read_table(source_table)
         batch = tensorize(df, key_cols=key_cols)
         base = CurveModelConfig(**(model_conf or {}))
+        xreg = None
+        if regressors:
+            xreg, base = _load_regressors(
+                self.catalog, regressors, batch, horizon, base
+            )
         search = HyperSearchConfig(
             n_trials=int(tuning.get("n_trials", 8)),
             metric=tuning.get("metric", "smape"),
@@ -317,7 +336,11 @@ class TrainingPipeline:
         cv = CVConfig(**(cv_conf or {}))
 
         t_start = time.time()
-        tuned = tune_curve_model(batch, base_config=base, search=search, cv=cv)
+        # tune sees the (trimmed) history xreg; the refit params carry the
+        # regressor coefficients so the serving artifact works with the
+        # same covariate table (inference.regressors conf)
+        tuned = tune_curve_model(batch, base_config=base, search=search,
+                                 cv=cv, xreg=xreg)
 
         # per-mode forecasts over history+horizon, combined by winning mode
         # (day grid built on device — no scalar pulls)
@@ -331,7 +354,8 @@ class TrainingPipeline:
         for mode, params in tuned.mode_params.items():
             cfg_m = _dc.replace(base, seasonality_mode=mode)
             outs[mode] = prophet_glm.forecast(
-                params, day_all, t_end, cfg_m, _jax.random.PRNGKey(0)
+                params, day_all, t_end, cfg_m, _jax.random.PRNGKey(0),
+                xreg=xreg,
             )
         # per-series winning-mode gather stays ON DEVICE: stack per-mode
         # outputs (M, S, T) and index with the (S,) mode-pick vector — only

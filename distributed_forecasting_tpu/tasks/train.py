@@ -22,6 +22,8 @@ Librarized equivalent of the reference's training notebook entry point
         table: hackathon.sales.promo_calendar   # model only): catalog table
         columns: [promo, price]     # with date (+ key cols if per_series)
         per_series: false           # covering history AND horizon days
+                                    # (composes with tuning.enabled; not
+                                    # with model=auto or path=allocated)
 """
 
 from __future__ import annotations

@@ -363,3 +363,55 @@ def test_regressor_conf_unsupported_combos(env_conf):
     with pytest.raises(ValueError, match="auto"):
         TrainTask(init_conf={**base, "training": {
             "model": "auto", "regressors": reg}}).launch()
+    # non-curve family stays rejected even with tuning enabled (the tuned
+    # path is curve-only; silently training prophet would be worse)
+    with pytest.raises(ValueError, match="does not accept"):
+        TrainTask(init_conf={**base, "training": {
+            "model": "holt_winters", "tuning": {"enabled": True},
+            "regressors": reg}}).launch()
+
+
+def test_train_task_tuned_with_regressors(env_conf):
+    """tuning.enabled + training.regressors: the sweep tunes prior scales
+    around the fixed covariates and the serving artifact carries them."""
+    import pandas as pd
+
+    IngestTask(init_conf={**env_conf, **_synth_conf()}).launch()
+    boot = CatalogTask(init_conf={**env_conf, "output": {
+        "catalog_name": "hackathon", "schema_name": "sales"}})
+    boot.launch()
+    raw = boot.catalog.read_table("hackathon.sales.raw")
+    dates = pd.DatetimeIndex(pd.to_datetime(raw["date"]).sort_values().unique())
+    horizon = 60
+    all_dates = dates.append(
+        pd.date_range(dates[-1] + pd.Timedelta(days=1), periods=horizon)
+    )
+    boot.catalog.save_table(
+        "hackathon.sales.promo_calendar",
+        pd.DataFrame({"date": all_dates,
+                      "promo": (np.arange(len(all_dates)) % 13 < 2).astype(float)}),
+    )
+    train = TrainTask(
+        init_conf={
+            **env_conf,
+            "input": {"table": "hackathon.sales.raw"},
+            "output": {"table": "hackathon.sales.finegrain_forecasts"},
+            "training": {
+                "model": "prophet",
+                "cv": {"initial": 400, "period": 180, "horizon": 60},
+                "horizon": horizon,
+                "tuning": {"enabled": True, "n_trials": 2},
+                "regressors": {"table": "hackathon.sales.promo_calendar",
+                               "columns": ["promo"]},
+            },
+        }
+    )
+    summary = train.launch()
+    assert summary["n_failed"] == 0
+    run = train.tracker.get_run(summary["experiment_id"], summary["run_id"])
+    # the artifact's config demands the covariates at serving time
+    from distributed_forecasting_tpu.serving import BatchForecaster
+
+    fc = BatchForecaster.load(run.artifact_path("forecaster"))
+    assert fc.config.n_regressors == 1
+    assert fc.params.reg_mu.shape[1] == 1
