@@ -26,7 +26,11 @@ from typing import Any, Dict, Optional
 
 from distributed_forecasting_tpu.data.catalog import DatasetCatalog
 from distributed_forecasting_tpu.tracking import FileTracker, ModelRegistry
-from distributed_forecasting_tpu.utils import get_logger, parse_conf_args
+from distributed_forecasting_tpu.utils import (
+    apply_platform_override,
+    get_logger,
+    parse_conf_args,
+)
 
 _DEFAULT_ROOT = "./dftpu_store"
 
@@ -40,6 +44,11 @@ class Task(ABC):
         registry: Optional[ModelRegistry] = None,
     ):
         self.logger = get_logger(self.__class__.__name__)
+        # DFTPU_PLATFORM=cpu escape hatch (degraded-accelerator operation):
+        # must run before any device access — see utils/platform.py
+        plat = apply_platform_override()
+        if plat:
+            self.logger.info("platform override: %s (DFTPU_PLATFORM)", plat)
         if init_conf is not None:
             self.conf = init_conf
         else:

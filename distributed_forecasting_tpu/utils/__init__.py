@@ -1,4 +1,5 @@
 from distributed_forecasting_tpu.utils.logging import get_logger
 from distributed_forecasting_tpu.utils.config import load_conf, parse_conf_args
+from distributed_forecasting_tpu.utils.platform import apply_platform_override
 
-__all__ = ["get_logger", "load_conf", "parse_conf_args"]
+__all__ = ["apply_platform_override", "get_logger", "load_conf", "parse_conf_args"]

@@ -1,0 +1,42 @@
+"""Platform override for task/CLI entry points.
+
+``DFTPU_PLATFORM=cpu`` forces the JAX backend through
+``jax.config.update("jax_platforms", ...)`` — the route that actually
+works in this environment.  The plain ``JAX_PLATFORMS`` env var is NOT
+sufficient when an ambient sitecustomize registers a remote-accelerator
+PJRT plugin with a patched ``get_backend``: that patch initializes its
+client regardless of the env filter, and a degraded remote tunnel then
+hangs every device access (observed 2026-07-30: ``JAX_PLATFORMS=cpu``
+blocked >60 s inside ``make_c_api_client`` while the config route ran
+instantly).  Call this BEFORE any ``jax.devices()``/array creation.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def apply_platform_override() -> str | None:
+    """Apply ``DFTPU_PLATFORM`` if set; returns the platform or None.
+
+    Safe to call repeatedly.  Raises if a DIFFERENT backend was already
+    initialized: the config update is silently ignored post-init (it is a
+    plain config value with no re-init hook), and logging a fake success
+    while the process stays on a hung accelerator would defeat the escape
+    hatch's purpose — callers must invoke this at process entry, before
+    any device access.
+    """
+    plat = os.environ.get("DFTPU_PLATFORM")
+    if not plat:
+        return None
+    import jax
+
+    jax.config.update("jax_platforms", plat)
+    actual = jax.default_backend()  # initializes the backend NOW if not yet
+    if actual != plat:
+        raise RuntimeError(
+            f"DFTPU_PLATFORM={plat!r} requested but the JAX backend was "
+            f"already initialized to {actual!r} — set the override before "
+            f"any jax.devices()/array use in this process"
+        )
+    return plat

@@ -415,3 +415,18 @@ def test_train_task_tuned_with_regressors(env_conf):
     fc = BatchForecaster.load(run.artifact_path("forecaster"))
     assert fc.config.n_regressors == 1
     assert fc.params.reg_mu.shape[1] == 1
+
+
+def test_platform_override(monkeypatch):
+    """DFTPU_PLATFORM routes through jax.config (the env-var route can be
+    bypassed by ambient PJRT plugin patches — see utils/platform.py)."""
+    from distributed_forecasting_tpu.utils import apply_platform_override
+
+    monkeypatch.delenv("DFTPU_PLATFORM", raising=False)
+    assert apply_platform_override() is None
+    # the suite already forces the cpu backend, so this is a no-op apply
+    monkeypatch.setenv("DFTPU_PLATFORM", "cpu")
+    assert apply_platform_override() == "cpu"
+    import jax
+
+    assert jax.default_backend() == "cpu"
