@@ -31,7 +31,7 @@ import pandas as pd
 
 from distributed_forecasting_tpu.data.catalog import DatasetCatalog
 
-_GRANULARITY_FREQ = {"1 day": "D", "1 week": "W", "1 month": "ME"}
+_GRANULARITY_FREQ = {"1 day": "D", "1 week": "W", "1 month": "M"}  # Period freqs
 
 
 @dataclasses.dataclass
@@ -95,25 +95,51 @@ class MonitorRegistry:
             os.remove(path)
 
 
-def _window_metrics(g: pd.DataFrame, cfg: MonitorConfig) -> Dict[str, float]:
-    y = g[cfg.label_col].to_numpy(dtype=float)
-    yhat = g[cfg.prediction_col].to_numpy(dtype=float)
+def _row_metrics(df: pd.DataFrame, cfg: MonitorConfig) -> pd.DataFrame:
+    """Per-row metric terms; every window/slice metric is then a plain
+    groupby mean over these (rmse via sqrt of the err2 mean), which turns
+    the profile computation into a handful of vectorized groupbys instead
+    of a Python loop over every slice value."""
+    y = df[cfg.label_col].to_numpy(dtype=float)
+    yhat = df[cfg.prediction_col].to_numpy(dtype=float)
     err = yhat - y
     denom = np.where(np.abs(y) > 1e-9, y, np.nan)
-    out = {
-        "n_obs": int(len(g)),
-        "mape": float(np.nanmean(np.abs(err / denom))),
-        "smape": float(
-            np.nanmean(np.abs(err) / np.maximum((np.abs(y) + np.abs(yhat)) / 2, 1e-9))
-        ),
-        "rmse": float(np.sqrt(np.mean(err**2))),
-        "bias": float(np.mean(err)),
-    }
+    out = pd.DataFrame(
+        {
+            "_ape": np.abs(err / denom),  # NaN rows skipped by mean()
+            "_sape": np.abs(err)
+            / np.maximum((np.abs(y) + np.abs(yhat)) / 2, 1e-9),
+            "_err2": err**2,
+            "_err": err,
+            # missing predictions must surface, not shrink the denominator:
+            # groupby mean skips NaN, so carry an indicator and NaN out
+            # rmse/bias for any window that contains one (the old np.mean
+            # semantics)
+            "_prednan": np.isnan(err).astype(float),
+        },
+        index=df.index,
+    )
     lo_c, hi_c = cfg.interval_cols
-    if lo_c in g.columns and hi_c in g.columns:
-        inside = (y >= g[lo_c].to_numpy(float)) & (y <= g[hi_c].to_numpy(float))
-        out["coverage"] = float(np.mean(inside))
+    if lo_c in df.columns and hi_c in df.columns:
+        out["_inside"] = (
+            (y >= df[lo_c].to_numpy(float)) & (y <= df[hi_c].to_numpy(float))
+        ).astype(float)
     return out
+
+
+def _grouped_metrics(terms: pd.DataFrame, keys: list) -> pd.DataFrame:
+    g = terms.groupby(keys, observed=True)  # dropna default: a NaN slice
+    # value never formed a group in the per-value loop this replaces
+    agg = g.mean()
+    agg["n_obs"] = g.size()
+    agg["rmse"] = np.sqrt(agg.pop("_err2"))
+    bad = agg.pop("_prednan") > 0
+    agg.loc[bad, ["rmse", "_err"]] = np.nan
+    agg = agg.rename(
+        columns={"_ape": "mape", "_sape": "smape", "_err": "bias",
+                 "_inside": "coverage"}
+    )
+    return agg.reset_index()
 
 
 def run_monitor(
@@ -136,35 +162,31 @@ def run_monitor(
         raise ValueError(f"no labeled rows in {config.table} to monitor")
     ts = pd.to_datetime(df[config.timestamp_col])
 
-    rows = []
+    terms = _row_metrics(df, config)
+    parts = []
     for gran in config.granularities:
         freq = _GRANULARITY_FREQ.get(gran)
         if freq is None:
             raise ValueError(
                 f"unknown granularity {gran!r}; valid: {sorted(_GRANULARITY_FREQ)}"
             )
-        window = ts.dt.to_period(freq).dt.start_time
-        slices = [(None, None)] + [
-            (c, v) for c in config.slicing_cols if c in df.columns
-            for v in df[c].unique()
-        ]
-        for col, val in slices:
-            sub = df if col is None else df[df[col] == val]
-            if sub.empty:
-                continue
-            wcol = window if col is None else window[sub.index]
-            for wstart, g in sub.groupby(wcol):
-                m = _window_metrics(g, config)
-                rows.append(
-                    {
-                        "window_start": wstart,
-                        "granularity": gran,
-                        "slice_key": col or ":all",
-                        "slice_value": str(val) if val is not None else ":all",
-                        **m,
-                    }
-                )
-    profile = pd.DataFrame(rows)
+        window = ts.dt.to_period(freq).dt.start_time.rename("window_start")
+        for col in [None, *[c for c in config.slicing_cols if c in df.columns]]:
+            keys = [window] if col is None else [df[col], window]
+            agg = _grouped_metrics(terms, keys)
+            agg["granularity"] = gran
+            agg["slice_key"] = col or ":all"
+            agg["slice_value"] = (
+                agg.pop(col).astype(str) if col is not None else ":all"
+            )
+            parts.append(agg)
+    lead = ["window_start", "granularity", "slice_key", "slice_value",
+            "n_obs"]
+    if parts:
+        profile = pd.concat(parts, ignore_index=True)
+        profile = profile[lead + [c for c in profile.columns if c not in lead]]
+    else:  # e.g. granularities=() in a hand-edited monitor spec
+        profile = pd.DataFrame(columns=lead)
     out_name = output_table or f"{config.table}_profile_metrics"
     catalog.save_table(out_name, profile)
     return profile

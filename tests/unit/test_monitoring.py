@@ -176,3 +176,41 @@ def test_monitor_task_with_anomalies(tmp_path):
     assert "n_anomalies" in res
     assert res["n_anomalies"] >= 0
     assert task.catalog.read_table("hackathon.sales.fc_anomalies") is not None
+
+
+def test_monitor_monthly_granularity_and_nan_predictions(catalog):
+    """'1 month' windows work (Period freq 'M'); a window containing a NaN
+    prediction reports NaN rmse/bias instead of silently shrinking the
+    denominator; empty granularities produce an empty profile."""
+    import numpy as np
+    import pandas as pd
+
+    from distributed_forecasting_tpu.monitoring import MonitorConfig, run_monitor
+
+    n = 90
+    df = pd.DataFrame({
+        "ds": pd.date_range("2024-01-01", periods=n),
+        "store": 1, "item": 1,
+        "y": np.ones(n) * 10.0,
+        "yhat": np.ones(n) * 11.0,
+    })
+    df.loc[5, "yhat"] = np.nan  # one missing prediction in January
+    catalog.save_table("hackathon.sales.m", df)
+
+    cfg = MonitorConfig(name="m", table="hackathon.sales.m",
+                        granularities=("1 month",), slicing_cols=())
+    prof = run_monitor(catalog, cfg)
+    assert set(prof.granularity) == {"1 month"}
+    jan = prof[prof.window_start == pd.Timestamp("2024-01-01")].iloc[0]
+    feb = prof[prof.window_start == pd.Timestamp("2024-02-01")].iloc[0]
+    assert np.isnan(jan.rmse) and np.isnan(jan.bias)  # NaN pred surfaces
+    assert jan.n_obs == 31  # ...while the row is still counted
+    assert feb.rmse == pytest.approx(1.0)
+    assert feb.bias == pytest.approx(1.0)
+
+    empty = run_monitor(
+        catalog,
+        MonitorConfig(name="m0", table="hackathon.sales.m",
+                      granularities=(), slicing_cols=()),
+    )
+    assert len(empty) == 0
