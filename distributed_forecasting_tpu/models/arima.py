@@ -40,7 +40,7 @@ import jax.numpy as jnp
 import optax
 from jax.scipy.special import ndtri
 
-from distributed_forecasting_tpu.models.base import register_model
+from distributed_forecasting_tpu.models.base import gaussian_quantiles, register_model
 
 _EPS = 1e-6
 
@@ -514,4 +514,5 @@ def forecast(params: ArimaParams, day_all, t_end, config: ArimaConfig, key=None)
     return _forecast_impl(params, day_all, config, _effective_r(config))
 
 
-register_model("arima", fit, forecast, ArimaConfig)
+register_model("arima", fit, forecast, ArimaConfig,
+               forecast_quantiles=gaussian_quantiles(forecast))

@@ -23,8 +23,37 @@ from __future__ import annotations
 from typing import Callable, NamedTuple
 
 import jax.numpy as jnp
+from jax.scipy.special import ndtri
 
 MODEL_REGISTRY: dict = {}
+
+
+def gaussian_quantiles(forecast_fn: Callable, floor=None) -> Callable:
+    """Exact quantile forecaster for families whose predictive is Gaussian
+    IN DATA SPACE (``hi = yhat + z·sd`` — true of holt_winters, arima,
+    theta, croston; the curve model has its own transform-aware
+    implementation in ``prophet_glm``).  The per-step sd is recovered from
+    the UPPER bound (never clamped), so a family that floors its lower
+    bound (croston's non-negative demand) still recovers the true sd;
+    ``floor`` then applies the same clamp to every priced quantile.
+    Returns (S, Q, T_all)."""
+
+    def forecast_quantiles(params, day_all, t_end, config,
+                           quantiles=(0.1, 0.5, 0.9), key=None):
+        if not quantiles or not all(0.0 < q < 1.0 for q in quantiles):
+            raise ValueError(
+                f"quantiles must lie in (0, 1), got {quantiles!r}"
+            )
+        yhat, lo, hi = forecast_fn(params, day_all, t_end, config, key)
+        z_w = ndtri(0.5 + config.interval_width / 2.0)
+        sd = (hi - yhat) / z_w
+        qs = jnp.asarray(tuple(quantiles), jnp.float32)
+        yq = yhat[:, None, :] + ndtri(qs)[None, :, None] * sd[:, None, :]
+        if floor is not None:
+            yq = jnp.maximum(yq, floor)
+        return yq
+
+    return forecast_quantiles
 
 
 def history_splice(fitted, future, day_all, day0, h):

@@ -20,7 +20,11 @@ import jax
 import jax.numpy as jnp
 from jax.scipy.special import ndtri
 
-from distributed_forecasting_tpu.models.base import history_splice, register_model
+from distributed_forecasting_tpu.models.base import (
+    gaussian_quantiles,
+    history_splice,
+    register_model,
+)
 
 _EPS = 1e-6
 
@@ -108,4 +112,5 @@ def forecast(params: CrostonParams, day_all, t_end, config: CrostonConfig,
     return yhat, lo, hi
 
 
-register_model("croston", fit, forecast, CrostonConfig)
+register_model("croston", fit, forecast, CrostonConfig,
+               forecast_quantiles=gaussian_quantiles(forecast, floor=0.0))

@@ -29,7 +29,11 @@ import jax
 import jax.numpy as jnp
 from jax.scipy.special import ndtri
 
-from distributed_forecasting_tpu.models.base import history_splice, register_model
+from distributed_forecasting_tpu.models.base import (
+    gaussian_quantiles,
+    history_splice,
+    register_model,
+)
 
 _EPS = 1e-6
 
@@ -302,4 +306,5 @@ def forecast(params: HWParams, day_all, t_end, config: HoltWintersConfig, key=No
     return yhat, yhat - z * sd, yhat + z * sd
 
 
-register_model("holt_winters", fit, forecast, HoltWintersConfig)
+register_model("holt_winters", fit, forecast, HoltWintersConfig,
+               forecast_quantiles=gaussian_quantiles(forecast))

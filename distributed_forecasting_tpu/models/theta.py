@@ -30,7 +30,11 @@ import jax
 import jax.numpy as jnp
 from jax.scipy.special import ndtri
 
-from distributed_forecasting_tpu.models.base import history_splice, register_model
+from distributed_forecasting_tpu.models.base import (
+    gaussian_quantiles,
+    history_splice,
+    register_model,
+)
 
 _EPS = 1e-6
 
@@ -183,4 +187,5 @@ def forecast(params: ThetaParams, day_all, t_end, config: ThetaConfig, key=None)
     return yhat, yhat - z * sd, yhat + z * sd
 
 
-register_model("theta", fit, forecast, ThetaConfig)
+register_model("theta", fit, forecast, ThetaConfig,
+               forecast_quantiles=gaussian_quantiles(forecast))

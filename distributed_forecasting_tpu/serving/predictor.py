@@ -337,15 +337,17 @@ class BatchForecaster:
         xreg=None,
     ) -> pd.DataFrame:
         """Probabilistic forecast: one column per requested quantile level
-        (``q0.1``, ``q0.5``, ...), M5-uncertainty style.  Only for model
-        families registered with a ``forecast_quantiles`` implementation
-        (the curve model); levels are priced from the same closed-form
-        predictive distribution the central interval uses."""
+        (``q0.1``, ``q0.5``, ...), M5-uncertainty style.  Every built-in
+        family registers a ``forecast_quantiles`` implementation
+        (transform-aware for the curve model, exact Gaussian-band recovery
+        for the others — ``models/base.gaussian_quantiles``); levels are
+        priced from the same predictive distribution the central interval
+        uses."""
         fns = get_model(self.model)
         if fns.forecast_quantiles is None:
             raise ValueError(
-                f"model {self.model!r} has no quantile forecast "
-                f"implementation; use the curve model ('prophet')"
+                f"model {self.model!r} registered no quantile forecast "
+                f"implementation"
             )
         quantiles = tuple(float(q) for q in quantiles)
         sidx, params, day_all, fc_kwargs = self._prepare_request(
