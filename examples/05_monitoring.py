@@ -20,6 +20,8 @@ if __name__ == "__main__":
                 "table": "hackathon.sales.finegrain_forecasts",
                 "granularities": ["1 day", "1 week"],
                 "slicing_cols": ["store", "item"],
+                # score residual z-anomalies against the model's own band
+                "anomalies": True,
             },
         }
     )
@@ -30,3 +32,13 @@ if __name__ == "__main__":
     )
     overall = profile[profile.slice_key == ":all"]
     print(overall.tail(8).to_string(index=False))
+
+    flagged = task.catalog.read_table(
+        "hackathon.sales.finegrain_forecasts_anomalies"
+    )
+    print(f"\n{len(flagged)} anomalous rows; worst offenders:")
+    print(
+        flagged.nlargest(5, "anomaly_score")[
+            ["ds", "store", "item", "y", "yhat", "anomaly_score"]
+        ].to_string(index=False)
+    )
