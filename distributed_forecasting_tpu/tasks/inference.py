@@ -23,6 +23,9 @@ Conf::
       horizon: 90
       promote_to: Staging   # stage transition after a successful batch
       on_missing: raise     # or 'skip' for unseen (store,item)
+      quantiles: null       # e.g. [0.1, 0.5, 0.9] -> probabilistic output
+                            # (one q<level> column per level) instead of
+                            # yhat/yhat_upper/yhat_lower
       regressors:           # required when the model was fit with
         table: hackathon.sales.promo_calendar   # n_regressors > 0: same
         columns: [promo, price]                 # covariate table, covering
@@ -69,12 +72,18 @@ class InferenceTask(Task):
                 keys=forecaster.keys,
                 key_names=forecaster.key_names,
             )
-        pred = forecaster.predict(
-            request,
+        quantiles = inf.get("quantiles")
+        kwargs = dict(
             horizon=horizon,
             on_missing=inf.get("on_missing", "raise"),
             xreg=xreg,
         )
+        if quantiles:
+            pred = forecaster.predict_quantiles(
+                request, quantiles=quantiles, **kwargs
+            )
+        else:
+            pred = forecaster.predict(request, **kwargs)
         table = out.get("table", "hackathon.sales.test_finegrain_forecasts")
         tversion = self.catalog.save_table(table, pred)
         self.logger.info("wrote %d forecast rows -> %s (v%s)", len(pred), table, tversion)

@@ -119,6 +119,22 @@ def test_train_deploy_infer_chain(env_conf):
     assert res["rows"] == 6 * 30
     out = infer.catalog.read_table("hackathon.sales.test_finegrain_forecasts")
     assert np.isfinite(out.yhat).all()
+
+    # probabilistic inference: one q<level> column per level
+    qtask = InferenceTask(
+        init_conf={
+            **env_conf,
+            "input": {"table": "hackathon.sales.raw"},
+            "output": {"table": "hackathon.sales.q_forecasts"},
+            "inference": {"model_name": "ForecastingBatchModel", "horizon": 30,
+                          "quantiles": [0.1, 0.9], "promote_to": None},
+        }
+    )
+    qres = qtask.launch()
+    assert qres["rows"] == 6 * 30
+    qout = qtask.catalog.read_table("hackathon.sales.q_forecasts")
+    assert {"q0.1", "q0.9"} <= set(qout.columns)
+    assert (qout["q0.1"] <= qout["q0.9"]).all()
     # stage promoted, like the reference's None -> Staging transition
     assert (
         infer.registry.get_version("ForecastingBatchModel", dep["version"]).stage
@@ -340,6 +356,26 @@ def test_train_infer_chain_with_regressors(env_conf):
     assert res["rows"] == 6 * 30
     out = infer.catalog.read_table("hackathon.sales.test_finegrain_forecasts")
     assert np.isfinite(out.yhat).all()
+
+    # probabilistic inference COMPOSES with regressors: quantile columns
+    # priced from the covariate-aware predictive
+    qtask = InferenceTask(
+        init_conf={
+            **env_conf,
+            "input": {"table": "hackathon.sales.raw"},
+            "output": {"table": "hackathon.sales.q_forecasts"},
+            "inference": {"model_name": "ForecastingBatchModel", "horizon": 30,
+                          "quantiles": [0.1, 0.9], "promote_to": None,
+                          "regressors": {
+                              "table": "hackathon.sales.promo_calendar",
+                              "columns": ["promo"]}},
+        }
+    )
+    qres = qtask.launch()
+    assert qres["rows"] == 6 * 30
+    qout = qtask.catalog.read_table("hackathon.sales.q_forecasts")
+    assert {"q0.1", "q0.9"} <= set(qout.columns)
+    assert (qout["q0.1"] <= qout["q0.9"]).all()
 
 
 def test_regressor_conf_unsupported_combos(env_conf):
