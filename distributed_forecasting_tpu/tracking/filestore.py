@@ -35,7 +35,6 @@ import time
 import uuid
 from typing import Dict, List, Optional
 
-import numpy as np
 
 
 def _now() -> float:
