@@ -92,3 +92,33 @@ def test_predict_is_request_proportional(forecaster):
     assert len(merged) == len(out_small)
     np.testing.assert_allclose(merged.yhat, merged.yhat_all, rtol=1e-5)
     np.testing.assert_allclose(merged.yhat_lower, merged.yhat_lower_all, rtol=1e-5)
+
+
+def test_legacy_artifact_without_regressor_fields_loads(tmp_path, batch_small):
+    """Artifacts saved before CurveParams grew reg_mu/reg_sd must still
+    load (missing npz keys fall back to the dataclass defaults) and serve."""
+    import numpy as np
+
+    from distributed_forecasting_tpu.engine import fit_forecast
+    from distributed_forecasting_tpu.models import CurveModelConfig
+    from distributed_forecasting_tpu.serving import BatchForecaster
+
+    cfg = CurveModelConfig()
+    params, _ = fit_forecast(batch_small, model="prophet", config=cfg,
+                             horizon=30)
+    fc = BatchForecaster.from_fit(batch_small, params, "prophet", cfg)
+    art = tmp_path / "legacy"
+    fc.save(str(art))
+
+    # simulate the old artifact: strip the new fields from params.npz
+    npz_path = art / "params.npz"
+    with np.load(npz_path) as z:
+        kept = {k: z[k] for k in z.files if k not in ("reg_mu", "reg_sd")}
+    np.savez(npz_path, **kept)
+
+    fc2 = BatchForecaster.load(str(art))
+    assert fc2.params.reg_mu.shape == (0, 0)  # default, not an error
+    req = batch_small.key_frame().head(1)
+    out = fc2.predict(req, horizon=14)
+    assert len(out) == 14
+    assert np.isfinite(out.yhat).all()
