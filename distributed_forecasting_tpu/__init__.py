@@ -24,3 +24,25 @@ Layer map (mirrors SURVEY.md §1):
 """
 
 from distributed_forecasting_tpu.version import __version__  # noqa: F401
+
+# DFTPU_PLATFORM=cpu escape hatch at PACKAGE import, so every entry point —
+# examples, bench scripts, ad-hoc shells, not just Task CLIs — gets the
+# working platform-override route before any device access (a degraded
+# remote accelerator otherwise hangs the first jax.devices() touch; see
+# utils/platform.py).  Guarded on the env var so the common no-override
+# import stays as light as before (no utils/yaml import), and a too-late
+# override WARNS here rather than failing the package import — Task init
+# re-applies it and raises with entry-point context.
+import os as _os
+
+if _os.environ.get("DFTPU_PLATFORM"):
+    from distributed_forecasting_tpu.utils.platform import (
+        apply_platform_override as _apply_platform_override,
+    )
+
+    try:
+        _apply_platform_override()
+    except RuntimeError as _e:
+        import warnings as _warnings
+
+        _warnings.warn(str(_e), RuntimeWarning)
