@@ -446,6 +446,21 @@ def fit_forecast_bucketed(
     return bucket_params, result
 
 
+def long_frame_skeleton(keys, key_names, day_all) -> dict:
+    """``[ds, *keys]`` columns of a long (series x day) table — one place
+    for the tile/repeat layout so every long output (forecast_frame, the
+    curve model's component_frame) stays aligned."""
+    keys = np.asarray(keys)
+    T_all = int(day_all.shape[0])
+    dates = pd.to_datetime(
+        np.asarray(day_all, dtype="int64"), unit="D", origin="unix"
+    )
+    frame = {"ds": np.tile(dates.values, keys.shape[0])}
+    for j, name in enumerate(key_names):
+        frame[name] = np.repeat(keys[:, j], T_all)
+    return frame
+
+
 def forecast_frame(
     batch: SeriesBatch,
     result: ForecastResult,
@@ -457,20 +472,12 @@ def forecast_frame(
     S = batch.n_series
     T_all = int(result.day_all.shape[0])
     T_hist = batch.n_time
-    dates = pd.to_datetime(
-        np.asarray(result.day_all, dtype="int64"), unit="D", origin="unix"
-    )
     y_full = np.full((S, T_all), np.nan)
     y_hist = np.asarray(batch.y)
     m_hist = np.asarray(batch.mask) > 0
     y_full[:, :T_hist] = np.where(m_hist, y_hist, np.nan)
 
-    keys = np.asarray(batch.keys)
-    frame = {
-        "ds": np.tile(dates.values, S),
-    }
-    for j, name in enumerate(batch.key_names):
-        frame[name] = np.repeat(keys[:, j], T_all)
+    frame = long_frame_skeleton(batch.keys, batch.key_names, result.day_all)
     frame["y"] = y_full.reshape(-1)
     frame["yhat"] = np.asarray(result.yhat).reshape(-1)
     frame["yhat_upper"] = np.asarray(result.hi).reshape(-1)

@@ -352,6 +352,25 @@ def _trend_deviation_variance(params: CurveParams, t_all, t_end_scaled, cfg):
     return 2.0 * lam_scale[:, None] ** 2 * p_cp * lag2[None, :]
 
 
+def _regressor_contrib(params: CurveParams, xreg, F0: int):
+    """Fit-space regressor contribution (unscaled by y_scale), (S, T_all).
+
+    Affine identity: ``beta.(x - mu)/sd = (beta/sd).x - sum(beta.mu/sd)``,
+    so the standardized (S, T_all, R) intermediate never materializes — a
+    shared calendar stays (T_all, R) through the einsum even when the
+    standardization stats are per-series.
+    """
+    xreg = jnp.asarray(xreg, jnp.float32)
+    beta_reg = params.beta[:, F0:]  # (S, R)
+    w = beta_reg / params.reg_sd  # (S, R)
+    offset = jnp.sum(w * params.reg_mu, axis=-1)[:, None]  # (S, 1)
+    return (
+        jnp.einsum("sr,str->st", w, xreg, optimize=True)
+        if xreg.ndim == 3
+        else jnp.einsum("sr,tr->st", w, xreg, optimize=True)
+    ) - offset
+
+
 def _predictive(params: CurveParams, day_all, t_end, config, key, xreg):
     """Fit-space predictive distribution over ``day_all``.
 
@@ -369,20 +388,7 @@ def _predictive(params: CurveParams, day_all, t_end, config, key, xreg):
     F0 = layout["n_features"]
     zhat = (params.beta[:, :F0] @ X.T) * params.y_scale[:, None]  # (S, T_all)
     if _check_xreg(xreg, config, "forecast"):
-        xreg = jnp.asarray(xreg, jnp.float32)
-        # affine identity: beta.(x - mu)/sd = (beta/sd).x - sum(beta.mu/sd),
-        # so the standardized (S, T_all, R) intermediate never materializes
-        # — a shared calendar stays (T_all, R) through the einsum even when
-        # the standardization stats are per-series
-        beta_reg = params.beta[:, F0:]  # (S, R)
-        w = beta_reg / params.reg_sd  # (S, R)
-        offset = jnp.sum(w * params.reg_mu, axis=-1)[:, None]  # (S, 1)
-        contrib = (
-            jnp.einsum("sr,str->st", w, xreg, optimize=True)
-            if xreg.ndim == 3
-            else jnp.einsum("sr,tr->st", w, xreg, optimize=True)
-        ) - offset
-        zhat = zhat + contrib * params.y_scale[:, None]
+        zhat = zhat + _regressor_contrib(params, xreg, F0) * params.y_scale[:, None]
     t_all = scaled_time(day_all, params.t0, params.t1)
     t_end_scaled = (t_end - params.t0) / jnp.maximum(params.t1 - params.t0, 1.0)
 
@@ -479,6 +485,75 @@ def forecast_quantiles(
     else:
         zq = zhat[:, None, :] + ndtri(qs)[None, :, None] * sd[:, None, :]
     return _to_data_space(zq, params, config)
+
+
+@partial(jax.jit, static_argnames=("config",))
+def decompose(params: CurveParams, day_all, config: CurveModelConfig,
+              xreg=None):
+    """Per-component contributions over ``day_all`` — the tabular analogue
+    of Prophet's component columns (trend/weekly/yearly/holidays, plus
+    regressors here).  Returns a dict name -> (S, T_all) in FIT SPACE,
+    scaled so the components sum to the fit-space point path: under
+    additive seasonality they sum to yhat directly; under multiplicative
+    (log-space) mode ``exp(component)`` is that component's multiplicative
+    factor on the forecast.
+
+    ``xreg`` is OPTIONAL even for a regressor-fit model: the trend and
+    seasonal panels never need covariate values, so omitting it just
+    omits the ``regressors`` component (components then sum to the path
+    minus the regressor effect).
+    """
+    X, layout = _design(day_all, params.t0, params.t1, config)
+    ys = params.y_scale[:, None]
+    comps = {}
+    tr = slice(0, 2 + config.n_changepoints)
+    comps["trend"] = (params.beta[:, tr] @ X[:, tr].T) * ys
+    for name in ("weekly", "yearly", "holidays"):
+        sl = layout.get(name)
+        if sl is not None and (sl.stop - sl.start) > 0:
+            comps[name] = (params.beta[:, sl] @ X[:, sl].T) * ys
+    if xreg is not None:
+        if config.n_regressors == 0:
+            raise ValueError(
+                "xreg passed but config.n_regressors == 0"
+            )
+        xreg = jnp.asarray(xreg, jnp.float32)
+        if xreg.shape[-1] != config.n_regressors:
+            raise ValueError(
+                f"xreg has {xreg.shape[-1]} columns, config.n_regressors="
+                f"{config.n_regressors}"
+            )
+        if xreg.shape[-2] != day_all.shape[0]:
+            raise ValueError(
+                f"xreg time axis is {xreg.shape[-2]}, expected "
+                f"len(day_all) = {day_all.shape[0]}"
+            )
+        comps["regressors"] = (
+            _regressor_contrib(params, xreg, layout["n_features"]) * ys
+        )
+    return comps
+
+
+def component_frame(batch, params: CurveParams, config: CurveModelConfig,
+                    horizon: int = 0, xreg=None):
+    """Long component table ``[ds, *keys, trend, weekly, yearly, ...]`` over
+    history + ``horizon`` days — what Prophet's ``predict`` output carries in
+    its component columns.  Values are fit-space contributions (see
+    :func:`decompose`)."""
+    import numpy as np
+    import pandas as pd
+
+    from distributed_forecasting_tpu.engine.fit import (
+        day_grid,
+        long_frame_skeleton,
+    )
+
+    day_all = day_grid(batch.day, horizon)
+    comps = decompose(params, day_all, config, xreg=xreg)
+    frame = long_frame_skeleton(batch.keys, batch.key_names, day_all)
+    for name, vals in comps.items():
+        frame[name] = np.asarray(vals).reshape(-1)
+    return pd.DataFrame(frame)
 
 
 def extract_params(params: CurveParams, config: CurveModelConfig) -> dict:

@@ -76,30 +76,24 @@ def plot_changepoints(params, config, series_index: int = 0, ax=None):
     return ax
 
 
-def plot_components(params, config, day_all, series_index: int = 0):
+def plot_components(params, config, day_all, series_index: int = 0,
+                    xreg=None):
     """Trend / weekly / yearly decomposition from the linear basis (the
     Prophet components plot equivalent).  Returns the figure."""
     import jax.numpy as jnp
 
-    from distributed_forecasting_tpu.models.prophet_glm import _design
+    from distributed_forecasting_tpu.models.prophet_glm import decompose
 
     plt = _plt()
-    X, layout = _design(
-        jnp.asarray(day_all, dtype=jnp.int32), params.t0, params.t1, config
-    )
-    X = np.asarray(X)
-    beta = np.asarray(params.beta[series_index])
     import pandas as pd
 
     dates = pd.to_datetime(np.asarray(day_all, "int64"), unit="D")
-
-    comps = {}
-    trend_cols = list(range(2 + config.n_changepoints))
-    comps["trend"] = X[:, trend_cols] @ beta[trend_cols]
-    for name in ("weekly", "yearly", "holidays"):
-        sl = layout.get(name)
-        if sl is not None and (sl.stop - sl.start) > 0:
-            comps[name] = X[:, sl] @ beta[sl]
+    comps = {
+        name: np.asarray(vals[series_index])
+        for name, vals in decompose(
+            params, jnp.asarray(day_all, dtype=jnp.int32), config, xreg=xreg
+        ).items()
+    }
 
     fig, axes = plt.subplots(len(comps), 1, figsize=(9, 2.2 * len(comps)),
                              sharex=True)

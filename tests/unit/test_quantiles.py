@@ -230,3 +230,69 @@ def test_croston_quantiles_respect_zero_floor():
     # the clamp is genuinely active somewhere in this regime
     assert (np.asarray(yhat) - float(-ndtri(0.05)) * sd < 0).any()
     assert (yq[:, 0] == 0.0).any()
+
+
+def test_decompose_components_sum_to_fit_space_path(batch_small):
+    """Prophet component-columns parity: per-component contributions sum to
+    the fit-space point path (additive mode: to yhat directly)."""
+    from distributed_forecasting_tpu.models.prophet_glm import (
+        component_frame,
+        decompose,
+    )
+
+    cfg = CurveModelConfig(seasonality_mode="additive")
+    params, res = fit_forecast(batch_small, model="prophet", config=cfg,
+                               horizon=30)
+    comps = decompose(params, res.day_all, cfg)
+    assert {"trend", "weekly", "yearly"} <= set(comps)
+    total = sum(np.asarray(v) for v in comps.values())
+    np.testing.assert_allclose(total, np.asarray(res.yhat), rtol=1e-4,
+                               atol=1e-3)
+
+    df = component_frame(batch_small, params, cfg, horizon=30)
+    assert {"ds", "store", "item", "trend", "weekly", "yearly"} <= set(
+        df.columns
+    )
+    assert len(df) == batch_small.n_series * (batch_small.n_time + 30)
+
+
+def test_decompose_includes_regressor_component():
+    from distributed_forecasting_tpu.models.prophet_glm import decompose
+
+    horizon = 30
+    from tests.unit.test_regressors import _make_batch_with_regressor
+
+    y, mask, day, xreg_all, _ = _make_batch_with_regressor(
+        per_series=False, S=3, T=365, horizon=horizon
+    )
+    cfg = CurveModelConfig(
+        seasonality_mode="additive", n_regressors=2, weekly_order=0,
+        yearly_order=0,
+    )
+    params = prophet_glm.fit(y, mask, day, cfg, xreg=xreg_all[:365])
+    day_all = jnp.arange(int(day[0]), int(day[0]) + 365 + horizon,
+                         dtype=jnp.int32)
+    comps = decompose(params, day_all, cfg, xreg=xreg_all)
+    assert "regressors" in comps
+    # the promo/driver effect carries real signal
+    assert float(np.std(np.asarray(comps["regressors"]))) > 0.5
+
+
+def test_decompose_without_xreg_on_regressor_model():
+    """Trend/seasonal decomposition works without covariate values even for
+    a regressor-fit model — only the 'regressors' component needs them."""
+    from tests.unit.test_regressors import _make_batch_with_regressor
+
+    from distributed_forecasting_tpu.models.prophet_glm import decompose
+
+    y, mask, day, xreg_all, _ = _make_batch_with_regressor(
+        per_series=False, S=3, T=365, horizon=0
+    )
+    cfg = CurveModelConfig(seasonality_mode="additive", n_regressors=2)
+    params = prophet_glm.fit(y, mask, day, cfg, xreg=xreg_all[:365])
+    comps = decompose(params, day, cfg)  # no xreg: no raise
+    assert "regressors" not in comps
+    assert "trend" in comps
+    # mismatched time axis is a clear error, not a ragged frame
+    with pytest.raises(ValueError, match="time axis"):
+        decompose(params, day, cfg, xreg=xreg_all[:100])
