@@ -12,6 +12,9 @@ Conf::
       slicing_cols: [store, item]
       anomalies: true           # also score residual z-anomalies against
       interval_width: 0.95      # the model's own band -> <table>_anomalies
+      anomaly_threshold: null   # z threshold; default = the band's z
+                                # (~5% of calibrated noise flags) — raise to
+                                # e.g. 3.5 for alert-grade severity only
 """
 
 from __future__ import annotations
@@ -52,9 +55,11 @@ class MonitorTask(Task):
             "daily_mape_mean": float(overall.mape.mean()),
         }
         if mc.get("anomalies", False):
+            thr = mc.get("anomaly_threshold")
             scored = detect_anomalies(
                 self.catalog, config.table,
                 interval_width=float(mc.get("interval_width", 0.95)),
+                score_threshold=float(thr) if thr is not None else None,
                 df=table_df,
             )
             n_flag = int(scored.is_anomaly.sum())

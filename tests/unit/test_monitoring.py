@@ -177,6 +177,12 @@ def test_monitor_task_with_anomalies(tmp_path):
     assert res["n_anomalies"] >= 0
     assert task.catalog.read_table("hackathon.sales.fc_anomalies") is not None
 
+    # a stricter threshold flags (weakly) fewer rows
+    strict = MonitorTask(init_conf={**env, "monitor": {
+        "name": "m2", "table": "hackathon.sales.fc", "anomalies": True,
+        "anomaly_threshold": 4.0}}).launch()
+    assert strict["n_anomalies"] <= res["n_anomalies"]
+
 
 def test_monitor_monthly_granularity_and_nan_predictions(catalog):
     """'1 month' windows work (Period freq 'M'); a window containing a NaN
