@@ -1,0 +1,64 @@
+"""Step 8 — probabilistic forecasting: quantiles, pinball loss, components.
+
+M5-uncertainty-style workflow: hold out the last 28 days, fit on the rest,
+price a 9-level quantile fan, score it with pinball loss against the
+holdout, and decompose the point path into trend/seasonal components —
+all from the same closed-form predictive distribution (no posterior
+sampling; docs/architecture.md "Covariates and probabilistic output").
+
+Run: python examples/08_probabilistic.py
+"""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_forecasting_tpu.data import synthetic_store_item_sales, tensorize
+from distributed_forecasting_tpu.engine import fit_forecast
+from distributed_forecasting_tpu.models import prophet_glm
+from distributed_forecasting_tpu.ops import metrics as M
+
+HOLDOUT = 28
+LEVELS = (0.005, 0.025, 0.165, 0.25, 0.5, 0.75, 0.835, 0.975, 0.995)  # M5
+
+if __name__ == "__main__":
+    df = synthetic_store_item_sales(n_stores=5, n_items=10, n_days=1096, seed=9)
+    full = tensorize(df)
+    T_fit = full.n_time - HOLDOUT
+
+    train = dataclasses.replace(
+        full, y=full.y[:, :T_fit], mask=full.mask[:, :T_fit],
+        day=full.day[:T_fit],
+    )
+    # ONE config for fit and pricing — customizing it keeps both consistent
+    cfg = prophet_glm.CurveModelConfig()
+    params, res = fit_forecast(train, model="prophet", config=cfg,
+                               horizon=HOLDOUT)
+
+    yq = prophet_glm.forecast_quantiles(
+        params, res.day_all, jnp.float32(train.day[-1]), cfg, LEVELS
+    )  # (S, Q, T_fit + HOLDOUT)
+
+    # pinball loss per level over the TRUE holdout days
+    y_hold = full.y[:, T_fit:]
+    m_hold = full.mask[:, T_fit:]
+    print(f"{full.n_series} series, {HOLDOUT}-day holdout; pinball by level:")
+    total = 0.0
+    for i, q in enumerate(LEVELS):
+        loss = float(jnp.mean(M.pinball(y_hold, yq[:, i, T_fit:], m_hold, q)))
+        total += loss
+        print(f"  q={q:<6} pinball={loss:.3f}")
+    print(f"mean pinball (the M5-uncertainty score shape): {total/len(LEVELS):.3f}")
+
+    # empirical coverage of the outer fan vs its nominal 99%
+    cov = float(jnp.mean(M.coverage(
+        y_hold, yq[:, 0, T_fit:], yq[:, -1, T_fit:], m_hold
+    )))
+    print(f"99% fan empirical coverage: {cov:.3f}")
+
+    # component view of the first series (what drives the forecast)
+    comps = prophet_glm.decompose(params, res.day_all, cfg)
+    parts = {k: float(np.std(np.asarray(v[0]))) for k, v in comps.items()}
+    print("component std (series 0):",
+          {k: round(v, 2) for k, v in parts.items()})
