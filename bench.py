@@ -110,7 +110,10 @@ def choose_backend() -> tuple[str, str | None]:
     only if even CPU fails — per VERDICT r1 #1, the bench must always emit
     its JSON line unless nothing at all works.
     """
-    ambient_timeout = float(os.environ.get("DFTPU_BENCH_PROBE_TIMEOUT", "300"))
+    # healthy first-compile is 20-40 s; 180 s is ample margin, and during a
+    # tunnel outage (observed twice on 2026-07-30, hours-long) every extra
+    # probe minute comes out of the driver's wall budget for the CPU fallback
+    ambient_timeout = float(os.environ.get("DFTPU_BENCH_PROBE_TIMEOUT", "180"))
     plat = _probe_backend(None, timeout=ambient_timeout)
     if plat is not None:
         return plat, None
