@@ -19,7 +19,7 @@ bench:
 	python bench.py
 
 e2e:
-	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+	env -u PALLAS_AXON_POOL_IPS DFTPU_PLATFORM=cpu \
 	python -m distributed_forecasting_tpu.workflows.runner \
 	  -f conf/workflows.yml -w forecasting-e2e
 
