@@ -135,34 +135,10 @@ def _cv_paths_impl(y, mask, day, key, model, config, cuts, horizon, xreg=None):
     return _cv_paths(y, mask, day, key, model, config, cuts, horizon, xreg)
 
 
-def cv_forecast_frame(
-    batch: SeriesBatch,
-    model: str = "prophet",
-    config=None,
-    cv: CVConfig = CVConfig(),
-    key: Optional[jax.Array] = None,
-    xreg=None,
-):
-    """Raw rolling-origin forecasts as a long frame — the shape Prophet's
-    ``diagnostics.cross_validation`` returns (one row per series per cutoff
-    per scored day: ``[ds, *keys, cutoff, y, yhat, yhat_lower,
-    yhat_upper]``), for residual plots and custom window metrics beyond the
-    per-series means :func:`cross_validate` reports.
-
-    Diagnostics-scale tool: materializes (C, S, T) paths on host — fine at
-    hundreds-of-series scale, not meant for the 50k regime.
-    """
-    import pandas as pd
-
-    config, key, xreg = _cv_entry(batch, model, config, key, xreg,
-                                  "cv_forecast_frame")
-    cuts = cutoff_indices(batch.n_time, cv)
-    yhat, lo, hi, eval_masks = _cv_paths_impl(
-        batch.y, batch.mask, batch.day, key,
-        model=model, config=config, cuts=tuple(cuts), horizon=cv.horizon,
-        xreg=xreg,
-    )
+def _frame_from_paths(batch: SeriesBatch, cuts, yhat, lo, hi, eval_masks):
+    """Host-side assembly of the diagnostics frame from (C, S, T) paths."""
     import numpy as np
+    import pandas as pd
 
     em = np.asarray(eval_masks) > 0  # (C, S, T)
     ci, si, ti = np.nonzero(em)
@@ -180,6 +156,36 @@ def cv_forecast_frame(
     return pd.DataFrame(frame)
 
 
+def cv_forecast_frame(
+    batch: SeriesBatch,
+    model: str = "prophet",
+    config=None,
+    cv: CVConfig = CVConfig(),
+    key: Optional[jax.Array] = None,
+    xreg=None,
+):
+    """Raw rolling-origin forecasts as a long frame — the shape Prophet's
+    ``diagnostics.cross_validation`` returns (one row per series per cutoff
+    per scored day: ``[ds, *keys, cutoff, y, yhat, yhat_lower,
+    yhat_upper]``), for residual plots and custom window metrics beyond the
+    per-series means :func:`cross_validate` reports.
+
+    Diagnostics-scale tool: materializes (C, S, T) paths on host — fine at
+    hundreds-of-series scale, not meant for the 50k regime.  To get the
+    frame AND the metric means from one CV pass, use
+    ``cross_validate(..., return_frame=True)``.
+    """
+    config, key, xreg = _cv_entry(batch, model, config, key, xreg,
+                                  "cv_forecast_frame")
+    cuts = cutoff_indices(batch.n_time, cv)
+    yhat, lo, hi, eval_masks = _cv_paths_impl(
+        batch.y, batch.mask, batch.day, key,
+        model=model, config=config, cuts=tuple(cuts), horizon=cv.horizon,
+        xreg=xreg,
+    )
+    return _frame_from_paths(batch, cuts, yhat, lo, hi, eval_masks)
+
+
 def cross_validate(
     batch: SeriesBatch,
     model: str = "prophet",
@@ -187,7 +193,8 @@ def cross_validate(
     cv: CVConfig = CVConfig(),
     key: Optional[jax.Array] = None,
     xreg=None,
-) -> Dict[str, jax.Array]:
+    return_frame: bool = False,
+):
     """Per-series CV-mean metrics: mse, rmse, mae, mape, smape, mdape,
     coverage — each an (S,) array (the reference logs the first three per
     series, ``02_training.py:187-192``; the AutoML path adds the rest).
@@ -197,12 +204,27 @@ def cross_validate(
     from the fit_forecast flow is accepted and trimmed (CV scores inside
     history only).
 
+    ``return_frame=True`` additionally returns the raw per-cutoff
+    diagnostics frame (see :func:`cv_forecast_frame`) computed from the
+    SAME forecast paths — one CV pass, not two — as ``(metrics, frame)``.
+
     Returns the dict plus ``"n_cutoffs"`` (python int) under key
     ``"_n_cutoffs"`` for logging parity.
     """
     config, key, xreg = _cv_entry(batch, model, config, key, xreg,
                                   "cross_validate")
     cuts = cutoff_indices(batch.n_time, cv)
+    if return_frame:
+        yhat, lo, hi, eval_masks = _cv_paths_impl(
+            batch.y, batch.mask, batch.day, key,
+            model=model, config=config, cuts=tuple(cuts), horizon=cv.horizon,
+            xreg=xreg,
+        )
+        y_b = jnp.broadcast_to(batch.y[None], yhat.shape)
+        per_cut = metrics_ops.compute_all(y_b, yhat, eval_masks, lo=lo, hi=hi)
+        out = {name: jnp.mean(v, axis=0) for name, v in per_cut.items()}
+        out["_n_cutoffs"] = len(cuts)
+        return out, _frame_from_paths(batch, cuts, yhat, lo, hi, eval_masks)
     out = dict(
         _cv_impl(
             batch.y, batch.mask, batch.day, key,

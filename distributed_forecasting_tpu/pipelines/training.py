@@ -102,6 +102,7 @@ class TrainingPipeline:
         seed: int = 0,
         bucketed: bool = False,
         regressors: Optional[Dict[str, Any]] = None,
+        cv_artifact: bool = False,
     ) -> Dict[str, Any]:
         if regressors:
             from distributed_forecasting_tpu.models.base import get_model
@@ -121,6 +122,11 @@ class TrainingPipeline:
                     f"model {model!r} does not accept exogenous regressors; "
                     f"use the curve model ('prophet')"
                 )
+        if cv_artifact and (model == "auto" or (tuning and tuning.get("enabled"))):
+            raise ValueError(
+                "training.cv_artifact is only supported on the plain "
+                "fine-grained path (not model='auto' or tuning.enabled)"
+            )
         if tuning and tuning.get("enabled"):
             if bucketed:
                 raise ValueError(
@@ -171,10 +177,17 @@ class TrainingPipeline:
             if run_cross_validation:
                 cv = CVConfig(**(cv_conf or {}))
                 with timer.phase("cross_validation"):
-                    cv_metrics = cross_validate(
-                        batch, model=model, config=config, cv=cv, key=key,
-                        xreg=xreg,
-                    )
+                    if cv_artifact:
+                        # one CV pass yields metrics AND the raw frame
+                        cv_metrics, cv_frame = cross_validate(
+                            batch, model=model, config=config, cv=cv,
+                            key=key, xreg=xreg, return_frame=True,
+                        )
+                    else:
+                        cv_metrics = cross_validate(
+                            batch, model=model, config=config, cv=cv, key=key,
+                            xreg=xreg,
+                        )
                     jax.block_until_ready(cv_metrics["mape"])
             with timer.phase("fit_forecast"):
                 if bucketed:
@@ -251,6 +264,11 @@ class TrainingPipeline:
                 agg["n_cv_cutoffs"] = cv_metrics["_n_cutoffs"]
             run.log_metrics(agg)
             run.log_table("series_metrics.parquet", series_table)
+            if cv_artifact and run_cross_validation:
+                # raw per-cutoff forecasts (Prophet diagnostics shape),
+                # computed in the cross_validation phase above — opt-in: at
+                # 500x1826x3 it is a ~2.7M-row parquet
+                run.log_table("cv_forecasts.parquet", cv_frame)
 
             if bucketed:
                 from distributed_forecasting_tpu.serving import (

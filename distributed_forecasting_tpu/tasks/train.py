@@ -16,6 +16,8 @@ Librarized equivalent of the reference's training notebook entry point
       horizon: 90
       experiment: finegrain_forecasting
       per_series_runs: false
+      cv_artifact: false            # also log the raw per-cutoff CV
+                                    # forecasts (diagnostics-scale parquet)
       bucketed: false               # span-bucketed fit for ragged batches
       path: fine_grained            # or 'allocated'
       regressors:                   # optional exogenous covariates (curve
@@ -67,6 +69,7 @@ class TrainTask(Task):
             tuning=tr.get("tuning"),
             bucketed=bool(tr.get("bucketed", False)),
             regressors=tr.get("regressors"),
+            cv_artifact=bool(tr.get("cv_artifact", False)),
         )
 
 

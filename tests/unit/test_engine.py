@@ -103,3 +103,19 @@ def test_cv_forecast_frame(batch_small):
     ).groupby([df.store, df.item]).mean().mean()
     assert frame_mape == pytest.approx(float(np.mean(np.asarray(out["mape"]))),
                                        rel=0.05)
+
+
+def test_cross_validate_return_frame_single_pass(batch_small):
+    """return_frame=True yields the same metric means as the plain call
+    plus the diagnostics frame, from ONE forecast pass."""
+    cv = CVConfig(initial=730, period=180, horizon=90)
+    plain = cross_validate(batch_small, model="prophet", cv=cv)
+    both, frame = cross_validate(batch_small, model="prophet", cv=cv,
+                                 return_frame=True)
+    for name in ("mape", "smape", "rmse", "coverage"):
+        np.testing.assert_allclose(
+            np.asarray(both[name]), np.asarray(plain[name]), rtol=1e-5,
+            atol=1e-6,
+        )
+    assert both["_n_cutoffs"] == plain["_n_cutoffs"]
+    assert len(frame) > 0 and {"cutoff", "yhat"} <= set(frame.columns)

@@ -80,6 +80,7 @@ def test_train_deploy_infer_chain(env_conf):
                 "model": "prophet",
                 "cv": {"initial": 400, "period": 180, "horizon": 60},
                 "horizon": 60,
+                "cv_artifact": True,
             },
         }
     )
@@ -95,6 +96,12 @@ def test_train_deploy_infer_chain(env_conf):
     assert "val_mape" in run.metrics()
     assert os.path.exists(run.artifact_path("series_metrics.parquet"))
     assert os.path.isdir(run.artifact_path("forecaster"))
+    # opt-in raw CV frame: per-cutoff rows in the Prophet diagnostics shape
+    import pandas as pd
+
+    cvf = pd.read_parquet(run.artifact_path("cv_forecasts.parquet"))
+    assert {"ds", "cutoff", "y", "yhat"} <= set(cvf.columns)
+    assert cvf.cutoff.nunique() >= 1
 
     deploy = DeployTask(
         init_conf={**env_conf,
@@ -399,6 +406,10 @@ def test_regressor_conf_unsupported_combos(env_conf):
     with pytest.raises(ValueError, match="auto"):
         TrainTask(init_conf={**base, "training": {
             "model": "auto", "regressors": reg}}).launch()
+    # cv_artifact on tuned/auto paths: loud error, not a silent drop
+    with pytest.raises(ValueError, match="cv_artifact"):
+        TrainTask(init_conf={**base, "training": {
+            "model": "auto", "cv_artifact": True}}).launch()
     # non-curve family stays rejected even with tuning enabled (the tuned
     # path is curve-only; silently training prophet would be worse)
     with pytest.raises(ValueError, match="does not accept"):
