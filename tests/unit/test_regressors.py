@@ -539,3 +539,33 @@ def test_serving_shared_xreg_when_R_equals_S(tmp_path):
         got = out[out.item == 1].yhat.to_numpy()
         err = float(np.mean(np.abs(got - y_full[1, T:])))
         assert err < 0.5
+
+
+def test_regressors_for_grid_matches_batch_variant(sales_df_small):
+    """The explicit-grid variant (serving path: artifact day0..day1+h, no
+    SeriesBatch) produces exactly what tensorize_regressors does."""
+    import pandas as pd
+
+    from distributed_forecasting_tpu.data import regressors_for_grid
+
+    batch = tensorize(sales_df_small)
+    horizon = 14
+    dates = batch.dates()
+    all_dates = dates.append(
+        pd.date_range(dates[-1] + pd.Timedelta(days=1), periods=horizon)
+    )
+    cal = pd.DataFrame({
+        "date": all_dates[::5],
+        "price": np.linspace(1.0, 3.0, len(all_dates[::5])),
+    })
+    via_batch = tensorize_regressors(cal, batch, ["price"], horizon=horizon)
+    via_grid = regressors_for_grid(
+        cal, day0=int(np.asarray(batch.day[0])),
+        n_days=batch.n_time + horizon, regressor_cols=["price"],
+    )
+    np.testing.assert_array_equal(np.asarray(via_batch), np.asarray(via_grid))
+
+    # per-series needs the key tables
+    with pytest.raises(ValueError, match="keys"):
+        regressors_for_grid(cal, day0=0, n_days=10, regressor_cols=["price"],
+                            per_series=True)
