@@ -85,6 +85,44 @@ def seasonal_naive_sigma(y, mask, season: int = 7):
     return jnp.where((n > 0) | (var > 0), jnp.maximum(sigma, 1e-6), 1.0)
 
 
+def health_fallback(y, mask, yhat, lo, hi, horizon: int, min_points: int,
+                    season: int = 7):
+    """Shared fail-safe semantics for every training path.
+
+    Reproduces the AutoML ``train_with_fail_safe`` contract (reference
+    ``notebooks/automl/22-09-26...py:131-136``): a series whose forecast has
+    any non-finite value, or with fewer than ``min_points`` observed points,
+    is flagged not-ok and its path replaced by the seasonal-naive fallback
+    with a NON-degenerate 95% band.  Seasonal-naive h-step error variance
+    compounds one innovation per seasonal cycle ahead:
+    var(h) = ceil(h/season) * sigma^2 — the band widens with lead time
+    instead of staying at the 1-step width.
+
+    Returns ``(yhat, lo, hi, ok)``.  Pure jnp — usable inside a jitted
+    engine pass (``_fit_forecast_impl``) and eagerly from the tuned pipeline.
+    """
+    finite = (
+        jnp.all(jnp.isfinite(yhat), axis=1)
+        & jnp.all(jnp.isfinite(lo), axis=1)
+        & jnp.all(jnp.isfinite(hi), axis=1)
+    )
+    enough = jnp.sum(mask, axis=1) >= min_points
+    ok = finite & enough
+
+    fb = seasonal_naive(y, mask, horizon, season=season)
+    fb_sigma = seasonal_naive_sigma(y, mask, season=season)
+    T = y.shape[1]
+    h_fut = jnp.arange(1, horizon + 1, dtype=jnp.float32)
+    widen = jnp.concatenate(
+        [jnp.ones((T,)), jnp.sqrt(jnp.ceil(h_fut / season))]
+    )  # (T + horizon,)
+    band = 1.96 * fb_sigma[:, None] * widen[None, :]
+    yhat = jnp.where(ok[:, None], yhat, fb)
+    lo = jnp.where(ok[:, None], lo, fb - band)
+    hi = jnp.where(ok[:, None], hi, fb + band)
+    return yhat, lo, hi, ok
+
+
 def validate_xreg(fns, model: str, config, xreg, expected_T, what: str,
                   trim_to=None):
     """Shared entry-point validation for exogenous-regressor tensors.
@@ -167,30 +205,8 @@ def _fit_forecast_impl(y, mask, day, key, model, config, horizon, min_points,
         params = fns.fit(y, mask, day, config)
         yhat, lo, hi = fns.forecast(params, day_all, t_end, config, key)
 
-    finite = (
-        jnp.all(jnp.isfinite(yhat), axis=1)
-        & jnp.all(jnp.isfinite(lo), axis=1)
-        & jnp.all(jnp.isfinite(hi), axis=1)
-    )
-    enough = jnp.sum(mask, axis=1) >= min_points
-    ok = finite & enough
-
-    # fallback splice: seasonal-naive path with a NON-degenerate 95% band.
-    # Seasonal-naive h-step error variance compounds one innovation per
-    # seasonal cycle ahead: var(h) = ceil(h/season) * sigma^2 — the band
-    # widens with lead time instead of staying at the 1-step width.
-    season = 7
-    fb = seasonal_naive(y, mask, horizon)
-    fb_sigma = seasonal_naive_sigma(y, mask, season=season)
-    T = y.shape[1]
-    h_fut = jnp.arange(1, horizon + 1, dtype=jnp.float32)
-    widen = jnp.concatenate(
-        [jnp.ones((T,)), jnp.sqrt(jnp.ceil(h_fut / season))]
-    )  # (T + horizon,)
-    band = 1.96 * fb_sigma[:, None] * widen[None, :]
-    yhat = jnp.where(ok[:, None], yhat, fb)
-    lo = jnp.where(ok[:, None], lo, fb - band)
-    hi = jnp.where(ok[:, None], hi, fb + band)
+    yhat, lo, hi, ok = health_fallback(y, mask, yhat, lo, hi, horizon,
+                                       min_points)
     return params, yhat, lo, hi, ok, day_all
 
 

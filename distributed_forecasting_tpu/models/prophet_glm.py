@@ -79,6 +79,8 @@ class CurveModelConfig:
     # seasonality_mode='multiplicative' (Prophet's mode default too).
     n_regressors: int = 0
     regressor_prior_scale: float = 10.0
+    # Prophet's standardize='auto': continuous columns are z-scored for
+    # conditioning; binary 0/1 columns pass through untouched
     regressor_standardize: bool = True
     regressor_names: tuple = ()  # optional, for logging/plots
 
@@ -203,21 +205,44 @@ def _standardize_xreg(xreg, mask, config: CurveModelConfig):
     (padded days carry arbitrary fill); shared (T, R) regressors over the
     whole grid.  A near-constant column (e.g. a promo flag never active in
     history) keeps sd=1 instead of exploding to 1/eps.
+
+    Binary 0/1 columns are left untransformed — Prophet's
+    ``standardize='auto'`` rule — so the effective prior scale on indicator
+    covariates (promo flags) matches reference behavior instead of being
+    rescaled by the flag's rarity.  The check is traced (all observed values
+    in {0, 1}), so it costs one reduction, not a recompile per column set.
     """
     if not config.regressor_standardize:
         R = xreg.shape[-1]
         return xreg, jnp.zeros((R,), jnp.float32), jnp.ones((R,), jnp.float32)
     if xreg.ndim == 3:
         w = mask[:, :, None]
+        # Prophet's rule needs BOTH values observed: an all-ones flag is NOT
+        # binary-exempt — centering it (mu=1) zeroes the column so the ridge
+        # prior pins its coefficient, instead of leaving a ones column
+        # collinear with the intercept
+        obs = w > 0
+        is01 = (
+            jnp.all((xreg == 0) | (xreg == 1) | ~obs, axis=1)
+            & jnp.any((xreg == 0) & obs, axis=1)
+            & jnp.any((xreg == 1) & obs, axis=1)
+        )  # (S, R)
         n = jnp.maximum(w.sum(axis=1), 1.0)  # (S, 1->R broadcast)
         mu = (xreg * w).sum(axis=1) / n  # (S, R)
         var = (((xreg - mu[:, None, :]) ** 2) * w).sum(axis=1) / n
         sd_raw = jnp.sqrt(var)
         sd = jnp.where(sd_raw > 1e-6, sd_raw, 1.0)
+        mu = jnp.where(is01, 0.0, mu)
+        sd = jnp.where(is01, 1.0, sd)
         return (xreg - mu[:, None, :]) / sd[:, None, :], mu, sd
-    mu = xreg.mean(axis=0)  # (R,)
+    is01 = (
+        jnp.all((xreg == 0) | (xreg == 1), axis=0)
+        & jnp.any(xreg == 0, axis=0)
+        & jnp.any(xreg == 1, axis=0)
+    )  # (R,)
+    mu = jnp.where(is01, 0.0, xreg.mean(axis=0))  # (R,)
     sd_raw = xreg.std(axis=0)
-    sd = jnp.where(sd_raw > 1e-6, sd_raw, 1.0)
+    sd = jnp.where(is01 | (sd_raw <= 1e-6), 1.0, sd_raw)
     return (xreg - mu) / sd, mu, sd
 
 

@@ -206,12 +206,16 @@ def detect_anomalies(
     """Score a forecast table's labeled rows for anomalies.
 
     Residual z-scores against the model's own predictive band: the
-    per-row sigma is recovered from the interval width (``(hi - lo) /
-    (2 z_w)`` for the ``interval_width`` the model was fit with), so the
-    score is comparable across series with different scales and across
-    lead times (the band widens with horizon).  A row is flagged when its
-    score exceeds ``score_threshold`` (default: the z of the interval,
-    i.e. y outside the band).  This is the alerting half the reference's
+    per-row sigma is recovered from the UPPER half-band, ``(hi - yhat) /
+    z_w`` for the ``interval_width`` the model was fit with (the lower
+    bound may be clamped — croston floors it at 0, multiplicative/logistic
+    bands are asymmetric in data space — so the full width underestimates
+    sigma), making the score comparable across series with different
+    scales and across lead times (the band widens with horizon).  A row is
+    flagged when its score exceeds ``score_threshold`` (default: the z of
+    the interval — for symmetric bands that is y outside the band; below a
+    clamped lower bound intentionally flags only past the same sigma
+    distance).  This is the alerting half the reference's
     WIP monitoring notebook never got to — built on the forecast table the
     training pipeline already writes, no extra model pass needed.
 
@@ -238,7 +242,13 @@ def detect_anomalies(
         score_threshold = z_w
     y = df[label_col].to_numpy(float)
     yhat = df[prediction_col].to_numpy(float)
-    sigma = (df[hi_c].to_numpy(float) - df[lo_c].to_numpy(float)) / (2.0 * z_w)
+    # sigma from the UPPER half-band only: lower bounds get clamped (croston
+    # floors yhat_lower at 0; multiplicative/logistic bands are asymmetric
+    # in data space), so (hi-lo)/(2z) under-estimates sigma for
+    # intermittent/near-zero series and inflates scores — same rationale as
+    # models/base.gaussian_quantiles.  Approximation for transformed bands:
+    # the upper half-width is read as one z_w of spread in data space.
+    sigma = (df[hi_c].to_numpy(float) - yhat) / z_w
     sigma = np.maximum(sigma, 1e-9)
     df["anomaly_score"] = np.abs(y - yhat) / sigma
     df["is_anomaly"] = df["anomaly_score"] > score_threshold

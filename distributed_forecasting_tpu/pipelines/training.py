@@ -46,6 +46,9 @@ from distributed_forecasting_tpu.utils.config import freeze
 
 _METRICS = ("mse", "rmse", "mae", "mape", "smape", "mdape", "coverage")
 
+# per-series drill-down runs: warn above this count (O(S) host loop)
+_PER_SERIES_RUNS_WARN = 2000
+
 
 def _config_from_conf(model: str, model_conf: Optional[Dict[str, Any]]):
     fns = get_model(model)
@@ -54,6 +57,63 @@ def _config_from_conf(model: str, model_conf: Optional[Dict[str, Any]]):
     return fns.config_cls(
         **{k: freeze(v) for k, v in (model_conf or {}).items()}
     )
+
+
+def _resolve_holidays_conf(
+    model_conf: Optional[Dict[str, Any]], batch, horizon: int
+) -> Optional[Dict[str, Any]]:
+    """Translate a NAMED holiday calendar in a task conf into the static
+    epoch-day spec the curve model carries.
+
+    The reference's AutoML trainer turns on holidays by name alone —
+    ``country_name="US"`` (``notebooks/automl/22-09-26-06:54-Prophet-*.py:118``)
+    — so a task YAML here accepts the same ergonomics::
+
+        model_conf:
+          holidays: US                 # or the expanded form:
+          holidays:
+            calendar: US
+            lower_window: 1            # widen each occurrence like Prophet
+            upper_window: 1
+            custom:                    # extra events, Prophet-dict style
+              promo: ["2017-11-24", "2017-12-26"]
+
+    The calendar is materialized over the batch's date range extended by the
+    horizon (``data/holidays.us_federal_holidays``), so forecast-window
+    occurrences get indicator columns too.  An explicit epoch-day spec
+    (list/tuple of (name, days) pairs) passes through untouched.
+    """
+    if not model_conf or not isinstance(model_conf.get("holidays"), (str, dict)):
+        return model_conf
+    from distributed_forecasting_tpu.data import holidays as H
+
+    spec = model_conf["holidays"]
+    if isinstance(spec, str):
+        spec = {"calendar": spec}
+    lower = int(spec.get("lower_window", 0))
+    upper = int(spec.get("upper_window", 0))
+    epoch = pd.Timestamp("1970-01-01")
+    start = epoch + pd.Timedelta(days=int(batch.day[0]))
+    end = epoch + pd.Timedelta(days=int(batch.day[-1]) + horizon)
+    cal: Dict[str, Any] = {}
+    name = spec.get("calendar")
+    if name:
+        if str(name).upper() != "US":
+            raise ValueError(
+                f"unknown holiday calendar {name!r}; supported: 'US' "
+                f"(plus custom date lists via the 'custom' key)"
+            )
+        cal.update(H.us_federal_holidays(range(start.year, end.year + 1)))
+    for event, dates in (spec.get("custom") or {}).items():
+        cal[str(event)] = [pd.Timestamp(d) for d in dates]
+    if not cal:
+        raise ValueError(
+            "holidays conf resolved to an empty calendar: give 'calendar: "
+            "US', a 'custom' dates dict, or both"
+        )
+    out = dict(model_conf)
+    out["holidays"] = H.holiday_spec(cal, lower, upper)
+    return out
 
 
 def _load_regressors(catalog, regressors: Dict[str, Any], batch, horizon: int,
@@ -149,12 +209,16 @@ class TrainingPipeline:
             )
         from distributed_forecasting_tpu.utils.profiling import PhaseTimer, device_trace
 
-        config = _config_from_conf(model, model_conf)
         timer = PhaseTimer()
         with timer.phase("read"):
             df = self.catalog.read_table(source_table)
         with timer.phase("tensorize"):
             batch = tensorize(df, key_cols=key_cols)
+        # config AFTER tensorize: a named holiday calendar resolves over the
+        # batch's actual date range (+horizon)
+        config = _config_from_conf(
+            model, _resolve_holidays_conf(model_conf, batch, horizon)
+        )
         xreg = None
         if regressors:
             # conf-driven covariates (Prophet add_regressor parity at the
@@ -336,11 +400,12 @@ class TrainingPipeline:
             tune_curve_model,
         )
         from distributed_forecasting_tpu.models import prophet_glm
-        from distributed_forecasting_tpu.models.prophet_glm import CurveModelConfig
 
         df = self.catalog.read_table(source_table)
         batch = tensorize(df, key_cols=key_cols)
-        base = CurveModelConfig(**(model_conf or {}))
+        base = _config_from_conf(
+            "prophet", _resolve_holidays_conf(model_conf, batch, horizon)
+        )
         xreg = None
         if regressors:
             xreg, base = _load_regressors(
@@ -385,16 +450,31 @@ class TrainingPipeline:
         yhat = _jnp.stack([outs[m][0] for m in modes])[pick, arange_s]
         lo = _jnp.stack([outs[m][1] for m in modes])[pick, arange_s]
         hi = _jnp.stack([outs[m][2] for m in modes])[pick, arange_s]
+        # same fail-safe contract as the plain path (engine/fit.py
+        # health_fallback): min_points gating + seasonal-naive splice with
+        # lead-time-widening bands — a degenerate series gets the fallback,
+        # not NaN-free garbage from a tuned refit on two points
+        from distributed_forecasting_tpu.engine.fit import health_fallback
+
+        yhat, lo, hi, ok = health_fallback(
+            batch.y, batch.mask, yhat, lo, hi, horizon, min_points=14
+        )
         fit_seconds = time.time() - t_start
 
-        result = ForecastResult(
-            yhat=yhat, lo=lo, hi=hi,
-            ok=_jnp.isfinite(yhat).all(axis=1), day_all=day_all,
-        )
+        result = ForecastResult(yhat=yhat, lo=lo, hi=hi, ok=ok, day_all=day_all)
+        n_failed = int((~np.asarray(ok)).sum())
+        if n_failed == batch.n_series:
+            raise RuntimeError("no series trained successfully")
+        if n_failed:
+            self.logger.warning(
+                "tuned partial model: %d series fell back", n_failed
+            )
 
         eid = self.tracker.create_experiment(experiment)
         with self.tracker.start_run(
-            eid, run_name="tuned_curve_fit", tags={"model": "prophet", "tuned": "true"}
+            eid, run_name="tuned_curve_fit",
+            tags={"model": "prophet", "tuned": "true",
+                  "partial_model": str(n_failed > 0)},
         ) as run:
             run.log_params(
                 {
@@ -404,10 +484,18 @@ class TrainingPipeline:
                     "horizon": horizon,
                 }
             )
+            # mean over healthy series with a finite CV score — a fallback
+            # series' score is +inf (engine/hyper.py), and a series can be
+            # ok (enough history for a forecast) yet have no observed points
+            # in any CV eval window, which is also +inf
+            scores = np.asarray(tuned.best_score)[np.asarray(ok)]
+            scores = scores[np.isfinite(scores)]
+            val_score = float(np.mean(scores)) if scores.size else float("nan")
             run.log_metrics(
                 {
-                    f"val_{search.metric}": float(np.mean(tuned.best_score)),
+                    f"val_{search.metric}": val_score,
                     "fit_seconds": fit_seconds,
+                    "n_failed_series": float(n_failed),
                 }
             )
             run.log_table("trials.parquet", tuned.trials)
@@ -436,9 +524,9 @@ class TrainingPipeline:
             "run_id": run_id,
             "table_version": version,
             "n_series": batch.n_series,
-            "n_failed": int((~np.asarray(result.ok)).sum()),
+            "n_failed": n_failed,
             "fit_seconds": fit_seconds,
-            "metrics": {f"val_{search.metric}": float(np.mean(tuned.best_score))},
+            "metrics": {f"val_{search.metric}": val_score},
         }
 
     # ---------------------------------------------------------- auto select
@@ -466,14 +554,16 @@ class TrainingPipeline:
         mc = model_conf or {}
         families = tuple(mc.get("families", DEFAULT_FAMILIES))
         metric = mc.get("metric", "smape")
-        configs = {
-            name: _config_from_conf(name, c)
-            for name, c in (mc.get("configs") or {}).items()
-        }
         cv = CVConfig(**(cv_conf or {}))
 
         df = self.catalog.read_table(source_table)
         batch = tensorize(df, key_cols=key_cols)
+        configs = {
+            name: _config_from_conf(
+                name, _resolve_holidays_conf(c, batch, horizon)
+            )
+            for name, c in (mc.get("configs") or {}).items()
+        }
         t_start = time.time()
         params_by_family, selection, result = fit_forecast_auto(
             batch, models=families, configs=configs, metric=metric, cv=cv,
@@ -552,7 +642,32 @@ class TrainingPipeline:
         parent run id, the artifact path, and the series' row index into
         every leading-S parameter array (``serving/predictor.py`` loads the
         pytree; ``gather_params([row])`` extracts exactly this slice).
+
+        This is an O(S) host loop over filesystem run directories — fine at
+        the reference's 500-series scale, pathological at 50k.  Above
+        ``_PER_SERIES_RUNS_WARN`` it warns; above the hard cap (default
+        20000, override ``DFTPU_PER_SERIES_RUNS_MAX``) it raises and points
+        at the ``series_metrics.parquet`` artifact, which already carries
+        every per-series metric in one table.
         """
+        import os
+
+        n = len(series_table)
+        cap = int(os.environ.get("DFTPU_PER_SERIES_RUNS_MAX", "20000"))
+        if n > cap:
+            raise ValueError(
+                f"per_series_runs requested for {n} series, above the "
+                f"{cap}-run cap: one filesystem run-dir per series does not "
+                f"scale. The parent run's series_metrics.parquet artifact "
+                f"already holds every per-series metric; raise "
+                f"DFTPU_PER_SERIES_RUNS_MAX to override."
+            )
+        if n > _PER_SERIES_RUNS_WARN:
+            self.logger.warning(
+                "per_series_runs: creating %d tracker run directories (an "
+                "O(S) host loop) — prefer the batched run's "
+                "series_metrics.parquet at this scale", n,
+            )
         for i, row in enumerate(series_table.itertuples(index=False)):
             d = row._asdict()
             name = f"run_item_{d.get('item')}_store_{d.get('store')}"

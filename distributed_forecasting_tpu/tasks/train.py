@@ -11,7 +11,14 @@ Librarized equivalent of the reference's training notebook entry point
     training:
       model: prophet                # prophet | holt_winters | arima | theta
                                     #   | croston | auto (per-series best-of)
-      model_conf: {...}             # fields of the model's config dataclass
+      model_conf: {...}             # fields of the model's config dataclass;
+                                    # curve model also accepts a NAMED
+                                    # holiday calendar:
+                                    #   holidays: US
+                                    # or {calendar: US, lower_window: 1,
+                                    #     upper_window: 1,
+                                    #     custom: {promo: [2017-11-24]}}
+                                    # resolved over the batch's date range
       cv: {initial: 730, period: 360, horizon: 90}
       horizon: 90
       experiment: finegrain_forecasting

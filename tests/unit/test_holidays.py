@@ -86,3 +86,82 @@ def test_serving_roundtrip_with_holidays(tmp_path):
     assert back.config.holidays == spec  # tuples restored, hashable
     out = back.predict(pd.DataFrame({"store": [1], "item": [1]}), horizon=7)
     assert len(out) == 7
+
+
+def test_named_calendar_conf_resolution(batch_small):
+    """`holidays: US` in a task conf resolves to the static epoch-day spec
+    over the batch's date range + horizon (reference automl trainer enables
+    holidays by name alone — country_name="US")."""
+    from distributed_forecasting_tpu.pipelines.training import (
+        _resolve_holidays_conf,
+    )
+
+    mc = _resolve_holidays_conf({"holidays": "US"}, batch_small, horizon=90)
+    spec = mc["holidays"]
+    names = [n for n, _ in spec]
+    assert "thanksgiving" in names and "christmas" in names
+    lo_day = int(batch_small.day[0])
+    hi_day = int(batch_small.day[-1]) + 90
+    all_days = [d for _, days in spec for d in days]
+    # covers the whole window including forecast-horizon occurrences
+    assert min(all_days) >= lo_day - 366
+    assert max(all_days) <= hi_day + 366
+
+    # expanded form: windows + custom events merge in
+    mc2 = _resolve_holidays_conf(
+        {
+            "holidays": {
+                "calendar": "US",
+                "upper_window": 1,
+                "custom": {"promo": ["2017-11-24"]},
+            }
+        },
+        batch_small,
+        horizon=90,
+    )
+    spec2 = dict(mc2["holidays"])
+    assert "promo" in spec2
+    xmas = dict(spec)["christmas"]
+    assert len(spec2["christmas"]) == 2 * len(xmas)  # day + day-after
+
+    # explicit epoch-day specs and absent keys pass through untouched
+    passthru = {"holidays": [["custom", [17000]]]}
+    assert _resolve_holidays_conf(passthru, batch_small, 90) is passthru
+    assert _resolve_holidays_conf(None, batch_small, 90) is None
+
+
+def test_named_calendar_conf_errors(batch_small):
+    import pytest
+
+    from distributed_forecasting_tpu.pipelines.training import (
+        _resolve_holidays_conf,
+    )
+
+    with pytest.raises(ValueError, match="unknown holiday calendar"):
+        _resolve_holidays_conf({"holidays": "FR"}, batch_small, 90)
+    with pytest.raises(ValueError, match="empty calendar"):
+        _resolve_holidays_conf({"holidays": {}}, batch_small, 90)
+
+
+def test_fine_grained_pipeline_with_named_holidays(tmp_path, sales_df_small):
+    """e2e: YAML-shaped conf alone turns on holiday features."""
+    from distributed_forecasting_tpu.data import DatasetCatalog
+    from distributed_forecasting_tpu.pipelines.training import TrainingPipeline
+    from distributed_forecasting_tpu.tracking import FileTracker
+
+    catalog = DatasetCatalog(str(tmp_path / "wh"))
+    tracker = FileTracker(str(tmp_path / "runs"))
+    catalog.save_table("hackathon.sales.raw", sales_df_small)
+    pipe = TrainingPipeline(catalog, tracker)
+    summary = pipe.fine_grained(
+        "hackathon.sales.raw",
+        "hackathon.sales.holiday_forecasts",
+        model_conf={"holidays": "US", "holiday_prior_scale": 5.0},
+        run_cross_validation=False,
+        horizon=30,
+    )
+    assert summary["n_failed"] == 0
+    run = tracker.get_run(summary["experiment_id"], summary["run_id"])
+    params = run.params()
+    assert int(params["n_holidays"]) == 8  # US federal calendar
+    assert float(params["holiday_prior_scale"]) == 5.0

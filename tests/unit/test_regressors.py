@@ -569,3 +569,67 @@ def test_regressors_for_grid_matches_batch_variant(sales_df_small):
     with pytest.raises(ValueError, match="keys"):
         regressors_for_grid(cal, day0=0, n_days=10, regressor_cols=["price"],
                             per_series=True)
+
+
+def test_binary_regressors_not_standardized():
+    """Prophet's standardize='auto' rule: 0/1 indicator columns keep their
+    raw scale (mu=0, sd=1) while continuous columns are z-scored
+    (ADVICE r2: effective prior on promo flags must match reference)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_forecasting_tpu.models.prophet_glm import (
+        CurveModelConfig,
+        _standardize_xreg,
+    )
+
+    rng = np.random.default_rng(0)
+    T = 200
+    flag = (rng.random(T) < 0.1).astype(np.float32)   # binary
+    cont = rng.normal(5.0, 2.0, T).astype(np.float32)  # continuous
+    x = jnp.asarray(np.stack([flag, cont], axis=1))
+    cfg = CurveModelConfig(n_regressors=2)
+
+    xs, mu, sd = _standardize_xreg(x, None, cfg)
+    assert float(mu[0]) == 0.0 and float(sd[0]) == 1.0
+    np.testing.assert_allclose(np.asarray(xs[:, 0]), flag)
+    assert abs(float(mu[1]) - 5.0) < 0.5 and float(sd[1]) > 1.0
+
+    # per-series form: mask hides a stretch where the flag is fractional —
+    # binary-ness is judged on OBSERVED values only
+    S = 2
+    x3 = jnp.asarray(np.stack([np.stack([flag, cont], axis=1)] * S))
+    mask = np.ones((S, T), np.float32)
+    x3 = x3.at[:, :10, 0].set(0.5)
+    mask[:, :10] = 0.0
+    xs3, mu3, sd3 = _standardize_xreg(x3, jnp.asarray(mask), cfg)
+    assert np.all(np.asarray(mu3[:, 0]) == 0.0)
+    assert np.all(np.asarray(sd3[:, 0]) == 1.0)
+    assert np.all(np.asarray(sd3[:, 1]) > 1.0)
+
+
+def test_always_active_flag_is_centered_not_binary_exempt():
+    """A column of all 1s (flag never off in history) must NOT take the
+    binary exemption: centering zeroes it so the ridge prior pins its
+    coefficient instead of leaving a ones column collinear with the
+    intercept (a planned future 0 would then step the forecast
+    arbitrarily)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_forecasting_tpu.models.prophet_glm import (
+        CurveModelConfig,
+        _standardize_xreg,
+    )
+
+    T = 100
+    ones = np.ones((T, 1), np.float32)
+    cfg = CurveModelConfig(n_regressors=1)
+    xs, mu, sd = _standardize_xreg(jnp.asarray(ones), None, cfg)
+    assert float(mu[0]) == 1.0 and float(sd[0]) == 1.0  # centered, sd floor
+    assert np.allclose(np.asarray(xs), 0.0)
+
+    x3 = jnp.asarray(np.broadcast_to(ones, (2, T, 1)))
+    xs3, mu3, sd3 = _standardize_xreg(x3, jnp.ones((2, T), jnp.float32), cfg)
+    assert np.all(np.asarray(mu3) == 1.0)
+    assert np.allclose(np.asarray(xs3), 0.0)
