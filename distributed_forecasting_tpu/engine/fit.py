@@ -31,6 +31,10 @@ import pandas as pd
 from distributed_forecasting_tpu.data.tensorize import SeriesBatch
 from distributed_forecasting_tpu.models.base import get_model
 
+# shared fail-safe threshold: a series needs at least this many observed
+# points for its model fit to be trusted (else the seasonal-naive fallback)
+DEFAULT_MIN_POINTS = 14
+
 
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass(frozen=True)
@@ -216,7 +220,7 @@ def fit_forecast(
     config=None,
     horizon: int = 90,
     key: Optional[jax.Array] = None,
-    min_points: int = 14,
+    min_points: int = DEFAULT_MIN_POINTS,
     xreg=None,
 ) -> Tuple[object, ForecastResult]:
     """Fit every series and forecast ``horizon`` days past the end of history.
@@ -285,7 +289,7 @@ def fit_forecast_chunked(
     horizon: int = 90,
     key: Optional[jax.Array] = None,
     chunk_size: int = 4096,
-    min_points: int = 14,
+    min_points: int = DEFAULT_MIN_POINTS,
     dispatch: str = "scan",
     xreg=None,
 ) -> Tuple[object, ForecastResult]:
@@ -398,7 +402,7 @@ def fit_forecast_bucketed(
     config=None,
     horizon: int = 90,
     key: Optional[jax.Array] = None,
-    min_points: int = 14,
+    min_points: int = DEFAULT_MIN_POINTS,
     max_buckets: int = 4,
     xreg=None,
 ):

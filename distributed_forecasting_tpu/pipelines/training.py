@@ -454,10 +454,14 @@ class TrainingPipeline:
         # health_fallback): min_points gating + seasonal-naive splice with
         # lead-time-widening bands — a degenerate series gets the fallback,
         # not NaN-free garbage from a tuned refit on two points
-        from distributed_forecasting_tpu.engine.fit import health_fallback
+        from distributed_forecasting_tpu.engine.fit import (
+            DEFAULT_MIN_POINTS,
+            health_fallback,
+        )
 
         yhat, lo, hi, ok = health_fallback(
-            batch.y, batch.mask, yhat, lo, hi, horizon, min_points=14
+            batch.y, batch.mask, yhat, lo, hi, horizon,
+            min_points=DEFAULT_MIN_POINTS,
         )
         fit_seconds = time.time() - t_start
 

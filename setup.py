@@ -29,6 +29,10 @@ setup(
     extras_require={
         "local": ["pyarrow", "scikit-learn"],
         "test": ["pytest", "pytest-cov"],
+        # real-MLflow interop lane: the adapters in tracking/mlflow_compat.py
+        # run against an actual mlflow file/sqlite store
+        # (tests/optional/test_mlflow_real.py; CI job mlflowInterop)
+        "mlflow": ["mlflow>=2.0"],
     },
     entry_points={
         "console_scripts": [

@@ -125,3 +125,26 @@ def test_mlflow_adapter_gated():
 
         t = get_tracker("/tmp/mlruns_test_auto", kind="auto")
         assert isinstance(t, FileTracker)
+
+
+def test_chunked_scan_above_toy_scale():
+    """5k-series smoke of the large-batch path (VERDICT r2 #3): the scan
+    dispatch must produce the same health semantics and finite forecasts at
+    a scale where chunking actually happens (chunk 1024 -> 5 chunks), not
+    just the 10-series equivalence toys."""
+    import numpy as np
+
+    from distributed_forecasting_tpu.data import synthetic_series_batch
+    from distributed_forecasting_tpu.engine import fit_forecast_chunked
+
+    batch = synthetic_series_batch(n_stores=100, n_items=50, n_days=366,
+                                   seed=12)
+    assert batch.n_series == 5000
+    params, res = fit_forecast_chunked(
+        batch, model="prophet", horizon=28, chunk_size=1024, dispatch="scan",
+    )
+    assert res.yhat.shape == (5000, 366 + 28)
+    assert bool(res.ok.all())
+    assert np.isfinite(np.asarray(res.yhat)).all()
+    # params flattened back to the series axis
+    assert params.beta.shape[0] == 5000
