@@ -1,0 +1,156 @@
+"""Settle the pallas-vs-einsum question across the design-width axis.
+
+VERDICT r2 #7: the masked-Gram Pallas kernel loses to XLA's einsum fusion at
+the headline design width (F~64; docs/benchmarks.md) — but the loss was only
+ever measured there.  This script slope-measures BOTH backends at a ladder of
+design widths F (the regime holidays + regressors + high Fourier orders
+actually produce) and prints a table, so the default in ``ops/solve.py`` can
+follow a measurement instead of a single-point extrapolation.
+
+Protocol (same dispatch-cost-cancelled slope as bench.py): the kernel under
+test runs inside one jitted ``lax.scan`` over K pre-staged weight tensors;
+per-step device time is the slope between two scan lengths, which cancels
+dispatch, host overhead, and result-fetch latency — mandatory on a
+remote-attached TPU where one round trip (~66 ms) dwarfs the op.  Backends
+are interleaved (E, P, E, P) within each F so clock drift hits both equally.
+
+Run on the real chip:  python scripts/gram_winregime.py
+(CPU runs the kernel in interpret mode — orders of magnitude slow — so this
+script refuses off-TPU unless --allow-cpu.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+
+def build_inputs(S: int, T: int, F: int, k_staged: int, seed: int = 0):
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    X = jnp.asarray(rng.normal(size=(T, F)).astype(np.float32))
+    Ws = jnp.asarray(
+        (rng.random((k_staged, S, T)) > 0.1).astype(np.float32)
+    )
+    Ys = jnp.asarray(rng.normal(size=(k_staged, S, T)).astype(np.float32))
+    float(X.sum()); float(Ws.sum()); float(Ys.sum())  # stage on device
+    return X, Ws, Ys
+
+
+def make_runner(backend: str, X, interpret: bool):
+    """One jitted scan over (K, S, T) weights: gram + moments + chol solve."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_forecasting_tpu.ops.pallas_gram import (
+        masked_gram_moments_pallas,
+    )
+    from distributed_forecasting_tpu.ops.solve import masked_gram
+
+    F = X.shape[1]
+    eye = jnp.eye(F)
+
+    def step(c, wy):
+        w, y = wy
+        if backend == "pallas":
+            G, b = masked_gram_moments_pallas(X, w, y, interpret=interpret)
+        else:
+            G = masked_gram(X, w)
+            b = jnp.einsum("st,tf->sf", w * y, X, optimize=True)
+        A = G + eye[None] * (1e-2 + 1e-6)
+        chol = jax.scipy.linalg.cho_factor(A, lower=True)
+        beta = jax.scipy.linalg.cho_solve(chol, b[..., None])[..., 0]
+        return c + beta.sum(), None
+
+    @jax.jit
+    def run(Ws, Ys):
+        tot, _ = jax.lax.scan(step, 0.0, (Ws, Ys))
+        return tot
+
+    return run
+
+
+def slope_ms(run, Ws, Ys, reps_long: int, n_rep: int = 3) -> float:
+    """Per-step device ms via the two-length slope."""
+    import jax.numpy as jnp
+
+    k = Ws.shape[0]
+    Wl = jnp.concatenate([Ws] * reps_long)
+    Yl = jnp.concatenate([Ys] * reps_long)
+
+    def timed(W, Y):
+        t0 = time.perf_counter()
+        float(run(W, Y))
+        return time.perf_counter() - t0
+
+    timed(Ws, Ys)  # compile short
+    timed(Wl, Yl)  # compile long
+    t_s = min(timed(Ws, Ys) for _ in range(n_rep))
+    t_l = min(timed(Wl, Yl) for _ in range(n_rep))
+    per = (t_l - t_s) / (k * reps_long - k)
+    if per <= 0:
+        per = t_l / (k * reps_long)  # jitter ate the slope: upper bound
+    return per * 1e3
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--allow-cpu", action="store_true")
+    ap.add_argument("--series", type=int, default=500)
+    ap.add_argument("--days", type=int, default=1826)
+    ap.add_argument("--widths", type=int, nargs="+",
+                    default=[64, 128, 192, 256, 384, 512])
+    ap.add_argument("--staged", type=int, default=4)
+    ap.add_argument("--reps-long", type=int, default=12)
+    args = ap.parse_args()
+
+    # package import first: applies the DFTPU_PLATFORM override through
+    # jax.config BEFORE any device access (a sitecustomize hook may have
+    # imported jax and pinned an accelerator platform already, so the
+    # JAX_PLATFORMS env var alone is read too late and hangs on a dead
+    # tunnel — see .claude/skills/verify/SKILL.md gotchas)
+    import distributed_forecasting_tpu  # noqa: F401
+    import jax
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    if not on_tpu and not args.allow_cpu:
+        sys.exit("refusing on non-TPU backend (pallas runs in interpret "
+                 "mode there); pass --allow-cpu to force")
+    print(f"device: {dev.platform} ({dev.device_kind}); "
+          f"S={args.series} T={args.days}", file=sys.stderr)
+
+    rows = []
+    for F in args.widths:
+        X, Ws, Ys = build_inputs(args.series, args.days, F, args.staged,
+                                 seed=F)
+        run_e = make_runner("einsum", X, interpret=not on_tpu)
+        run_p = make_runner("pallas", X, interpret=not on_tpu)
+        # interleave: E, P, E, P — average the two passes of each
+        e1 = slope_ms(run_e, Ws, Ys, args.reps_long)
+        p1 = slope_ms(run_p, Ws, Ys, args.reps_long)
+        e2 = slope_ms(run_e, Ws, Ys, args.reps_long)
+        p2 = slope_ms(run_p, Ws, Ys, args.reps_long)
+        e, p = (e1 + e2) / 2, (p1 + p2) / 2
+        winner = "pallas" if p < e else "einsum"
+        rows.append((F, e, p, e / p, winner))
+        print(f"F={F:4d}: einsum {e:7.2f} ms/step ({e1:.2f}/{e2:.2f})  "
+              f"pallas {p:7.2f} ms/step ({p1:.2f}/{p2:.2f})  "
+              f"einsum/pallas x{e / p:.2f}  -> {winner}")
+
+    print("\nF, einsum_ms, pallas_ms, ratio_einsum_over_pallas, winner")
+    for F, e, p, r, w in rows:
+        print(f"{F}, {e:.3f}, {p:.3f}, {r:.3f}, {w}")
+    crossover = next((F for F, _, _, r, _ in rows if r > 1.0), None)
+    if crossover is None:
+        print("\nno crossover: einsum wins at every measured F")
+    else:
+        print(f"\npallas first wins at F={crossover}")
+
+
+if __name__ == "__main__":
+    main()
