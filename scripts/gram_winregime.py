@@ -108,6 +108,12 @@ def main() -> None:
     ap.add_argument("--reps-long", type=int, default=12)
     args = ap.parse_args()
 
+    # scripts/ is sys.path[0] when run as `python scripts/gram_winregime.py`;
+    # put the repo root there so the package imports without an editable
+    # install (bench.py gets this for free from running at the root)
+    import os
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     # package import first: applies the DFTPU_PLATFORM override through
     # jax.config BEFORE any device access (a sitecustomize hook may have
     # imported jax and pinned an accelerator platform already, so the

@@ -220,3 +220,33 @@ def test_monitor_monthly_granularity_and_nan_predictions(catalog):
                       granularities=(), slicing_cols=()),
     )
     assert len(empty) == 0
+
+
+def test_detect_anomalies_clamped_lower_band(catalog):
+    """Sigma is recovered from the UPPER half-band only (ADVICE r2): a
+    croston-style row whose lower bound is floored at 0 must not have its
+    sigma halved (and its scores doubled) by the clamp."""
+    import numpy as np
+    import pandas as pd
+
+    from distributed_forecasting_tpu.monitoring import detect_anomalies
+
+    n = 60
+    ds = pd.date_range("2024-01-01", periods=n)
+    yhat = np.full(n, 1.0)
+    sigma = 2.0  # intermittent demand: band much wider than the level
+    y = yhat + np.linspace(-1.0, 3.0, n)  # residuals within ~1.5 sigma
+    df = pd.DataFrame({
+        "ds": ds, "store": 1, "item": 1, "y": y, "yhat": yhat,
+        # lower bound clamped at zero (croston), upper the honest 1.96 sigma
+        "yhat_lower": np.zeros(n),
+        "yhat_upper": yhat + 1.96 * sigma,
+    })
+    catalog.save_table("hackathon.sales.intermittent_fc", df)
+
+    scored = detect_anomalies(catalog, "hackathon.sales.intermittent_fc")
+    # max |residual| is 3.0 = 1.5 sigma -> nothing anomalous.  Under the old
+    # full-width formula sigma would be (1.96*2+1)/(2*1.96) ~ 1.26 and the
+    # worst row would score 2.39 > 1.96: a false positive.
+    assert not scored.is_anomaly.any()
+    assert scored.anomaly_score.max() == pytest.approx(3.0 / 2.0, abs=0.01)
