@@ -1,0 +1,97 @@
+"""Worker process for the real two-process distributed test.
+
+Spawned (twice) by ``test_multihost.py`` with a shared coordinator port —
+the CPU-backend analogue of one host in a pod slice, exactly how the
+reference's integration tier ships the same test code to a real cluster
+(reference ``tests/entrypoint.py`` + ``conf/deployment.yml:19-26``).  Each
+worker:
+
+1. brings up the distributed runtime via the production wrapper
+   (``parallel.mesh.initialize_distributed`` — the code path a
+   ``distributed:`` conf section triggers in ``tasks/common.py``);
+2. takes its host-local series shard with ``host_local_frame`` (stable
+   hash, no coordination — DCN carries input only, SURVEY.md §2.4);
+3. fits ONLY its shard (fits are series-independent; no cross-host fit
+   traffic by design);
+4. aggregates per-series metrics into a global mean with a REAL
+   cross-process collective (``multihost_utils.process_allgather`` — an
+   all-gather through the distributed backend, not host arithmetic);
+5. prints one JSON line the parent asserts on.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--process-id", type=int, required=True)
+    ap.add_argument("--num-processes", type=int, default=2)
+    args = ap.parse_args()
+
+    # hermetic CPU backend BEFORE any device access (the parent also sets
+    # XLA_FLAGS for 4 virtual devices per process)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from distributed_forecasting_tpu.parallel.mesh import initialize_distributed
+
+    initialize_distributed(
+        coordinator_address=f"localhost:{args.port}",
+        num_processes=args.num_processes,
+        process_id=args.process_id,
+    )
+    assert jax.process_count() == args.num_processes, jax.process_count()
+    assert jax.process_index() == args.process_id
+    n_local = jax.local_device_count()
+    n_global = jax.device_count()
+    assert n_global == args.num_processes * n_local, (n_global, n_local)
+
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    from distributed_forecasting_tpu.data import (
+        synthetic_store_item_sales,
+        tensorize,
+    )
+    from distributed_forecasting_tpu.engine import fit_forecast
+    from distributed_forecasting_tpu.ops import metrics as M
+    from distributed_forecasting_tpu.parallel.distributed import (
+        host_local_frame,
+    )
+
+    # identical global table on every host; each host tensorizes ONLY its
+    # hash-owned shard
+    df = synthetic_store_item_sales(n_stores=2, n_items=5, n_days=240, seed=5)
+    local = host_local_frame(df)
+    assert len(local) < len(df), "shard must be a proper subset"
+    batch = tensorize(local)
+    _, res = fit_forecast(batch, model="prophet", horizon=14)
+    mape = M.mape(batch.y, res.yhat[:, : batch.n_time], batch.mask)
+
+    # cross-process all-gather through the distributed backend: per-host
+    # (weighted-sum, count) pairs -> identical global mean on every host
+    local_stats = jnp.asarray(
+        [jnp.sum(mape), mape.shape[0]], dtype=jnp.float32
+    )
+    gathered = multihost_utils.process_allgather(local_stats)  # (P, 2)
+    total, count = np.asarray(gathered).sum(axis=0)
+    print(json.dumps({
+        "process_id": args.process_id,
+        "processes": jax.process_count(),
+        "global_devices": n_global,
+        "n_local_series": int(batch.n_series),
+        "global_mean_mape": round(float(total / count), 6),
+        "all_ok": bool(np.asarray(res.ok).all()),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
+    sys.exit(0)

@@ -1,0 +1,105 @@
+"""REAL two-process distributed runtime test (no monkeypatch).
+
+Round 2 verified the multi-host plumbing only by monkeypatching
+``jax.distributed.initialize``; this spawns TWO actual processes that rendezvous
+through a coordinator, shard the series axis by the stable hash, fit their
+local shards, and agree on a global metric via a cross-process collective —
+the CPU-backend equivalent of the reference running its integration test on
+a real cluster (``azure-pipelines.yml:42-58``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_WORKER = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_two_process_distributed_fit_and_allgather():
+    port = _free_port()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.getcwd()] + env.get("PYTHONPATH", "").split(os.pathsep)
+    )
+    # stdout/stderr go to files, not PIPEs: the workers run CONCURRENTLY
+    # (they rendezvous), and a sequential communicate() would leave the
+    # other worker's pipes undrained — chatty Gloo/absl logging filling an
+    # OS pipe buffer would deadlock the collective and time the test out
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        files = []
+        procs = []
+        for i in range(2):
+            fo = open(os.path.join(td, f"out{i}"), "w+")
+            fe = open(os.path.join(td, f"err{i}"), "w+")
+            files.append((fo, fe))
+            procs.append(subprocess.Popen(
+                [sys.executable, _WORKER, "--port", str(port),
+                 "--process-id", str(i), "--num-processes", "2"],
+                env=env, stdout=fo, stderr=fe, text=True,
+            ))
+        outs = []
+        try:
+            for p, (fo, fe) in zip(procs, files):
+                try:
+                    p.wait(timeout=240)
+                except subprocess.TimeoutExpired:
+                    for q in procs:
+                        q.kill()
+                    raise
+                fo.seek(0), fe.seek(0)
+                out, err = fo.read(), fe.read()
+                assert p.returncode == 0, f"worker failed:\n{err[-2000:]}"
+                # Gloo (the CPU cross-process collective transport) chats on
+                # stdout around the worker's one JSON line — find it
+                payload = [ln for ln in out.splitlines()
+                           if ln.startswith("{")]
+                assert payload, f"no JSON in worker stdout:\n{out[-2000:]}"
+                outs.append(json.loads(payload[-1]))
+        finally:
+            for fo, fe in files:
+                fo.close(), fe.close()
+
+    a, b = sorted(outs, key=lambda o: o["process_id"])
+    assert (a["processes"], b["processes"]) == (2, 2)
+    assert a["global_devices"] == b["global_devices"] == 8
+    # the hash partition covers all 10 series exactly once
+    assert a["n_local_series"] + b["n_local_series"] == 10
+    assert a["n_local_series"] > 0 and b["n_local_series"] > 0
+    assert a["all_ok"] and b["all_ok"]
+    # both hosts computed the SAME global mean through the collective
+    assert a["global_mean_mape"] == b["global_mean_mape"]
+
+    # and it matches a single-process full-batch fit (fits are per-series
+    # independent, so sharding must not change the numbers)
+    from distributed_forecasting_tpu.data import (
+        synthetic_store_item_sales,
+        tensorize,
+    )
+    from distributed_forecasting_tpu.engine import fit_forecast
+    from distributed_forecasting_tpu.ops import metrics as M
+
+    df = synthetic_store_item_sales(n_stores=2, n_items=5, n_days=240, seed=5)
+    batch = tensorize(df)
+    _, res = fit_forecast(batch, model="prophet", horizon=14)
+    ref = float(np.mean(np.asarray(
+        M.mape(batch.y, res.yhat[:, : batch.n_time], batch.mask)
+    )))
+    assert abs(a["global_mean_mape"] - ref) < 1e-4, (a["global_mean_mape"], ref)
