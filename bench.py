@@ -433,36 +433,48 @@ def main() -> None:
         if not budget_left():
             raise RuntimeError("probe budget exhausted")
         import dataclasses as _dc
+        import math
 
         from distributed_forecasting_tpu.data import synthetic_series_batch
         from distributed_forecasting_tpu.models import holt_winters as hw
 
-        T_long = 20000
-        S_long = 8
-        b_long = synthetic_series_batch(
-            n_stores=1, n_items=S_long, n_days=T_long, seed=21
+        # two points, one per regime: (a) many lanes x long T — the grid
+        # fills the chip, sequential depth is hidden, scan should win;
+        # (b) ONE series x ONE grid lane x very long T — nothing to
+        # vectorize over, depth IS the bottleneck, the associative scan's
+        # O(log T) depth should win.  Reporting both keeps the
+        # filter-default story honest instead of extrapolating from (a).
+        points = (
+            ("lanes", 8, 20000, dict(n_alpha=3, n_beta=2, n_gamma=2)),
+            ("depth", 1, 200000, dict(n_alpha=1, n_beta=1, n_gamma=1)),
         )
-        float(b_long.y.sum())
-        cfg_scan = hw.HoltWintersConfig(seasonality_mode="additive",
-                                        n_alpha=3, n_beta=2, n_gamma=2)
-        cfg_ps = _dc.replace(cfg_scan, filter="pscan")
-        out = {}
-        for label, cfg in (("scan", cfg_scan), ("pscan", cfg_ps)):
-            p = hw.fit(b_long.y, b_long.mask, b_long.day, cfg)
-            float(p.level.sum())  # compile + barrier
-            ts = []
-            for _ in range(2):
-                t0 = time.perf_counter()
+        for regime, S_long, T_long, grid in points:
+            b_long = synthetic_series_batch(
+                n_stores=1, n_items=S_long, n_days=T_long, seed=21
+            )
+            float(b_long.y.sum())
+            cfg_scan = hw.HoltWintersConfig(
+                seasonality_mode="additive", **grid
+            )
+            cfg_ps = _dc.replace(cfg_scan, filter="pscan")
+            out = {}
+            for label, cfg in (("scan", cfg_scan), ("pscan", cfg_ps)):
                 p = hw.fit(b_long.y, b_long.mask, b_long.day, cfg)
-                float(p.level.sum())
-                ts.append(time.perf_counter() - t0)
-            out[label] = min(ts)
-        print(
-            f"[bench] HW long-T (S={S_long}, T={T_long}): "
-            f"scan {out['scan']:.3f}s vs pscan {out['pscan']:.3f}s "
-            f"(speedup x{out['scan'] / out['pscan']:.2f})",
-            file=sys.stderr,
-        )
+                float(p.level.sum())  # compile + barrier
+                ts = []
+                for _ in range(2):
+                    t0 = time.perf_counter()
+                    p = hw.fit(b_long.y, b_long.mask, b_long.day, cfg)
+                    float(p.level.sum())
+                    ts.append(time.perf_counter() - t0)
+                out[label] = min(ts)
+            print(
+                f"[bench] HW long-T [{regime} regime] (S={S_long}, "
+                f"T={T_long}, lanes={S_long * math.prod(grid.values())}):"
+                f" scan {out['scan']:.3f}s vs pscan {out['pscan']:.3f}s "
+                f"(pscan speedup x{out['scan'] / out['pscan']:.2f})",
+                file=sys.stderr,
+            )
     except Exception as e:
         print(f"[bench] long-T probe failed: {type(e).__name__}: {e}",
               file=sys.stderr)

@@ -144,6 +144,21 @@ class BucketedForecaster:
             )
         return xr[fc.day0 - d0_union: fc.day1 + horizon - d0_union + 1]
 
+    def warmup(self, horizon: int = 90, sizes=(1,)) -> int:
+        """Precompile every span bucket's predict path (see
+        ``BatchForecaster.warmup``).
+
+        Requests route to per-bucket forecasters by key, so a listed size
+        may split into any smaller sub-request — warm the full power-of-two
+        ladder up to the largest requested size in every member.
+        """
+        from distributed_forecasting_tpu.serving.predictor import _bucket_ladder
+
+        return sum(
+            fc.warmup(horizon=horizon, sizes=_bucket_ladder(sizes))
+            for fc in self.forecasters
+        )
+
     def predict(
         self,
         request: pd.DataFrame,

@@ -98,6 +98,30 @@ class MultiModelForecaster:
         return cls(fcs, np.asarray(meta["assignment"]))
 
     # -- inference ----------------------------------------------------------
+    @property
+    def n_series(self) -> int:
+        return int(self.keys.shape[0])
+
+    def warmup(self, horizon: int = 90, sizes=(1,)) -> int:
+        """Precompile every family's predict path (see
+        ``BatchForecaster.warmup``).
+
+        A mixed request splits by per-series assignment, so the member
+        sub-request sizes are unpredictable — warm the FULL power-of-two
+        ladder up to the largest requested size in every family, which
+        covers any split of a listed size.
+        """
+        from distributed_forecasting_tpu.serving.predictor import (
+            _bucket_ladder,
+        )
+
+        return sum(
+            self.forecasters[m].warmup(
+                horizon=horizon, sizes=_bucket_ladder(sizes)
+            )
+            for m in self.models
+        )
+
     def predict(
         self,
         request: pd.DataFrame,

@@ -81,6 +81,23 @@ def quantile_columns(quantiles) -> list:
     return [f"q{float(q):g}" for q in quantiles]
 
 
+def _bucket_ladder(sizes) -> tuple:
+    """Every power-of-two request bucket up to the largest requested size.
+
+    Composite forecasters (ensemble/bucketed) split a request across
+    members by per-series routing, so a listed warmup size can reach a
+    member as ANY smaller sub-request; warming the whole ladder covers
+    every possible split.  (1, 2, 4, ..., bucket(max(sizes))).
+    """
+    top = max(max(int(k), 1) for k in sizes)
+    top_bucket = 1 << (top - 1).bit_length() if top > 1 else 1
+    ladder, b = [], 1
+    while b <= top_bucket:
+        ladder.append(b)
+        b <<= 1
+    return tuple(ladder)
+
+
 class BatchForecaster:
     """Loads once, predicts every requested series in one compiled call."""
 
@@ -237,8 +254,7 @@ class BatchForecaster:
             self.day0, self.day1 + horizon + 1, dtype=jnp.int32
         )
         k = int(sidx.size)
-        bucket = min(1 << (k - 1).bit_length(), self.keys.shape[0])
-        bucket = max(bucket, k)  # k == S but S not a power of two
+        bucket = self._bucket(k)
         padded = np.concatenate([sidx, np.full(bucket - k, sidx[0], sidx.dtype)])
         params = self.gather_params(padded)
         fc_kwargs = {}
@@ -285,6 +301,57 @@ class BatchForecaster:
         for j, name in enumerate(self.key_names):
             frame[name] = np.repeat(self.keys[sidx, j], T)
         return frame
+
+    @property
+    def n_series(self) -> int:
+        """Trained-series count — uniform accessor across BatchForecaster /
+        MultiModelForecaster / BucketedForecaster (the serve task and the
+        /health endpoint must not reach for `.keys`, which the bucketed
+        composite does not have)."""
+        return int(self.keys.shape[0])
+
+    def _bucket(self, k: int) -> int:
+        """Request-size bucket: next power of two, capped at S.
+
+        The ONE bucketing policy — shared by the live request path
+        (`_prepare_request`) and `warmup`, so startup always compiles
+        exactly the shapes production requests will hit.
+        """
+        S = self.keys.shape[0]
+        bucket = min(1 << (k - 1).bit_length(), S)
+        return max(bucket, k)  # k == S but S not a power of two
+
+    def warmup(self, horizon: int = 90, sizes=(1,)) -> int:
+        """Precompile the predict path for the given request-size buckets.
+
+        A long-lived scorer compiles one program per (bucket, horizon)
+        shape; without warmup the FIRST request of each bucket size pays
+        that compile (~seconds, 20-40 s on a cold TPU) inside its latency.
+        Runs one throwaway predict per distinct bucket so production
+        requests hit the cache.  Covered: `predict` at this horizon, the
+        listed sizes, shared-covariate models (warmed with a zero (T_all,
+        R) calendar).  NOT covered — first use still compiles: other
+        horizons, `predict_quantiles` (one program per quantile tuple),
+        per-series (S, T_all, R) covariate requests.  Returns the number
+        of distinct buckets compiled.
+
+        Sizes beyond the trained-series count clamp to S (a serve conf
+        sized for a big artifact must not make a small one compile — and
+        report — phantom buckets).
+        """
+        S = self.keys.shape[0]
+        buckets = sorted({
+            self._bucket(min(max(int(k), 1), S)) for k in sizes
+        })
+        xreg = None
+        R = getattr(self.config, "n_regressors", 0)
+        if R:
+            T_all = self.day1 - self.day0 + horizon + 1
+            xreg = jnp.zeros((T_all, R), jnp.float32)
+        for b in buckets:
+            req = pd.DataFrame(self.keys[:b], columns=self.key_names)
+            self.predict(req, horizon=horizon, xreg=xreg)
+        return len(buckets)
 
     def predict(
         self,

@@ -97,7 +97,9 @@ class _Handler(BaseHTTPRequestHandler):
                 {
                     "status": "ok",
                     "model": getattr(fc, "model", "ensemble"),
-                    "n_series": int(fc.keys.shape[0]),
+                    # n_series, not .keys: the span-bucketed composite has
+                    # no top-level key table, only per-bucket routing
+                    "n_series": int(fc.n_series),
                     "version": self.server.model_version,
                 },
             )

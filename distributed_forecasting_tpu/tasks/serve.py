@@ -14,6 +14,10 @@ Conf::
       stage: Staging          # optional latest-version filter
       host: 0.0.0.0
       port: 8080
+      warmup_sizes: [1, 8]    # optional: precompile these request-size
+      warmup_horizon: 90      # buckets before accepting traffic, so the
+                              # first request of each size doesn't pay the
+                              # compile inside its latency
 """
 
 from __future__ import annotations
@@ -28,9 +32,21 @@ class ServeTask(Task):
         name = conf.get("model_name", "ForecastingBatchModel")
         stage = conf.get("stage")
         forecaster, version = resolve_from_registry(self.registry, name, stage=stage)
+        sizes = conf.get("warmup_sizes")
+        if sizes:
+            import time
+
+            t0 = time.time()
+            n = forecaster.warmup(
+                horizon=int(conf.get("warmup_horizon", 90)),
+                sizes=[int(s) for s in sizes],
+            )
+            self.logger.info(
+                "warmed %d request-size bucket(s) in %.1fs", n, time.time() - t0
+            )
         self.logger.info(
             "serving %s v%s (%d series) on %s:%s",
-            name, version.version, forecaster.keys.shape[0],
+            name, version.version, forecaster.n_series,
             conf.get("host", "0.0.0.0"), conf.get("port", 8080),
         )
         serve(

@@ -1,0 +1,41 @@
+#!/bin/bash
+# One-shot harvest of a healthy-TPU-tunnel window: run every measurement
+# that needs the real chip, capturing logs under scripts/tpu_logs/.
+#
+# The tunnel degrades for hours at a time (see bench.py choose_backend), so
+# when a window opens the order matters — cheapest/highest-value first:
+#   1. integration tier (make test-tpu): the <10s envelope + pscan lowering
+#      + on-device regressors, ~ minutes
+#   2. full bench suite ambient: the BENCH artifact preview (headline + CV +
+#      scale + arima + long-T + pallas comparison)
+#   3. width-regime gram measurement: settles the pallas default by F
+#
+# Usage: bash scripts/tpu_window.sh            (from the repo root)
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p scripts/tpu_logs
+ts=$(date +%Y%m%dT%H%M%S)
+
+echo "== probe =="
+if ! timeout 90 python -c "import jax, jax.numpy as jnp; d=jax.devices()[0]; assert d.platform=='tpu', d; print('TPU OK', d.device_kind, float(jnp.ones((256,256)).sum()))"; then
+  echo "tunnel not healthy; aborting (nothing written)"
+  exit 1
+fi
+
+echo "== 1/3 integration tier (make test-tpu) =="
+timeout 1800 make test-tpu 2>&1 | tee "scripts/tpu_logs/test_tpu_${ts}.log"
+echo "test-tpu rc=${PIPESTATUS[0]}" | tee -a "scripts/tpu_logs/test_tpu_${ts}.log"
+
+echo "== 2/3 full bench suite =="
+DFTPU_BENCH_BUDGET=600 timeout 1800 python bench.py \
+  > "scripts/tpu_logs/bench_${ts}.json" \
+  2> "scripts/tpu_logs/bench_${ts}.log"
+echo "bench rc=$?" >> "scripts/tpu_logs/bench_${ts}.log"
+cat "scripts/tpu_logs/bench_${ts}.json"
+tail -20 "scripts/tpu_logs/bench_${ts}.log"
+
+echo "== 3/3 gram width-regime =="
+timeout 1800 python scripts/gram_winregime.py 2>&1 \
+  | tee "scripts/tpu_logs/gram_winregime_${ts}.log"
+
+echo "== done: logs in scripts/tpu_logs/*_${ts}.* =="

@@ -123,6 +123,10 @@ def test_registry_resolution_and_serve_task(tmp_path):
     assert version.version == 1
     assert loaded.keys.shape[0] == 2
 
+    # warmup before accepting traffic (the serve task's warmup_sizes conf
+    # path): compiles the size-1 bucket so the first request hits the cache
+    assert loaded.warmup(horizon=7, sizes=(1,)) == 1
+
     srv = start_server(loaded, model_version=str(version.version))
     try:
         code, out = _call(
@@ -221,3 +225,44 @@ def test_invocations_quantiles(server):
                   {"inputs": [{"store": 1, "item": 2}], "horizon": 7,
                    "quantiles": bad})
         assert e.value.code == 400
+
+
+def test_bucketed_artifact_serves_health_and_invocations(tmp_path):
+    """A span-bucketed artifact must serve end-to-end: /health reads
+    n_series (the composite has no top-level key table) and requests route
+    through the per-bucket forecasters."""
+    import numpy as np
+    import pandas as pd
+
+    from distributed_forecasting_tpu.data import tensorize
+    from distributed_forecasting_tpu.engine import fit_forecast_bucketed
+    from distributed_forecasting_tpu.serving import BucketedForecaster
+
+    rng = np.random.default_rng(3)
+    rows = []
+    dates = pd.date_range("2015-01-01", periods=900)
+    for item, span in ((1, 900), (2, 900), (3, 200), (4, 200)):
+        d = dates[-span:]
+        rows.append(pd.DataFrame({
+            "date": d, "store": 1, "item": item,
+            "sales": 20 + 5 * np.sin(np.arange(span) / 58.1)
+            + rng.normal(0, 0.5, span),
+        }))
+    batch = tensorize(pd.concat(rows, ignore_index=True))
+    buckets, _ = fit_forecast_bucketed(batch, model="prophet", horizon=14)
+    bf = BucketedForecaster.from_bucketed_fit(buckets, "prophet")
+    assert bf.n_series == 4
+    assert bf.warmup(horizon=7, sizes=(2,)) >= 2  # ladder: 1 and 2 per member
+
+    srv = start_server(bf, model_version="1")
+    try:
+        code, out = _call(srv, "/health", None)
+        assert code == 200 and out["n_series"] == 4
+        code, out = _call(
+            srv, "/invocations",
+            {"inputs": [{"store": 1, "item": 1}, {"store": 1, "item": 3}],
+             "horizon": 7},
+        )
+        assert code == 200 and len(out["predictions"]) == 14
+    finally:
+        srv.shutdown()

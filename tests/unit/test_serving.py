@@ -122,3 +122,76 @@ def test_legacy_artifact_without_regressor_fields_loads(tmp_path, batch_small):
     out = fc2.predict(req, horizon=14)
     assert len(out) == 14
     assert np.isfinite(out.yhat).all()
+
+
+def test_warmup_precompiles_buckets(tmp_path):
+    """warmup() compiles the predict path for each requested bucket so the
+    first live request doesn't pay the compile; regressor models warm the
+    shared-covariate shape with zeros."""
+    import dataclasses
+
+    import jax.numpy as jnp
+    import numpy as np
+    import pandas as pd
+
+    from distributed_forecasting_tpu.data import (
+        synthetic_store_item_sales,
+        tensorize,
+    )
+    from distributed_forecasting_tpu.engine import fit_forecast
+    from distributed_forecasting_tpu.models.prophet_glm import CurveModelConfig
+    from distributed_forecasting_tpu.serving import BatchForecaster
+
+    df = synthetic_store_item_sales(n_stores=2, n_items=5, n_days=400, seed=1)
+    b = tensorize(df)
+    cfg = CurveModelConfig()
+    params, _ = fit_forecast(b, model="prophet", config=cfg, horizon=14)
+    fc = BatchForecaster.from_fit(b, params, "prophet", cfg)
+    # sizes 1, 2, 3, 8 -> buckets {1, 2, 4, 8}
+    assert fc.warmup(horizon=14, sizes=(1, 2, 3, 8)) == 4
+    out = fc.predict(pd.DataFrame({"store": [1], "item": [1]}), horizon=14)
+    assert len(out) == 14
+
+    # regressor-fit model: warmup supplies the zero covariate calendar
+    T_all = b.n_time + 14
+    xreg = jnp.asarray(
+        np.random.default_rng(0).normal(size=(T_all, 1)).astype(np.float32)
+    )
+    cfg_x = dataclasses.replace(cfg, n_regressors=1)
+    params_x, _ = fit_forecast(
+        b, model="prophet", config=cfg_x, horizon=14, xreg=xreg
+    )
+    fcx = BatchForecaster.from_fit(b, params_x, "prophet", cfg_x)
+    assert fcx.warmup(horizon=14, sizes=(1,)) == 1
+
+
+def test_warmup_on_composite_forecasters(tmp_path):
+    """Ensemble and span-bucketed artifacts warm their member forecasters
+    (the serve task calls warmup unconditionally when conf asks for it)."""
+    from distributed_forecasting_tpu.data import (
+        synthetic_store_item_sales,
+        tensorize,
+    )
+    from distributed_forecasting_tpu.engine import (
+        fit_forecast_bucketed,
+        fit_forecast_auto,
+    )
+    from distributed_forecasting_tpu.serving import (
+        BucketedForecaster,
+        MultiModelForecaster,
+    )
+
+    df = synthetic_store_item_sales(n_stores=1, n_items=4, n_days=400, seed=2)
+    b = tensorize(df)
+    from distributed_forecasting_tpu.engine import CVConfig
+
+    params_by_family, selection, _ = fit_forecast_auto(
+        b, models=("prophet", "holt_winters"), horizon=14,
+        cv=CVConfig(initial=300, period=60, horizon=30),
+    )
+    mm = MultiModelForecaster.from_fit(b, params_by_family, None, selection)
+    assert mm.warmup(horizon=14, sizes=(1, 2)) >= 2
+
+    buckets, _ = fit_forecast_bucketed(b, model="prophet", horizon=14)
+    bf = BucketedForecaster.from_bucketed_fit(buckets, "prophet")
+    assert bf.warmup(horizon=14, sizes=(1,)) >= 1
