@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import dataclasses
 from functools import partial
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -54,6 +55,14 @@ class CurveModelConfig:
     # (Prophet takes an explicit cap column; a data-derived cap covers the
     # retail-demand case without a second input table)
     cap_multiplier: float = 1.1
+    # Prophet's explicit saturating bounds: cap_value overrides the
+    # data-derived rule with a known shared capacity (Prophet's `cap`
+    # column); floor_value is the saturating minimum (Prophet's `floor`) —
+    # the trend is linear in logit((y - floor)/(cap - floor)) space, so the
+    # forecast saturates at both bounds.  floor_value only applies to
+    # logistic growth.
+    cap_value: Optional[float] = None
+    floor_value: float = 0.0
     n_changepoints: int = 25
     changepoint_range: float = 0.8
     changepoint_prior_scale: float = 0.05
@@ -110,16 +119,19 @@ class CurveParams:
     )
 
 
-def _fit_space(y, mask, mode, cap=None):
+def _fit_space(y, mask, mode, cap=None, floor=0.0):
     """Transform observations into the (additive) fitting space.
 
-    multiplicative -> log space; logistic growth -> logit of y/cap (the
-    saturating-growth analogue: a linear trend in logit space is a logistic
-    curve in data space, matching Prophet's ``growth='logistic'`` intent
-    with a data-derived cap); otherwise identity.
+    multiplicative -> log space; logistic growth -> logit of
+    (y - floor)/(cap - floor) (the saturating-growth analogue: a linear
+    trend in logit space is a logistic curve in data space, matching
+    Prophet's ``growth='logistic'`` with its ``cap``/``floor`` bounds);
+    otherwise identity.
     """
     if cap is not None:
-        frac = jnp.clip(y / cap[:, None], _LOG_EPS, 1.0 - _LOG_EPS)
+        frac = jnp.clip(
+            (y - floor) / (cap[:, None] - floor), _LOG_EPS, 1.0 - _LOG_EPS
+        )
         return jnp.log(frac / (1.0 - frac)) * mask
     if mode == "multiplicative":
         return jnp.log(jnp.maximum(y, _LOG_EPS)) * mask
@@ -285,10 +297,28 @@ def fit(y, mask, day, config: CurveModelConfig, prior_scales=None,
     t0 = day[0].astype(jnp.float32)
     t1 = day[-1].astype(jnp.float32)
     if config.growth == "logistic":
-        cap = config.cap_multiplier * jnp.maximum(
-            jnp.max(y * mask, axis=1), _LOG_EPS
-        )
-        z = _fit_space(y, mask, config.seasonality_mode, cap=cap)
+        if config.cap_value is not None:
+            if config.cap_value <= config.floor_value:
+                raise ValueError(
+                    f"cap_value ({config.cap_value}) must exceed "
+                    f"floor_value ({config.floor_value})"
+                )
+            cap = jnp.full((y.shape[0],), float(config.cap_value))
+        else:
+            if config.floor_value != 0.0:
+                # the data-derived rule assumes the saturation range starts
+                # at 0; a floor above a small series' derived cap would
+                # silently invert the logit.  Prophet likewise only defines
+                # `floor` alongside an explicit `cap`.
+                raise ValueError(
+                    "floor_value requires an explicit cap_value (the "
+                    "cap_multiplier rule derives capacity from 0)"
+                )
+            cap = config.cap_multiplier * jnp.maximum(
+                jnp.max(y * mask, axis=1), _LOG_EPS
+            )
+        z = _fit_space(y, mask, config.seasonality_mode, cap=cap,
+                       floor=float(config.floor_value))
         y_scale = jnp.ones((y.shape[0],))
     else:
         cap = jnp.ones((y.shape[0],))
@@ -436,7 +466,8 @@ def _to_data_space(v, params: CurveParams, config):
     trailing axes (v leads with S)."""
     if config.growth == "logistic":
         cap = params.cap.reshape((-1,) + (1,) * (v.ndim - 1))
-        return cap * jax.nn.sigmoid(v)
+        floor = float(config.floor_value)
+        return floor + (cap - floor) * jax.nn.sigmoid(v)
     if config.seasonality_mode == "multiplicative":
         return jnp.exp(v)
     return v

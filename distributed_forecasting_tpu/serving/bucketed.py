@@ -63,6 +63,12 @@ class BucketedForecaster:
         return len(self._route)
 
     @property
+    def model(self) -> str:
+        """All span buckets share one family (from_bucketed_fit contract) —
+        surface it so /health reports the real model, not a placeholder."""
+        return self.forecasters[0].model
+
+    @property
     def serving_schema(self) -> str:
         return self.forecasters[0].serving_schema
 

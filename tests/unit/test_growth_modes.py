@@ -49,3 +49,59 @@ def test_flat_growth_has_no_trend():
     early_future = flat[700:730].mean()
     late_future = flat[-30:].mean()
     assert abs(late_future - early_future) < 12
+
+
+def test_logistic_explicit_cap_and_floor():
+    """Prophet's explicit saturating bounds: cap_value overrides the
+    data-derived rule; floor_value saturates the forecast from below —
+    declining series flatten at the floor instead of crossing it."""
+    import pytest
+
+    T = 700
+    t = np.arange(T)
+    # decline from ~90 toward a known floor of 20 with weekly wiggle
+    y = 20 + 70 / (1 + np.exp((t - 250) / 60))
+    y = y * (1 + 0.02 * np.sin(2 * np.pi * t / 7))
+    y = y + np.random.default_rng(1).normal(0, 0.5, T)
+    df = pd.DataFrame(
+        {"date": pd.date_range("2020-01-01", periods=T), "store": 1,
+         "item": 1, "sales": y}
+    )
+    b = tensorize(df)
+
+    cfg = P.CurveModelConfig(growth="logistic", seasonality_mode="additive",
+                             yearly_order=0, cap_value=100.0,
+                             floor_value=20.0)
+    p = P.fit(b.y, b.mask, b.day, cfg)
+    # explicit cap overrides the data-derived multiplier rule
+    assert np.allclose(np.asarray(p.cap), 100.0)
+    day_all = jnp.arange(int(b.day[0]), int(b.day[-1]) + 361,
+                         dtype=jnp.int32)
+    yh, lo, hi = P.forecast(p, day_all, b.day[-1].astype(jnp.float32), cfg)
+    yh = np.asarray(yh)[0]
+    # bounded on both sides, and the decline saturates NEAR the floor
+    # instead of crossing it (a linear trend would go negative here)
+    assert yh.min() >= 20.0 - 1e-3
+    assert yh.max() <= 100.0 + 1e-3
+    assert 20.0 <= yh[-30:].mean() < 30.0
+
+    # without the floor, the same series fit floor-free saturates at 0
+    # (old behavior preserved: floor_value defaults to 0)
+    cfg0 = P.CurveModelConfig(growth="logistic", seasonality_mode="additive",
+                              yearly_order=0)
+    p0 = P.fit(b.y, b.mask, b.day, cfg0)
+    yh0, _, _ = P.forecast(p0, day_all, b.day[-1].astype(jnp.float32), cfg0)
+    assert np.asarray(yh0).min() >= -1e-3
+
+    # invalid bounds fail loudly at fit time
+    bad = P.CurveModelConfig(growth="logistic", cap_value=10.0,
+                             floor_value=20.0)
+    with pytest.raises(ValueError, match="cap_value"):
+        P.fit(b.y, b.mask, b.day, bad)
+
+    # a floor without an explicit cap is rejected too: the data-derived
+    # capacity rule starts at 0 and a large floor would silently invert
+    # the logit for small series
+    bad2 = P.CurveModelConfig(growth="logistic", floor_value=20.0)
+    with pytest.raises(ValueError, match="explicit cap_value"):
+        P.fit(b.y, b.mask, b.day, bad2)

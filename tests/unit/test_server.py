@@ -258,6 +258,7 @@ def test_bucketed_artifact_serves_health_and_invocations(tmp_path):
     try:
         code, out = _call(srv, "/health", None)
         assert code == 200 and out["n_series"] == 4
+        assert out["model"] == "prophet"  # real family, not a placeholder
         code, out = _call(
             srv, "/invocations",
             {"inputs": [{"store": 1, "item": 1}, {"store": 1, "item": 3}],
