@@ -69,6 +69,10 @@ class CurveModelConfig:
     seasonality_prior_scale: float = 10.0
     weekly_order: int = 3
     yearly_order: int = 10
+    # Prophet's add_seasonality: ((name, period_days, fourier_order), ...)
+    # static tuples — e.g. (("monthly", 30.5, 5),); YAML lists freeze to
+    # tuples through the task conf path.  Shares seasonality_prior_scale.
+    extra_seasonalities: tuple = ()
     seasonality_mode: str = "multiplicative"  # or 'additive'
     # static holiday spec ((name, (epoch_day, ...)), ...) — build with
     # data/holidays.holiday_spec / us_holiday_spec_for_range
@@ -148,6 +152,9 @@ def _feature_masks(layout):
     seas = _np.zeros(F, _np.float32)
     seas[layout["weekly"]] = 1.0
     seas[layout["yearly"]] = 1.0
+    # custom seasonalities share the seasonality prior scale (Prophet's
+    # add_seasonality default prior_scale=10.0 matches it)
+    seas[layout["extra_seas"]] = 1.0
     fixed = _np.zeros(F, _np.float32)
     fixed[layout["intercept"]] = 1.0
     slope = _np.zeros(F, _np.float32)
@@ -197,7 +204,35 @@ def _prior_precision(layout, cfg: CurveModelConfig, cp_scale=None, seas_scale=No
     return lam
 
 
+_RESERVED_COMPONENTS = frozenset({
+    # built-in decompose components
+    "trend", "weekly", "yearly", "holidays", "regressors",
+    # component_frame skeleton columns a custom name must not clobber
+    "ds", "store", "item", "y", "yhat", "yhat_lower", "yhat_upper",
+})
+
+
 def _design(day, t0, t1, cfg: CurveModelConfig):
+    seen = set()
+    for entry in cfg.extra_seasonalities:
+        name, period, order = entry
+        if str(name) in _RESERVED_COMPONENTS:
+            raise ValueError(
+                f"extra seasonality name {name!r} collides with a built-in "
+                f"component; rename it"
+            )
+        if str(name) in seen:
+            # a duplicate would fit both blocks but silently overwrite the
+            # layout slice, dropping the first block from decomposition
+            raise ValueError(
+                f"duplicate extra seasonality name {name!r}"
+            )
+        seen.add(str(name))
+        if not (float(period) > 0 and int(order) > 0):
+            raise ValueError(
+                f"extra seasonality {name!r} needs period > 0 and "
+                f"order >= 1, got period={period}, order={order}"
+            )
     return curve_design_matrix(
         day,
         t0,
@@ -207,6 +242,7 @@ def _design(day, t0, t1, cfg: CurveModelConfig):
         yearly_order=cfg.yearly_order,
         changepoint_range=cfg.changepoint_range,
         holidays=cfg.holidays,
+        extra_seasonalities=cfg.extra_seasonalities,
     )
 
 
@@ -564,8 +600,14 @@ def decompose(params: CurveParams, day_all, config: CurveModelConfig,
     comps = {}
     tr = slice(0, 2 + config.n_changepoints)
     comps["trend"] = (params.beta[:, tr] @ X[:, tr].T) * ys
-    for name in ("weekly", "yearly", "holidays"):
-        sl = layout.get(name)
+    extra_names = tuple(
+        str(e[0]) for e in config.extra_seasonalities
+    )
+    for name, key in (
+        [(n, n) for n in ("weekly", "yearly", "holidays")]
+        + [(n, f"seas_{n}") for n in extra_names]
+    ):
+        sl = layout.get(key)
         if sl is not None and (sl.stop - sl.start) > 0:
             comps[name] = (params.beta[:, sl] @ X[:, sl].T) * ys
     if xreg is not None:
@@ -626,6 +668,9 @@ def extract_params(params: CurveParams, config: CurveModelConfig) -> dict:
         "interval_width": config.interval_width,
         "weekly_order": config.weekly_order,
         "yearly_order": config.yearly_order,
+        "extra_seasonalities": ",".join(
+            f"{n}:{p}:{o}" for n, p, o in config.extra_seasonalities
+        ) or "none",
         "uncertainty_samples": config.uncertainty_samples,
         "n_holidays": len(config.holidays),
         "holiday_prior_scale": config.holiday_prior_scale,

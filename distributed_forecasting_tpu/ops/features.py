@@ -107,13 +107,20 @@ def curve_design_matrix(
     yearly_order: int = 10,
     changepoint_range: float = 0.8,
     holidays: tuple = (),
+    extra_seasonalities: tuple = (),
 ) -> tuple[jnp.ndarray, dict]:
     """Full (T, F) design matrix + a static layout descriptor.
 
     Column layout: [1, t, hinge_1..K, weekly sin/cos, yearly sin/cos,
-    holiday indicators].  The layout dict gives slices for parameter
-    interpretation (trend uncertainty needs the changepoint block; see
-    models/prophet_glm.py).
+    extra-seasonality sin/cos blocks, holiday indicators].  The layout dict
+    gives slices for parameter interpretation (trend uncertainty needs the
+    changepoint block; see models/prophet_glm.py).
+
+    ``extra_seasonalities``: Prophet's ``add_seasonality`` — static
+    ``((name, period_days, fourier_order), ...)`` tuples, e.g.
+    ``(("monthly", 30.5, 5),)``; each contributes a ``2*order``-column
+    Fourier block, with a per-name ``seas_<name>`` layout slice so
+    decomposition can report the component.
     """
     t = scaled_time(day, t0, t1)
     A, s = changepoint_features(t, n_changepoints, changepoint_range)
@@ -128,17 +135,26 @@ def curve_design_matrix(
         cols.append(wk)
     if yr is not None:
         cols.append(yr)
+    extra_slices = {}
+    pos = n_fixed + k + n_wk + n_yr
+    for name, period, order in extra_seasonalities:
+        order = int(order)
+        cols.append(fourier_features(day, float(period), order))
+        extra_slices[f"seas_{name}"] = slice(pos, pos + 2 * order)
+        pos += 2 * order
     n_hol = len(holidays)
     if n_hol:
         cols.append(holiday_features(day, holidays))
     X = jnp.concatenate(cols, axis=1)
-    base = n_fixed + k + n_wk + n_yr
+    base = pos
     layout = {
         "intercept": slice(0, 1),
         "slope": slice(1, 2),
         "changepoints": slice(n_fixed, n_fixed + k),
         "weekly": slice(n_fixed + k, n_fixed + k + n_wk),
-        "yearly": slice(n_fixed + k + n_wk, base),
+        "yearly": slice(n_fixed + k + n_wk, n_fixed + k + n_wk + n_yr),
+        "extra_seas": slice(n_fixed + k + n_wk + n_yr, base),
+        **extra_slices,
         "holidays": slice(base, base + n_hol),
         "n_features": base + n_hol,
         "changepoint_grid": s,
