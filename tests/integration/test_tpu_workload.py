@@ -151,3 +151,34 @@ def test_quantiles_on_device(tpu_device, batch500):
     assert (np.diff(yq, axis=1) >= -1e-4).all()
     np.testing.assert_allclose(yq[:, 1], np.asarray(res.yhat), rtol=1e-4,
                                atol=1e-4)
+
+
+def test_extended_design_on_device(tpu_device, batch500):
+    """The widest design the conf surface can produce — US holidays +
+    custom monthly seasonality + saturating logistic bounds — compiles and
+    fits on real hardware in one fused pass (the large-F regime the Pallas
+    win-regime measurement targets; scripts/gram_winregime.py)."""
+    import jax
+
+    from distributed_forecasting_tpu.data.holidays import (
+        us_holiday_spec_for_range,
+    )
+    from distributed_forecasting_tpu.engine import fit_forecast
+    from distributed_forecasting_tpu.models.prophet_glm import CurveModelConfig
+
+    cfg = CurveModelConfig(
+        holidays=us_holiday_spec_for_range("2013-01-01", "2018-12-31"),
+        extra_seasonalities=(("monthly", 30.5, 5),),
+        yearly_order=15,
+    )
+    params, res = fit_forecast(batch500, model="prophet", config=cfg, horizon=90)
+    jax.block_until_ready(res.yhat)
+    assert bool(res.ok.all())
+    assert np.isfinite(np.asarray(res.yhat)).all()
+
+    cfg_log = CurveModelConfig(growth="logistic", cap_value=1000.0,
+                               floor_value=0.0)
+    _, res_log = fit_forecast(batch500, model="prophet", config=cfg_log,
+                              horizon=90)
+    jax.block_until_ready(res_log.yhat)
+    assert float(np.asarray(res_log.yhat).max()) <= 1000.0 + 1e-2
