@@ -71,7 +71,9 @@ class CurveModelConfig:
     yearly_order: int = 10
     # Prophet's add_seasonality: ((name, period_days, fourier_order), ...)
     # static tuples — e.g. (("monthly", 30.5, 5),); YAML lists freeze to
-    # tuples through the task conf path.  Shares seasonality_prior_scale.
+    # tuples through the task conf path.  Shares seasonality_prior_scale
+    # unless an entry carries its own 4th element, Prophet's per-seasonality
+    # prior_scale: ("monthly", 30.5, 5, 2.0).
     extra_seasonalities: tuple = ()
     seasonality_mode: str = "multiplicative"  # or 'additive'
     # static holiday spec ((name, (epoch_day, ...)), ...) — build with
@@ -142,8 +144,13 @@ def _fit_space(y, mask, mode, cap=None, floor=0.0):
     return y * mask
 
 
-def _feature_masks(layout):
-    """Static 0/1 masks over the feature axis for each prior group."""
+def _feature_masks(layout, own_scale=()):
+    """Static 0/1 masks over the feature axis for each prior group.
+
+    ``own_scale``: ((slice, prior_scale), ...) for extra seasonalities
+    carrying their own Prophet-style prior_scale — excluded from the shared
+    seasonal mask and returned as (mask, scale) pairs.
+    """
     F = layout["n_features"]
     import numpy as _np
 
@@ -153,8 +160,15 @@ def _feature_masks(layout):
     seas[layout["weekly"]] = 1.0
     seas[layout["yearly"]] = 1.0
     # custom seasonalities share the seasonality prior scale (Prophet's
-    # add_seasonality default prior_scale=10.0 matches it)
+    # add_seasonality default prior_scale=10.0 matches it) unless an entry
+    # sets its own
     seas[layout["extra_seas"]] = 1.0
+    own = []
+    for sl, ps in own_scale:
+        m = _np.zeros(F, _np.float32)
+        m[sl] = 1.0
+        seas[sl] = 0.0
+        own.append((jnp.asarray(m), float(ps)))
     fixed = _np.zeros(F, _np.float32)
     fixed[layout["intercept"]] = 1.0
     slope = _np.zeros(F, _np.float32)
@@ -166,7 +180,7 @@ def _feature_masks(layout):
     if "regressors" in layout:
         reg[layout["regressors"]] = 1.0
     return (jnp.asarray(cp), jnp.asarray(seas), jnp.asarray(fixed),
-            jnp.asarray(slope), jnp.asarray(hol), jnp.asarray(reg))
+            jnp.asarray(slope), jnp.asarray(hol), jnp.asarray(reg), own)
 
 
 def _prior_precision(layout, cfg: CurveModelConfig, cp_scale=None, seas_scale=None,
@@ -187,7 +201,14 @@ def _prior_precision(layout, cfg: CurveModelConfig, cp_scale=None, seas_scale=No
     cp_scale = jnp.asarray(cp_scale)[..., None]  # (...,1) broadcasts over F
     seas_scale = jnp.asarray(seas_scale)[..., None]
     hol_scale = jnp.asarray(hol_scale)[..., None]
-    cp_m, seas_m, fixed_m, slope_m, hol_m, reg_m = _feature_masks(layout)
+    own_scale = tuple(
+        (layout[f"seas_{name}"], ps)
+        for name, _p, _o, ps in _extra_entries(cfg)
+        if ps is not None
+    )
+    cp_m, seas_m, fixed_m, slope_m, hol_m, reg_m, own = _feature_masks(
+        layout, own_scale
+    )
     # flat growth = no trend at all: clamp the slope AND the changepoint
     # hinges (which would otherwise reintroduce a piecewise trend)
     slope_prec = 1e8 if cfg.growth == "flat" else 1e-8
@@ -201,6 +222,10 @@ def _prior_precision(layout, cfg: CurveModelConfig, cp_scale=None, seas_scale=No
         + hol_m * (1.0 / hol_scale**2)
         + reg_m * (1.0 / cfg.regressor_prior_scale**2)
     )
+    # Prophet per-seasonality prior scales: fixed (static) precisions for
+    # entries that carry their own scale, outside the swept shared scale
+    for m, ps in own:
+        lam = lam + m * (1.0 / ps**2)
     return lam
 
 
@@ -212,10 +237,28 @@ _RESERVED_COMPONENTS = frozenset({
 })
 
 
-def _design(day, t0, t1, cfg: CurveModelConfig):
+def _extra_entries(cfg: CurveModelConfig):
+    """Validate and normalize extra_seasonalities to
+    (name, period, order, prior_scale_or_None) 4-tuples."""
     seen = set()
+    out = []
     for entry in cfg.extra_seasonalities:
-        name, period, order = entry
+        if len(entry) == 3:
+            name, period, order = entry
+            ps = None
+        elif len(entry) == 4:
+            name, period, order, ps = entry
+            # YAML null = "use the shared scale", same as the 3-tuple form
+            if ps is not None and not float(ps) > 0:
+                raise ValueError(
+                    f"extra seasonality {name!r} prior_scale must be > 0, "
+                    f"got {ps}"
+                )
+        else:
+            raise ValueError(
+                f"extra seasonality entries are (name, period, order[, "
+                f"prior_scale]), got {entry!r}"
+            )
         if str(name) in _RESERVED_COMPONENTS:
             raise ValueError(
                 f"extra seasonality name {name!r} collides with a built-in "
@@ -233,6 +276,15 @@ def _design(day, t0, t1, cfg: CurveModelConfig):
                 f"extra seasonality {name!r} needs period > 0 and "
                 f"order >= 1, got period={period}, order={order}"
             )
+        out.append((
+            str(name), float(period), int(order),
+            None if ps is None else float(ps),
+        ))
+    return tuple(out)
+
+
+def _design(day, t0, t1, cfg: CurveModelConfig):
+    entries = _extra_entries(cfg)
     return curve_design_matrix(
         day,
         t0,
@@ -242,7 +294,7 @@ def _design(day, t0, t1, cfg: CurveModelConfig):
         yearly_order=cfg.yearly_order,
         changepoint_range=cfg.changepoint_range,
         holidays=cfg.holidays,
-        extra_seasonalities=cfg.extra_seasonalities,
+        extra_seasonalities=tuple((n, p, o) for n, p, o, _ in entries),
     )
 
 
@@ -669,7 +721,8 @@ def extract_params(params: CurveParams, config: CurveModelConfig) -> dict:
         "weekly_order": config.weekly_order,
         "yearly_order": config.yearly_order,
         "extra_seasonalities": ",".join(
-            f"{n}:{p}:{o}" for n, p, o in config.extra_seasonalities
+            f"{n}:{p}:{o}" + (f":{ps}" if ps is not None else "")
+            for n, p, o, ps in _extra_entries(config)
         ) or "none",
         "uncertainty_samples": config.uncertainty_samples,
         "n_holidays": len(config.holidays),

@@ -372,3 +372,59 @@ def test_extra_seasonality_learns_monthly_cycle(tmp_path):
     with pytest.raises(ValueError, match="collides"):
         P.fit(b.y, b.mask, b.day, P.CurveModelConfig(
             extra_seasonalities=(("ds", 30.5, 2),)))
+
+
+def test_extra_seasonality_own_prior_scale():
+    """A per-seasonality prior_scale (Prophet add_seasonality 4th arg)
+    regularizes ONLY that block: a tiny scale crushes the monthly component
+    while the shared seasonal prior is untouched."""
+    import numpy as np
+    import pandas as pd
+    import pytest
+
+    from distributed_forecasting_tpu.data import tensorize
+    from distributed_forecasting_tpu.models import prophet_glm as P
+    import jax.numpy as jnp
+
+    T = 730
+    t = np.arange(T)
+    rng = np.random.default_rng(4)
+    y = (100.0 + 12.0 * np.sin(2 * np.pi * t / 30.5)
+         + 5.0 * np.sin(2 * np.pi * t / 7)
+         + rng.normal(0, 0.5, T))
+    df = pd.DataFrame({
+        "date": pd.date_range("2020-01-01", periods=T),
+        "store": 1, "item": 1, "sales": y,
+    })
+    b = tensorize(df)
+    day_all = jnp.arange(int(b.day[0]), int(b.day[-1]) + 1, dtype=jnp.int32)
+
+    loose = P.CurveModelConfig(seasonality_mode="additive", yearly_order=0,
+                               extra_seasonalities=(("monthly", 30.5, 5, 10.0),))
+    tight = P.CurveModelConfig(seasonality_mode="additive", yearly_order=0,
+                               extra_seasonalities=(("monthly", 30.5, 5, 1e-3),))
+    amp = {}
+    for label, cfg in (("loose", loose), ("tight", tight)):
+        p = P.fit(b.y, b.mask, b.day, cfg)
+        comps = P.decompose(p, day_all, cfg)
+        amp[label] = float(np.asarray(comps["monthly"])[0].std())
+        weekly_amp = float(np.asarray(comps["weekly"])[0].std())
+        assert weekly_amp > 2.0, (label, weekly_amp)  # shared prior intact
+    assert amp["loose"] > 6.0, amp
+    assert amp["tight"] < 0.1, amp
+
+    with pytest.raises(ValueError, match="prior_scale"):
+        P.fit(b.y, b.mask, b.day, P.CurveModelConfig(
+            extra_seasonalities=(("m", 30.5, 2, 0.0),)))
+    with pytest.raises(ValueError, match="entries are"):
+        P.fit(b.y, b.mask, b.day, P.CurveModelConfig(
+            extra_seasonalities=(("m", 30.5),)))
+
+    # YAML null prior_scale means "use the shared scale" (3-tuple behavior)
+    null_ps = P.CurveModelConfig(
+        seasonality_mode="additive", yearly_order=0,
+        extra_seasonalities=(("monthly", 30.5, 5, None),),
+    )
+    p = P.fit(b.y, b.mask, b.day, null_ps)
+    comps = P.decompose(p, day_all, null_ps)
+    assert float(np.asarray(comps["monthly"])[0].std()) > 6.0
