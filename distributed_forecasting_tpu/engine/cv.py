@@ -79,8 +79,12 @@ def _cv_entry(batch, model, config, key, xreg, what):
     config = config if config is not None else fns.config_cls()
     if key is None:
         key = jax.random.PRNGKey(0)
-    from distributed_forecasting_tpu.engine.fit import validate_xreg
+    from distributed_forecasting_tpu.engine.fit import (
+        validate_changepoint_days,
+        validate_xreg,
+    )
 
+    validate_changepoint_days(config, batch.day)
     xreg = validate_xreg(fns, model, config, xreg, None, what,
                          trim_to=batch.n_time)
     return config, key, xreg

@@ -171,6 +171,28 @@ def validate_xreg(fns, model: str, config, xreg, expected_T, what: str,
     return xreg
 
 
+def validate_changepoint_days(config, day) -> None:
+    """Static guard for explicit changepoint sites (curve model).
+
+    Prophet raises 'Changepoints must fall within training data'; same
+    contract here, checked at the engine entries where the day grid is
+    still concrete (inside jit it is traced).  Catches in particular the
+    wrong-epoch blunder (raw ``toordinal()`` values land ~719163 days past
+    the range) which would otherwise silently fit a hinge-free line.
+    """
+    days = getattr(config, "changepoint_days", ()) if config is not None else ()
+    if not days:
+        return
+    lo, hi = int(day[0]), int(day[-1])
+    bad = [int(d) for d in days if not lo <= int(d) <= hi]
+    if bad:
+        raise ValueError(
+            f"changepoint_days {bad} fall outside the training data "
+            f"(day range [{lo}, {hi}]); days are unix epoch days — "
+            f"pd.Timestamp(d).toordinal() - 719163"
+        )
+
+
 def day_grid(day, horizon: int):
     """History + horizon day grid, built on device.
 
@@ -239,6 +261,7 @@ def fit_forecast(
     config = config if config is not None else fns.config_cls()
     if key is None:
         key = jax.random.PRNGKey(0)
+    validate_changepoint_days(config, batch.day)
     xreg = validate_xreg(fns, model, config, xreg, batch.n_time + horizon,
                          "fit_forecast")
     params, yhat, lo, hi, ok, day_all = _fit_forecast_impl(
@@ -318,6 +341,7 @@ def fit_forecast_chunked(
     config = config if config is not None else fns.config_cls()
     if key is None:
         key = jax.random.PRNGKey(0)
+    validate_changepoint_days(config, batch.day)
     xreg = validate_xreg(fns, model, config, xreg, batch.n_time + horizon,
                          "fit_forecast_chunked")
     n_chunks = -(-S // chunk_size)
@@ -429,6 +453,7 @@ def fit_forecast_bucketed(
     S, T = batch.n_series, batch.n_time
     T_all = T + horizon
     fns = get_model(model)
+    validate_changepoint_days(config, batch.day)
     xreg = validate_xreg(
         fns, model, config if config is not None else fns.config_cls(),
         xreg, T_all, "fit_forecast_bucketed",

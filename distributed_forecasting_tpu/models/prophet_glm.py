@@ -65,6 +65,11 @@ class CurveModelConfig:
     floor_value: float = 0.0
     n_changepoints: int = 25
     changepoint_range: float = 0.8
+    # Prophet's explicit `changepoints`: hinge sites at KNOWN dates (static
+    # tuple of epoch-day ints, e.g. via data/holidays-style day math or
+    # pd.Timestamp(...).toordinal() - 719163); overrides the uniform
+    # n_changepoints/changepoint_range grid when non-empty
+    changepoint_days: tuple = ()
     changepoint_prior_scale: float = 0.05
     seasonality_prior_scale: float = 10.0
     weekly_order: int = 3
@@ -283,6 +288,18 @@ def _extra_entries(cfg: CurveModelConfig):
     return tuple(out)
 
 
+def _n_cp(cfg: CurveModelConfig) -> int:
+    """Effective hinge count: explicit changepoint_days override the grid."""
+    return len(cfg.changepoint_days) or cfg.n_changepoints
+
+
+def _cp_range(cfg: CurveModelConfig) -> float:
+    """Fraction of history the hinge sites span — the uniform grid covers
+    changepoint_range; explicit dates are treated as covering the whole
+    history for the future-changepoint rate."""
+    return 1.0 if cfg.changepoint_days else cfg.changepoint_range
+
+
 def _design(day, t0, t1, cfg: CurveModelConfig):
     entries = _extra_entries(cfg)
     return curve_design_matrix(
@@ -295,6 +312,7 @@ def _design(day, t0, t1, cfg: CurveModelConfig):
         changepoint_range=cfg.changepoint_range,
         holidays=cfg.holidays,
         extra_seasonalities=tuple((n, p, o) for n, p, o, _ in entries),
+        changepoint_days=cfg.changepoint_days,
     )
 
 
@@ -459,7 +477,7 @@ def _trend_deviation_samples(params: CurveParams, t_all, t_end_scaled, cfg, key)
     S = params.beta.shape[0]
     N = cfg.uncertainty_samples
     L = _FUTURE_CP_GRID
-    deltas_hist = params.beta[:, 2 : 2 + cfg.n_changepoints]  # (S, K)
+    deltas_hist = params.beta[:, 2 : 2 + _n_cp(cfg)]  # (S, K)
     lam_scale = jnp.mean(jnp.abs(deltas_hist), axis=1)  # (S,)
     t_max = t_all[-1]
     span = jnp.maximum(t_max - t_end_scaled, 0.0)
@@ -468,7 +486,7 @@ def _trend_deviation_samples(params: CurveParams, t_all, t_end_scaled, cfg, key)
     # expected changepoints in the window = K * span / changepoint_range;
     # spread over L sites
     p_cp = jnp.clip(
-        cfg.n_changepoints * span / cfg.changepoint_range / L, 0.0, 1.0
+        _n_cp(cfg) * span / _cp_range(cfg) / L, 0.0, 1.0
     )
     k_bern, k_lap = jax.random.split(key)
     occur = jax.random.bernoulli(k_bern, p_cp, shape=(S, N, L)).astype(jnp.float32)
@@ -485,12 +503,12 @@ def _trend_deviation_variance(params: CurveParams, t_all, t_end_scaled, cfg):
     each site l flips on with prob p and Laplace(0, b) magnitude, so
     Var[dev(t)] = 2 b^2 p * sum_l max(0, t - s_l)^2.  Returns (S, T_all)."""
     L = _FUTURE_CP_GRID
-    deltas_hist = params.beta[:, 2 : 2 + cfg.n_changepoints]
+    deltas_hist = params.beta[:, 2 : 2 + _n_cp(cfg)]
     lam_scale = jnp.mean(jnp.abs(deltas_hist), axis=1)  # (S,) Laplace b
     t_max = t_all[-1]
     span = jnp.maximum(t_max - t_end_scaled, 0.0)
     sites = t_end_scaled + (jnp.arange(L, dtype=jnp.float32) + 0.5) / L * span
-    p_cp = jnp.clip(cfg.n_changepoints * span / cfg.changepoint_range / L, 0.0, 1.0)
+    p_cp = jnp.clip(_n_cp(cfg) * span / _cp_range(cfg) / L, 0.0, 1.0)
     lag2 = jnp.sum(jnp.maximum(0.0, t_all[None, :] - sites[:, None]) ** 2, axis=0)
     return 2.0 * lam_scale[:, None] ** 2 * p_cp * lag2[None, :]
 
@@ -650,7 +668,7 @@ def decompose(params: CurveParams, day_all, config: CurveModelConfig,
     X, layout = _design(day_all, params.t0, params.t1, config)
     ys = params.y_scale[:, None]
     comps = {}
-    tr = slice(0, 2 + config.n_changepoints)
+    tr = slice(0, 2 + _n_cp(config))
     comps["trend"] = (params.beta[:, tr] @ X[:, tr].T) * ys
     extra_names = tuple(
         str(e[0]) for e in config.extra_seasonalities
@@ -712,7 +730,8 @@ def extract_params(params: CurveParams, config: CurveModelConfig) -> dict:
     (``02_training.py:146-147``)."""
     return {
         "growth": config.growth,
-        "n_changepoints": config.n_changepoints,
+        "n_changepoints": _n_cp(config),
+        "explicit_changepoints": bool(config.changepoint_days),
         "changepoint_range": config.changepoint_range,
         "changepoint_prior_scale": config.changepoint_prior_scale,
         "seasonality_prior_scale": config.seasonality_prior_scale,

@@ -108,6 +108,7 @@ def curve_design_matrix(
     changepoint_range: float = 0.8,
     holidays: tuple = (),
     extra_seasonalities: tuple = (),
+    changepoint_days: tuple = (),
 ) -> tuple[jnp.ndarray, dict]:
     """Full (T, F) design matrix + a static layout descriptor.
 
@@ -123,10 +124,19 @@ def curve_design_matrix(
     decomposition can report the component.
     """
     t = scaled_time(day, t0, t1)
-    A, s = changepoint_features(t, n_changepoints, changepoint_range)
+    if changepoint_days:
+        # Prophet's explicit `changepoints`: hinge sites at known dates
+        # (epoch days) instead of the uniform grid; the hinge count is
+        # static (len of the tuple) while the scaled positions follow the
+        # traced training span — same scaling as the t axis they hinge on
+        s = scaled_time(jnp.asarray(sorted(changepoint_days)), t0, t1)
+        A = jnp.maximum(0.0, t[:, None] - s[None, :])
+        k = len(changepoint_days)
+    else:
+        A, s = changepoint_features(t, n_changepoints, changepoint_range)
+        k = n_changepoints
     cols = [jnp.ones_like(t)[:, None], t[:, None], A]
     n_fixed = 2
-    k = n_changepoints
     wk = fourier_features(day, WEEK_PERIOD, weekly_order) if weekly_order else None
     yr = fourier_features(day, YEAR_PERIOD, yearly_order) if yearly_order else None
     n_wk = 0 if wk is None else 2 * weekly_order

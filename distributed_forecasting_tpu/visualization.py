@@ -61,15 +61,22 @@ def plot_forecast(
 def plot_changepoints(params, config, series_index: int = 0, ax=None):
     """Learned changepoint slope deltas over the changepoint grid — the
     reference's changepoint overlay, shown as the model actually stores it."""
+    from distributed_forecasting_tpu.models.prophet_glm import _n_cp
+
     plt = _plt()
     if ax is None:
         _, ax = plt.subplots(figsize=(8, 3))
-    deltas = np.asarray(
-        params.beta[series_index, 2 : 2 + config.n_changepoints]
-    )
-    grid = np.arange(1, config.n_changepoints + 1) / (config.n_changepoints + 1)
-    grid = grid * config.changepoint_range
-    ax.bar(grid, deltas, width=0.8 / (config.n_changepoints + 1))
+    k = _n_cp(config)
+    deltas = np.asarray(params.beta[series_index, 2 : 2 + k])
+    if config.changepoint_days:
+        # explicit sites: scaled by the training span the params carry
+        t0, t1 = float(params.t0), float(params.t1)
+        grid = (
+            np.asarray(sorted(config.changepoint_days), float) - t0
+        ) / max(t1 - t0, 1.0)
+    else:
+        grid = np.arange(1, k + 1) / (k + 1) * config.changepoint_range
+    ax.bar(grid, deltas, width=0.8 / (k + 1))
     ax.set_xlabel("scaled time of changepoint")
     ax.set_ylabel("slope delta")
     ax.set_title("changepoint magnitudes")

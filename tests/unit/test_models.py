@@ -428,3 +428,73 @@ def test_extra_seasonality_own_prior_scale():
     p = P.fit(b.y, b.mask, b.day, null_ps)
     comps = P.decompose(p, day_all, null_ps)
     assert float(np.asarray(comps["monthly"])[0].std()) > 6.0
+
+
+def test_explicit_changepoint_days():
+    """Prophet's explicit `changepoints`: a known structural-break date as
+    the single hinge site captures a sharp slope change that the uniform
+    grid smears, and the trend-uncertainty path sizes to the explicit
+    count."""
+    import numpy as np
+    import pandas as pd
+
+    from distributed_forecasting_tpu.data import tensorize
+    from distributed_forecasting_tpu.models import prophet_glm as P
+    import jax
+    import jax.numpy as jnp
+
+    T = 600
+    t = np.arange(T)
+    break_at = 400
+    rng = np.random.default_rng(5)
+    y = 50.0 + 0.02 * t + np.where(t > break_at, 0.5 * (t - break_at), 0.0)
+    y = y + rng.normal(0, 0.3, T)
+    dates = pd.date_range("2020-01-01", periods=T)
+    df = pd.DataFrame({"date": dates, "store": 1, "item": 1, "sales": y})
+    b = tensorize(df)
+
+    break_day = int(np.asarray(b.day)[break_at])
+    cfg = P.CurveModelConfig(
+        seasonality_mode="additive", weekly_order=0, yearly_order=0,
+        changepoint_days=(break_day,), changepoint_prior_scale=5.0,
+    )
+    p = P.fit(b.y, b.mask, b.day, cfg)
+    assert p.beta.shape[1] == 3  # intercept, slope, ONE hinge
+    # the hinge coefficient carries the slope change (scaled): recover the
+    # post-break slope from a 60-day-ahead forecast
+    day_all = jnp.arange(int(b.day[0]), int(b.day[-1]) + 61, dtype=jnp.int32)
+    yh, lo, hi = P.forecast(p, day_all, b.day[-1].astype(jnp.float32), cfg)
+    jax.block_until_ready(yh)
+    yh = np.asarray(yh)[0]
+    fut_slope = (yh[-1] - yh[-60]) / 59.0
+    assert 0.45 < fut_slope < 0.60, fut_slope  # ~0.52 true post-break slope
+    assert bool((hi >= lo).all())
+
+    # component decomposition sizes the trend block to the explicit count
+    comps = P.decompose(p, day_all, cfg)
+    assert np.isfinite(np.asarray(comps["trend"])).all()
+    # logging reports the effective count and flags the explicit mode
+    logged = P.extract_params(p, cfg)
+    assert logged["n_changepoints"] == 1
+    assert logged["explicit_changepoints"] is True
+
+    # out-of-span sites (the classic raw-toordinal blunder) fail loudly at
+    # the engine entries instead of silently fitting a hinge-free line
+    import pytest
+
+    from distributed_forecasting_tpu.engine import cross_validate, fit_forecast
+
+    bad = P.CurveModelConfig(changepoint_days=(int(dates[0].toordinal()),))
+    with pytest.raises(ValueError, match="outside the training data"):
+        fit_forecast(b, model="prophet", config=bad, horizon=10)
+    with pytest.raises(ValueError, match="outside the training data"):
+        cross_validate(b, model="prophet", config=bad)
+
+    # the changepoint plot sizes to the explicit sites
+    import matplotlib
+
+    matplotlib.use("Agg")
+    from distributed_forecasting_tpu.visualization import plot_changepoints
+
+    ax = plot_changepoints(p, cfg)
+    assert len(ax.patches) == 1
