@@ -74,6 +74,34 @@ def holiday_features(day: jnp.ndarray, holidays: tuple) -> jnp.ndarray:
     return jnp.stack(cols, axis=1)
 
 
+def conditional_seasonality_columns(
+    day: jnp.ndarray, period: float, order: int, condition
+) -> jnp.ndarray:
+    """Prophet's ``add_seasonality(condition_name=...)`` as regressor columns.
+
+    A conditional seasonality is a Fourier block active only where a known
+    boolean condition holds (Prophet's example: an in-season weekly
+    pattern).  Internally Prophet zeroes the Fourier features off-condition
+    — exactly an elementwise product — so the block is expressible as
+    ordinary exogenous-regressor columns and needs NO new data channel:
+    feed the result as (part of) ``xreg`` with
+    ``CurveModelConfig(n_regressors=2*order, regressor_standardize=False)``
+    (the columns are already centered waves; standardizing a mostly-zero
+    column would rescale by condition rarity).
+
+    ``condition``: (T,) boolean/0-1 values over the SAME day grid —
+    history + horizon, since future condition values must be known, like
+    any covariate.  Returns (T, 2*order) float columns.
+    """
+    cond = jnp.asarray(condition, jnp.float32)
+    if cond.shape != (day.shape[0],):
+        raise ValueError(
+            f"condition must be one value per grid day ({day.shape[0]},), "
+            f"got {cond.shape}"
+        )
+    return fourier_features(day, float(period), int(order)) * cond[:, None]
+
+
 def with_regressors(X: jnp.ndarray, layout: dict, xreg: jnp.ndarray):
     """Append exogenous-regressor columns to a design matrix.
 

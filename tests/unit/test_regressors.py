@@ -633,3 +633,65 @@ def test_always_active_flag_is_centered_not_binary_exempt():
     xs3, mu3, sd3 = _standardize_xreg(x3, jnp.ones((2, T), jnp.float32), cfg)
     assert np.all(np.asarray(mu3) == 1.0)
     assert np.allclose(np.asarray(xs3), 0.0)
+
+
+def test_conditional_seasonality_via_regressor_columns():
+    """Prophet's condition_name seasonality expressed as xreg columns: a
+    weekly pattern that exists ONLY in-season is recovered in-season and
+    stays flat off-season, which an unconditional weekly basis cannot do."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_forecasting_tpu.data.tensorize import SeriesBatch
+    from distributed_forecasting_tpu.engine import fit_forecast
+    from distributed_forecasting_tpu.models.prophet_glm import CurveModelConfig
+    from distributed_forecasting_tpu.ops.features import (
+        conditional_seasonality_columns,
+    )
+
+    rng = np.random.default_rng(0)
+    T, H = 730, 90
+    day = np.arange(1000, 1000 + T + H)
+    in_season = ((day // 180) % 2 == 0).astype(np.float32)  # ~half the year
+    dow_wave = 5.0 * np.sin(2 * np.pi * day / 7.0)
+    y = 50.0 + in_season[:T] * dow_wave[:T] + rng.normal(0, 0.3, T)
+    batch = SeriesBatch(
+        y=jnp.asarray(y[None], jnp.float32),
+        mask=jnp.ones((1, T), jnp.float32),
+        day=jnp.asarray(day[:T], jnp.int32),
+        keys=np.asarray([[1, 1]], np.int64), key_names=("store", "item"),
+        start_date="1972-09-27",
+    )
+
+    order = 3
+    xreg = conditional_seasonality_columns(
+        jnp.asarray(day, jnp.int32), 7.0, order, in_season
+    )
+    cfg = CurveModelConfig(
+        seasonality_mode="additive", weekly_order=0, yearly_order=0,
+        n_regressors=2 * order, regressor_standardize=False,
+    )
+    _, res = fit_forecast(batch, model="prophet", config=cfg, horizon=H,
+                          xreg=xreg)
+    yh = np.asarray(res.yhat)[0]
+    fut = slice(T, T + H)
+    on = in_season[fut] > 0
+    # forecast carries the wave in-season, stays flat off-season
+    assert yh[fut][on].std() > 2.5
+    assert yh[fut][~on].std() < 0.8
+
+    # an UNconditional weekly basis averages the two regimes: it can't be
+    # both right — its in-season amplitude lands near half the true wave
+    cfg0 = CurveModelConfig(seasonality_mode="additive", weekly_order=3,
+                            yearly_order=0)
+    _, res0 = fit_forecast(batch, model="prophet", config=cfg0, horizon=H)
+    yh0 = np.asarray(res0.yhat)[0]
+    assert yh0[fut][~on].std() > 1.2  # leaks the wave off-season
+
+    # shape guard
+    import pytest
+
+    with pytest.raises(ValueError, match="per grid day"):
+        conditional_seasonality_columns(
+            jnp.asarray(day, jnp.int32), 7.0, 2, in_season[:10]
+        )
