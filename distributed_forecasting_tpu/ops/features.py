@@ -89,16 +89,36 @@ def conditional_seasonality_columns(
     (the columns are already centered waves; standardizing a mostly-zero
     column would rescale by condition rarity).
 
+    Two knobs to know about when migrating from Prophet:
+
+    * ``regressor_standardize`` is GLOBAL — it also turns off z-scoring for
+      any continuous covariates sharing the ``xreg`` tensor.  When mixing,
+      either pre-standardize the continuous columns yourself, or keep
+      ``True`` and accept that this block's effective prior tightens by
+      ``sqrt(condition rate)``.
+    * the block is regularized by ``regressor_prior_scale`` (it rides the
+      regressor channel), NOT ``seasonality_prior_scale`` — set it to the
+      shrinkage you'd have given the seasonality.
+
     ``condition``: (T,) boolean/0-1 values over the SAME day grid —
     history + horizon, since future condition values must be known, like
-    any covariate.  Returns (T, 2*order) float columns.
+    any covariate (Prophet likewise rejects non-boolean condition
+    columns).  Returns (T, 2*order) float columns.
     """
-    cond = jnp.asarray(condition, jnp.float32)
-    if cond.shape != (day.shape[0],):
+    import numpy as np
+
+    cvals = np.asarray(condition)
+    if cvals.shape != (int(day.shape[0]),):
         raise ValueError(
-            f"condition must be one value per grid day ({day.shape[0]},), "
-            f"got {cond.shape}"
+            f"condition must be one value per grid day ({int(day.shape[0])},), "
+            f"got {cvals.shape}"
         )
+    if not np.isin(cvals, (0, 1)).all():
+        raise ValueError(
+            "condition must be boolean/0-1 per day (a fractional value "
+            "would scale the seasonality instead of gating it)"
+        )
+    cond = jnp.asarray(cvals, jnp.float32)
     return fourier_features(day, float(period), int(order)) * cond[:, None]
 
 

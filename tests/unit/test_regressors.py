@@ -639,12 +639,7 @@ def test_conditional_seasonality_via_regressor_columns():
     """Prophet's condition_name seasonality expressed as xreg columns: a
     weekly pattern that exists ONLY in-season is recovered in-season and
     stays flat off-season, which an unconditional weekly basis cannot do."""
-    import jax.numpy as jnp
-    import numpy as np
-
     from distributed_forecasting_tpu.data.tensorize import SeriesBatch
-    from distributed_forecasting_tpu.engine import fit_forecast
-    from distributed_forecasting_tpu.models.prophet_glm import CurveModelConfig
     from distributed_forecasting_tpu.ops.features import (
         conditional_seasonality_columns,
     )
@@ -688,10 +683,12 @@ def test_conditional_seasonality_via_regressor_columns():
     yh0 = np.asarray(res0.yhat)[0]
     assert yh0[fut][~on].std() > 1.2  # leaks the wave off-season
 
-    # shape guard
-    import pytest
-
+    # guards: shape, and Prophet's non-boolean rejection
     with pytest.raises(ValueError, match="per grid day"):
         conditional_seasonality_columns(
             jnp.asarray(day, jnp.int32), 7.0, 2, in_season[:10]
+        )
+    with pytest.raises(ValueError, match="boolean"):
+        conditional_seasonality_columns(
+            jnp.asarray(day, jnp.int32), 7.0, 2, in_season * 0.5
         )
