@@ -1,0 +1,127 @@
+"""Split the headline engine pass into phases on the real chip.
+
+The 500 x 1826 fit+forecast runs ~3.7 ms/batch on v5e while the Gram
+contraction alone is ~7.5 GFLOP — roughly 2% MXU utilization — so most of
+the time is NOT the solve.  This measures, with the same
+dispatch-cost-cancelled slope protocol as bench.py, per-batch device time
+of:
+
+  * fit only (design + Gram + Cholesky + params)
+  * fit + point forecast (no intervals)   [uncertainty_samples=0 analytic
+    intervals are still computed in `forecast`; isolate with a direct
+    matmul of the design]
+  * the full engine pass (fit + forecast + intervals + fallback splice)
+
+so the next optimization targets the phase that actually costs.  Run on
+TPU: python scripts/phase_split.py   (CPU allowed with --allow-cpu; numbers
+then describe the fallback, not the chip).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--allow-cpu", action="store_true")
+    ap.add_argument("--reps-long", type=int, default=12)
+    args = ap.parse_args()
+
+    import os
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import distributed_forecasting_tpu  # noqa: F401  (platform override first)
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    if dev.platform != "tpu" and not args.allow_cpu:
+        sys.exit("refusing on non-TPU backend; pass --allow-cpu to force")
+    print(f"device: {dev.platform} ({dev.device_kind})", file=sys.stderr)
+
+    from distributed_forecasting_tpu.data import synthetic_store_item_sales, tensorize
+    from distributed_forecasting_tpu.engine.fit import day_grid, health_fallback
+    from distributed_forecasting_tpu.models import prophet_glm
+    from distributed_forecasting_tpu.models.prophet_glm import CurveModelConfig
+
+    cfg = CurveModelConfig()
+    horizon = 90
+    K = 4
+    batches = []
+    for s in range(K):
+        b = tensorize(synthetic_store_item_sales(10, 50, 1826, seed=s))
+        float(b.y.sum())
+        batches.append(b)
+    Y = jnp.stack([b.y for b in batches])
+    M = jnp.stack([b.mask for b in batches])
+    day = batches[0].day
+    day_all = day_grid(day, horizon)
+    t_end = day[-1].astype(jnp.float32)
+    key = jax.random.PRNGKey(0)
+
+    def scan_over(fn):
+        @jax.jit
+        def run(Yk, Mk):
+            def step(c, ym):
+                y, m = ym
+                return c + fn(y, m), None
+
+            tot, _ = jax.lax.scan(step, 0.0, (Yk, Mk))
+            return tot
+
+        return run
+
+    def fit_only(y, m):
+        p = prophet_glm.fit(y, m, day, cfg)
+        return p.beta.sum() + p.sigma.sum()
+
+    def fit_forecast_point(y, m):
+        p = prophet_glm.fit(y, m, day, cfg)
+        yh, lo, hi = prophet_glm.forecast(p, day_all, t_end, cfg, key)
+        return yh.sum()
+
+    def full_pass(y, m):
+        p = prophet_glm.fit(y, m, day, cfg)
+        yh, lo, hi = prophet_glm.forecast(p, day_all, t_end, cfg, key)
+        yh, lo, hi, ok = health_fallback(y, m, yh, lo, hi, horizon, 14)
+        return yh.sum() + lo.sum() + hi.sum()
+
+    R = args.reps_long
+    Yl = jnp.concatenate([Y] * R)
+    Ml = jnp.concatenate([M] * R)
+
+    results = {}
+    for label, fn in (("fit_only", fit_only),
+                      ("fit+forecast", fit_forecast_point),
+                      ("full_pass", full_pass)):
+        run = scan_over(fn)
+
+        def timed(Yk, Mk):
+            t0 = time.perf_counter()
+            float(run(Yk, Mk))
+            return time.perf_counter() - t0
+
+        timed(Y, M)      # compile short
+        timed(Yl, Ml)    # compile long
+        t_s = min(timed(Y, M) for _ in range(3))
+        t_l = min(timed(Yl, Ml) for _ in range(3))
+        per = (t_l - t_s) / (K * R - K)
+        if per <= 0:
+            per = t_l / (K * R)
+        results[label] = per * 1e3
+        print(f"{label:13s}: {per * 1e3:7.3f} ms/batch", file=sys.stderr)
+
+    fit = results["fit_only"]
+    fc = results["fit+forecast"] - fit
+    tail = results["full_pass"] - results["fit+forecast"]
+    print(
+        f"breakdown: fit {fit:.3f} ms | forecast+intervals {fc:.3f} ms | "
+        f"fallback splice {tail:.3f} ms"
+    )
+
+
+if __name__ == "__main__":
+    main()

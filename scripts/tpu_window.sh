@@ -22,11 +22,11 @@ if ! timeout 90 python -c "import jax, jax.numpy as jnp; d=jax.devices()[0]; ass
   exit 1
 fi
 
-echo "== 1/3 integration tier (make test-tpu) =="
+echo "== 1/4 integration tier (make test-tpu) =="
 timeout 1800 make test-tpu 2>&1 | tee "scripts/tpu_logs/test_tpu_${ts}.log"
 echo "test-tpu rc=${PIPESTATUS[0]}" | tee -a "scripts/tpu_logs/test_tpu_${ts}.log"
 
-echo "== 2/3 full bench suite =="
+echo "== 2/4 full bench suite =="
 DFTPU_BENCH_BUDGET=600 timeout 1800 python bench.py \
   > "scripts/tpu_logs/bench_${ts}.json" \
   2> "scripts/tpu_logs/bench_${ts}.log"
@@ -34,8 +34,12 @@ echo "bench rc=$?" >> "scripts/tpu_logs/bench_${ts}.log"
 cat "scripts/tpu_logs/bench_${ts}.json"
 tail -20 "scripts/tpu_logs/bench_${ts}.log"
 
-echo "== 3/3 gram width-regime =="
+echo "== 3/4 gram width-regime =="
 timeout 1800 python scripts/gram_winregime.py 2>&1 \
   | tee "scripts/tpu_logs/gram_winregime_${ts}.log"
+
+echo "== 4/4 engine phase split =="
+timeout 900 python scripts/phase_split.py 2>&1 \
+  | tee "scripts/tpu_logs/phase_split_${ts}.log"
 
 echo "== done: logs in scripts/tpu_logs/*_${ts}.* =="
