@@ -43,7 +43,11 @@ from distributed_forecasting_tpu.ops.features import (
     scaled_time,
     with_regressors,
 )
-from distributed_forecasting_tpu.ops.solve import ridge_solve_batch, weighted_residual_scale
+from distributed_forecasting_tpu.ops.solve import (
+    fitted_values,
+    ridge_solve_batch,
+    weighted_residual_scale,
+)
 
 _LOG_EPS = 1e-3
 
@@ -90,6 +94,15 @@ class CurveModelConfig:
     # changepoint process — deterministic and compile-cheap, the default);
     # >0 = Prophet-faithful Monte-Carlo quantiles over that many paths.
     uncertainty_samples: int = 0
+    # Autoregression on the fit residuals (NeuralProphet's headline
+    # addition to the Prophet decomposition: arXiv:2111.15397).  Two-stage:
+    # the curve fit is unchanged; an AR(p) is then fit on its in-sample
+    # residuals by batched Yule-Walker (closed form, no optimizer) and the
+    # forecast adds the AR extrapolation seeded from the last observed
+    # residuals — short-horizon accuracy when residuals are autocorrelated,
+    # decaying to the plain curve forecast (and its marginal variance) at
+    # long leads.  0 = off (the Prophet-parity default).
+    ar_order: int = 0
     # Exogenous regressors (Prophet's ``add_regressor``): static column
     # count; values arrive as the ``xreg`` argument to fit/forecast —
     # (T, R) shared across series (promo calendar, weather) or (S, T, R)
@@ -127,6 +140,26 @@ class CurveParams:
     )
     reg_sd: jax.Array = dataclasses.field(
         default_factory=lambda: jnp.ones((0, 0), jnp.float32)
+    )
+    # AR-on-residuals state (ar_order > 0; empty otherwise so old artifacts
+    # keep loading): Yule-Walker coefficients, the residual window ending
+    # at each series' last OBSERVED day (seeds the forecast rollout), and
+    # the one-step innovation std — all in normalized fit space.
+    ar_phi: jax.Array = dataclasses.field(
+        default_factory=lambda: jnp.zeros((0, 0), jnp.float32)
+    )
+    ar_tail: jax.Array = dataclasses.field(
+        default_factory=lambda: jnp.zeros((0, 0), jnp.float32)
+    )
+    ar_sigma: jax.Array = dataclasses.field(
+        default_factory=lambda: jnp.zeros((0,), jnp.float32)
+    )
+    # absolute day of each series' last observation -- the AR lead index is
+    # per-series so a stale series (observations ending G days before the
+    # batch end) gets the decayed phi^(G+h) correction and the wider
+    # (G+h)-step variance, not a full-strength lead-1 one
+    ar_last_day: jax.Array = dataclasses.field(
+        default_factory=lambda: jnp.zeros((0,), jnp.float32)
     )
 
 
@@ -457,8 +490,19 @@ def fit(y, mask, day, config: CurveModelConfig, prior_scales=None,
     lam = _prior_precision(layout, config, cp_s, seas_s, hol_s)
     beta = ridge_solve_batch(X, zn, mask, lam)
     sigma = weighted_residual_scale(X, zn, mask, beta)
+    ar_kwargs = {}
+    if config.ar_order > 0:
+        resid = (zn - fitted_values(X, beta)) * mask
+        phi, tail, s_inn, last = _fit_ar_residuals(
+            resid, mask, config.ar_order
+        )
+        ar_kwargs = dict(
+            ar_phi=phi, ar_tail=tail, ar_sigma=s_inn,
+            ar_last_day=day[last].astype(jnp.float32),
+        )
     return CurveParams(beta=beta, sigma=sigma, y_scale=y_scale, cap=cap,
-                       t0=t0, t1=t1, reg_mu=reg_mu, reg_sd=reg_sd)
+                       t0=t0, t1=t1, reg_mu=reg_mu, reg_sd=reg_sd,
+                       **ar_kwargs)
 
 
 _FUTURE_CP_GRID = 25  # static count of candidate future changepoint sites
@@ -532,6 +576,150 @@ def _regressor_contrib(params: CurveParams, xreg, F0: int):
     ) - offset
 
 
+# AR extrapolation/variance tables are precomputed for this many leads and
+# gathered by clipped lead index — beyond it the mean has decayed to ~0 and
+# the variance has saturated to the marginal residual variance, so clipping
+# reproduces the plain curve forecast exactly where AR no longer matters.
+# (A full-T_all sequential scan here would cost ~20 ms/batch of pure serial
+# depth on the hot engine path — see the same note in models/arima.py.)
+_AR_TABLE_LEN = 64
+
+
+def _fit_ar_residuals(resid, mask, p: int):
+    """Batched Yule-Walker AR(p) on masked residuals.
+
+    resid, mask: (S, T) with resid already zeroed off-mask.  Returns
+    (phi (S, p), tail (S, p), sigma_inn (S,)):
+
+    * ``phi`` from the biased (divisor n) sample autocovariances — the PSD
+      choice, so the solution is stationary;
+    * ``tail``: the residual window ending at each series' LAST OBSERVED
+      day (dynamic per-series slice — under a CV cutoff mask the grid's
+      final positions are masked and would seed zeros);
+    * ``sigma_inn``: std of the one-step innovations
+      ``e_t = r_t - sum_k phi_k r_{t-k}`` over fully-observed lag windows.
+    """
+    S, T = resid.shape
+    n0 = jnp.maximum(jnp.sum(mask, axis=1), 1.0)  # (S,)
+    # autocovariances c_0..c_p (biased): observed-pair products / n0
+    cov = []
+    for k in range(p + 1):
+        rk = resid[:, k:] * resid[:, : T - k]
+        mk = mask[:, k:] * mask[:, : T - k]
+        cov.append(jnp.sum(rk * mk, axis=1) / n0)
+    c = jnp.stack(cov, axis=1)  # (S, p+1)
+    idx = jnp.abs(jnp.arange(p)[:, None] - jnp.arange(p)[None, :])  # (p, p)
+    R = c[:, idx]  # (S, p, p) Toeplitz of c_0..c_{p-1}
+    R = R + 1e-6 * c[:, :1, None] * jnp.eye(p)[None] + 1e-12 * jnp.eye(p)[None]
+    phi = jnp.linalg.solve(R, c[:, 1:, None])[..., 0]  # (S, p)
+
+    # residual window ending at the last observed index (newest last)
+    last = jnp.argmax(
+        jnp.arange(T, dtype=jnp.float32)[None, :] * mask
+        + mask,  # all-masked series resolve to index 0
+        axis=1,
+    )
+    start = jnp.clip(last - (p - 1), 0, T - p)
+
+    def take_window(r_row, s0):
+        return jax.lax.dynamic_slice(r_row, (s0,), (p,))
+
+    tail = jax.vmap(take_window)(resid, start)  # (S, p) newest last
+
+    # one-step innovations over fully-observed windows
+    lags = jnp.stack(
+        [resid[:, p - k : T - k] for k in range(1, p + 1)], axis=2
+    )  # (S, T-p, p) lag k at [..., k-1]
+    lag_mask = jnp.prod(
+        jnp.stack([mask[:, p - k : T - k] for k in range(0, p + 1)], axis=2),
+        axis=2,
+    )  # (S, T-p) — target and every lag observed
+    e = (resid[:, p:] - jnp.einsum("stp,sp->st", lags, phi)) * lag_mask
+    ne = jnp.maximum(jnp.sum(lag_mask, axis=1), 1.0)
+    sigma_inn = jnp.sqrt(jnp.sum(e**2, axis=1) / ne)
+    # no valid windows -> fall back to the marginal residual std
+    sigma_marg = jnp.sqrt(jnp.maximum(c[:, 0], 1e-12))
+    sigma_inn = jnp.where(jnp.sum(lag_mask, axis=1) > 0, sigma_inn, sigma_marg)
+    return phi, tail, sigma_inn, last
+
+
+def _ar_tables(params: CurveParams, p: int):
+    """(mean_table (K+1, S), var_table (K+1, S)) for leads 0..K.
+
+    Row h holds the AR(p) h-step-ahead residual prediction from the stored
+    tail window, and its predictive variance ``sigma_inn^2 * sum psi_j^2``
+    (psi = MA(inf) weights of the fitted AR).  Row 0 is zero mean /
+    marginal-free variance anchor (unused: in-history leads clip to 0 and
+    take the marginal sigma instead).
+    """
+    phi, tail, s_inn = params.ar_phi, params.ar_tail, params.ar_sigma
+    K = _AR_TABLE_LEN
+
+    def step(carry, _):
+        w, psi_w, var_acc = carry
+        # next residual prediction: newest lag is w[:, -1]
+        r_next = jnp.einsum("sp,sp->s", w, phi[:, ::-1])
+        w = jnp.concatenate([w[:, 1:], r_next[:, None]], axis=1)
+        # h-step predictive variance uses psi_0..psi_{h-1}: emit the sum
+        # BEFORE folding in psi_h (lead 1 = psi_0^2 alone)
+        out_var = var_acc
+        psi_next = jnp.einsum("sp,sp->s", psi_w, phi[:, ::-1])
+        psi_w = jnp.concatenate([psi_w[:, 1:], psi_next[:, None]], axis=1)
+        var_acc = var_acc + psi_next**2
+        return (w, psi_w, var_acc), (r_next, out_var)
+
+    S = phi.shape[0]
+    psi0 = jnp.concatenate(
+        [jnp.zeros((S, p - 1)), jnp.ones((S, 1))], axis=1
+    )  # psi_0 = 1 impulse
+    var0 = jnp.ones((S,))  # sum psi_0^2
+    (_, _, _), (means, var_sums) = jax.lax.scan(
+        step, (tail, psi0, var0), None, length=K
+    )
+    # lead h=1..K: mean = means[h-1]; var = sigma_inn^2 * var_sums[h-1]
+    zero = jnp.zeros((1, S))
+    mean_table = jnp.concatenate([zero, means], axis=0)  # (K+1, S)
+    var_table = jnp.concatenate(
+        [jnp.ones((1, S)), var_sums], axis=0
+    ) * (s_inn[None, :] ** 2)
+    return mean_table, var_table
+
+
+def _ar_correction(params: CurveParams, day_all, t_end, p: int):
+    """(mean (S, T_all), var (S, T_all), future_mask (S, T_all)).
+
+    Lead index is PER SERIES from each series' last observed day
+    (``ar_last_day``) — a stale series whose observations end G days
+    before the batch end gets the decayed ``phi^(G+h)`` correction and the
+    wider (G+h)-step variance, not a full-strength lead-1 one.  The
+    correction is gated to days strictly past ``t_end`` (the forecast
+    start: the batch end, or a CV cutoff), clipped into the precomputed
+    tables where the mean has decayed and the variance saturated.  Values
+    are in normalized fit space (multiply the mean by ``y_scale``).
+    """
+    mean_t, var_t = _ar_tables(params, p)  # (K+1, S) each
+    dayf = day_all.astype(jnp.float32)
+    h_raw = jnp.round(dayf[None, :] - params.ar_last_day[:, None]).astype(
+        jnp.int32
+    )  # (S, T_all)
+    h_idx = jnp.clip(h_raw, 0, _AR_TABLE_LEN)
+    within = h_raw <= _AR_TABLE_LEN
+    fut = (dayf[None, :] > t_end) & (h_raw > 0)
+    # beyond the table the mean is ZEROED, not frozen at its lead-K value:
+    # for a near-unit-root phi the table end still carries a material
+    # offset, and freezing it would contradict the decay-to-plain-forecast
+    # contract; the variance falls back to the marginal residual variance
+    mean = jnp.where(
+        fut & within, jnp.take_along_axis(mean_t.T, h_idx, axis=1), 0.0
+    )
+    var = jnp.where(
+        within,
+        jnp.take_along_axis(var_t.T, h_idx, axis=1),
+        params.sigma[:, None] ** 2,
+    )
+    return mean, var, fut
+
+
 def _predictive(params: CurveParams, day_all, t_end, config, key, xreg):
     """Fit-space predictive distribution over ``day_all``.
 
@@ -553,16 +741,24 @@ def _predictive(params: CurveParams, day_all, t_end, config, key, xreg):
     t_all = scaled_time(day_all, params.t0, params.t1)
     t_end_scaled = (t_end - params.t0) / jnp.maximum(params.t1 - params.t0, 1.0)
 
+    var_obs = params.sigma[:, None] ** 2  # marginal residual variance
+    if config.ar_order > 0:
+        ar_mean, ar_var, fut = _ar_correction(
+            params, day_all, t_end, config.ar_order
+        )
+        zhat = zhat + ar_mean * params.y_scale[:, None]
+        var_obs = jnp.where(fut, ar_var, var_obs)
+
     if config.uncertainty_samples > 0:
         dev = _trend_deviation_samples(params, t_all, t_end_scaled, config, key)
         noise = (
             jax.random.normal(jax.random.fold_in(key, 1), shape=dev.shape)
-            * (params.sigma * params.y_scale)[:, None, None]
+            * (jnp.sqrt(var_obs) * params.y_scale[:, None])[:, None, :]
         )
         paths = zhat[:, None, :] + dev * params.y_scale[:, None, None] + noise
         return zhat, None, paths
     var_dev = _trend_deviation_variance(params, t_all, t_end_scaled, config)
-    sd = jnp.sqrt(var_dev + params.sigma[:, None] ** 2) * params.y_scale[:, None]
+    sd = jnp.sqrt(var_dev + var_obs) * params.y_scale[:, None]
     return zhat, sd, None
 
 
@@ -651,7 +847,7 @@ def forecast_quantiles(
 
 @partial(jax.jit, static_argnames=("config",))
 def decompose(params: CurveParams, day_all, config: CurveModelConfig,
-              xreg=None):
+              xreg=None, t_end=None):
     """Per-component contributions over ``day_all`` — the tabular analogue
     of Prophet's component columns (trend/weekly/yearly/holidays, plus
     regressors here).  Returns a dict name -> (S, T_all) in FIT SPACE,
@@ -664,6 +860,12 @@ def decompose(params: CurveParams, day_all, config: CurveModelConfig,
     seasonal panels never need covariate values, so omitting it just
     omits the ``regressors`` component (components then sum to the path
     minus the regressor effect).
+
+    ``t_end``: forecast-start day, required to include the ``ar``
+    component when ``config.ar_order > 0`` (the AR correction is a
+    forecast-time term anchored at the forecast start, not a design
+    column); omitting it omits that component the same way omitting
+    ``xreg`` omits the regressor one.
     """
     X, layout = _design(day_all, params.t0, params.t1, config)
     ys = params.y_scale[:, None]
@@ -699,6 +901,10 @@ def decompose(params: CurveParams, day_all, config: CurveModelConfig,
         comps["regressors"] = (
             _regressor_contrib(params, xreg, layout["n_features"]) * ys
         )
+    if config.ar_order > 0 and t_end is not None:
+        ar_mean, _, _ = _ar_correction(params, day_all, t_end,
+                                       config.ar_order)
+        comps["ar"] = ar_mean * ys
     return comps
 
 
@@ -717,7 +923,8 @@ def component_frame(batch, params: CurveParams, config: CurveModelConfig,
     )
 
     day_all = day_grid(batch.day, horizon)
-    comps = decompose(params, day_all, config, xreg=xreg)
+    comps = decompose(params, day_all, config, xreg=xreg,
+                      t_end=batch.day[-1].astype(jnp.float32))
     frame = long_frame_skeleton(batch.keys, batch.key_names, day_all)
     for name, vals in comps.items():
         frame[name] = np.asarray(vals).reshape(-1)
@@ -748,6 +955,7 @@ def extract_params(params: CurveParams, config: CurveModelConfig) -> dict:
         "holiday_prior_scale": config.holiday_prior_scale,
         "n_regressors": config.n_regressors,
         "regressor_prior_scale": config.regressor_prior_scale,
+        "ar_order": config.ar_order,
     }
 
 

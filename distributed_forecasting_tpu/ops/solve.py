@@ -121,6 +121,15 @@ def ridge_solve_batch(
     return beta
 
 
+def fitted_values(X: jnp.ndarray, beta: jnp.ndarray) -> jnp.ndarray:
+    """(S, T) fitted path for a shared (T, F) or per-series (S, T, F)
+    design — the ONE place the two layouts dispatch, shared by the
+    residual-scale computation and the AR-on-residuals fit."""
+    if X.ndim == 3:
+        return jnp.einsum("sf,stf->st", beta, X, optimize=True)
+    return beta @ X.T  # (S, T)
+
+
 def weighted_residual_scale(
     X: jnp.ndarray, y: jnp.ndarray, w: jnp.ndarray, beta: jnp.ndarray
 ) -> jnp.ndarray:
@@ -128,10 +137,7 @@ def weighted_residual_scale(
 
     X: (T, F) shared or (S, T, F) per-series (regressor path).
     """
-    if X.ndim == 3:
-        yhat = jnp.einsum("sf,stf->st", beta, X, optimize=True)
-    else:
-        yhat = beta @ X.T  # (S, T)
+    yhat = fitted_values(X, beta)
     r2 = w * (y - yhat) ** 2
     n = jnp.maximum(jnp.sum(w, axis=1), 1.0)
     return jnp.sqrt(jnp.sum(r2, axis=1) / n)

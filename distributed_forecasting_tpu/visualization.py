@@ -84,7 +84,7 @@ def plot_changepoints(params, config, series_index: int = 0, ax=None):
 
 
 def plot_components(params, config, day_all, series_index: int = 0,
-                    xreg=None):
+                    xreg=None, t_end=None):
     """Trend / weekly / yearly decomposition from the linear basis (the
     Prophet components plot equivalent).  Returns the figure."""
     import jax.numpy as jnp
@@ -98,7 +98,8 @@ def plot_components(params, config, day_all, series_index: int = 0,
     comps = {
         name: np.asarray(vals[series_index])
         for name, vals in decompose(
-            params, jnp.asarray(day_all, dtype=jnp.int32), config, xreg=xreg
+            params, jnp.asarray(day_all, dtype=jnp.int32), config, xreg=xreg,
+            t_end=None if t_end is None else jnp.float32(t_end),
         ).items()
     }
 

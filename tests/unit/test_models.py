@@ -498,3 +498,188 @@ def test_explicit_changepoint_days():
 
     ax = plot_changepoints(p, cfg)
     assert len(ax.patches) == 1
+
+
+def test_ar_on_residuals():
+    """NeuralProphet-style AR on residuals (arXiv:2111.15397): with an
+    AR(1) residual process, ar_order=1 recovers phi, narrows the short-lead
+    band by the right factor, beats the plain curve forecast on average,
+    and decays to it (mean AND variance) at long leads."""
+    import numpy as np
+    import pandas as pd
+    import jax.numpy as jnp
+
+    from distributed_forecasting_tpu.data import tensorize
+    from distributed_forecasting_tpu.models import prophet_glm as P
+
+    S, T, H = 20, 730, 90
+    rng = np.random.default_rng(0)
+    t = np.arange(T + H)
+    rows, truth = [], []
+    for s in range(S):
+        base = 40 + 0.03 * t + 4 * np.sin(2 * np.pi * t / 7)
+        r = np.zeros(T + H)
+        for i in range(1, T + H):
+            r[i] = 0.85 * r[i - 1] + rng.normal(0, 1.0)
+        y = base + 3.0 * r
+        truth.append(y[T:])
+        rows.append(pd.DataFrame({
+            "date": pd.date_range("2020-01-01", periods=T),
+            "store": 1, "item": s + 1, "sales": y[:T],
+        }))
+    b = tensorize(pd.concat(rows, ignore_index=True))
+    truth = np.stack(truth)
+    day_all = jnp.arange(int(b.day[0]), int(b.day[-1]) + H + 1,
+                         dtype=jnp.int32)
+    t_end = b.day[-1].astype(jnp.float32)
+
+    cfg0 = P.CurveModelConfig(seasonality_mode="additive", yearly_order=0)
+    cfg1 = P.CurveModelConfig(seasonality_mode="additive", yearly_order=0,
+                              ar_order=1)
+    p0 = P.fit(b.y, b.mask, b.day, cfg0)
+    p1 = P.fit(b.y, b.mask, b.day, cfg1)
+    yh0, lo0, hi0 = P.forecast(p0, day_all, t_end, cfg0)
+    yh1, lo1, hi1 = P.forecast(p1, day_all, t_end, cfg1)
+    yh0, yh1 = np.asarray(yh0), np.asarray(yh1)
+
+    # Yule-Walker recovers the residual AR coefficient
+    phi = np.asarray(p1.ar_phi)[:, 0]
+    assert 0.75 < phi.mean() < 0.92, phi.mean()
+
+    # short-lead accuracy: AR wins on average across 20 series
+    mae0 = np.abs(yh0[:, T:T + 10] - truth[:, :10]).mean()
+    mae1 = np.abs(yh1[:, T:T + 10] - truth[:, :10]).mean()
+    assert mae1 < mae0 - 0.2, (mae1, mae0)
+
+    # 1-step band narrows by ~sqrt(1 - phi^2) (innovation vs marginal sd)
+    w0 = np.asarray(hi0 - lo0)[:, T]
+    w1 = np.asarray(hi1 - lo1)[:, T]
+    ratio = (w1 / w0).mean()
+    assert 0.45 < ratio < 0.70, ratio  # sqrt(1-0.85^2)=0.53
+
+    # long leads: correction decayed, band back to the marginal width
+    far = slice(T + 70, T + H)
+    assert np.abs(yh1[:, far] - yh0[:, far]).max() < 1.0
+    wf = (np.asarray(hi1 - lo1)[:, far] / np.asarray(hi0 - lo0)[:, far])
+    assert 0.95 < wf.mean() < 1.05, wf.mean()
+
+    # in-history path is untouched (AR is a forecast-time correction)
+    np.testing.assert_allclose(yh1[:, :T], yh0[:, :T], rtol=1e-5, atol=1e-3)
+
+
+def test_ar_seeds_from_last_observed_under_cutoff_mask(tmp_path):
+    """A CV-style prefix mask must seed the AR tail at the last OBSERVED
+    day, not the (masked) end of the grid; and the AR leaves round-trip
+    through the serving artifact."""
+    import numpy as np
+    import pandas as pd
+    import jax.numpy as jnp
+
+    from distributed_forecasting_tpu.data import tensorize
+    from distributed_forecasting_tpu.models import prophet_glm as P
+    from distributed_forecasting_tpu.serving import BatchForecaster
+
+    T = 400
+    rng = np.random.default_rng(1)
+    r = np.zeros(T)
+    for i in range(1, T):
+        r[i] = 0.9 * r[i - 1] + rng.normal(0, 1.0)
+    y = 50.0 + 3.0 * r
+    df = pd.DataFrame({"date": pd.date_range("2021-01-01", periods=T),
+                       "store": 1, "item": 1, "sales": y})
+    b = tensorize(df)
+    cfg = P.CurveModelConfig(seasonality_mode="additive", yearly_order=0,
+                             weekly_order=0, ar_order=1)
+
+    cut = 300
+    mask_cut = np.zeros((1, T), np.float32)
+    mask_cut[:, :cut] = 1.0
+    p_cut = P.fit(b.y, jnp.asarray(mask_cut), b.day, cfg)
+    # tail = residual at the cutoff, not the masked grid end (zeros)
+    assert abs(float(p_cut.ar_tail[0, -1])) > 1e-4
+    # forecasting from the cutoff uses that seed: 1-step-ahead prediction
+    # correlates with the observed next value's deviation
+    day_all = b.day
+    t_cut_end = b.day[cut - 1].astype(jnp.float32)
+    yh, _, _ = P.forecast(p_cut, day_all, t_cut_end, cfg)
+    corr_pred = float(yh[0, cut]) - 50.0
+    corr_true = y[cut] - 50.0
+    assert np.sign(corr_pred) == np.sign(corr_true)
+    assert abs(corr_pred - 0.9 * (y[cut - 1] - 50.0)) < 2.5
+
+    # serving round trip carries the AR leaves
+    p = P.fit(b.y, b.mask, b.day, cfg)
+    fc = BatchForecaster.from_fit(b, p, "prophet", cfg)
+    fc.save(str(tmp_path / "m"))
+    back = BatchForecaster.load(str(tmp_path / "m"))
+    assert back.params.ar_phi.shape == (1, 1)
+    out = back.predict(pd.DataFrame({"store": [1], "item": [1]}), horizon=7)
+    assert np.isfinite(out.yhat).all()
+
+
+def test_ar_stale_series_decays_and_decompose_component():
+    """A series whose observations end G days before the batch end must get
+    the decayed phi^(G+h) correction (and near-marginal variance) at the
+    first forecast day — not a full-strength lead-1 one; and decompose
+    reports the AR term as an `ar` component when given t_end."""
+    import numpy as np
+    import pandas as pd
+    import jax.numpy as jnp
+
+    from distributed_forecasting_tpu.data import tensorize
+    from distributed_forecasting_tpu.models import prophet_glm as P
+
+    T, G, H = 400, 40, 30
+    rng = np.random.default_rng(2)
+    rows = []
+    for item, cut_tail in ((1, 0), (2, G)):
+        r = np.zeros(T)
+        for i in range(1, T):
+            r[i] = 0.9 * r[i - 1] + rng.normal(0, 1.0)
+        y = 50.0 + 3.0 * r
+        n = T - cut_tail
+        rows.append(pd.DataFrame({
+            "date": pd.date_range("2021-01-01", periods=T)[:n],
+            "store": 1, "item": item, "sales": y[:n],
+        }))
+    b = tensorize(pd.concat(rows, ignore_index=True))
+    cfg = P.CurveModelConfig(seasonality_mode="additive", yearly_order=0,
+                             weekly_order=0, ar_order=1)
+    p = P.fit(b.y, b.mask, b.day, cfg)
+    # per-series last-observed day recorded
+    assert int(p.ar_last_day[0]) == int(b.day[-1])
+    assert int(p.ar_last_day[1]) == int(b.day[-1]) - G
+
+    day_all = jnp.arange(int(b.day[0]), int(b.day[-1]) + H + 1,
+                         dtype=jnp.int32)
+    t_end = b.day[-1].astype(jnp.float32)
+    mean, var, fut = P._ar_correction(p, day_all, t_end, 1)
+    mean, var = np.asarray(mean), np.asarray(var)
+    Tn = b.n_time
+    # fresh series: full-strength lead-1 correction, innovation variance
+    assert abs(mean[0, Tn]) > 0.5 * abs(float(p.ar_tail[0, -1]))
+    assert var[0, Tn] < 0.5 * float(p.sigma[0]) ** 2
+    # stale series: correction decayed by ~phi^G, variance near marginal
+    phi1 = float(p.ar_phi[1, 0])
+    assert abs(mean[1, Tn]) <= abs(float(p.ar_tail[1, -1])) * phi1**G * 3 + 1e-5
+    assert var[1, Tn] > 0.8 * float(p.sigma[1]) ** 2
+
+    # decompose: components + ar sum to the forecast path (additive mode)
+    comps = P.decompose(p, day_all, cfg, t_end=t_end)
+    assert "ar" in comps
+    yh, _, _ = P.forecast(p, day_all, t_end, cfg)
+    total = sum(np.asarray(v) for v in comps.values())
+    np.testing.assert_allclose(total, np.asarray(yh), rtol=1e-4, atol=1e-2)
+    # without t_end the ar component is omitted (documented contract)
+    assert "ar" not in P.decompose(p, day_all, cfg)
+
+    # beyond the AR table the correction ZEROES (decay contract) and the
+    # variance returns to marginal — even for near-unit-root phi the
+    # forecast far out is the plain curve forecast
+    day_far = jnp.arange(int(b.day[0]), int(b.day[-1]) + 200,
+                         dtype=jnp.int32)
+    m_far, v_far, _ = P._ar_correction(p, day_far, t_end, 1)
+    assert float(np.abs(np.asarray(m_far)[:, -1]).max()) == 0.0
+    np.testing.assert_allclose(
+        np.asarray(v_far)[:, -1], np.asarray(p.sigma) ** 2, rtol=1e-5
+    )
