@@ -41,6 +41,7 @@ import optax
 from jax.scipy.special import ndtri
 
 from distributed_forecasting_tpu.models.base import gaussian_quantiles, register_model
+from distributed_forecasting_tpu.ops.solve import yule_walker_masked
 
 _EPS = 1e-6
 
@@ -267,17 +268,14 @@ def _hannan_rissanen(z, m, ar_lags, ma_lags, p_eff: int, q_eff: int, K: int,
     """
     S, T = z.shape
     zm = z * m
-    g0 = jnp.sum(zm * zm, axis=1) / jnp.maximum(jnp.sum(m, axis=1), 1.0)
-    g0 = jnp.maximum(g0, _EPS)
-    rho = [jnp.ones_like(g0)]
-    for k in range(1, K + 1):
-        num = jnp.sum(zm[:, k:] * zm[:, :-k], axis=1)
-        den = jnp.maximum(jnp.sum(m[:, k:] * m[:, :-k], axis=1), 1.0)
-        rho.append((num / den) / g0)
-    rho = jnp.stack(rho, axis=1)  # (S, K+1), rho_0 = 1
-    idx = jnp.abs(jnp.arange(K)[:, None] - jnp.arange(K)[None, :])
-    Rm = rho[:, idx] + ridge * jnp.eye(K)[None]
-    a = jnp.linalg.solve(Rm, rho[:, 1 : K + 1][..., None])[..., 0]  # (S, K)
+    # masked series variance: also scales the ridge of the MA regression
+    g0 = jnp.maximum(
+        jnp.sum(zm * zm, axis=1) / jnp.maximum(jnp.sum(m, axis=1), 1.0),
+        _EPS,
+    )
+    a, _rho = yule_walker_masked(
+        z, m, K, per_lag_norm=True, jitter_abs=ridge, eps=_EPS
+    )  # (S, K)
 
     e = zm
     evalid = m

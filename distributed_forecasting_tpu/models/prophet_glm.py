@@ -47,6 +47,7 @@ from distributed_forecasting_tpu.ops.solve import (
     fitted_values,
     ridge_solve_batch,
     weighted_residual_scale,
+    yule_walker_masked,
 )
 
 _LOG_EPS = 1e-3
@@ -600,18 +601,9 @@ def _fit_ar_residuals(resid, mask, p: int):
       ``e_t = r_t - sum_k phi_k r_{t-k}`` over fully-observed lag windows.
     """
     S, T = resid.shape
-    n0 = jnp.maximum(jnp.sum(mask, axis=1), 1.0)  # (S,)
-    # autocovariances c_0..c_p (biased): observed-pair products / n0
-    cov = []
-    for k in range(p + 1):
-        rk = resid[:, k:] * resid[:, : T - k]
-        mk = mask[:, k:] * mask[:, : T - k]
-        cov.append(jnp.sum(rk * mk, axis=1) / n0)
-    c = jnp.stack(cov, axis=1)  # (S, p+1)
-    idx = jnp.abs(jnp.arange(p)[:, None] - jnp.arange(p)[None, :])  # (p, p)
-    R = c[:, idx]  # (S, p, p) Toeplitz of c_0..c_{p-1}
-    R = R + 1e-6 * c[:, :1, None] * jnp.eye(p)[None] + 1e-12 * jnp.eye(p)[None]
-    phi = jnp.linalg.solve(R, c[:, 1:, None])[..., 0]  # (S, p)
+    phi, c = yule_walker_masked(
+        resid, mask, p, per_lag_norm=False, jitter_rel=1e-6, jitter_abs=1e-12
+    )
 
     # residual window ending at the last observed index (newest last)
     last = jnp.argmax(
@@ -959,6 +951,18 @@ def extract_params(params: CurveParams, config: CurveModelConfig) -> dict:
     }
 
 
+@dataclasses.dataclass(frozen=True)
+class CurveModelConfigAR(CurveModelConfig):
+    """Curve model with AR-on-residuals ON by default — registered as the
+    ``prophet_ar`` family so auto-selection (`engine/select.py`) can race
+    the plain and AR-augmented curve per series:
+    ``families=("prophet", "prophet_ar", ...)``."""
+
+    ar_order: int = 1
+
+
+register_model("prophet_ar", fit, forecast, CurveModelConfigAR,
+               supports_xreg=True, forecast_quantiles=forecast_quantiles)
 register_model("prophet", fit, forecast, CurveModelConfig, supports_xreg=True,
                forecast_quantiles=forecast_quantiles)
 register_model("curve", fit, forecast, CurveModelConfig, supports_xreg=True,

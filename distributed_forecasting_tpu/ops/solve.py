@@ -121,6 +121,57 @@ def ridge_solve_batch(
     return beta
 
 
+def yule_walker_masked(
+    z: jnp.ndarray,
+    m: jnp.ndarray,
+    K: int,
+    per_lag_norm: bool = False,
+    jitter_rel: float = 0.0,
+    jitter_abs: float = 0.0,
+    eps: float = 1e-12,
+):
+    """Batched masked Yule-Walker AR(K) solve — ONE implementation for the
+    two callers (the ARIMA Hannan-Rissanen long-AR step and the curve
+    model's AR-on-residuals), so conditioning/normalization cannot drift.
+
+    z, m: (S, T) series and 0/1 mask (z need not be pre-zeroed off-mask).
+    Returns ``(coef (S, K), acov (S, K+1))`` where ``acov`` is:
+
+    * ``per_lag_norm=False``: biased (divisor n_0) sample autocovariances —
+      the PSD choice, so the solution is stationary; ``acov[:, 0]`` is the
+      masked variance (useful for sigma fallbacks);
+    * ``per_lag_norm=True``: pairwise-normalized autocorrelations
+      (``acov[:, 0] = 1``) — the Hannan-Rissanen long-AR convention.
+
+    The (S, K, K) Toeplitz system is regularized with
+    ``jitter_rel * acov_0 + jitter_abs`` on the diagonal.
+    """
+    zm = z * m
+    if per_lag_norm:
+        g0 = jnp.sum(zm * zm, axis=1) / jnp.maximum(jnp.sum(m, axis=1), 1.0)
+        g0 = jnp.maximum(g0, eps)
+        rows = [jnp.ones_like(g0)]
+        for k in range(1, K + 1):
+            num = jnp.sum(zm[:, k:] * zm[:, :-k], axis=1)
+            den = jnp.maximum(jnp.sum(m[:, k:] * m[:, :-k], axis=1), 1.0)
+            rows.append((num / den) / g0)
+        acov = jnp.stack(rows, axis=1)  # (S, K+1), acov_0 = 1
+    else:
+        n0 = jnp.maximum(jnp.sum(m, axis=1), 1.0)
+        rows = [jnp.sum(zm * zm, axis=1) / n0]
+        for k in range(1, K + 1):
+            rows.append(jnp.sum(zm[:, k:] * zm[:, :-k], axis=1) / n0)
+        acov = jnp.stack(rows, axis=1)  # (S, K+1)
+    idx = jnp.abs(jnp.arange(K)[:, None] - jnp.arange(K)[None, :])
+    R = (
+        acov[:, idx]
+        + jitter_rel * acov[:, :1, None] * jnp.eye(K)[None]
+        + jitter_abs * jnp.eye(K)[None]
+    )
+    coef = jnp.linalg.solve(R, acov[:, 1 : K + 1][..., None])[..., 0]
+    return coef, acov
+
+
 def fitted_values(X: jnp.ndarray, beta: jnp.ndarray) -> jnp.ndarray:
     """(S, T) fitted path for a shared (T, F) or per-series (S, T, F)
     design — the ONE place the two layouts dispatch, shared by the

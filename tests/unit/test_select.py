@@ -117,3 +117,46 @@ def test_multi_model_unknown_series_raises(mixed_batch):
     mm = MultiModelForecaster.from_fit(mixed_batch, params_by_family, None, sel)
     with pytest.raises(UnknownSeriesError):
         mm.predict(pd.DataFrame({"store": [9], "item": [99]}))
+
+
+def test_auto_select_can_race_ar_family():
+    """families=(prophet, prophet_ar) races the plain and AR-augmented
+    curve per series: AR-residual series pick prophet_ar, white-noise
+    series have no reason to (its extra CV edge is ~0)."""
+    import numpy as np
+    import pandas as pd
+    import jax
+
+    from distributed_forecasting_tpu.data import tensorize
+    from distributed_forecasting_tpu.engine import CVConfig
+    from distributed_forecasting_tpu.engine.select import fit_forecast_auto
+
+    rng = np.random.default_rng(3)
+    T = 730
+    t = np.arange(T)
+    rows = []
+    for item in range(1, 9):
+        base = 50 + 0.02 * t + 4 * np.sin(2 * np.pi * t / 7)
+        if item <= 4:  # strong AR(1) residuals
+            r = np.zeros(T)
+            for i in range(1, T):
+                r[i] = 0.9 * r[i - 1] + rng.normal(0, 1.0)
+            y = base + 3.0 * r
+        else:  # white noise residuals
+            y = base + rng.normal(0, 1.0, T)
+        rows.append(pd.DataFrame({
+            "date": pd.date_range("2020-01-01", periods=T),
+            "store": 1, "item": item, "sales": y,
+        }))
+    b = tensorize(pd.concat(rows, ignore_index=True))
+    _, selection, result = fit_forecast_auto(
+        b, models=("prophet", "prophet_ar"),
+        cv=CVConfig(initial=365, period=120, horizon=30), horizon=30,
+        key=jax.random.PRNGKey(0),
+    )
+    jax.block_until_ready(result.yhat)
+    chosen = np.asarray(selection.chosen)
+    # most AR-residual series should prefer the AR family
+    ar_rate_on_ar_series = (chosen[:4] == "prophet_ar").mean()
+    assert ar_rate_on_ar_series >= 0.5, chosen
+    assert bool(result.ok.all())
