@@ -2,7 +2,9 @@ from distributed_forecasting_tpu.monitoring.monitor import (
     MonitorConfig,
     MonitorRegistry,
     detect_anomalies,
+    drift_report,
     run_monitor,
 )
 
-__all__ = ["MonitorConfig", "MonitorRegistry", "detect_anomalies", "run_monitor"]
+__all__ = ["MonitorConfig", "MonitorRegistry", "detect_anomalies",
+           "drift_report", "run_monitor"]

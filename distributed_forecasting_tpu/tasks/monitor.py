@@ -15,6 +15,10 @@ Conf::
       anomaly_threshold: null   # z threshold; default = the band's z
                                 # (~5% of calibrated noise flags) — raise to
                                 # e.g. 3.5 for alert-grade severity only
+      drift: true               # PSI/KS drift vs a previous table version
+      drift_baseline: null      # explicit baseline version id (default:
+                                # the previous version); -> <table>_drift
+      drift_columns: [y, yhat]
 """
 
 from __future__ import annotations
@@ -23,6 +27,7 @@ from distributed_forecasting_tpu.monitoring import (
     MonitorConfig,
     MonitorRegistry,
     detect_anomalies,
+    drift_report,
     run_monitor,
 )
 from distributed_forecasting_tpu.tasks.common import Task
@@ -68,6 +73,31 @@ class MonitorTask(Task):
                 n_flag, len(scored), config.table,
             )
             summary["n_anomalies"] = n_flag
+        if mc.get("drift", False):
+            baseline = mc.get("drift_baseline")
+            if baseline is None and len(
+                self.catalog.table_versions(config.table)
+            ) < 2:
+                # first snapshot: nothing to compare yet — skip, don't
+                # fail the profile/anomaly results already computed
+                self.logger.info(
+                    "drift scan skipped: %s has a single version (a "
+                    "baseline appears at the next snapshot)", config.table,
+                )
+            else:
+                drift = drift_report(
+                    self.catalog, config.table,
+                    baseline_version=baseline,
+                    columns=tuple(mc.get("drift_columns", ("y", "yhat"))),
+                    slicing_cols=config.slicing_cols,
+                    df=table_df,
+                )
+                n_drift = int(drift.drifted.sum())
+                self.logger.info(
+                    "drift scan: %d/%d (column, slice) pairs drifted -> "
+                    "%s_drift", n_drift, len(drift), config.table,
+                )
+                summary["n_drifted"] = n_drift
         return summary
 
 

@@ -250,3 +250,91 @@ def test_detect_anomalies_clamped_lower_band(catalog):
     # worst row would score 2.39 > 1.96: a false positive.
     assert not scored.is_anomaly.any()
     assert scored.anomaly_score.max() == pytest.approx(3.0 / 2.0, abs=0.01)
+
+
+def test_drift_report_psi_ks(catalog):
+    """PSI/KS drift between table versions: a shifted distribution on one
+    store drifts, the untouched store does not; baseline defaults to the
+    previous version via catalog time travel."""
+    rng = np.random.default_rng(0)
+    n = 2000
+    ds = pd.date_range("2024-01-01", periods=n // 2)
+
+    def make(shift2):
+        rows = []
+        for store, shift in ((1, 0.0), (2, shift2)):
+            y = rng.normal(100 + shift, 10, n // 2)
+            rows.append(pd.DataFrame({
+                "ds": ds, "store": store, "item": 1, "y": y,
+                "yhat": y + rng.normal(0, 2, n // 2),
+            }))
+        return pd.concat(rows, ignore_index=True)
+
+    catalog.save_table("hackathon.sales.fc_drift_src", make(0.0))
+    catalog.save_table("hackathon.sales.fc_drift_src", make(25.0))
+
+    from distributed_forecasting_tpu.monitoring import drift_report
+
+    rep = drift_report(
+        catalog, "hackathon.sales.fc_drift_src",
+        columns=("y",), slicing_cols=("store",),
+    )
+    overall = rep[(rep.slice_key == ":all") & (rep.column == "y")].iloc[0]
+    s1 = rep[(rep.slice_key == "store") & (rep.slice_value == "1")].iloc[0]
+    s2 = rep[(rep.slice_key == "store") & (rep.slice_value == "2")].iloc[0]
+    # store 2 shifted by 2.5 sigma: unambiguous drift; store 1 stable
+    assert s2.drifted and s2.psi > 1.0 and s2.ks > 0.5
+    assert not s1.drifted and s1.psi < 0.1
+    assert overall.drifted  # half the rows moved
+    # persisted artifact
+    out = catalog.read_table("hackathon.sales.fc_drift_src_drift")
+    assert len(out) == len(rep)
+
+    # single-version tables fail loudly without an explicit baseline
+    catalog.save_table("hackathon.sales.fc_one", make(0.0))
+    with pytest.raises(ValueError, match="baseline"):
+        drift_report(catalog, "hackathon.sales.fc_one")
+
+
+def test_drift_vanished_segment_and_ks_fallback(catalog):
+    """A store missing from the current snapshot is reported as drift
+    (status=vanished), and a mostly-zero baseline that collapses the PSI
+    bins still flags through the KS leg."""
+    rng = np.random.default_rng(1)
+    n = 600
+    ds = pd.date_range("2024-01-01", periods=n)
+
+    # baseline: two stores; current: store 2 gone, store 3 new
+    base_rows = [
+        pd.DataFrame({"ds": ds, "store": s_, "item": 1,
+                      "y": rng.normal(100, 10, n), "yhat": 100.0})
+        for s_ in (1, 2)
+    ]
+    cur_rows = [
+        pd.DataFrame({"ds": ds, "store": s_, "item": 1,
+                      "y": rng.normal(100, 10, n), "yhat": 100.0})
+        for s_ in (1, 3)
+    ]
+    catalog.save_table("hackathon.sales.fc_van", pd.concat(base_rows))
+    catalog.save_table("hackathon.sales.fc_van", pd.concat(cur_rows))
+
+    from distributed_forecasting_tpu.monitoring import drift_report
+
+    rep = drift_report(catalog, "hackathon.sales.fc_van",
+                       columns=("y",), slicing_cols=("store",))
+    by_val = rep[rep.slice_key == "store"].set_index("slice_value")
+    assert by_val.loc["2"].status == "vanished" and by_val.loc["2"].drifted
+    assert by_val.loc["3"].status == "new" and by_val.loc["3"].drifted
+    assert by_val.loc["1"].status == "compared" and not by_val.loc["1"].drifted
+
+    # intermittent baseline (90% zeros): PSI bins collapse, KS still flags
+    y_base = np.where(rng.random(n) < 0.9, 0.0, rng.normal(5, 1, n))
+    y_cur = np.abs(rng.normal(5, 1, n))  # all positive now
+    catalog.save_table("hackathon.sales.fc_int", pd.DataFrame(
+        {"ds": ds, "store": 1, "item": 1, "y": y_base, "yhat": 0.0}))
+    catalog.save_table("hackathon.sales.fc_int", pd.DataFrame(
+        {"ds": ds, "store": 1, "item": 1, "y": y_cur, "yhat": 0.0}))
+    rep2 = drift_report(catalog, "hackathon.sales.fc_int", columns=("y",))
+    row = rep2.iloc[0]
+    assert row.ks > 0.5
+    assert row.drifted  # via the KS leg even if psi degenerated
