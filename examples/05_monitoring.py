@@ -42,3 +42,24 @@ if __name__ == "__main__":
             ["ds", "store", "item", "y", "yhat", "anomaly_score"]
         ].to_string(index=False)
     )
+
+    # --- drift: compare against the previous table version (time travel) ---
+    from distributed_forecasting_tpu.monitoring import drift_report
+
+    versions = task.catalog.table_versions(
+        "hackathon.sales.finegrain_forecasts"
+    )
+    if len(versions) >= 2:
+        drift = drift_report(
+            task.catalog, "hackathon.sales.finegrain_forecasts",
+            columns=("y", "yhat"), slicing_cols=("store",),
+        )
+        n = int(drift.drifted.sum())
+        print(f"\ndrift vs version {versions[-2]}: "
+              f"{n}/{len(drift)} (column, slice) pairs drifted")
+        print(drift[drift.slice_key == ":all"][
+            ["column", "psi", "ks", "status", "drifted"]
+        ].to_string(index=False))
+    else:
+        print("\ndrift: single table version — scan appears at the next "
+              "training snapshot")
