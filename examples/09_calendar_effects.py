@@ -95,3 +95,24 @@ if __name__ == "__main__":
         f"bounded decline: forecast tail mean {tail.mean():.1f} "
         f"(floor 20, never below: {bool(tail.min() >= 20 - 1e-3)})"
     )
+
+    # --- AR-on-residuals: short-lead accuracy from autocorrelated noise ----
+    ar_noise = np.zeros(T)
+    for i in range(1, T):
+        ar_noise[i] = 0.85 * ar_noise[i - 1] + rng.normal(0, 1.0)
+    df3 = pd.DataFrame(
+        {"date": dates, "store": 1, "item": 3,
+         "sales": 80 + 0.01 * t + 3.0 * ar_noise}
+    )
+    b3 = tensorize(df3)
+    cfg_ar = CurveModelConfig(seasonality_mode="additive", yearly_order=0,
+                              weekly_order=0, ar_order=1)
+    p3, r3 = fit_forecast(b3, model="prophet", config=cfg_ar, horizon=30)
+    phi = float(p3.ar_phi[0, 0])
+    band1 = float(r3.hi[0, b3.n_time] - r3.lo[0, b3.n_time])
+    band30 = float(r3.hi[0, -1] - r3.lo[0, -1])
+    print(
+        f"AR-on-residuals: recovered phi={phi:.2f} (true 0.85); "
+        f"1-day band {band1:.1f} vs 30-day band {band30:.1f} "
+        f"(narrows by ~sqrt(1-phi^2) near the data, widens to marginal)"
+    )
