@@ -169,17 +169,31 @@ def _build_ssm(phi, theta, r):
 
 def _init_cov(T, RRt, n_iter=30):
     """Stationary covariance by fixed-point iteration of the Lyapunov
-    equation P = T P T' + RR' (converges geometrically for stationary T)."""
+    equation P = T P T' + RR' (converges geometrically for stationary T).
+    float32 matmuls: 30 chained products at the TPU's bfloat16 default
+    would hand every downstream filter a drifted P0."""
     def body(P, _):
         return T @ P @ T.T + RRt, None
 
-    P, _ = jax.lax.scan(body, RRt, None, length=n_iter)
+    with jax.default_matmul_precision("float32"):
+        P, _ = jax.lax.scan(body, RRt, None, length=n_iter)
     return P
 
 
 def _kalman_loglik(z, mask, phi, theta, r):
     """Filter one differenced series; unit innovation variance (sigma2 is
-    concentrated out).  Returns (ssq, ldet, n, preds, Fs, a_T, P_T)."""
+    concentrated out).  Returns (ssq, ldet, n, preds, Fs, a_T, P_T).
+
+    Matmuls run at float32 precision: the TPU MXU bfloat16 default drifts
+    the covariance recursion over ~1.8k steps, and the parallel-scan
+    variant (``ops/pkalman``) holds the same precision so the two filters
+    agree on hardware (integration tier, round 3).  FLOPs at r <= ~10 are
+    negligible either way."""
+    with jax.default_matmul_precision("float32"):
+        return _kalman_loglik_impl(z, mask, phi, theta, r)
+
+
+def _kalman_loglik_impl(z, mask, phi, theta, r):
     T_mat, Rv = _build_ssm(phi, theta, r)
     RRt = jnp.outer(Rv, Rv)
     P0 = _init_cov(T_mat, RRt)
@@ -473,7 +487,9 @@ def _forecast_impl(params: ArimaParams, day_all, config: ArimaConfig, _r: int):
             a2, P2 = T_mat @ a, T_mat @ P @ T_mat.T + RRt
             return (a2, P2), (a2[0], P2[0, 0])
 
-        _, (zf, vf) = jax.lax.scan(step, (a0, P0), None, length=H)
+        # float32: H chained covariance products (see _init_cov)
+        with jax.default_matmul_precision("float32"):
+            _, (zf, vf) = jax.lax.scan(step, (a0, P0), None, length=H)
         return zf, vf * s2
 
     zf, vf = jax.vmap(fc_one)(

@@ -122,8 +122,14 @@ def affine_scan(
         A_cum, c_cum = full
         return (A_cum @ x0[None, :, None])[..., 0] + c_cum
 
-    return blocked_prefix(_compose, (A, c), identity, block_size,
-                          project=to_states)
+    # float32 matmuls: the TPU MXU default (bfloat16 passes) compounds
+    # roundoff through the O(log T) composition tree until the prefix
+    # states drift visibly from the sequential recurrence (caught by the
+    # real-hardware integration tier, round 3).  The (d, d) products are
+    # FLOP-negligible, so full precision costs nothing measurable.
+    with jax.default_matmul_precision("float32"):
+        return blocked_prefix(_compose, (A, c), identity, block_size,
+                              project=to_states)
 
 
 def affine_scan_batched(A, c, x0):
