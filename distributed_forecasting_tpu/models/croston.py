@@ -1,14 +1,19 @@
-"""Batched Croston / SBA intermittent-demand forecasting.
+"""Batched Croston / SBA / TSB intermittent-demand forecasting.
 
 Beyond-parity model family: at store x item granularity much retail demand
 is *intermittent* (mostly zero days with occasional demands), where
 curve/HW/ARIMA models systematically under- or over-shoot.  Croston's method
 smooths demand sizes and inter-demand intervals separately with SES and
 forecasts their ratio; the SBA variant applies the (1 - alpha/2) bias
-correction.  The recursion is a ``lax.scan`` with a (size-level,
-interval-level, gap-counter) carry, vmapped over series — same batched
-architecture as every other family here (one compiled program for all
-series, reference fan-out analogy as in models/holt_winters.py).
+correction.  The TSB variant (Teunter-Syntetos-Babai 2011, public method)
+instead smooths the demand *probability* every observed period — so a run
+of zero-demand days decays the forecast toward zero, handling product
+obsolescence, where Croston/SBA freeze at the last demand rate forever.
+The recursion is a ``lax.scan`` with a (size-level, interval-level,
+gap-counter) carry — (size-level, probability) for TSB — vmapped over
+series; same batched architecture as every other family here (one compiled
+program for all series, reference fan-out analogy as in
+models/holt_winters.py).
 """
 
 from __future__ import annotations
@@ -32,7 +37,12 @@ _EPS = 1e-6
 @dataclasses.dataclass(frozen=True)
 class CrostonConfig:
     alpha: float = 0.1          # SES smoothing for sizes and intervals
-    variant: str = "sba"        # 'croston' | 'sba'
+    variant: str = "sba"        # 'croston' | 'sba' | 'tsb'
+    # TSB only: smoothing rate for the demand-probability EWMA (updated
+    # every observed period, unlike sizes/intervals which update only at
+    # demand points — this is what lets the forecast decay to zero over a
+    # dead tail)
+    beta: float = 0.1
     interval_width: float = 0.95
 
 
@@ -40,7 +50,10 @@ class CrostonConfig:
 @dataclasses.dataclass(frozen=True)
 class CrostonParams:
     z_level: jax.Array   # (S,) smoothed demand size
-    p_level: jax.Array   # (S,) smoothed inter-demand interval
+    # (S,) smoothed inter-demand interval; for the TSB variant this holds
+    # the INVERSE smoothed demand probability (1/b >= 1), so the shared
+    # forecast rate z/p equals TSB's z*b with an unchanged param pytree
+    p_level: jax.Array
     sigma: jax.Array     # (S,) one-step residual std (demand-rate space)
     fitted: jax.Array    # (S, T) one-step-ahead fitted rates
     day0: jax.Array
@@ -56,6 +69,11 @@ def _rate(z, p, alpha, variant):
 
 @partial(jax.jit, static_argnames=("config",))
 def fit(y, mask, day, config: CrostonConfig) -> CrostonParams:
+    if config.variant not in ("croston", "sba", "tsb"):
+        raise ValueError(
+            f"unknown CrostonConfig.variant {config.variant!r}; "
+            f"'croston', 'sba', or 'tsb'"
+        )
     a = config.alpha
 
     def per_series(ys, ms):
@@ -63,26 +81,49 @@ def fit(y, mask, day, config: CrostonConfig) -> CrostonParams:
         n_demands = jnp.maximum(jnp.sum(nz), 1.0)
         z0 = jnp.sum(jnp.where(nz, ys, 0.0)) / n_demands
         n_obs = jnp.maximum(jnp.sum(ms), 1.0)
-        p0 = n_obs / n_demands
-
-        def step(carry, inp):
-            z, p, q, sse, n = carry
-            yt, mt = inp
-            pred = _rate(z, p, a, config.variant)
-            demand = (yt > _EPS) & (mt > 0)
-            q_new = q + mt  # observed periods since last demand
-            z_upd = a * yt + (1 - a) * z
-            p_upd = a * q_new + (1 - a) * p
-            z2 = jnp.where(demand, z_upd, z)
-            p2 = jnp.where(demand, p_upd, p)
-            q2 = jnp.where(demand, 0.0, q_new)
-            err = (yt - pred) * mt
-            return (z2, p2, q2, sse + err**2, n + mt), pred
-
         zero = jnp.sum(ys) * 0.0  # varying-type-safe zero (see holt_winters)
-        (z, p, _q, sse, n), preds = jax.lax.scan(
-            step, (z0, p0, zero, zero, zero), (ys, ms)
-        )
+
+        if config.variant == "tsb":
+            bta = config.beta
+            b0 = n_demands / n_obs
+
+            def step(carry, inp):
+                z, b, sse, n = carry
+                yt, mt = inp
+                pred = z * b
+                demand = (yt > _EPS) & (mt > 0)
+                ind = jnp.where(demand, 1.0, 0.0)
+                # probability updates EVERY observed period; size only at
+                # demand points — the asymmetry that makes dead tails decay
+                b2 = jnp.where(mt > 0, bta * ind + (1 - bta) * b, b)
+                z2 = jnp.where(demand, a * yt + (1 - a) * z, z)
+                err = (yt - pred) * mt
+                return (z2, b2, sse + err**2, n + mt), pred
+
+            (z, b, sse, n), preds = jax.lax.scan(
+                step, (z0, b0, zero, zero), (ys, ms)
+            )
+            p = 1.0 / jnp.maximum(b, _EPS)
+        else:
+            p0 = n_obs / n_demands
+
+            def step(carry, inp):
+                z, p, q, sse, n = carry
+                yt, mt = inp
+                pred = _rate(z, p, a, config.variant)
+                demand = (yt > _EPS) & (mt > 0)
+                q_new = q + mt  # observed periods since last demand
+                z_upd = a * yt + (1 - a) * z
+                p_upd = a * q_new + (1 - a) * p
+                z2 = jnp.where(demand, z_upd, z)
+                p2 = jnp.where(demand, p_upd, p)
+                q2 = jnp.where(demand, 0.0, q_new)
+                err = (yt - pred) * mt
+                return (z2, p2, q2, sse + err**2, n + mt), pred
+
+            (z, p, _q, sse, n), preds = jax.lax.scan(
+                step, (z0, p0, zero, zero, zero), (ys, ms)
+            )
         sigma = jnp.sqrt(sse / jnp.maximum(n, 1.0))
         return z, p, sigma, preds
 

@@ -68,3 +68,71 @@ def test_croston_through_engine(intermittent_batch):
     assert bool(res.ok.all())
     assert np.isfinite(np.asarray(res.yhat)).all()
     assert (np.asarray(res.lo) >= 0).all()  # demand can't go negative
+
+
+def test_tsb_recovers_demand_rate(intermittent_batch):
+    """The size level is a tight estimate (EWMA of lognormal sizes); the
+    probability level is an EWMA of a 0/1 indicator whose ENDPOINT has
+    std ~ sqrt(beta/(2-beta) p(1-p)) — large relative to small p — so the
+    probability check is a band, not a tolerance (that variance is the
+    price TSB pays for obsolescence-awareness)."""
+    batch, specs = intermittent_batch
+    cfg = CrostonConfig(variant="tsb", alpha=0.1, beta=0.1)
+    params = C.fit(batch.y, batch.mask, batch.day, cfg)
+    for s, (p, m) in enumerate(specs):
+        mean_size = m * np.exp(0.5 * 0.2**2)
+        z = float(params.z_level[s])
+        assert abs(z - mean_size) / mean_size < 0.15, (s, z, mean_size)
+        # rate via the time-average of the fitted one-step predictions over
+        # the back half (the endpoint alone is one noisy EWMA sample)
+        rate = float(np.asarray(params.fitted[s, 300:]).mean())
+        true_rate = p * mean_size
+        assert abs(rate - true_rate) / true_rate < 0.35, (s, rate, true_rate)
+
+
+def test_tsb_decays_under_obsolescence():
+    """The variant's reason to exist: after a product dies (long all-zero
+    tail), croston/sba freeze at the last demand rate forever while TSB's
+    probability EWMA decays the forecast toward zero."""
+    rng = np.random.default_rng(1)
+    T, dead_from = 600, 300
+    occur = rng.random(T) < 0.3
+    occur[dead_from:] = False
+    y = np.where(occur, rng.lognormal(np.log(10.0), 0.2, T), 0.0)
+    df = pd.DataFrame(
+        {"date": pd.date_range("2020-01-01", periods=T), "store": 1,
+         "item": 1, "sales": y}
+    )
+    batch = tensorize(df)
+    day_all = jnp.asarray([int(batch.day[-1]) + 1], dtype=jnp.int32)
+    t_end = batch.day[-1].astype(jnp.float32)
+
+    cfg_c = CrostonConfig(variant="croston")
+    y_c, *_ = C.forecast(
+        C.fit(batch.y, batch.mask, batch.day, cfg_c), day_all, t_end, cfg_c
+    )
+    cfg_t = CrostonConfig(variant="tsb", beta=0.1)
+    y_t, *_ = C.forecast(
+        C.fit(batch.y, batch.mask, batch.day, cfg_t), day_all, t_end, cfg_t
+    )
+    live_rate = 0.3 * 10.0
+    assert float(y_c[0, 0]) > 0.5 * live_rate      # croston still near live rate
+    # 300 dead periods at beta=0.1: probability ~ (0.9)^300 ~ 2e-14 of b0
+    assert float(y_t[0, 0]) < 0.01 * live_rate     # tsb decayed to ~zero
+
+
+def test_tsb_through_engine(intermittent_batch):
+    batch, _ = intermittent_batch
+    params, res = fit_forecast(
+        batch, model="croston", config=CrostonConfig(variant="tsb"),
+        horizon=28,
+    )
+    assert bool(res.ok.all())
+    assert np.isfinite(np.asarray(res.yhat)).all()
+    assert (np.asarray(res.lo) >= 0).all()
+
+
+def test_unknown_variant_raises(intermittent_batch):
+    batch, _ = intermittent_batch
+    with pytest.raises(ValueError, match="variant"):
+        C.fit(batch.y, batch.mask, batch.day, CrostonConfig(variant="wilson"))
