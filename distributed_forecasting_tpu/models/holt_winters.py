@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import dataclasses
 from functools import partial
+from typing import ClassVar
 
 import jax
 import jax.numpy as jnp
@@ -47,6 +48,14 @@ class HoltWintersConfig:
     n_alpha: int = 6
     n_beta: int = 4
     n_gamma: int = 4
+    # Damped trend (Gardner-McKenzie; ETS(A,Ad,A)/(A,Ad,M)): the trend is
+    # multiplied by phi < 1 each step, so long-horizon forecasts flatten to
+    # level + phi/(1-phi) * trend instead of extrapolating a straight line
+    # off a 5-year grid.  When enabled, phi joins the candidate grid as one
+    # more vmapped axis (n_phi values in [0.80, 0.98]); when disabled the
+    # recursion runs with phi = 1 exactly and the grid is unchanged.
+    damped: bool = False
+    n_phi: int = 3
     # time-dimension solver: 'scan' = sequential lax.scan (serial depth T);
     # 'pscan' = associative parallel prefix over affine maps (O(log T) depth,
     # additive mode only) — the long-series regime where the scan's serial
@@ -61,6 +70,7 @@ class HWParams:
     alpha: jax.Array   # (S,)
     beta: jax.Array    # (S,)
     gamma: jax.Array   # (S,)
+    phi: jax.Array     # (S,) trend damping; 1.0 when config.damped=False
     level: jax.Array   # (S,) final level
     trend: jax.Array   # (S,) final trend
     season: jax.Array  # (S, m) final seasonal states (slot = row index mod m)
@@ -68,6 +78,23 @@ class HWParams:
     fitted: jax.Array  # (S, T) one-step-ahead fitted values on the train grid
     day0: jax.Array    # () first training day (absolute)
     t_fit_end: jax.Array  # () last training day (absolute)
+
+    # serving artifacts saved before the damped-trend feature have no phi
+    # field; phi=1 is exactly the recursion they were fit with
+    # (serving/predictor.load_params_npz consults this registry)
+    _LEGACY_DEFAULTS: ClassVar[dict] = {
+        "phi": lambda fields: jnp.ones_like(fields["alpha"])
+    }
+
+
+def _damp_sum(phi, h):
+    """sum_{j=1..h} phi^j, continuous in h; equals h at phi == 1 (the
+    geometric form is 0/0 there, so the undamped case takes the exact
+    branch via where, keeping the pre-damping forecast path bit-identical)."""
+    near1 = jnp.abs(1.0 - phi) < 1e-6
+    phi_safe = jnp.where(near1, 0.5, phi)
+    geo = phi_safe * (1.0 - phi_safe**h) / (1.0 - phi_safe)
+    return jnp.where(near1, h, geo)
 
 
 def _init_state(y, mask, m, mode):
@@ -84,11 +111,13 @@ def _init_state(y, mask, m, mode):
     return l0, b0, s0
 
 
-def _filter(y, mask, alpha, beta, gamma, m, mode):
+def _filter(y, mask, alpha, beta, gamma, m, mode, phi=1.0):
     """One-step-ahead filter for one series & one candidate.
 
     Returns (final_state, mse, preds) where preds is the (T,) one-step
-    prediction path.
+    prediction path.  ``phi`` damps the trend (Gardner-McKenzie): every
+    appearance of the prior trend is phi*b, including the pure-prediction
+    advance on masked steps; phi=1.0 is exactly the classic recursion.
     """
     l0, b0, s0 = _init_state(y, mask, m, mode)
     T = y.shape[0]
@@ -98,17 +127,18 @@ def _filter(y, mask, alpha, beta, gamma, m, mode):
         l, b, s, sse, n = carry
         yt, mt, it = inp
         si = s[it]
+        pb = phi * b
         if mode == "multiplicative":
-            pred = (l + b) * si
-            l_obs = alpha * yt / jnp.maximum(si, _EPS) + (1 - alpha) * (l + b)
+            pred = (l + pb) * si
+            l_obs = alpha * yt / jnp.maximum(si, _EPS) + (1 - alpha) * (l + pb)
             s_obs = gamma * yt / jnp.maximum(l_obs, _EPS) + (1 - gamma) * si
         else:
-            pred = l + b + si
-            l_obs = alpha * (yt - si) + (1 - alpha) * (l + b)
+            pred = l + pb + si
+            l_obs = alpha * (yt - si) + (1 - alpha) * (l + pb)
             s_obs = gamma * (yt - l_obs) + (1 - gamma) * si
-        b_obs = beta * (l_obs - l) + (1 - beta) * b
-        l_new = jnp.where(mt > 0, l_obs, l + b)
-        b_new = jnp.where(mt > 0, b_obs, b)
+        b_obs = beta * (l_obs - l) + (1 - beta) * pb
+        l_new = jnp.where(mt > 0, l_obs, l + pb)
+        b_new = jnp.where(mt > 0, b_obs, pb)
         s_new = s.at[it].set(jnp.where(mt > 0, s_obs, si))
         err = (yt - pred) * mt
         return (l_new, b_new, s_new, sse + err**2, n + mt), pred
@@ -123,7 +153,7 @@ def _filter(y, mask, alpha, beta, gamma, m, mode):
     return (l, b, s), mse, preds
 
 
-def parallel_filter(y, mask, alpha, beta, gamma, m):
+def parallel_filter(y, mask, alpha, beta, gamma, m, phi=1.0):
     """Additive HW filter via parallel prefix over time (O(log T) depth).
 
     The sequential ``_filter`` is a lax.scan — fine at T~2k, but serial depth
@@ -134,7 +164,8 @@ def parallel_filter(y, mask, alpha, beta, gamma, m):
     parallelism story of this framework (SURVEY.md §5).
 
     Returns (final_state_tuple, mse, preds) matching ``_filter`` semantics
-    (mode='additive').
+    (mode='additive', same ``phi`` damping — the prior-trend coefficients
+    of the affine maps each carry the phi factor).
     """
     from distributed_forecasting_tpu.ops.pscan import affine_scan
 
@@ -144,15 +175,16 @@ def parallel_filter(y, mask, alpha, beta, gamma, m):
     eye_m = jnp.eye(m)
     e = eye_m[idx]  # (T, m) one-hot seasonal slot per step
 
-    # observed-update matrix rows (affine in previous state):
-    #   l' = (1-a) l + (1-a) b - a s_i            + a y
-    #   b' = -ab l + (b(1-a)+(1-b)) b - ab s_i    + ab y
-    #   s_i' = -g(1-a) l - g(1-a) b + (ga+1-g)s_i + g(1-a) y ; s_j'=s_j
+    # observed-update matrix rows (affine in previous state; f = phi):
+    #   l' = (1-a) l + (1-a)f b - a s_i             + a y
+    #   b' = -ab l + f(b(1-a)+(1-b)) b - ab s_i     + ab y
+    #   s_i' = -g(1-a) l - g(1-a)f b + (ga+1-g)s_i  + g(1-a) y ; s_j'=s_j
     row_l = jnp.concatenate(
-        [jnp.full((T, 1), 1 - alpha), jnp.full((T, 1), 1 - alpha), -alpha * e],
+        [jnp.full((T, 1), 1 - alpha), jnp.full((T, 1), (1 - alpha) * phi),
+         -alpha * e],
         axis=1,
     )
-    bb = beta * (1 - alpha) + (1 - beta)
+    bb = (beta * (1 - alpha) + (1 - beta)) * phi
     row_b = jnp.concatenate(
         [jnp.full((T, 1), -alpha * beta), jnp.full((T, 1), bb),
          -alpha * beta * e],
@@ -168,7 +200,7 @@ def parallel_filter(y, mask, alpha, beta, gamma, m):
     )
     s_lb = e[:, :, None] * jnp.stack(
         [jnp.full((T,), -gamma * (1 - alpha)),
-         jnp.full((T,), -gamma * (1 - alpha))], axis=-1
+         jnp.full((T,), -gamma * (1 - alpha) * phi)], axis=-1
     )[:, None, :]  # (T, m, 2) only slot row gets l/b terms
     A_obs = jnp.concatenate(
         [
@@ -187,8 +219,8 @@ def parallel_filter(y, mask, alpha, beta, gamma, m):
         axis=1,
     )  # (T, d)
 
-    A_pred = jnp.zeros((d, d)).at[0, 0].set(1.0).at[0, 1].set(1.0)
-    A_pred = A_pred.at[1, 1].set(1.0)
+    A_pred = jnp.zeros((d, d)).at[0, 0].set(1.0).at[0, 1].set(phi)
+    A_pred = A_pred.at[1, 1].set(phi)
     A_pred = A_pred.at[2:, 2:].set(eye_m)
     mt = mask[:, None, None]
     A = jnp.where(mt > 0, A_obs, A_pred[None])
@@ -199,7 +231,7 @@ def parallel_filter(y, mask, alpha, beta, gamma, m):
     states = affine_scan(A, c, x0)  # (T, d) after each step
 
     prev = jnp.concatenate([x0[None], states[:-1]], axis=0)  # state before t
-    preds = prev[:, 0] + prev[:, 1] + jnp.sum(prev[:, 2:] * e, axis=1)
+    preds = prev[:, 0] + phi * prev[:, 1] + jnp.sum(prev[:, 2:] * e, axis=1)
     err = (y - preds) * mask
     n = jnp.maximum(jnp.sum(mask), 1.0)
     mse = jnp.sum(err**2) / n
@@ -211,8 +243,10 @@ def _candidate_grid(cfg: HoltWintersConfig):
     a = jnp.linspace(0.05, 0.95, cfg.n_alpha)
     b = jnp.linspace(0.01, 0.4, cfg.n_beta)
     g = jnp.linspace(0.05, 0.6, cfg.n_gamma)
-    A, B, G = jnp.meshgrid(a, b, g, indexing="ij")
-    return A.ravel(), B.ravel(), G.ravel()  # (C,) each
+    # phi = 1 exactly when undamped (one grid value, no candidate growth)
+    p = jnp.linspace(0.80, 0.98, cfg.n_phi) if cfg.damped else jnp.ones((1,))
+    A, B, G, P = jnp.meshgrid(a, b, g, p, indexing="ij")
+    return A.ravel(), B.ravel(), G.ravel(), P.ravel()  # (C,) each
 
 
 @partial(jax.jit, static_argnames=("config",))
@@ -220,7 +254,7 @@ def fit(y, mask, day, config: HoltWintersConfig) -> HWParams:
     """Grid-search fit of all series at once.  y, mask: (S, T); day: (T,)."""
     m = config.season_length
     mode = config.seasonality_mode
-    A, B, G = _candidate_grid(config)
+    A, B, G, P = _candidate_grid(config)
 
     if config.filter == "pscan":
         if mode != "additive":
@@ -228,26 +262,26 @@ def fit(y, mask, day, config: HoltWintersConfig) -> HWParams:
                 "filter='pscan' supports additive seasonality only "
                 "(the multiplicative update is not affine in the state)"
             )
-        filt = lambda ys, ms, a, b, g: parallel_filter(ys, ms, a, b, g, m)
+        filt = lambda ys, ms, a, b, g, p: parallel_filter(ys, ms, a, b, g, m, p)
     elif config.filter == "scan":
-        filt = lambda ys, ms, a, b, g: _filter(ys, ms, a, b, g, m, mode)
+        filt = lambda ys, ms, a, b, g, p: _filter(ys, ms, a, b, g, m, mode, p)
     else:
         raise ValueError(f"unknown filter {config.filter!r}; 'scan' or 'pscan'")
 
     def per_series(ys, ms):
-        def score(a, b, g):
-            _, mse, _ = filt(ys, ms, a, b, g)
+        def score(a, b, g, p):
+            _, mse, _ = filt(ys, ms, a, b, g, p)
             return mse
 
-        msec = jax.vmap(score)(A, B, G)  # (C,)
+        msec = jax.vmap(score)(A, B, G, P)  # (C,)
         best = jnp.argmin(msec)
-        a, b, g = A[best], B[best], G[best]
-        (l, bb, s), mse, preds = filt(ys, ms, a, b, g)
-        return a, b, g, l, bb, s, jnp.sqrt(mse), preds
+        a, b, g, p = A[best], B[best], G[best], P[best]
+        (l, bb, s), mse, preds = filt(ys, ms, a, b, g, p)
+        return a, b, g, p, l, bb, s, jnp.sqrt(mse), preds
 
-    a, b, g, l, t, s, sig, fitted = jax.vmap(per_series)(y, mask)
+    a, b, g, p, l, t, s, sig, fitted = jax.vmap(per_series)(y, mask)
     return HWParams(
-        alpha=a, beta=b, gamma=g, level=l, trend=t, season=s, sigma=sig,
+        alpha=a, beta=b, gamma=g, phi=p, level=l, trend=t, season=s, sigma=sig,
         fitted=fitted,
         day0=day[0].astype(jnp.float32),
         t_fit_end=day[-1].astype(jnp.float32),
@@ -278,7 +312,12 @@ def forecast(params: HWParams, day_all, t_end, config: HoltWintersConfig, key=No
     # is (d - day0) mod m
     sidx = jnp.mod((dayf - params.day0).astype(jnp.int32), m)
     s_at = params.season[:, :][jnp.arange(S)[:, None], sidx[None, :].repeat(S, 0)]
-    base = params.level[:, None] + params.trend[:, None] * jnp.maximum(h, 0.0)[None, :]
+    # h-step trend multiplier: sum_{j=1..h} phi^j = phi(1-phi^h)/(1-phi),
+    # which is exactly h when phi = 1 (the undamped case)
+    hpos = jnp.maximum(h, 0.0)[None, :]
+    base = params.level[:, None] + params.trend[:, None] * _damp_sum(
+        params.phi[:, None], hpos
+    )
     if config.seasonality_mode == "multiplicative":
         fut = base * s_at
     else:
@@ -287,10 +326,13 @@ def forecast(params: HWParams, day_all, t_end, config: HoltWintersConfig, key=No
     # in-sample: gather fitted by day offset
     yhat = history_splice(params.fitted, fut, day_all, params.day0, h)
 
-    # class-1 variance: var(h) = sigma^2 (1 + sum_{j=1}^{h-1} c_j^2)
+    # class-1 variance: var(h) = sigma^2 (1 + sum_{j=1}^{h-1} c_j^2); the
+    # damped form replaces j*beta with beta * sum_{i<=j} phi^i (Hyndman et
+    # al., class-1 ETS(A,Ad,A)), reducing to j*beta at phi = 1
     j = jnp.arange(1, T_all + 1, dtype=jnp.float32)
     cj = (
-        params.alpha[:, None] * (1.0 + j[None, :] * params.beta[:, None])
+        params.alpha[:, None]
+        * (1.0 + params.beta[:, None] * _damp_sum(params.phi[:, None], j[None, :]))
         + params.gamma[:, None] * (jnp.mod(j[None, :], float(m)) == 0)
     )
     cum = jnp.concatenate(

@@ -60,10 +60,24 @@ def save_params_npz(path: str, params) -> str:
 
 
 def load_params_npz(path: str, params_type: str):
+    """Reconstruct a param dataclass from its npz.
+
+    Fields the class declares but the artifact lacks are back-filled from
+    the class's ``_LEGACY_DEFAULTS`` registry (name -> fn(fields)), so
+    artifacts serialized before a param class grew a field keep loading —
+    e.g. pre-damped-trend HWParams npz's have no ``phi``; phi=1 is exactly
+    the behavior they were fit with.  A missing field with no registered
+    default still raises the constructor's natural TypeError.
+    """
     module, qualname = params_type.split(":")
     cls = getattr(importlib.import_module(module), qualname)
     with np.load(path) as z:
         fields = {k: jnp.asarray(z[k]) for k in z.files}
+    declared = {f.name for f in dataclasses.fields(cls)}
+    backfill = getattr(cls, "_LEGACY_DEFAULTS", {})
+    for name in sorted(declared - fields.keys()):
+        if name in backfill:
+            fields[name] = backfill[name](fields)
     return cls(**fields)
 
 

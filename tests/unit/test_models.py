@@ -683,3 +683,127 @@ def test_ar_stale_series_decays_and_decompose_component():
     np.testing.assert_allclose(
         np.asarray(v_far)[:, -1], np.asarray(p.sigma) ** 2, rtol=1e-5
     )
+
+
+def test_hw_damped_trend_flattens_long_horizon():
+    """ETS(A,Ad,A): with a strong linear trend in history, the damped
+    forecast converges to level + phi/(1-phi)*trend while the undamped one
+    extrapolates linearly — at long horizon they must differ materially,
+    and the damped path must be monotone-flattening (increments shrink)."""
+    import pandas as pd
+
+    from distributed_forecasting_tpu.models import HoltWintersConfig
+    from distributed_forecasting_tpu.models import holt_winters as hw
+
+    T = 400
+    t = np.arange(T)
+    y = 50.0 + 0.5 * t + 4.0 * np.sin(2 * np.pi * t / 7)
+    df = pd.DataFrame(
+        {"date": pd.date_range("2020-01-01", periods=T), "store": 1,
+         "item": 1, "sales": y}
+    )
+    batch = tensorize(df)
+    H = 365
+    day_all = jnp.arange(int(batch.day[-1]) + 1, int(batch.day[-1]) + 1 + H,
+                         dtype=jnp.int32)
+    t_end = batch.day[-1].astype(jnp.float32)
+
+    cfg_u = HoltWintersConfig()
+    p_u = hw.fit(batch.y, batch.mask, batch.day, cfg_u)
+    y_u, *_ = hw.forecast(p_u, day_all, t_end, cfg_u)
+
+    cfg_d = HoltWintersConfig(damped=True, n_phi=3)
+    p_d = hw.fit(batch.y, batch.mask, batch.day, cfg_d)
+    y_d, *_ = hw.forecast(p_d, day_all, t_end, cfg_d)
+
+    assert float(p_d.phi[0]) < 1.0
+    assert float(p_u.phi[0]) == 1.0
+    # undamped keeps climbing ~0.5/day; damped saturates
+    tail_u = float(y_u[0, -1] - y_u[0, -100])
+    tail_d = float(y_d[0, -1] - y_d[0, -100])
+    assert tail_u > 30.0, tail_u
+    assert abs(tail_d) < 0.25 * tail_u, (tail_d, tail_u)
+    # closed-form ceiling: level + phi/(1-phi) * trend (+ season amplitude)
+    phi, lvl, tr = (float(p_d.phi[0]), float(p_d.level[0]),
+                    float(p_d.trend[0]))
+    ceiling = lvl + phi / (1.0 - phi) * tr + 10.0
+    assert float(np.asarray(y_d[0]).max()) < ceiling
+
+
+def test_hw_damped_filters_agree_and_undamped_grid_is_phi1():
+    """The sequential and parallel-prefix filters must agree at any phi
+    (guards the phi wiring of the affine maps), and the undamped grid must
+    fit with phi = 1 exactly for every series (guards the candidate-grid
+    ordering after it gained a 4th axis)."""
+    from distributed_forecasting_tpu.models import HoltWintersConfig
+    from distributed_forecasting_tpu.models import holt_winters as hw
+    from distributed_forecasting_tpu.models.holt_winters import (
+        _filter,
+        parallel_filter,
+    )
+
+    df = synthetic_store_item_sales(n_stores=1, n_items=4, n_days=300, seed=5)
+    batch = tensorize(df)
+    ys, ms = batch.y[0], batch.mask[0]
+    for phi in (1.0, 0.9):
+        (l1, b1, s1), mse1, pr1 = _filter(ys, ms, 0.3, 0.1, 0.2, 7,
+                                          "additive", phi)
+        (l2, b2, s2), mse2, pr2 = parallel_filter(ys, ms, 0.3, 0.1, 0.2, 7,
+                                                  phi)
+        np.testing.assert_allclose(float(l1), float(l2), rtol=2e-4)
+        np.testing.assert_allclose(float(b1), float(b2), rtol=2e-3, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(s1), np.asarray(s2), rtol=2e-3,
+                                   atol=1e-3)
+        np.testing.assert_allclose(np.asarray(pr1), np.asarray(pr2),
+                                   rtol=2e-3, atol=0.05)
+    p_u = hw.fit(batch.y, batch.mask, batch.day,
+                 HoltWintersConfig(n_alpha=3, n_beta=2, n_gamma=2))
+    np.testing.assert_array_equal(np.asarray(p_u.phi), 1.0)
+
+
+def test_hw_legacy_artifact_without_phi_loads():
+    """Artifacts serialized before HWParams grew `phi` must keep loading:
+    load_params_npz back-fills phi=1 from the class's _LEGACY_DEFAULTS."""
+    import os
+    import tempfile
+
+    from distributed_forecasting_tpu.models import HoltWintersConfig
+    from distributed_forecasting_tpu.models import holt_winters as hw
+    from distributed_forecasting_tpu.serving.predictor import (
+        load_params_npz,
+        save_params_npz,
+    )
+
+    df = synthetic_store_item_sales(n_stores=1, n_items=3, n_days=300, seed=7)
+    batch = tensorize(df)
+    cfg = HoltWintersConfig(n_alpha=2, n_beta=2, n_gamma=2)
+    params = hw.fit(batch.y, batch.mask, batch.day, cfg)
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "params.npz")
+        ptype = save_params_npz(path, params)
+        # rewrite the npz WITHOUT phi — the pre-damped on-disk format
+        with np.load(path) as z:
+            legacy = {k: z[k] for k in z.files if k != "phi"}
+        np.savez(path, **legacy)
+        loaded = load_params_npz(path, ptype)
+    np.testing.assert_array_equal(np.asarray(loaded.phi), 1.0)
+    day_all = jnp.arange(int(batch.day[-1]) + 1, int(batch.day[-1]) + 29,
+                         dtype=jnp.int32)
+    yhat, lo, hi = hw.forecast(loaded, day_all,
+                               batch.day[-1].astype(jnp.float32), cfg)
+    assert np.isfinite(np.asarray(yhat)).all()
+
+
+def test_hw_damped_through_engine():
+    from distributed_forecasting_tpu.models import HoltWintersConfig
+
+    df = synthetic_store_item_sales(n_stores=1, n_items=5, n_days=400, seed=6)
+    batch = tensorize(df)
+    params, res = fit_forecast(
+        batch, model="holt_winters",
+        config=HoltWintersConfig(damped=True, n_alpha=3, n_beta=2, n_gamma=2,
+                                 n_phi=2),
+        horizon=60,
+    )
+    assert bool(res.ok.all())
+    assert np.isfinite(np.asarray(res.yhat)).all()
