@@ -7,6 +7,10 @@ from distributed_forecasting_tpu.engine.fit import (
     seasonal_naive,
 )
 from distributed_forecasting_tpu.engine.cv import CVConfig, cross_validate, cv_forecast_frame
+from distributed_forecasting_tpu.engine.calibrate import (
+    apply_interval_scale,
+    conformal_interval_scale,
+)
 from distributed_forecasting_tpu.engine.hyper import (
     HyperSearchConfig,
     TuneResult,
@@ -34,4 +38,6 @@ __all__ = [
     "CVConfig",
     "cross_validate",
     "cv_forecast_frame",
+    "apply_interval_scale",
+    "conformal_interval_scale",
 ]

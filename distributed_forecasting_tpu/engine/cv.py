@@ -198,6 +198,7 @@ def cross_validate(
     key: Optional[jax.Array] = None,
     xreg=None,
     return_frame: bool = False,
+    calibrate: bool = False,
 ):
     """Per-series CV-mean metrics: mse, rmse, mae, mape, smape, mdape,
     coverage — each an (S,) array (the reference logs the first three per
@@ -212,13 +213,18 @@ def cross_validate(
     diagnostics frame (see :func:`cv_forecast_frame`) computed from the
     SAME forecast paths — one CV pass, not two — as ``(metrics, frame)``.
 
+    ``calibrate=True`` adds ``"_interval_scale"``: the (S,) split-conformal
+    band scale computed from the same paths (``engine/calibrate``) — the
+    factor that makes the model's interval actually cover
+    ``config.interval_width`` on the CV residuals.
+
     Returns the dict plus ``"n_cutoffs"`` (python int) under key
     ``"_n_cutoffs"`` for logging parity.
     """
     config, key, xreg = _cv_entry(batch, model, config, key, xreg,
                                   "cross_validate")
     cuts = cutoff_indices(batch.n_time, cv)
-    if return_frame:
+    if return_frame or calibrate:
         yhat, lo, hi, eval_masks = _cv_paths_impl(
             batch.y, batch.mask, batch.day, key,
             model=model, config=config, cuts=tuple(cuts), horizon=cv.horizon,
@@ -228,7 +234,39 @@ def cross_validate(
         per_cut = metrics_ops.compute_all(y_b, yhat, eval_masks, lo=lo, hi=hi)
         out = {name: jnp.mean(v, axis=0) for name, v in per_cut.items()}
         out["_n_cutoffs"] = len(cuts)
-        return out, _frame_from_paths(batch, cuts, yhat, lo, hi, eval_masks)
+        if calibrate:
+            from distributed_forecasting_tpu.engine.calibrate import (
+                apply_interval_scale,
+                conformal_scale_from_paths,
+            )
+            from distributed_forecasting_tpu.models.base import get_model as _gm
+
+            scale = conformal_scale_from_paths(
+                batch.y, yhat, hi, eval_masks,
+                interval_width=float(getattr(config, "interval_width", 0.95)),
+            )
+            out["_interval_scale"] = scale
+            # coverage of the CALIBRATED band on the same CV paths, so a
+            # run's metrics show the raw -> calibrated movement (coverage
+            # above stays the raw band's; the shipped bands are calibrated)
+            _, lo_c, hi_c = jax.vmap(
+                lambda yh, l, h: apply_interval_scale(
+                    yh, l, h, scale, floor=_gm(model).band_floor
+                )
+            )(yhat, lo, hi)
+            out["_coverage_calibrated"] = jnp.mean(
+                metrics_ops.coverage(
+                    y_b.reshape(-1, y_b.shape[-1]),
+                    lo_c.reshape(-1, lo_c.shape[-1]),
+                    hi_c.reshape(-1, hi_c.shape[-1]),
+                    eval_masks.reshape(-1, eval_masks.shape[-1]),
+                ).reshape(yhat.shape[0], yhat.shape[1]),
+                axis=0,
+            )
+        if return_frame:
+            return out, _frame_from_paths(batch, cuts, yhat, lo, hi,
+                                          eval_masks)
+        return out
     out = dict(
         _cv_impl(
             batch.y, batch.mask, batch.day, key,

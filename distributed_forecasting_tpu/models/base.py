@@ -85,14 +85,20 @@ class ModelFns(NamedTuple):
     # optional probabilistic output: (params, day_all, t_end, config,
     # quantiles, key=None[, xreg=None]) -> (S, Q, T_all) quantile paths
     forecast_quantiles: Callable = None
+    # hard floor the family enforces on its lower band/quantiles (croston
+    # clamps demand at 0); band post-processing (conformal scaling,
+    # engine/calibrate) must re-apply it after widening
+    band_floor: float = None
 
 
 def register_model(name: str, fit: Callable, forecast: Callable, config_cls: type,
-                   supports_xreg: bool = False, forecast_quantiles: Callable = None):
+                   supports_xreg: bool = False, forecast_quantiles: Callable = None,
+                   band_floor: float = None):
     MODEL_REGISTRY[name] = ModelFns(fit=fit, forecast=forecast,
                                     config_cls=config_cls,
                                     supports_xreg=supports_xreg,
-                                    forecast_quantiles=forecast_quantiles)
+                                    forecast_quantiles=forecast_quantiles,
+                                    band_floor=band_floor)
 
 
 def get_model(name: str) -> ModelFns:

@@ -154,4 +154,5 @@ def forecast(params: CrostonParams, day_all, t_end, config: CrostonConfig,
 
 
 register_model("croston", fit, forecast, CrostonConfig,
-               forecast_quantiles=gaussian_quantiles(forecast, floor=0.0))
+               forecast_quantiles=gaussian_quantiles(forecast, floor=0.0),
+               band_floor=0.0)

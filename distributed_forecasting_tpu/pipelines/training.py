@@ -163,6 +163,7 @@ class TrainingPipeline:
         bucketed: bool = False,
         regressors: Optional[Dict[str, Any]] = None,
         cv_artifact: bool = False,
+        calibrate_intervals: bool = False,
     ) -> Dict[str, Any]:
         if regressors:
             from distributed_forecasting_tpu.models.base import get_model
@@ -187,6 +188,28 @@ class TrainingPipeline:
                 "training.cv_artifact is only supported on the plain "
                 "fine-grained path (not model='auto' or tuning.enabled)"
             )
+        if calibrate_intervals:
+            # scoped to the plain path: the CV pass that calibration reuses
+            # runs there; silently ignoring the flag elsewhere would ship
+            # uncalibrated bands the operator believes are calibrated
+            if model == "auto" or (tuning and tuning.get("enabled")):
+                raise ValueError(
+                    "training.calibrate_intervals is only supported on the "
+                    "plain fine-grained path (not model='auto' or "
+                    "tuning.enabled)"
+                )
+            if bucketed:
+                raise ValueError(
+                    "training.calibrate_intervals is not supported together "
+                    "with training.bucketed — the bucketed artifact has no "
+                    "shared series axis to carry per-series scales"
+                )
+            if not run_cross_validation:
+                raise ValueError(
+                    "training.calibrate_intervals requires "
+                    "run_cross_validation: the CV residuals ARE the "
+                    "calibration set"
+                )
         if tuning and tuning.get("enabled"):
             if bucketed:
                 raise ValueError(
@@ -246,11 +269,12 @@ class TrainingPipeline:
                         cv_metrics, cv_frame = cross_validate(
                             batch, model=model, config=config, cv=cv,
                             key=key, xreg=xreg, return_frame=True,
+                            calibrate=calibrate_intervals,
                         )
                     else:
                         cv_metrics = cross_validate(
                             batch, model=model, config=config, cv=cv, key=key,
-                            xreg=xreg,
+                            xreg=xreg, calibrate=calibrate_intervals,
                         )
                     jax.block_until_ready(cv_metrics["mape"])
             with timer.phase("fit_forecast"):
@@ -273,6 +297,24 @@ class TrainingPipeline:
                         key=key, xreg=xreg,
                     )
                 jax.block_until_ready(result.yhat)
+        interval_scale = None
+        if calibrate_intervals:
+            # widen/tighten the shipped bands by the CV-conformal factor —
+            # the forecast table and the serving artifact carry calibrated
+            # bands; the logged val_coverage stays the RAW band's coverage
+            # and val_coverage_calibrated (from cv.py's calibrate branch)
+            # reports the calibrated one, so the before/after is visible
+            import dataclasses as _dc
+
+            from distributed_forecasting_tpu.engine import apply_interval_scale
+            from distributed_forecasting_tpu.models.base import get_model
+
+            interval_scale = cv_metrics["_interval_scale"]
+            _, lo_c, hi_c = apply_interval_scale(
+                result.yhat, result.lo, result.hi, interval_scale,
+                floor=get_model(model).band_floor,
+            )
+            result = _dc.replace(result, lo=lo_c, hi=hi_c)
         fit_seconds = time.time() - t_start
 
         ok = np.asarray(result.ok)
@@ -326,6 +368,14 @@ class TrainingPipeline:
                     series_table[name] = vals
                     agg[f"val_{name}"] = float(np.mean(vals[ok])) if ok.any() else float("nan")
                 agg["n_cv_cutoffs"] = cv_metrics["_n_cutoffs"]
+            if interval_scale is not None:
+                scales = np.asarray(interval_scale)
+                series_table["interval_scale"] = scales
+                agg["interval_scale_mean"] = float(np.mean(scales[ok])) if ok.any() else float("nan")
+                # raw val_coverage stays above; this is the shipped band's
+                cov_c = np.asarray(cv_metrics["_coverage_calibrated"])
+                series_table["coverage_calibrated"] = cov_c
+                agg["val_coverage_calibrated"] = float(np.mean(cov_c[ok])) if ok.any() else float("nan")
             run.log_metrics(agg)
             run.log_table("series_metrics.parquet", series_table)
             if cv_artifact and run_cross_validation:
@@ -344,7 +394,8 @@ class TrainingPipeline:
                 )
             else:
                 forecaster = BatchForecaster.from_fit(
-                    batch, params, model, config
+                    batch, params, model, config,
+                    interval_scale=interval_scale,
                 )
             forecaster.save(run.artifact_path("forecaster"))
 

@@ -36,6 +36,7 @@ from distributed_forecasting_tpu.utils.config import freeze as _freeze
 
 _PARAMS_FILE = "params.npz"
 _META_FILE = "forecaster.json"
+_SCALE_FILE = "interval_scale.npy"
 
 
 def _to_jsonable(x):
@@ -124,6 +125,7 @@ class BatchForecaster:
         key_names: tuple,
         day0: int,
         day1: int,
+        interval_scale: Optional[np.ndarray] = None,
     ):
         self.model = model
         self.config = config
@@ -132,11 +134,26 @@ class BatchForecaster:
         self.key_names = tuple(key_names)
         self.day0 = int(day0)  # first training day (absolute day number)
         self.day1 = int(day1)  # last training day
+        # (S,) per-series conformal band scale (engine/calibrate) — applied
+        # multiplicatively to both half-bands at predict time; None = the
+        # model's parametric bands ship as-is
+        self.interval_scale = (
+            None if interval_scale is None
+            else np.asarray(interval_scale, dtype=np.float32)
+        )
+        if self.interval_scale is not None and (
+            self.interval_scale.shape != (self.keys.shape[0],)
+        ):
+            raise ValueError(
+                f"interval_scale must be ({self.keys.shape[0]},) — one scale "
+                f"per trained series — got {self.interval_scale.shape}"
+            )
         self._index = {tuple(k): i for i, k in enumerate(self.keys.tolist())}
 
     # -- construction -------------------------------------------------------
     @classmethod
-    def from_fit(cls, batch, params, model: str, config) -> "BatchForecaster":
+    def from_fit(cls, batch, params, model: str, config,
+                 interval_scale=None) -> "BatchForecaster":
         # one host pull for both grid endpoints (meta needs python ints)
         day0, day1 = np.asarray(batch.day[jnp.asarray([0, -1])]).tolist()
         return cls(
@@ -147,6 +164,7 @@ class BatchForecaster:
             key_names=batch.key_names,
             day0=day0,
             day1=day1,
+            interval_scale=interval_scale,
         )
 
     # -- persistence --------------------------------------------------------
@@ -155,6 +173,10 @@ class BatchForecaster:
         params_type = save_params_npz(
             os.path.join(directory, _PARAMS_FILE), self.params
         )
+        if self.interval_scale is not None:
+            # own file, not meta JSON: (S,) floats would bloat the meta at
+            # the 50k-artifact scale
+            np.save(os.path.join(directory, _SCALE_FILE), self.interval_scale)
         meta = {
             "params_type": params_type,
             "model": self.model,
@@ -182,6 +204,8 @@ class BatchForecaster:
         config = fns.config_cls(
             **{k: _freeze(v) for k, v in meta["config"].items()}
         )
+        scale_path = os.path.join(directory, _SCALE_FILE)
+        interval_scale = np.load(scale_path) if os.path.exists(scale_path) else None
         return cls(
             model=meta["model"],
             config=config,
@@ -190,6 +214,7 @@ class BatchForecaster:
             key_names=tuple(meta["key_names"]),
             day0=meta["day0"],
             day1=meta["day1"],
+            interval_scale=interval_scale,
         )
 
     # -- inference ----------------------------------------------------------
@@ -263,7 +288,7 @@ class BatchForecaster:
         """
         sidx = self.series_indices(request, on_missing=on_missing)
         if sidx.size == 0:
-            return sidx, None, None, None
+            return sidx, None, None, None, None
         day_all = jnp.arange(
             self.day0, self.day1 + horizon + 1, dtype=jnp.int32
         )
@@ -271,6 +296,10 @@ class BatchForecaster:
         bucket = self._bucket(k)
         padded = np.concatenate([sidx, np.full(bucket - k, sidx[0], sidx.dtype)])
         params = self.gather_params(padded)
+        scale = (
+            None if self.interval_scale is None
+            else jnp.asarray(self.interval_scale[padded])
+        )
         fc_kwargs = {}
         if xreg is not None:
             fns = get_model(self.model)
@@ -303,7 +332,7 @@ class BatchForecaster:
                     )
                 xreg = xreg[jnp.asarray(padded)]
             fc_kwargs["xreg"] = xreg
-        return sidx, params, day_all, fc_kwargs
+        return sidx, params, day_all, fc_kwargs, scale
 
     def _frame_skeleton(self, sidx, day_all):
         """ds + key columns for a long result frame over ``day_all`` —
@@ -385,7 +414,7 @@ class BatchForecaster:
         was fit with ``n_regressors > 0`` — (T_all, R) shared or
         (S_trained, T_all, R) per-series over the FULL day0..day1+horizon
         grid (per-series rows are gathered down to the request)."""
-        sidx, params, day_all, fc_kwargs = self._prepare_request(
+        sidx, params, day_all, fc_kwargs, scale = self._prepare_request(
             request, horizon, on_missing, xreg
         )
         if sidx.size == 0:
@@ -398,6 +427,13 @@ class BatchForecaster:
             params, day_all, jnp.float32(self.day1), self.config, key,
             **fc_kwargs,
         )
+        if scale is not None:
+            from distributed_forecasting_tpu.engine.calibrate import (
+                apply_interval_scale,
+            )
+
+            yhat, lo, hi = apply_interval_scale(yhat, lo, hi, scale,
+                                                floor=fns.band_floor)
         if not include_history:
             day_all = day_all[-horizon:]
             yhat, lo, hi = yhat[:, -horizon:], lo[:, -horizon:], hi[:, -horizon:]
@@ -431,17 +467,33 @@ class BatchForecaster:
                 f"implementation"
             )
         quantiles = tuple(float(q) for q in quantiles)
-        sidx, params, day_all, fc_kwargs = self._prepare_request(
+        sidx, params, day_all, fc_kwargs, scale = self._prepare_request(
             request, horizon, on_missing, xreg
         )
         qcols = quantile_columns(quantiles)
         if sidx.size == 0:
             return pd.DataFrame(columns=["ds", *self.key_names, *qcols])
         k = int(sidx.size)
+        # conformal scaling spreads every level around the median, so the
+        # median is priced alongside when calibration is on (one extra
+        # column in the same compiled program) and dropped if not requested
+        priced = quantiles
+        if scale is not None and 0.5 not in priced:
+            priced = tuple(sorted((*priced, 0.5)))
         yq = fns.forecast_quantiles(
             params, day_all, jnp.float32(self.day1), self.config,
-            quantiles, key, **fc_kwargs,
+            priced, key, **fc_kwargs,
         )  # (bucket, Q, T_all)
+        if scale is not None:
+            med = yq[:, priced.index(0.5), :][:, None, :]
+            yq = med + scale[:, None, None] * (yq - med)
+            if fns.band_floor is not None:
+                # re-apply the family's hard clamp (gaussian_quantiles
+                # floors the raw levels; widening must not undo it)
+                yq = jnp.maximum(yq, fns.band_floor)
+        if priced != quantiles:
+            keep = jnp.asarray([priced.index(q) for q in quantiles])
+            yq = yq[:, keep, :]
         if not include_history:
             day_all = day_all[-horizon:]
             yq = yq[:, :, -horizon:]

@@ -25,6 +25,10 @@ Librarized equivalent of the reference's training notebook entry point
       per_series_runs: false
       cv_artifact: false            # also log the raw per-cutoff CV
                                     # forecasts (diagnostics-scale parquet)
+      calibrate_intervals: false    # split-conformal band calibration from
+                                    # the CV residuals (engine/calibrate):
+                                    # table + artifact ship bands scaled to
+                                    # actually cover interval_width
       bucketed: false               # span-bucketed fit for ragged batches
       path: fine_grained            # or 'allocated'
       regressors:                   # optional exogenous covariates (curve
@@ -77,6 +81,7 @@ class TrainTask(Task):
             bucketed=bool(tr.get("bucketed", False)),
             regressors=tr.get("regressors"),
             cv_artifact=bool(tr.get("cv_artifact", False)),
+            calibrate_intervals=bool(tr.get("calibrate_intervals", False)),
         )
 
 
