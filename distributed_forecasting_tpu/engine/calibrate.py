@@ -60,9 +60,19 @@ def _conformal_scale_impl(y, yhat, hi, eval_masks, interval_width: float,
     the ceil((n+1) * width)-th order statistic — the finite-sample-valid
     rank, giving >= width coverage on exchangeable data.
     """
-    half = jnp.maximum(hi - yhat, _EPS)
-    r = jnp.abs(y[None] - yhat) / half                       # (C, S, T)
-    obs = eval_masks > 0
+    half = hi - yhat
+    # validity: observed AND a non-degenerate band.  A cutoff that predates
+    # a late-starting series' history produces a degenerate fit there
+    # (hi == yhat) while the eval window IS observed — dividing by the eps
+    # floor would inject ~1e9 scores that the rank quantile then lands on,
+    # widening the shipped band astronomically (and polluting the pooled
+    # fallback).  Such points carry no band information; exclude them from
+    # the calibration set (a fully-degenerate series has n = 0 and takes
+    # the pooled scale).  The threshold is RELATIVE to the point path so a
+    # legitimately tiny-magnitude series (rates ~1e-7) keeps its genuine
+    # small bands in the set; only true hi == yhat collapse is excluded.
+    obs = (eval_masks > 0) & (half > 1e-6 * (jnp.abs(yhat) + _EPS))
+    r = jnp.abs(y[None] - yhat) / jnp.maximum(half, _EPS)    # (C, S, T)
     r = jnp.where(obs, r, jnp.inf)
     S = r.shape[1]
     r_s = jnp.sort(jnp.swapaxes(r, 0, 1).reshape(S, -1), axis=1)  # (S, C*T)
@@ -82,6 +92,12 @@ def _conformal_scale_impl(y, yhat, hi, eval_masks, interval_width: float,
     # no calibration data at all (or degenerate inf quantile): identity
     q = jnp.where(jnp.isfinite(q) & (n_tot > 0), q, 1.0)
     return q
+
+
+def config_interval_width(config) -> float:
+    """The width a config's bands target — single source for every
+    calibration route (standalone, cross_validate, fused CV impl)."""
+    return float(getattr(config, "interval_width", 0.95))
 
 
 def conformal_scale_from_paths(y, yhat, hi, eval_masks,
@@ -115,9 +131,8 @@ def conformal_interval_scale(
         model=model, config=config, cuts=tuple(cuts), horizon=cv.horizon,
         xreg=xreg,
     )
-    width = float(getattr(config, "interval_width", 0.95))
     return conformal_scale_from_paths(batch.y, yhat, hi, eval_masks,
-                                      interval_width=width,
+                                      interval_width=config_interval_width(config),
                                       min_points=min_points)
 
 

@@ -139,6 +139,54 @@ def _cv_paths_impl(y, mask, day, key, model, config, cuts, horizon, xreg=None):
     return _cv_paths(y, mask, day, key, model, config, cuts, horizon, xreg)
 
 
+def _calibration_outputs(y, y_b, yhat, lo, hi, eval_masks, model, config):
+    """Conformal scale + calibrated-band CV coverage from the paths.
+    Traced inside ``_cv_calibrate_impl`` (fused route) and reused by the
+    materializing ``return_frame`` route, so the two cannot drift."""
+    from distributed_forecasting_tpu.engine.calibrate import (
+        apply_interval_scale,
+        config_interval_width,
+        conformal_scale_from_paths,
+    )
+
+    scale = conformal_scale_from_paths(
+        y, yhat, hi, eval_masks,
+        interval_width=config_interval_width(config),
+    )
+    # (S, 1) scale broadcasts against the (C, S, T) paths directly
+    _, lo_c, hi_c = apply_interval_scale(
+        yhat, lo, hi, scale, floor=get_model(model).band_floor
+    )
+    cov_c = jnp.mean(
+        metrics_ops.coverage(y_b, lo_c, hi_c, eval_masks), axis=0
+    )
+    return scale, cov_c
+
+
+@partial(jax.jit, static_argnames=("model", "config", "cuts", "horizon"))
+def _cv_calibrate_impl(y, mask, day, key, model, config, cuts, horizon,
+                       xreg=None):
+    """CV metrics + conformal calibration as ONE compiled program.
+
+    The calibrate-without-frame route must not fall back to materializing
+    the four (C, S, T) path arrays as jit outputs: at the 50k-series
+    regime that is gigabytes of HBM held across eager metric ops.  Here
+    the paths stay internal to XLA and only (S,) reductions come out —
+    same design as ``_cv_impl``."""
+    yhat, lo, hi, eval_masks = _cv_paths(
+        y, mask, day, key, model, config, cuts, horizon, xreg
+    )
+    y_b = jnp.broadcast_to(y[None], yhat.shape)
+    per_cut = metrics_ops.compute_all(y_b, yhat, eval_masks, lo=lo, hi=hi)
+    out = {name: jnp.mean(v, axis=0) for name, v in per_cut.items()}
+    scale, cov_c = _calibration_outputs(
+        y, y_b, yhat, lo, hi, eval_masks, model, config
+    )
+    out["_interval_scale"] = scale
+    out["_coverage_calibrated"] = cov_c
+    return out
+
+
 def _frame_from_paths(batch: SeriesBatch, cuts, yhat, lo, hi, eval_masks):
     """Host-side assembly of the diagnostics frame from (C, S, T) paths."""
     import numpy as np
@@ -224,7 +272,9 @@ def cross_validate(
     config, key, xreg = _cv_entry(batch, model, config, key, xreg,
                                   "cross_validate")
     cuts = cutoff_indices(batch.n_time, cv)
-    if return_frame or calibrate:
+    if return_frame:
+        # diagnostics-scale route: paths materialize on host for the frame
+        # anyway, so metrics/calibration compute from the same arrays
         yhat, lo, hi, eval_masks = _cv_paths_impl(
             batch.y, batch.mask, batch.day, key,
             model=model, config=config, cuts=tuple(cuts), horizon=cv.horizon,
@@ -235,40 +285,15 @@ def cross_validate(
         out = {name: jnp.mean(v, axis=0) for name, v in per_cut.items()}
         out["_n_cutoffs"] = len(cuts)
         if calibrate:
-            from distributed_forecasting_tpu.engine.calibrate import (
-                apply_interval_scale,
-                conformal_scale_from_paths,
-            )
-            from distributed_forecasting_tpu.models.base import get_model as _gm
-
-            scale = conformal_scale_from_paths(
-                batch.y, yhat, hi, eval_masks,
-                interval_width=float(getattr(config, "interval_width", 0.95)),
+            scale, cov_c = _calibration_outputs(
+                batch.y, y_b, yhat, lo, hi, eval_masks, model, config
             )
             out["_interval_scale"] = scale
-            # coverage of the CALIBRATED band on the same CV paths, so a
-            # run's metrics show the raw -> calibrated movement (coverage
-            # above stays the raw band's; the shipped bands are calibrated)
-            _, lo_c, hi_c = jax.vmap(
-                lambda yh, l, h: apply_interval_scale(
-                    yh, l, h, scale, floor=_gm(model).band_floor
-                )
-            )(yhat, lo, hi)
-            out["_coverage_calibrated"] = jnp.mean(
-                metrics_ops.coverage(
-                    y_b.reshape(-1, y_b.shape[-1]),
-                    lo_c.reshape(-1, lo_c.shape[-1]),
-                    hi_c.reshape(-1, hi_c.shape[-1]),
-                    eval_masks.reshape(-1, eval_masks.shape[-1]),
-                ).reshape(yhat.shape[0], yhat.shape[1]),
-                axis=0,
-            )
-        if return_frame:
-            return out, _frame_from_paths(batch, cuts, yhat, lo, hi,
-                                          eval_masks)
-        return out
+            out["_coverage_calibrated"] = cov_c
+        return out, _frame_from_paths(batch, cuts, yhat, lo, hi, eval_masks)
+    impl = _cv_calibrate_impl if calibrate else _cv_impl
     out = dict(
-        _cv_impl(
+        impl(
             batch.y, batch.mask, batch.day, key,
             model=model, config=config, cuts=tuple(cuts), horizon=cv.horizon,
             xreg=xreg,

@@ -173,10 +173,16 @@ class BatchForecaster:
         params_type = save_params_npz(
             os.path.join(directory, _PARAMS_FILE), self.params
         )
+        scale_path = os.path.join(directory, _SCALE_FILE)
         if self.interval_scale is not None:
             # own file, not meta JSON: (S,) floats would bloat the meta at
             # the 50k-artifact scale
-            np.save(os.path.join(directory, _SCALE_FILE), self.interval_scale)
+            np.save(scale_path, self.interval_scale)
+        elif os.path.exists(scale_path):
+            # re-saving an uncalibrated forecaster into a reused directory
+            # must not resurrect a previous run's scales (load() keys on
+            # the file's existence)
+            os.remove(scale_path)
         meta = {
             "params_type": params_type,
             "model": self.model,

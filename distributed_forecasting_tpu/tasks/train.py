@@ -59,6 +59,15 @@ class TrainTask(Task):
                     "path — covariates would be fit at item level and then "
                     "ratio-scaled; use path: fine_grained"
                 )
+            if tr.get("calibrate_intervals"):
+                # silently shipping raw bands the conf says are calibrated
+                # is the one failure mode this flag must never have
+                raise ValueError(
+                    "training.calibrate_intervals is not supported on the "
+                    "allocated path (item-level bands are ratio-scaled to "
+                    "stores, so per-series CV calibration does not apply); "
+                    "use path: fine_grained"
+                )
             return pipeline.allocated(
                 source_table=inp.get("table", "hackathon.sales.raw"),
                 output_table=out.get("table", "hackathon.sales.allocated_forecasts"),

@@ -303,3 +303,55 @@ def test_calibrated_coverage_metric_reported():
     # the raw band's and near the 0.95 target (rank-quantile guarantee)
     assert cal >= raw - 1e-6, (raw, cal)
     assert cal >= 0.93, cal
+
+
+def test_degenerate_cutoff_points_excluded_from_calibration():
+    """A late-starting series whose history begins after early CV cutoffs
+    gets degenerate fits there (hi == yhat); those eval points must be
+    excluded, not scored as |resid|/eps ~ 1e9 (which would widen the
+    shipped band astronomically)."""
+    df = _level_shift_frame(n_series=6, seed=8)
+    # series (1, 6): drop the first 500 days -> no history before the
+    # first two cutoffs (initial=360, period=90)
+    dates = pd.to_datetime(df["date"])
+    late = df["item"] == 6
+    df = df[~late | (dates >= dates.min() + pd.Timedelta(days=500))]
+    batch = tensorize(df)
+    scale = np.asarray(conformal_interval_scale(
+        batch, model="holt_winters", config=HW_CFG, cv=CV
+    ))
+    assert np.isfinite(scale).all(), scale
+    # sane magnitudes for every series, including the late starter
+    assert (scale < 10.0).all(), scale
+    assert (scale > 0.05).all(), scale
+
+
+def test_resave_without_scale_removes_stale_file(tmp_path):
+    """Re-saving an uncalibrated forecaster into a reused artifact dir
+    must not resurrect the previous run's interval_scale.npy."""
+    from distributed_forecasting_tpu.serving import BatchForecaster
+
+    batch = _heavy_tailed_batch(n_series=2, seed=9)
+    params, _ = fit_forecast(batch, model="holt_winters", config=HW_CFG,
+                             horizon=14)
+    art = str(tmp_path / "fc")
+    fc_cal = BatchForecaster.from_fit(
+        batch, params, "holt_winters", HW_CFG,
+        interval_scale=np.asarray([2.0, 2.0], dtype=np.float32),
+    )
+    fc_cal.save(art)
+    assert BatchForecaster.load(art).interval_scale is not None
+    fc_plain = BatchForecaster.from_fit(batch, params, "holt_winters", HW_CFG)
+    fc_plain.save(art)
+    assert BatchForecaster.load(art).interval_scale is None
+
+
+def test_allocated_path_rejects_calibrate_flag(tmp_path, monkeypatch):
+    from distributed_forecasting_tpu.tasks.train import TrainTask
+
+    conf = {
+        "env": {"root": str(tmp_path)},
+        "training": {"path": "allocated", "calibrate_intervals": True},
+    }
+    with pytest.raises(ValueError, match="allocated"):
+        TrainTask(init_conf=conf).launch()
