@@ -45,6 +45,7 @@ from distributed_forecasting_tpu.ops.features import (
 )
 from distributed_forecasting_tpu.ops.solve import (
     fitted_values,
+    huber_irls_solve,
     ridge_solve_batch,
     weighted_residual_scale,
     yule_walker_masked,
@@ -117,6 +118,16 @@ class CurveModelConfig:
     # conditioning; binary 0/1 columns pass through untouched
     regressor_standardize: bool = True
     regressor_names: tuple = ()  # optional, for logging/plots
+    # Outlier-robust fitting: 'huber' replaces the L2 MAP solve with IRLS
+    # (ops/solve.huber_irls_solve) — promo spikes / stockouts / glitches
+    # stop dragging the trend and inflating sigma; each IRLS round is one
+    # more batched weighted-Gram solve.  The residual scale then comes
+    # from the robust weights (inlier spread), so bands track typical
+    # days, not the spikes.  'l2' is the Prophet-parity default (Stan's
+    # MAP is Gaussian-likelihood).
+    loss: str = "l2"  # 'l2' | 'huber'
+    huber_delta: float = 1.345
+    robust_iters: int = 3
 
 
 @jax.tree_util.register_dataclass
@@ -489,11 +500,39 @@ def fit(y, mask, day, config: CurveModelConfig, prior_scales=None,
     else:
         cp_s, seas_s, hol_s = prior_scales
     lam = _prior_precision(layout, config, cp_s, seas_s, hol_s)
-    beta = ridge_solve_batch(X, zn, mask, lam)
-    sigma = weighted_residual_scale(X, zn, mask, beta)
+    resid_clip = None
+    if config.loss == "huber":
+        from distributed_forecasting_tpu.ops.solve import masked_mad_scale
+
+        beta, w_rob = huber_irls_solve(
+            X, zn, mask, lam, delta=config.huber_delta,
+            iters=config.robust_iters,
+        )
+        # sigma = MAD scale of the final residuals: fully bounded in
+        # outlier magnitude (Huber-WEIGHTED squares still grow as
+        # delta*s*|r|, so one extreme glitch would widen every band) and
+        # Gaussian-consistent on clean data — the inlier spread, which is
+        # exactly what the bands should price
+        r_fin = (zn - fitted_values(X, beta)) * mask
+        sigma = masked_mad_scale(r_fin, mask)
+        # downstream consumers of the residuals (the AR stage) must see
+        # the same robustness: winsorize at delta * sigma so a spike on
+        # the last observed days cannot seed the AR tail
+        cl = (config.huber_delta * sigma)[:, None]
+        resid_clip = jnp.clip(r_fin, -cl, cl)
+    elif config.loss == "l2":
+        beta = ridge_solve_batch(X, zn, mask, lam)
+        sigma = weighted_residual_scale(X, zn, mask, beta)
+    else:
+        raise ValueError(
+            f"unknown CurveModelConfig.loss {config.loss!r}; 'l2' or 'huber'"
+        )
     ar_kwargs = {}
     if config.ar_order > 0:
-        resid = (zn - fitted_values(X, beta)) * mask
+        if resid_clip is not None:
+            resid = resid_clip
+        else:
+            resid = (zn - fitted_values(X, beta)) * mask
         phi, tail, s_inn, last = _fit_ar_residuals(
             resid, mask, config.ar_order
         )

@@ -47,24 +47,32 @@ def smape(y, yhat, mask):
     return _mean(jnp.abs(y - yhat) / jnp.maximum(denom, _EPS), ok)
 
 
-def mdape(y, yhat, mask):
-    """Median absolute percentage error under the mask.
+def masked_median(x, valid):
+    """Median over the last axis of the entries where ``valid`` > 0; 0.0
+    for an all-invalid row.
 
-    Median-under-mask via sorting with +inf sentinels on masked slots, then
-    indexing the middle of the valid prefix (static shapes; vmap-safe).
+    Median-under-mask via sorting with +inf sentinels on invalid slots,
+    then indexing the middle of the valid prefix (static shapes;
+    vmap-safe).  Shared by :func:`mdape` and the robust residual scale
+    (``ops/solve.masked_mad_scale``).
     """
-    ok = mask * (jnp.abs(y) > _EPS)
-    ape = jnp.abs((y - yhat) / jnp.where(jnp.abs(y) > _EPS, y, 1.0))
-    ape = jnp.where(ok > 0, ape, jnp.inf)
-    s = jnp.sort(ape, axis=-1)
-    n = jnp.sum(ok > 0, axis=-1).astype(jnp.int32)
-    hi = jnp.clip((n - 1) // 2 + (n - 1) % 2, 0, ape.shape[-1] - 1)
-    lo = jnp.clip((n - 1) // 2, 0, ape.shape[-1] - 1)
+    xv = jnp.where(valid > 0, x, jnp.inf)
+    s = jnp.sort(xv, axis=-1)
+    n = jnp.sum(valid > 0, axis=-1).astype(jnp.int32)
+    hi = jnp.clip((n - 1) // 2 + (n - 1) % 2, 0, x.shape[-1] - 1)
+    lo = jnp.clip((n - 1) // 2, 0, x.shape[-1] - 1)
     med = (
         jnp.take_along_axis(s, lo[..., None], axis=-1)
         + jnp.take_along_axis(s, hi[..., None], axis=-1)
     )[..., 0] / 2.0
     return jnp.where(n > 0, med, 0.0)
+
+
+def mdape(y, yhat, mask):
+    """Median absolute percentage error under the mask."""
+    ok = mask * (jnp.abs(y) > _EPS)
+    ape = jnp.abs((y - yhat) / jnp.where(jnp.abs(y) > _EPS, y, 1.0))
+    return masked_median(ape, ok)
 
 
 def coverage(y, lo, hi, mask):

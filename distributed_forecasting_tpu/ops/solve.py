@@ -192,3 +192,51 @@ def weighted_residual_scale(
     r2 = w * (y - yhat) ** 2
     n = jnp.maximum(jnp.sum(w, axis=1), 1.0)
     return jnp.sqrt(jnp.sum(r2, axis=1) / n)
+
+
+def masked_mad_scale(r: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """Robust per-series residual scale: 1.4826 * median(|r|) under the
+    mask (consistent for the Gaussian sigma).  (S, T) -> (S,).
+
+    One inf-padded sort per series (``ops/metrics.masked_median``) —
+    static shapes, no host round trips.
+    """
+    from distributed_forecasting_tpu.ops.metrics import masked_median
+
+    return 1.4826 * masked_median(jnp.abs(r), mask)
+
+
+def huber_irls_solve(
+    X: jnp.ndarray,
+    y: jnp.ndarray,
+    mask: jnp.ndarray,
+    lam: jnp.ndarray,
+    delta: float = 1.345,
+    iters: int = 3,
+):
+    """Huber-robust penalized regression by IRLS — the outlier-resistant
+    variant of ``ridge_solve_batch``.
+
+    Retail demand carries spikes (promos, stockouts, data glitches) that an
+    L2 fit chases: one 8x day drags the trend/seasonal coefficients and
+    inflates sigma, so both the point path and the bands degrade.  IRLS
+    downweights points beyond ``delta`` robust-sigmas (w = delta*s/|r|,
+    Huber's psi over r) and re-solves; each iteration is ONE more batched
+    weighted-Gram + Cholesky — the exact MXU kernel the plain fit uses, so
+    robustness costs iters extra solves, not a different algorithm.  The
+    iteration count is static (no data-dependent convergence loop under
+    jit); 2-3 iterations are standard for IRLS at this delta.
+
+    Returns (beta, w_robust) with w_robust the final (S, T) weights inside
+    the mask — callers use them for an honest inlier residual scale.
+    """
+    beta = ridge_solve_batch(X, y, mask, lam)
+    w_rob = mask
+    for _ in range(int(iters)):
+        r = y - fitted_values(X, beta)
+        s = jnp.maximum(masked_mad_scale(r, mask), 1e-9)[:, None]
+        a = jnp.abs(r) / s
+        w_h = jnp.where(a <= delta, 1.0, delta / jnp.maximum(a, 1e-9))
+        w_rob = mask * w_h
+        beta = ridge_solve_batch(X, y, w_rob, lam)
+    return beta, w_rob
