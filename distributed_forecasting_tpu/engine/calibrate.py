@@ -126,7 +126,7 @@ def conformal_interval_scale(
     config, key, xreg = _cv_entry(batch, model, config, key, xreg,
                                   "conformal_interval_scale")
     cuts = cutoff_indices(batch.n_time, cv)
-    yhat, lo, hi, eval_masks = _cv_paths_impl(
+    yhat, lo, hi, eval_masks, _ = _cv_paths_impl(
         batch.y, batch.mask, batch.day, key,
         model=model, config=config, cuts=tuple(cuts), horizon=cv.horizon,
         xreg=xreg,

@@ -92,7 +92,7 @@ def _cv_entry(batch, model, config, key, xreg, what):
 
 def _cv_paths(y, mask, day, key, model, config, cuts, horizon, xreg):
     """Shared trace body: every cutoff's fit+forecast (cutoffs vmapped).
-    Returns (yhat, lo, hi, eval_masks) each (C, S, T).
+    Returns (yhat, lo, hi, eval_masks, train_masks) each (C, S, T).
 
     ``xreg``: regressor values over the HISTORY grid — (T, R) or (S, T, R);
     CV never forecasts past the history end, so no future values needed.
@@ -115,7 +115,18 @@ def _cv_paths(y, mask, day, key, model, config, cuts, horizon, xreg):
         return fns.forecast(params, day, t_end, config, k)
 
     yhat, lo, hi = jax.vmap(one_cutoff)(train_masks, t_ends, keys)  # (C, S, T)
-    return yhat, lo, hi, eval_masks
+    return yhat, lo, hi, eval_masks, train_masks
+
+
+def _cv_metric_means(y, yhat, lo, hi, eval_masks, train_masks):
+    """Per-series CV-mean metric dict from the (C, S, T) paths — the ONE
+    metric assembly for all three cross_validate routes (fused, fused+
+    calibrate, materializing), including MASE against each cutoff's own
+    training window."""
+    y_b = jnp.broadcast_to(y[None], yhat.shape)
+    per_cut = metrics_ops.compute_all(y_b, yhat, eval_masks, lo=lo, hi=hi)
+    per_cut["mase"] = metrics_ops.mase(y_b, yhat, eval_masks, train_masks)
+    return {name: jnp.mean(v, axis=0) for name, v in per_cut.items()}  # (S,)
 
 
 @partial(jax.jit, static_argnames=("model", "config", "cuts", "horizon"))
@@ -124,12 +135,10 @@ def _cv_impl(y, mask, day, key, model, config, cuts, horizon, xreg=None):
     cutoff's fit+forecast, metric reductions.  No host round trips inside
     — device scalar pulls cost tens of ms on remote-attached TPUs (see
     engine/fit._fit_forecast_impl)."""
-    yhat, lo, hi, eval_masks = _cv_paths(
+    yhat, lo, hi, eval_masks, train_masks = _cv_paths(
         y, mask, day, key, model, config, cuts, horizon, xreg
     )
-    y_b = jnp.broadcast_to(y[None], yhat.shape)
-    per_cut = metrics_ops.compute_all(y_b, yhat, eval_masks, lo=lo, hi=hi)
-    return {name: jnp.mean(v, axis=0) for name, v in per_cut.items()}  # (S,)
+    return _cv_metric_means(y, yhat, lo, hi, eval_masks, train_masks)
 
 
 @partial(jax.jit, static_argnames=("model", "config", "cuts", "horizon"))
@@ -173,12 +182,11 @@ def _cv_calibrate_impl(y, mask, day, key, model, config, cuts, horizon,
     regime that is gigabytes of HBM held across eager metric ops.  Here
     the paths stay internal to XLA and only (S,) reductions come out —
     same design as ``_cv_impl``."""
-    yhat, lo, hi, eval_masks = _cv_paths(
+    yhat, lo, hi, eval_masks, train_masks = _cv_paths(
         y, mask, day, key, model, config, cuts, horizon, xreg
     )
+    out = _cv_metric_means(y, yhat, lo, hi, eval_masks, train_masks)
     y_b = jnp.broadcast_to(y[None], yhat.shape)
-    per_cut = metrics_ops.compute_all(y_b, yhat, eval_masks, lo=lo, hi=hi)
-    out = {name: jnp.mean(v, axis=0) for name, v in per_cut.items()}
     scale, cov_c = _calibration_outputs(
         y, y_b, yhat, lo, hi, eval_masks, model, config
     )
@@ -230,7 +238,7 @@ def cv_forecast_frame(
     config, key, xreg = _cv_entry(batch, model, config, key, xreg,
                                   "cv_forecast_frame")
     cuts = cutoff_indices(batch.n_time, cv)
-    yhat, lo, hi, eval_masks = _cv_paths_impl(
+    yhat, lo, hi, eval_masks, _ = _cv_paths_impl(
         batch.y, batch.mask, batch.day, key,
         model=model, config=config, cuts=tuple(cuts), horizon=cv.horizon,
         xreg=xreg,
@@ -275,16 +283,15 @@ def cross_validate(
     if return_frame:
         # diagnostics-scale route: paths materialize on host for the frame
         # anyway, so metrics/calibration compute from the same arrays
-        yhat, lo, hi, eval_masks = _cv_paths_impl(
+        yhat, lo, hi, eval_masks, train_masks = _cv_paths_impl(
             batch.y, batch.mask, batch.day, key,
             model=model, config=config, cuts=tuple(cuts), horizon=cv.horizon,
             xreg=xreg,
         )
-        y_b = jnp.broadcast_to(batch.y[None], yhat.shape)
-        per_cut = metrics_ops.compute_all(y_b, yhat, eval_masks, lo=lo, hi=hi)
-        out = {name: jnp.mean(v, axis=0) for name, v in per_cut.items()}
+        out = _cv_metric_means(batch.y, yhat, lo, hi, eval_masks, train_masks)
         out["_n_cutoffs"] = len(cuts)
         if calibrate:
+            y_b = jnp.broadcast_to(batch.y[None], yhat.shape)
             scale, cov_c = _calibration_outputs(
                 batch.y, y_b, yhat, lo, hi, eval_masks, model, config
             )

@@ -75,6 +75,32 @@ def mdape(y, yhat, mask):
     return masked_median(ape, ok)
 
 
+def mase(y, yhat, eval_mask, train_mask, m: int = 7):
+    """Mean absolute SCALED error (Hyndman-Koehler; the M-competition
+    standard the reference's metric set lacks): eval-window MAE divided by
+    the seasonal-naive MAE on the TRAINING window.  Scale-free — unlike
+    MAPE it neither explodes on near-zero actuals nor degenerates on
+    intermittent series — and anchored to the no-model baseline: MASE < 1
+    means beating seasonal-naive out of sample.
+
+    ``train_mask``/``eval_mask``: the rolling-origin window masks
+    (``engine.cv.cv_windows``); ``m``: the naive season (7 = weekly, the
+    domain default).  Leading batch axes broadcast like every metric here.
+    """
+    dy = jnp.abs(y[..., m:] - y[..., : -m])
+    both = train_mask[..., m:] * train_mask[..., : -m]
+    scale = jnp.sum(dy * both, axis=-1) / jnp.maximum(
+        jnp.sum(both, axis=-1), 1.0
+    )
+    mae_eval = _mean(jnp.abs(y - yhat), eval_mask)
+    # a zero naive scale (constant/all-zero training window) makes the
+    # ratio meaningless — NaN, not mae/eps ~ 1e9: selection's isfinite
+    # guard then excludes it and aggregates use nanmean, instead of one
+    # flat series swamping every mean
+    return jnp.where(scale > _EPS, mae_eval / jnp.maximum(scale, _EPS),
+                     jnp.nan)
+
+
 def coverage(y, lo, hi, mask):
     """Fraction of actuals inside [lo, hi] — interval calibration
     (AutoML 'coverage', should approach interval_width=0.95)."""

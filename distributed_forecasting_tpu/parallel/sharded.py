@@ -179,7 +179,9 @@ def sharded_cv_metrics(
     idx = jnp.arange(T)
     cut_steps = jnp.asarray(cuts, dtype=jnp.int32)
     t_ends = batch.day[cut_steps].astype(jnp.float32)
-    metric_names = sorted(list(metrics_ops.METRIC_FNS) + ["coverage"])
+    # same metric set as engine.cv.cross_validate (incl. mase) — consumers
+    # treat the sharded and single-chip CV routes as interchangeable
+    metric_names = sorted(list(metrics_ops.METRIC_FNS) + ["coverage", "mase"])
 
     def local_cv(y, mask, day, cut_steps, t_ends, key, *xr):
         k0 = jax.random.fold_in(key, jax.lax.axis_index(SERIES_AXIS))
@@ -196,6 +198,7 @@ def sharded_cv_metrics(
                 params = fns.fit(y, train_mask, day, config)
                 yhat, lo, hi = fns.forecast(params, day, t_end, config, k)
             m = metrics_ops.compute_all(y, yhat, eval_mask, lo=lo, hi=hi)
+            m["mase"] = metrics_ops.mase(y, yhat, eval_mask, train_mask)
             return jnp.stack([m[n] for n in metric_names])
 
         keys = jax.random.split(k0, len(cuts))

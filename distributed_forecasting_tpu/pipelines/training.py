@@ -44,7 +44,8 @@ from distributed_forecasting_tpu.tracking import FileTracker
 from distributed_forecasting_tpu.utils import get_logger
 from distributed_forecasting_tpu.utils.config import freeze
 
-_METRICS = ("mse", "rmse", "mae", "mape", "smape", "mdape", "coverage")
+_METRICS = ("mse", "rmse", "mae", "mape", "smape", "mdape", "coverage",
+            "mase")
 
 # per-series drill-down runs: warn above this count (O(S) host loop)
 _PER_SERIES_RUNS_WARN = 2000
@@ -366,7 +367,9 @@ class TrainingPipeline:
                 for name in _METRICS:
                     vals = np.asarray(cv_metrics[name])
                     series_table[name] = vals
-                    agg[f"val_{name}"] = float(np.mean(vals[ok])) if ok.any() else float("nan")
+                    # nanmean: a per-series NaN (e.g. mase on a constant
+                    # training window) must not poison the aggregate
+                    agg[f"val_{name}"] = float(np.nanmean(vals[ok])) if ok.any() else float("nan")
                 agg["n_cv_cutoffs"] = cv_metrics["_n_cutoffs"]
             if interval_scale is not None:
                 scales = np.asarray(interval_scale)
