@@ -11,6 +11,7 @@ from distributed_forecasting_tpu.engine.calibrate import (
     apply_interval_scale,
     conformal_interval_scale,
 )
+from distributed_forecasting_tpu.engine.season import detect_season_length
 from distributed_forecasting_tpu.engine.hyper import (
     HyperSearchConfig,
     TuneResult,
@@ -40,4 +41,5 @@ __all__ = [
     "cv_forecast_frame",
     "apply_interval_scale",
     "conformal_interval_scale",
+    "detect_season_length",
 ]

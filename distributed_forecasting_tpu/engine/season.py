@@ -1,0 +1,153 @@
+"""Dominant-seasonality detection for conf-level ``season_length: auto``.
+
+The reference's workload hardcodes weekly seasonality (daily retail data,
+``Prophet(weekly_seasonality=True)``), and this framework's scan families
+default to ``season_length=7`` the same way.  Real catalogs mix cadences —
+weekly SKUs, monthly wholesale, hourly-aggregated-to-day patterns — and an
+operator writing a task YAML should be able to say ``season_length: auto``
+instead of guessing.
+
+Method: masked autocorrelation of the FIRST-DIFFERENCED series, computed
+by FFT.  Differencing kills trend (an undifferenced ACF decays slowly from
+lag 1 and drowns seasonal peaks).  The masked pairwise products at every
+lag are two self-correlations — ``irfft(|rfft(z)|^2)`` for the
+mean-centered masked values and the same for the mask — so the whole lag
+axis costs one O(T log T) transform pair per batch instead of an unrolled
+per-lag reduction graph (an earlier slice-per-lag version compiled
+~linearly in max_lag; ``ops/solve.yule_walker_masked`` keeps its explicit
+per-lag loop because its K is small and it feeds a Toeplitz solve — at
+K ~ 400 the FFT route is the right tool).  Each series normalizes by its
+own pairwise-counted lag-0 autocovariance, then scores average over
+series; only the (L,) score vector leaves the device.
+
+Period selection runs on host because the result must be a static Python
+int (``season_length`` is a frozen-config field that shapes compiled
+programs), and single-lag rules fail in measured ways: the ACF of a
+periodic signal peaks at EVERY multiple of the period and noise decides
+which harmonic wins the raw argmax (observed: 180 over a true 30); a
+smooth near-sinusoidal ACF is high at SMALL lags, so
+smallest-above-threshold collapses to d=2; per-lag sample noise shifts
+peaks by +-1 for long periods (59 for a true 60).  The selector that
+survives all three is a HARMONIC COMB (pitch-detection style): each
+candidate m scores the mean ACF at its first <=3 multiples minus the mean
+at its anti-phase half-multiples; the argmax of that comb locates the
+period (the comb curve is smooth in m — tolerance rules drift to m-1), a
+full-comb rescoring of m*+-2 pins the exact lag (misalignment compounds
+with the tooth index), and a near-submultiple within ``harmonic_tol``
+takes precedence when the argmax sits on a harmonic.
+
+This is batch-level detection by design: one period for the whole batch
+keeps every compiled shape static (per-series periods would force a
+recompile per value; series genuinely mixing cadences belong in separate
+batches or span buckets).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_MIN_LAG = 2
+
+
+@partial(jax.jit, static_argnames=("max_lag",))
+def _acf_scores(y, mask, max_lag: int):
+    """(max_lag+1,) batch-mean masked ACF of diff(y) at lags 0..max_lag."""
+    dy = y[:, 1:] - y[:, :-1]
+    dm = mask[:, 1:] * mask[:, :-1]
+    n = jnp.maximum(jnp.sum(dm, axis=1, keepdims=True), 1.0)
+    mu = jnp.sum(dy * dm, axis=1, keepdims=True) / n
+    z = (dy - mu) * dm
+    T = z.shape[1]
+    L = int(2 ** np.ceil(np.log2(T + max_lag + 1)))  # linear, not circular
+    fz = jnp.fft.rfft(z, n=L, axis=1)
+    fm = jnp.fft.rfft(dm, n=L, axis=1)
+    num = jnp.fft.irfft(fz * jnp.conj(fz), n=L, axis=1)[:, : max_lag + 1]
+    cnt = jnp.fft.irfft(fm * jnp.conj(fm), n=L, axis=1)[:, : max_lag + 1]
+    acov = num / jnp.maximum(cnt, 1.0)            # (S, max_lag+1)
+    a0 = acov[:, :1]
+    r = jnp.where(a0 > 1e-12, acov / jnp.maximum(a0, 1e-12), 0.0)
+    return jnp.mean(r, axis=0)
+
+
+def detect_season_length(
+    batch,
+    max_lag: int = 400,
+    default: int = 7,
+    min_score: float = 0.1,
+    harmonic_tol: float = 0.85,
+) -> int:
+    """Pick the batch's dominant seasonal period as a static Python int.
+
+    Scans lags 2..max_lag (clamped to T/3); candidate periods need two
+    comb teeth inside that window, so detection requires ``T >= ~6m`` and
+    periods below 4 are out of range.  Returns ``default`` when the best
+    comb score stays under ``min_score`` (a genuinely non-seasonal batch
+    should get the domain default, not an argmax over noise).  See the
+    module docstring for the selection rationale.
+    """
+    T = batch.n_time
+    max_lag = int(min(max_lag, max(T // 3, _MIN_LAG)))
+    if max_lag < 4:
+        return int(default)
+    raw = np.asarray(_acf_scores(batch.y, batch.mask, max_lag))
+    # 3-point smoothing: differencing attenuates a period-m signal by
+    # 2 sin(pi/m), so long periods sit near the noise floor and per-lag
+    # sample noise (~1/sqrt(S*T)) makes peaks jagged (measured: raw argmax
+    # at 59 for a true 60)
+    s = raw.copy()
+    s[1:-1] = (raw[:-2] + raw[1:-1] + raw[2:]) / 3.0
+
+    # Harmonic comb score per candidate period m (pitch-detection style):
+    # mean ACF at the first <=3 multiples of m MINUS mean at the anti-phase
+    # half-multiples (0.5m, 1.5m, 2.5m — deep troughs for a true period).
+    # Teeth are capped at 3 and candidates need >= 2 multiples in range:
+    # distant single-tooth candidates otherwise cherry-pick one aligned
+    # peak + one deep trough and outscore the diluted many-teeth
+    # fundamental (measured: 189 over a true 7).  The final rule is
+    # smallest-within-tolerance OF THE COMB score — odd multiples of the
+    # fundamental (91 = 13x7) can edge out its comb by a few percent with
+    # two cherry teeth, but the fundamental always scores within
+    # ``harmonic_tol`` of them and is smaller.
+    cand = np.arange(4, max_lag // 2 + 1)
+    if cand.size == 0:
+        return int(default)
+    combs = np.full(cand.shape, -np.inf)
+    for i, m in enumerate(cand):
+        ks = np.arange(1, min(3, max_lag // m) + 1)
+        peaks_idx = ks * m
+        trough_idx = np.clip(np.round((ks - 0.5) * m).astype(int), 1, max_lag)
+        combs[i] = float(np.mean(s[peaks_idx]) - np.mean(s[trough_idx]))
+    best_i = int(np.argmax(combs))
+    m_star, c_star = int(cand[best_i]), float(combs[best_i])
+    if c_star < min_score:
+        return int(default)
+
+    def full_comb(m: int) -> float:
+        # every tooth in range: a +-1 misalignment compounds with the
+        # tooth index (89 vs 90 differ by 4 lags at the 4th tooth), so
+        # the full comb pins the exact period where the 3-tooth scan
+        # cannot (measured: 89 for a true 90 at T=1080)
+        ks = np.arange(1, max_lag // m + 1)
+        trough = np.clip(np.round((ks - 0.5) * m).astype(int), 1, max_lag)
+        return float(np.mean(s[ks * m]) - np.mean(s[trough]))
+
+    refine = [m for m in range(m_star - 2, m_star + 3)
+              if cand[0] <= m <= cand[-1]]
+    m_star = max(refine, key=full_comb)
+    best_i = int(m_star - cand[0])
+    c_star = float(combs[best_i])
+    # the comb curve is SMOOTH in m, so the argmax — not a
+    # smallest-within-tolerance rule, which drifts to m-1 — locates the
+    # period; what remains is the argmax landing on a HARMONIC of the
+    # true period, so prefer the smallest near-submultiple (ratio >= 2,
+    # off-grid by at most one lag) whose comb is within harmonic_tol
+    for d in cand[: best_i]:
+        ratio = round(m_star / d)
+        if ratio >= 2 and abs(m_star - ratio * d) <= 1:
+            if combs[d - cand[0]] >= harmonic_tol * c_star:
+                return int(d)
+    return int(m_star)

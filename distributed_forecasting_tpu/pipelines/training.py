@@ -60,6 +60,22 @@ def _config_from_conf(model: str, model_conf: Optional[Dict[str, Any]]):
     )
 
 
+def _resolve_season_conf(
+    model_conf: Optional[Dict[str, Any]], batch
+) -> Optional[Dict[str, Any]]:
+    """Translate ``season_length: auto`` into the batch's detected dominant
+    period (``engine/season``) — config fields are static jit args, so the
+    detection runs once here on the host and the config carries a plain
+    int.  Any other value passes through untouched."""
+    if not model_conf or model_conf.get("season_length") != "auto":
+        return model_conf
+    from distributed_forecasting_tpu.engine.season import detect_season_length
+
+    out = dict(model_conf)
+    out["season_length"] = detect_season_length(batch)
+    return out
+
+
 def _resolve_holidays_conf(
     model_conf: Optional[Dict[str, Any]], batch, horizon: int
 ) -> Optional[Dict[str, Any]]:
@@ -241,8 +257,16 @@ class TrainingPipeline:
         # config AFTER tensorize: a named holiday calendar resolves over the
         # batch's actual date range (+horizon)
         config = _config_from_conf(
-            model, _resolve_holidays_conf(model_conf, batch, horizon)
+            model,
+            _resolve_season_conf(
+                _resolve_holidays_conf(model_conf, batch, horizon), batch
+            ),
         )
+        if (model_conf or {}).get("season_length") == "auto":
+            self.logger.info(
+                "season_length: auto -> detected period %d",
+                config.season_length,
+            )
         xreg = None
         if regressors:
             # conf-driven covariates (Prophet add_regressor parity at the
@@ -618,7 +642,10 @@ class TrainingPipeline:
         batch = tensorize(df, key_cols=key_cols)
         configs = {
             name: _config_from_conf(
-                name, _resolve_holidays_conf(c, batch, horizon)
+                name,
+                _resolve_season_conf(
+                    _resolve_holidays_conf(c, batch, horizon), batch
+                ),
             )
             for name, c in (mc.get("configs") or {}).items()
         }
@@ -762,13 +789,13 @@ class TrainingPipeline:
         historical share ``sales / SUM(sales) OVER (PARTITION BY item)``;
         scale item forecasts down to (store, item) granularity.
         """
-        config = _config_from_conf(model, model_conf)
         df = self.catalog.read_table(source_table)
 
         item_df = (
             df.groupby(["date", "item"], as_index=False)["sales"].sum()
         )
         batch = tensorize(item_df, key_cols=("item",))
+        config = _config_from_conf(model, _resolve_season_conf(model_conf, batch))
         key = jax.random.PRNGKey(seed)
         params, result = fit_forecast(
             batch, model=model, config=config, horizon=horizon, key=key

@@ -18,7 +18,11 @@ Librarized equivalent of the reference's training notebook entry point
                                     # or {calendar: US, lower_window: 1,
                                     #     upper_window: 1,
                                     #     custom: {promo: [2017-11-24]}}
-                                    # resolved over the batch's date range
+                                    # resolved over the batch's date range;
+                                    # scan families (holt_winters, theta)
+                                    # accept season_length: auto — the
+                                    # dominant period is detected from the
+                                    # batch (engine/season)
       cv: {initial: 730, period: 360, horizon: 90}
       horizon: 90
       experiment: finegrain_forecasting
