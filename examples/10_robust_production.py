@@ -1,0 +1,120 @@
+"""Step 10 — production robustness: spikes, miscalibrated bands, unknown
+cadence.
+
+Three things real retail feeds do that the clean reference dataset never
+shows: promo/glitch spikes that drag an L2 fit, bands whose nominal 95%
+is fiction out of sample, and mixed cadences where "weekly" is a guess.
+This walkthrough runs the three countermeasures together — Huber-robust
+fitting (``loss='huber'``), split-conformal band calibration
+(``engine/calibrate``), and auto seasonality detection
+(``engine/season``) — on a contaminated monthly-cadence batch.  In a task
+YAML this is three conf lines (``model_conf: {loss: huber, season_length:
+auto}``, ``calibrate_intervals: true``); here the library calls run
+directly so each effect is visible in isolation.
+
+Run: python examples/10_robust_production.py
+"""
+
+import numpy as np
+import pandas as pd
+
+import jax.numpy as jnp
+
+from distributed_forecasting_tpu.data import tensorize
+from distributed_forecasting_tpu.engine import (
+    CVConfig,
+    apply_interval_scale,
+    conformal_interval_scale,
+    detect_season_length,
+    fit_forecast,
+)
+from distributed_forecasting_tpu.models import HoltWintersConfig
+from distributed_forecasting_tpu.models.prophet_glm import CurveModelConfig
+from distributed_forecasting_tpu.ops import metrics as M
+
+HOLDOUT = 60
+
+if __name__ == "__main__":
+    # --- a hostile batch: monthly cycle, trend, 3% spike days, breaks ------
+    rng = np.random.default_rng(0)
+    T = 900
+    t = np.arange(T)
+    rows, clean = [], []
+    for item in range(1, 9):
+        base = 80.0 + 0.04 * t + 15.0 * np.sin(2 * np.pi * t / 30 + item)
+        level = np.where(t > 600, base + 12.0, base)  # a mid-life break
+        y = level + 2.0 * rng.normal(size=T)
+        spikes = rng.random(T) < 0.03
+        y = np.where(spikes, y * rng.uniform(5.0, 10.0, T), y)
+        clean.append(level)
+        rows.append(pd.DataFrame(
+            {"date": pd.date_range("2020-01-01", periods=T), "store": 1,
+             "item": item, "sales": y}
+        ))
+    df = pd.concat(rows, ignore_index=True)
+    clean = np.stack(clean)
+    full = tensorize(df)
+
+    # --- 1. cadence is detected, not guessed -------------------------------
+    m = detect_season_length(full)
+    print(f"season_length: auto -> detected period {m} (true: 30)")
+
+    # --- 2. robust vs L2 under contamination (curve model) -----------------
+    for loss in ("l2", "huber"):
+        cfg = CurveModelConfig(seasonality_mode="additive", loss=loss,
+                               extra_seasonalities=(("monthly", float(m), 5),))
+        params, res = fit_forecast(full, model="prophet", config=cfg,
+                                   horizon=0)
+        rmse = float(np.sqrt(np.mean(
+            (np.asarray(res.yhat)[:, :T] - clean) ** 2
+        )))
+        width = float(np.mean(np.asarray(res.hi - res.lo)))
+        print(f"  loss={loss:<6} clean-signal RMSE {rmse:7.2f}   "
+              f"mean band width {width:8.1f}")
+
+    # --- 3. conformal calibration closes the coverage gap ------------------
+    # A separate hostile regime: recurring level shifts WITHOUT spikes
+    # (spike days belong to the robust-fit story above — their 5-10x
+    # excursions are outliers no honest band should chase).  The one-step
+    # sigma the HW band is built from cannot anticipate shifts, so the
+    # parametric band under-covers at h-step; the CV residuals see the
+    # shifts and the conformal scale widens the band accordingly.
+    rows_b = []
+    for item in range(1, 9):
+        level = np.zeros(T)
+        cur = 80.0
+        for i in range(T):
+            # one shift lands INSIDE the holdout window (day 865) — the
+            # out-of-sample surprise the calibrated band must absorb
+            if i % 165 == 40:
+                cur += rng.choice([-1, 1]) * rng.uniform(8, 15)
+            level[i] = cur
+        yb = level + 10.0 * np.sin(2 * np.pi * t / 7 + item) \
+            + 1.5 * rng.normal(size=T)
+        rows_b.append(pd.DataFrame(
+            {"date": pd.date_range("2020-01-01", periods=T), "store": 1,
+             "item": item, "sales": yb}
+        ))
+    df_b = pd.concat(rows_b, ignore_index=True)
+    full_b = tensorize(df_b)
+    cut_date = df_b["date"].min() + pd.Timedelta(days=T - HOLDOUT - 1)
+    train = tensorize(df_b[df_b["date"] <= cut_date])
+    hw = HoltWintersConfig(n_alpha=4, n_beta=3, n_gamma=3)
+    scale = conformal_interval_scale(
+        train, model="holt_winters", config=hw,
+        cv=CVConfig(initial=360, period=120, horizon=HOLDOUT),
+    )
+    params, res = fit_forecast(train, model="holt_winters", config=hw,
+                               horizon=HOLDOUT)
+    y_hold = jnp.asarray(full_b.y[:, -HOLDOUT:])
+    mask_hold = jnp.ones_like(y_hold)
+    for label, (lo_b, hi_b) in {
+        "raw      ": (res.lo, res.hi),
+        "conformal": apply_interval_scale(res.yhat, res.lo, res.hi, scale)[1:],
+    }.items():
+        cov = float(jnp.mean(M.coverage(
+            y_hold, lo_b[:, -HOLDOUT:], hi_b[:, -HOLDOUT:], mask_hold
+        )))
+        print(f"  95% band holdout coverage ({label}): {cov:.3f}")
+    print(f"conformal band scales: mean {float(jnp.mean(scale)):.2f} "
+          f"(shiftier series get wider bands)")
