@@ -12,6 +12,11 @@ from distributed_forecasting_tpu.engine.calibrate import (
     conformal_interval_scale,
 )
 from distributed_forecasting_tpu.engine.season import detect_season_length
+from distributed_forecasting_tpu.engine.blend import (
+    BlendResult,
+    blend_weights,
+    fit_forecast_blend,
+)
 from distributed_forecasting_tpu.engine.hyper import (
     HyperSearchConfig,
     TuneResult,
@@ -42,4 +47,7 @@ __all__ = [
     "apply_interval_scale",
     "conformal_interval_scale",
     "detect_season_length",
+    "BlendResult",
+    "blend_weights",
+    "fit_forecast_blend",
 ]

@@ -1,0 +1,173 @@
+"""Per-series weighted cross-family blending (linear opinion pool).
+
+``engine/select`` answers "which ONE family serves each series"; this
+module answers the M-competition finding that a weighted COMBINATION of
+families beats every single member on mixed catalogs (simple combination
+is the classic forecasting result — Clemen 1989's review; the M4 winners
+are weighted ensembles).  Weights are per series and data-driven: each
+family's rolling-origin CV error (the same one compiled CV pass per
+family that selection uses) maps to an inverse-error weight, so a series
+whose demand is intermittent leans croston while its seasonal neighbor
+leans HW — smoothly, instead of the winner-take-all cut.
+
+Combination rules, deliberately simple and closed-form:
+
+* point path: ``yhat = sum_f w_f yhat_f`` — the linear pool;
+* bands: half-widths combine LINEARLY, ``hi - yhat = sum_f w_f (hi_f -
+  yhat_f)`` — the perfectly-correlated assumption.  Family errors on the
+  same series are strongly positively correlated (they all miss the same
+  demand shocks), so the independence rule (root-sum-square) would
+  under-state uncertainty; the linear rule is the honest conservative
+  choice and keeps every band closed-form.
+* a family with a non-finite CV metric on a series gets weight 0 there
+  (``train_with_fail_safe`` semantics, at any temperature); a series where
+  EVERY family is non-finite falls back to equal weights and is surfaced
+  through ``ok=False``; and because the blend SUMS every member in, a
+  series is ``ok`` only if every family CARRYING WEIGHT on it fit
+  healthily — a 0.6-weight member that fell back to seasonal-naive makes
+  the series not-ok, unlike the winner-gather auto path.
+
+Everything is one (S, F) weight matrix applied to F batched forecasts —
+no per-series Python, same compiled programs the auto path runs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from distributed_forecasting_tpu.data.tensorize import SeriesBatch
+from distributed_forecasting_tpu.engine.cv import CVConfig
+from distributed_forecasting_tpu.engine.fit import ForecastResult, fit_forecast
+from distributed_forecasting_tpu.engine.select import (
+    DEFAULT_FAMILIES,
+    _HIGHER_BETTER,
+    select_model,
+)
+
+_EPS = 1e-9
+
+
+@dataclasses.dataclass
+class BlendResult:
+    models: Tuple[str, ...]   # family names, the weight matrix's column space
+    weights: np.ndarray       # (S, F) convex weights per series
+    scores: pd.DataFrame      # (S, F) per-family CV metric
+    metric: str
+    valid: np.ndarray         # (S,) bool — at least one family scored finite
+
+    def mean_weights(self) -> Dict[str, float]:
+        return {
+            name: float(self.weights[:, i].mean())
+            for i, name in enumerate(self.models)
+        }
+
+
+def blend_weights(
+    batch: SeriesBatch,
+    models: Sequence[str] = DEFAULT_FAMILIES,
+    configs: Optional[Dict[str, object]] = None,
+    metric: str = "smape",
+    cv: CVConfig = CVConfig(),
+    key: Optional[jax.Array] = None,
+    temperature: float = 1.0,
+) -> BlendResult:
+    """Per-series inverse-CV-error weights: ``w_f ∝ (1/err_f)^temperature``.
+
+    ``temperature`` sharpens (>1) or flattens (<1) the pool; 1.0 is the
+    classical inverse-error rule, and temperature -> inf recovers
+    winner-take-all selection.
+    """
+    # one CV-scoring contract for selection AND blending: select_model owns
+    # the per-family CV loop (key folding, metric extraction), so the
+    # weights here always correspond to the scores the auto path would
+    # have selected on
+    sel = select_model(
+        batch, models=models, configs=configs, metric=metric, cv=cv, key=key
+    )
+    table = sel.scores[list(models)].to_numpy(dtype=np.float64)  # (S, F)
+    finite = np.isfinite(table)
+    if metric in _HIGHER_BETTER:
+        # a score like coverage is already "bigger is better" and
+        # non-negative: weight proportional to the score itself (the
+        # inverse-error rule applies to errors, not negated scores)
+        base = np.maximum(table, 0.0)
+    else:
+        base = 1.0 / np.maximum(table, _EPS)
+    # finite mask applied AFTER the temperature power: 0**0 == 1 would
+    # hand a non-finite family equal weight at temperature=0
+    inv = np.where(finite, base ** temperature, 0.0)
+    tot = inv.sum(axis=1, keepdims=True)
+    equal = np.full_like(inv, 1.0 / len(models))
+    weights = np.where(tot > 0, inv / np.maximum(tot, _EPS), equal)
+    return BlendResult(
+        models=tuple(models),
+        weights=weights,
+        scores=sel.scores,
+        metric=metric,
+        valid=sel.valid,
+    )
+
+
+def fit_forecast_blend(
+    batch: SeriesBatch,
+    models: Sequence[str] = DEFAULT_FAMILIES,
+    configs: Optional[Dict[str, object]] = None,
+    metric: str = "smape",
+    cv: CVConfig = CVConfig(),
+    horizon: int = 90,
+    key: Optional[jax.Array] = None,
+    blend: Optional[BlendResult] = None,
+    temperature: float = 1.0,
+) -> Tuple[Dict[str, object], BlendResult, ForecastResult]:
+    """Weight per series, fit every family on full history, combine.
+
+    Returns ``(params_by_family, blend, result)``; the params dict plus
+    ``blend.weights`` feed ``serving.BlendedForecaster``.
+    """
+    configs = configs or {}
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    if blend is None:
+        blend = blend_weights(
+            batch, models=models, configs=configs, metric=metric, cv=cv,
+            key=key, temperature=temperature,
+        )
+
+    params_by_family: Dict[str, object] = {}
+    w = jnp.asarray(blend.weights)
+    yhat = up = dn = None
+    ok = day_all = None
+    for i, name in enumerate(blend.models):
+        params, res = fit_forecast(
+            batch, model=name, config=configs.get(name), horizon=horizon,
+            key=jax.random.fold_in(key, 1000 + i),
+        )
+        params_by_family[name] = params
+        wf = w[:, i][:, None]
+        # a family only vouches for series it actually carries: the blend
+        # SUMS every family in (unlike the auto path's winner gather), so
+        # ok must AND over weight-carrying families — a 0.6-weight member
+        # whose fit fell back to seasonal-naive ships 60% fallback and the
+        # series must surface as not-ok, even if another member fit fine
+        carries_ok = res.ok | (w[:, i] <= 1e-6)
+        if yhat is None:
+            yhat = wf * res.yhat
+            up = wf * (res.hi - res.yhat)
+            dn = wf * (res.yhat - res.lo)
+            ok, day_all = carries_ok, res.day_all
+        else:
+            yhat = yhat + wf * res.yhat
+            up = up + wf * (res.hi - res.yhat)
+            dn = dn + wf * (res.yhat - res.lo)
+            ok = ok & carries_ok
+    ok = ok & jnp.asarray(blend.valid)
+    result = ForecastResult(
+        yhat=yhat, lo=yhat - dn, hi=yhat + up, ok=ok, day_all=day_all
+    )
+    return params_by_family, blend, result

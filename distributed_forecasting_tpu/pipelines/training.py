@@ -185,12 +185,12 @@ class TrainingPipeline:
         if regressors:
             from distributed_forecasting_tpu.models.base import get_model
 
-            if model == "auto":
+            if model in ("auto", "blend"):
                 raise ValueError(
-                    "training.regressors is not supported together with "
-                    "model='auto' — the non-curve families in the selection "
-                    "pool cannot use covariates; fit the curve model "
-                    "directly with regressors"
+                    f"training.regressors is not supported together with "
+                    f"model={model!r} — the non-curve families in the "
+                    f"selection/blend pool cannot use covariates; fit the "
+                    f"curve model directly with regressors"
                 )
             # unconditional: the tuned path is curve-only, but a conf naming
             # a non-curve model with regressors must still fail loudly
@@ -200,19 +200,21 @@ class TrainingPipeline:
                     f"model {model!r} does not accept exogenous regressors; "
                     f"use the curve model ('prophet')"
                 )
-        if cv_artifact and (model == "auto" or (tuning and tuning.get("enabled"))):
+        if cv_artifact and (model in ("auto", "blend")
+                            or (tuning and tuning.get("enabled"))):
             raise ValueError(
                 "training.cv_artifact is only supported on the plain "
-                "fine-grained path (not model='auto' or tuning.enabled)"
+                "fine-grained path (not model='auto'/'blend' or "
+                "tuning.enabled)"
             )
         if calibrate_intervals:
             # scoped to the plain path: the CV pass that calibration reuses
             # runs there; silently ignoring the flag elsewhere would ship
             # uncalibrated bands the operator believes are calibrated
-            if model == "auto" or (tuning and tuning.get("enabled")):
+            if model in ("auto", "blend") or (tuning and tuning.get("enabled")):
                 raise ValueError(
                     "training.calibrate_intervals is only supported on the "
-                    "plain fine-grained path (not model='auto' or "
+                    "plain fine-grained path (not model='auto'/'blend' or "
                     "tuning.enabled)"
                 )
             if bucketed:
@@ -237,13 +239,15 @@ class TrainingPipeline:
                 source_table, output_table, model_conf, cv_conf, tuning,
                 experiment, horizon, key_cols, regressors=regressors,
             )
-        if model == "auto":
+        if model in ("auto", "blend"):
             if bucketed:
                 raise ValueError(
-                    "training.bucketed is not supported together with "
-                    "model='auto' — auto-select fits on the shared grid"
+                    f"training.bucketed is not supported together with "
+                    f"model={model!r} — pooled fits run on the shared grid"
                 )
-            return self._fine_grained_auto(
+            impl = (self._fine_grained_auto if model == "auto"
+                    else self._fine_grained_blend)
+            return impl(
                 source_table, output_table, model_conf, cv_conf,
                 experiment, horizon, key_cols, seed,
             )
@@ -715,6 +719,108 @@ class TrainingPipeline:
             "fit_seconds": fit_seconds,
             "chosen_counts": counts,
             "metrics": {f"val_{metric}": val_metric},
+        }
+
+    # ---------------------------------------------------------- blended fit
+    def _fine_grained_blend(
+        self,
+        source_table: str,
+        output_table: str,
+        model_conf: Optional[Dict[str, Any]],
+        cv_conf: Optional[Dict[str, Any]],
+        experiment: str,
+        horizon: int,
+        key_cols,
+        seed: int,
+    ) -> Dict[str, Any]:
+        """Per-series weighted cross-family pool (``engine/blend``) — where
+        the auto path picks each series' single winner, this combines all
+        families with inverse-CV-error weights (the M-competition result:
+        combinations beat members on mixed catalogs).  ``model_conf`` may
+        carry ``{"families": [...], "metric": ..., "temperature": ...,
+        "configs": {family: {...}}}``."""
+        from distributed_forecasting_tpu.engine.blend import fit_forecast_blend
+        from distributed_forecasting_tpu.engine.select import DEFAULT_FAMILIES
+        from distributed_forecasting_tpu.serving.ensemble import BlendedForecaster
+
+        mc = model_conf or {}
+        families = tuple(mc.get("families", DEFAULT_FAMILIES))
+        metric = mc.get("metric", "smape")
+        temperature = float(mc.get("temperature", 1.0))
+        cv = CVConfig(**(cv_conf or {}))
+
+        df = self.catalog.read_table(source_table)
+        batch = tensorize(df, key_cols=key_cols)
+        configs = {
+            name: _config_from_conf(
+                name,
+                _resolve_season_conf(
+                    _resolve_holidays_conf(c, batch, horizon), batch
+                ),
+            )
+            for name, c in (mc.get("configs") or {}).items()
+        }
+        t_start = time.time()
+        params_by_family, blend, result = fit_forecast_blend(
+            batch, models=families, configs=configs, metric=metric, cv=cv,
+            horizon=horizon, key=jax.random.PRNGKey(seed),
+            temperature=temperature,
+        )
+        jax.block_until_ready(result.yhat)
+        fit_seconds = time.time() - t_start
+
+        eid = self.tracker.create_experiment(experiment)
+        with self.tracker.start_run(
+            eid, run_name="blended_fit",
+            tags={"model": "blend", "families": ",".join(families)},
+        ) as run:
+            run.log_params(
+                {
+                    "families": list(families),
+                    "blend_metric": metric,
+                    "temperature": temperature,
+                    "n_series": batch.n_series,
+                    "horizon": horizon,
+                }
+            )
+            valid = blend.valid
+            run.log_metrics(
+                {
+                    "n_invalid_series": float((~valid).sum()),
+                    "fit_seconds": fit_seconds,
+                    **{f"mean_weight_{name}": w
+                       for name, w in blend.mean_weights().items()},
+                }
+            )
+            series_table = batch.key_frame()
+            for i, name in enumerate(blend.models):
+                series_table[f"weight_{name}"] = blend.weights[:, i]
+                series_table[f"{metric}_{name}"] = blend.scores[name].to_numpy()
+            run.log_table("series_metrics.parquet", series_table)
+            bf = BlendedForecaster.from_fit(
+                batch, params_by_family, configs, blend
+            )
+            bf.save(run.artifact_path("forecaster"))
+            run_id = run.run_id
+
+        table_df = forecast_frame(batch, result)
+        version = self.catalog.save_table(output_table, table_df)
+        self.logger.info(
+            "blended fit: %d series over %s in %.2fs (mean weights: %s) -> %s v%s",
+            batch.n_series, list(families), fit_seconds,
+            {k: round(v, 3) for k, v in blend.mean_weights().items()},
+            output_table, version,
+        )
+        return {
+            "experiment_id": eid,
+            "run_id": run_id,
+            "table_version": version,
+            "n_series": batch.n_series,
+            "n_failed": int((~np.asarray(result.ok)).sum()),
+            "fit_seconds": fit_seconds,
+            "mean_weights": blend.mean_weights(),
+            "metrics": {f"mean_weight_{k}": v
+                        for k, v in blend.mean_weights().items()},
         }
 
     def _log_per_series_runs(self, eid: str, series_table: pd.DataFrame, parent: str):

@@ -1,6 +1,9 @@
 from distributed_forecasting_tpu.serving.predictor import BatchForecaster
 from distributed_forecasting_tpu.serving.bucketed import BucketedForecaster
-from distributed_forecasting_tpu.serving.ensemble import MultiModelForecaster
+from distributed_forecasting_tpu.serving.ensemble import (
+    BlendedForecaster,
+    MultiModelForecaster,
+)
 from distributed_forecasting_tpu.serving.server import (
     ForecastServer,
     load_forecaster,
@@ -13,6 +16,7 @@ __all__ = [
     "BatchForecaster",
     "BucketedForecaster",
     "MultiModelForecaster",
+    "BlendedForecaster",
     "ForecastServer",
     "load_forecaster",
     "resolve_from_registry",

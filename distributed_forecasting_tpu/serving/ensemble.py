@@ -210,3 +210,195 @@ class MultiModelForecaster:
             out["model"] = name
             parts.append(out)
         return pd.concat(parts, ignore_index=True)
+
+
+_BLEND_META_FILE = "blend.json"
+_BLEND_WEIGHTS_FILE = "blend_weights.npy"
+
+
+class BlendedForecaster:
+    """Linear-pool serving for ``engine.fit_forecast_blend``: every family
+    predicts every requested series and the (S, F) weight matrix combines
+    them — point paths as the weighted mean, band half-widths linearly
+    (the perfectly-correlated rule; see ``engine/blend``), quantile levels
+    as the weighted level-wise pool (exact under location shifts, the
+    standard linear-pool approximation otherwise).
+
+    Cost: F batched predicts per request instead of the dispatch
+    composite's one-per-family-PRESENT — the price of smooth combination;
+    still never per series.
+    """
+
+    def __init__(
+        self,
+        forecasters: Dict[str, BatchForecaster],
+        weights: np.ndarray,
+        models: Optional[tuple] = None,
+    ):
+        if not forecasters:
+            raise ValueError("need at least one family forecaster")
+        self.forecasters = dict(forecasters)
+        # weight COLUMNS follow this order — explicit, never re-sorted
+        self.models = tuple(models) if models is not None else tuple(sorted(forecasters))
+        if set(self.models) != set(self.forecasters):
+            raise ValueError(
+                f"models order {self.models} does not cover forecasters "
+                f"{sorted(self.forecasters)}"
+            )
+        first = self.forecasters[self.models[0]]
+        self.keys = first.keys
+        self.key_names = first.key_names
+        self.weights = np.asarray(weights, dtype=np.float32)
+        if self.weights.shape != (self.keys.shape[0], len(self.models)):
+            raise ValueError(
+                f"weights must be ({self.keys.shape[0]}, {len(self.models)}) "
+                f"— one row per series, one column per family — got "
+                f"{self.weights.shape}"
+            )
+
+    @classmethod
+    def from_fit(cls, batch, params_by_family, configs, blend
+                 ) -> "BlendedForecaster":
+        """Build from ``engine.fit_forecast_blend`` outputs (params for
+        EVERY family in ``blend.models``; weight columns follow it)."""
+        from distributed_forecasting_tpu.models.base import get_model
+
+        missing = sorted(set(blend.models) - set(params_by_family))
+        if missing:
+            raise ValueError(
+                f"blend weights cover famil{'ies' if len(missing) > 1 else 'y'} "
+                f"{missing} absent from params_by_family"
+            )
+        fcs = {}
+        for name in blend.models:
+            cfg = (configs or {}).get(name) or get_model(name).config_cls()
+            fcs[name] = BatchForecaster.from_fit(
+                batch, params_by_family[name], name, cfg
+            )
+        return cls(fcs, blend.weights, models=blend.models)
+
+    @property
+    def serving_schema(self) -> str:
+        return self.forecasters[self.models[0]].serving_schema
+
+    @property
+    def n_series(self) -> int:
+        return int(self.keys.shape[0])
+
+    # -- persistence --------------------------------------------------------
+    def save(self, directory: str) -> None:
+        os.makedirs(directory, exist_ok=True)
+        for name, fc in self.forecasters.items():
+            fc.save(os.path.join(directory, name))
+        np.save(os.path.join(directory, _BLEND_WEIGHTS_FILE), self.weights)
+        with open(os.path.join(directory, _BLEND_META_FILE), "w") as f:
+            json.dump({"models": list(self.models)}, f)
+
+    @classmethod
+    def load(cls, directory: str) -> "BlendedForecaster":
+        with open(os.path.join(directory, _BLEND_META_FILE)) as f:
+            meta = json.load(f)
+        fcs = {
+            name: BatchForecaster.load(os.path.join(directory, name))
+            for name in meta["models"]
+        }
+        weights = np.load(os.path.join(directory, _BLEND_WEIGHTS_FILE))
+        return cls(fcs, weights, models=tuple(meta["models"]))
+
+    def warmup(self, horizon: int = 90, sizes=(1,)) -> int:
+        """Every family serves every request, so each warms the requested
+        sizes directly (no split-ladder needed — see MultiModelForecaster)."""
+        return sum(
+            self.forecasters[m].warmup(horizon=horizon, sizes=sizes)
+            for m in self.models
+        )
+
+    # -- inference ----------------------------------------------------------
+    def _family_kwargs(self, name, xreg):
+        from distributed_forecasting_tpu.models.base import get_model
+
+        if xreg is not None and get_model(name).supports_xreg:
+            return {"xreg": xreg}
+        return {}
+
+    def predict(
+        self,
+        request: pd.DataFrame,
+        horizon: int = 90,
+        include_history: bool = False,
+        key: Optional[jax.Array] = None,
+        on_missing: str = "raise",
+        xreg=None,
+    ) -> pd.DataFrame:
+        first = self.forecasters[self.models[0]]
+        sidx = first.series_indices(request, on_missing=on_missing)
+        if sidx.size == 0:
+            return pd.DataFrame(
+                columns=["ds", *self.key_names, "yhat", "yhat_upper",
+                         "yhat_lower"]
+            )
+        req = pd.DataFrame(self.keys[sidx], columns=list(self.key_names))
+        out = None
+        for i, name in enumerate(self.models):
+            part = self.forecasters[name].predict(
+                req, horizon=horizon, include_history=include_history,
+                key=key, **self._family_kwargs(name, xreg),
+            )
+            # identical request + shared day grid => frames align row-for-row
+            T_rows = len(part) // sidx.size
+            w = np.repeat(self.weights[sidx, i], T_rows)
+            yh = part["yhat"].to_numpy()
+            up = w * (part["yhat_upper"].to_numpy() - yh)
+            dn = w * (yh - part["yhat_lower"].to_numpy())
+            if out is None:
+                out = part[["ds", *self.key_names]].copy()
+                out["yhat"] = w * yh
+                out["_up"], out["_dn"] = up, dn
+            else:
+                out["yhat"] += w * yh
+                out["_up"] += up
+                out["_dn"] += dn
+        out["yhat_upper"] = out["yhat"] + out.pop("_up")
+        out["yhat_lower"] = out["yhat"] - out.pop("_dn")
+        return out[["ds", *self.key_names, "yhat", "yhat_upper", "yhat_lower"]]
+
+    def predict_quantiles(
+        self,
+        request: pd.DataFrame,
+        quantiles=(0.1, 0.5, 0.9),
+        horizon: int = 90,
+        include_history: bool = False,
+        key: Optional[jax.Array] = None,
+        on_missing: str = "raise",
+        xreg=None,
+    ) -> pd.DataFrame:
+        from distributed_forecasting_tpu.models.base import get_model
+
+        for name in self.models:
+            if get_model(name).forecast_quantiles is None:
+                raise ValueError(
+                    f"family {name!r} has no quantile forecast implementation"
+                )
+        first = self.forecasters[self.models[0]]
+        sidx = first.series_indices(request, on_missing=on_missing)
+        qcols = quantile_columns(quantiles)
+        if sidx.size == 0:
+            return pd.DataFrame(columns=["ds", *self.key_names, *qcols])
+        req = pd.DataFrame(self.keys[sidx], columns=list(self.key_names))
+        out = None
+        for i, name in enumerate(self.models):
+            part = self.forecasters[name].predict_quantiles(
+                req, quantiles=quantiles, horizon=horizon,
+                include_history=include_history, key=key,
+                **self._family_kwargs(name, xreg),
+            )
+            T_rows = len(part) // sidx.size
+            w = np.repeat(self.weights[sidx, i], T_rows)
+            if out is None:
+                out = part[["ds", *self.key_names]].copy()
+                for c in qcols:
+                    out[c] = w * part[c].to_numpy()
+            else:
+                for c in qcols:
+                    out[c] += w * part[c].to_numpy()
+        return out

@@ -38,7 +38,10 @@ from typing import Optional
 import numpy as np
 import pandas as pd
 
-from distributed_forecasting_tpu.serving.ensemble import MultiModelForecaster
+from distributed_forecasting_tpu.serving.ensemble import (
+    BlendedForecaster,
+    MultiModelForecaster,
+)
 from distributed_forecasting_tpu.serving.predictor import (
     BatchForecaster,
     UnknownSeriesError,
@@ -46,6 +49,7 @@ from distributed_forecasting_tpu.serving.predictor import (
 from distributed_forecasting_tpu.utils import get_logger
 
 _ENSEMBLE_META = "ensemble.json"
+_BLEND_META = "blend.json"
 _BUCKETS_META = "buckets.json"
 _MAX_HORIZON = 3650  # 10 years daily — beyond any sane scoring request
 _MAX_QUANTILES = 32  # more levels than any scorer needs; bounds compile count
@@ -53,10 +57,12 @@ _MAX_QUANTILES = 32  # more levels than any scorer needs; bounds compile count
 
 def load_forecaster(artifact_dir: str):
     """Load whichever serving artifact lives in ``artifact_dir`` — a single
-    BatchForecaster, a mixed-family MultiModelForecaster, or a span-bucketed
-    BucketedForecaster."""
+    BatchForecaster, a mixed-family MultiModelForecaster, a weighted
+    BlendedForecaster, or a span-bucketed BucketedForecaster."""
     if os.path.exists(os.path.join(artifact_dir, _ENSEMBLE_META)):
         return MultiModelForecaster.load(artifact_dir)
+    if os.path.exists(os.path.join(artifact_dir, _BLEND_META)):
+        return BlendedForecaster.load(artifact_dir)
     if os.path.exists(os.path.join(artifact_dir, _BUCKETS_META)):
         from distributed_forecasting_tpu.serving.bucketed import (
             BucketedForecaster,

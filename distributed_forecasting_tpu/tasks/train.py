@@ -11,6 +11,10 @@ Librarized equivalent of the reference's training notebook entry point
     training:
       model: prophet                # prophet | holt_winters | arima | theta
                                     #   | croston | auto (per-series best-of)
+                                    #   | blend (per-series inverse-CV-error
+                                    #     weighted pool across families;
+                                    #     model_conf: {families: [...],
+                                    #     metric: smape, temperature: 1.0})
       model_conf: {...}             # fields of the model's config dataclass;
                                     # curve model also accepts a NAMED
                                     # holiday calendar:
