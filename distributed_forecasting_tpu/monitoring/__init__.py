@@ -2,9 +2,10 @@ from distributed_forecasting_tpu.monitoring.monitor import (
     MonitorConfig,
     MonitorRegistry,
     detect_anomalies,
+    degradation_report,
     drift_report,
     run_monitor,
 )
 
 __all__ = ["MonitorConfig", "MonitorRegistry", "detect_anomalies",
-           "drift_report", "run_monitor"]
+           "drift_report", "degradation_report", "run_monitor"]

@@ -379,3 +379,111 @@ def drift_report(
     out = pd.DataFrame(rows)
     catalog.save_table(output_table or f"{table}_drift", out)
     return out
+
+
+def degradation_report(
+    catalog: DatasetCatalog,
+    config: MonitorConfig,
+    profile: Optional[pd.DataFrame] = None,
+    metric: str = "mape",
+    granularity: str = "1 week",
+    min_windows: int = 6,
+    z_threshold: float = 3.0,
+    output_table: Optional[str] = None,
+) -> pd.DataFrame:
+    """Flag slices whose LATEST window's realized accuracy degraded vs
+    their own history — the alerting layer over the profile table.
+
+    The profile (:func:`run_monitor`) already tracks per-window quality;
+    this closes the loop the reference's WIP monitor gestured at
+    ("model quality monitoring"): for every (slice_key, slice_value), the
+    trailing windows (all but the latest) form a robust baseline —
+    median + MAD — and the latest window is scored one-sided,
+
+        z = (latest - median) / (1.4826 * MAD)
+
+    (one-sided because only WORSE matters: a metric improving is not an
+    alert).  ``degraded`` is z > z_threshold; slices with fewer than
+    ``min_windows`` windows report ``insufficient_history`` instead of a
+    verdict, and a zero-MAD baseline (flat history) falls back to a small
+    fraction of the median so a genuinely flat-then-broken slice still
+    alerts.  Output persists to ``<table>_degradation``.
+    """
+    if metric not in ("mape", "smape", "rmse", "bias", "coverage"):
+        raise ValueError(f"unknown degradation metric {metric!r}")
+    if profile is None:
+        profile = run_monitor(catalog, config, df=None)
+    if metric not in profile.columns:
+        # coverage is only profiled when the table carries interval columns
+        raise ValueError(
+            f"profile has no {metric!r} column — for 'coverage' the "
+            f"monitored table must carry the interval columns "
+            f"{config.interval_cols}"
+        )
+    part = profile[profile.granularity == granularity]
+    if part.empty:
+        raise ValueError(
+            f"profile has no rows at granularity {granularity!r} "
+            f"(monitor granularities: {config.granularities})"
+        )
+    rows = []
+    for (skey, sval), grp in part.groupby(["slice_key", "slice_value"]):
+        grp = grp.sort_values("window_start")
+        vals = grp[metric].to_numpy(dtype=float)
+        # orient so LARGER always means worse: coverage degrades down;
+        # bias degrades in BOTH directions (a severe under-forecast is as
+        # broken as an over-forecast), so its score is the absolute
+        # deviation from the baseline median
+        if metric == "coverage":
+            series = -vals
+        elif metric == "bias":
+            base_med = float(np.nanmedian(vals[:-1])) if len(vals) > 1 else 0.0
+            series = np.abs(vals - base_med)
+        else:
+            series = vals
+        latest_raw = series[-1] if len(series) else np.nan
+        base = series[:-1][np.isfinite(series[:-1])]
+        n = base.size + int(np.isfinite(latest_raw))
+        row = {
+            "slice_key": skey,
+            "slice_value": sval,
+            "metric": metric,
+            "granularity": granularity,
+            "n_windows": int(n),
+            "latest_window": grp["window_start"].iloc[-1],
+            "latest_value": float(vals[-1]) if len(vals) else np.nan,
+            "baseline_median": float(np.nanmedian(vals[:-1]))
+            if len(vals) > 1 else np.nan,
+        }
+        if not np.isfinite(latest_raw):
+            # the latest window was unmeasurable (e.g. rmse NaN'd by a
+            # missing prediction): say so — scoring an OLDER window as
+            # "latest" would let a broken-and-unmeasurable window pass
+            row.update(z_score=np.nan, degraded=False,
+                       insufficient_history=False, latest_unmeasured=True)
+            rows.append(row)
+            continue
+        if n < min_windows:
+            row.update(z_score=np.nan, degraded=False,
+                       insufficient_history=True, latest_unmeasured=False)
+            rows.append(row)
+            continue
+        med = float(np.median(base))
+        mad = float(np.median(np.abs(base - med)))
+        scale = 1.4826 * mad
+        if scale <= 0:
+            # flat history: a relative floor keeps z finite and still
+            # catches a break (1% of |median|, or epsilon for ~zero bases)
+            scale = max(0.01 * abs(med), 1e-9)
+        z = (latest_raw - med) / scale
+        row.update(
+            z_score=float(z),
+            degraded=bool(z > z_threshold),
+            insufficient_history=False,
+            latest_unmeasured=False,
+        )
+        rows.append(row)
+    report = pd.DataFrame(rows)
+    out_name = output_table or f"{config.table}_degradation"
+    catalog.save_table(out_name, report)
+    return report

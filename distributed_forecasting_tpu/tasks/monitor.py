@@ -19,6 +19,12 @@ Conf::
       drift_baseline: null      # explicit baseline version id (default:
                                 # the previous version); -> <table>_drift
       drift_columns: [y, yhat]
+      degradation: true         # flag slices whose LATEST window's realized
+      degradation_metric: mape  # accuracy broke from its own history
+      degradation_granularity: "1 week"   # (robust z vs trailing
+                                # median+MAD) -> <table>_degradation
+      degradation_threshold: 3.0          # robust-z alert threshold
+      degradation_min_windows: 6          # history needed for a verdict
 """
 
 from __future__ import annotations
@@ -26,6 +32,7 @@ from __future__ import annotations
 from distributed_forecasting_tpu.monitoring import (
     MonitorConfig,
     MonitorRegistry,
+    degradation_report,
     detect_anomalies,
     drift_report,
     run_monitor,
@@ -98,6 +105,26 @@ class MonitorTask(Task):
                     "%s_drift", n_drift, len(drift), config.table,
                 )
                 summary["n_drifted"] = n_drift
+        if mc.get("degradation", False):
+            gran = mc.get("degradation_granularity", "1 week")
+            if gran not in config.granularities:
+                raise ValueError(
+                    f"degradation_granularity {gran!r} is not among the "
+                    f"monitor's granularities {config.granularities}"
+                )
+            report = degradation_report(
+                self.catalog, config, profile=profile,
+                metric=mc.get("degradation_metric", "mape"),
+                granularity=gran,
+                z_threshold=float(mc.get("degradation_threshold", 3.0)),
+                min_windows=int(mc.get("degradation_min_windows", 6)),
+            )
+            n_deg = int(report.degraded.sum())
+            self.logger.info(
+                "degradation scan: %d/%d slices broke from their history "
+                "-> %s_degradation", n_deg, len(report), config.table,
+            )
+            summary["n_degraded"] = n_deg
         return summary
 
 

@@ -338,3 +338,144 @@ def test_drift_vanished_segment_and_ks_fallback(catalog):
     row = rep2.iloc[0]
     assert row.ks > 0.5
     assert row.drifted  # via the KS leg even if psi degenerated
+
+
+def _degradation_table(catalog, break_last_week: bool, weeks=10):
+    """Weekly-windowed forecast table: stable accuracy, optionally with the
+    LAST week's predictions badly off."""
+    rng = np.random.default_rng(7)
+    T = weeks * 7
+    dates = pd.date_range("2024-01-01", periods=T)
+    rows = []
+    for store in (1, 2):
+        y = 50 + 10 * rng.random(T)
+        yhat = y * (1 + rng.normal(0, 0.03, T))
+        if break_last_week:
+            yhat[-7:] = y[-7:] * 1.6   # ~60% error in the final window
+        rows.append(pd.DataFrame(
+            {"ds": dates, "store": store, "item": 1, "y": y, "yhat": yhat,
+             "yhat_lower": yhat * 0.8, "yhat_upper": yhat * 1.2}
+        ))
+    catalog.save_table("hackathon.sales.finegrain_forecasts",
+                       pd.concat(rows, ignore_index=True))
+    return MonitorConfig(name="m", table="hackathon.sales.finegrain_forecasts",
+                         granularities=("1 week",), slicing_cols=("store",))
+
+
+def test_degradation_flags_broken_final_window(catalog):
+    from distributed_forecasting_tpu.monitoring import degradation_report
+
+    cfg = _degradation_table(catalog, break_last_week=True)
+    report = degradation_report(catalog, cfg, granularity="1 week")
+    allrow = report[report.slice_key == ":all"].iloc[0]
+    assert bool(allrow.degraded), report
+    assert allrow.z_score > 3.0
+    # persisted
+    saved = catalog.read_table(
+        "hackathon.sales.finegrain_forecasts_degradation"
+    )
+    assert bool(saved.degraded.any())
+
+
+def test_degradation_quiet_on_stable_history(catalog):
+    from distributed_forecasting_tpu.monitoring import degradation_report
+
+    cfg = _degradation_table(catalog, break_last_week=False)
+    report = degradation_report(catalog, cfg, granularity="1 week")
+    assert not bool(report.degraded.any()), report
+    assert not bool(report.insufficient_history.any())
+
+
+def test_degradation_insufficient_history(catalog):
+    from distributed_forecasting_tpu.monitoring import degradation_report
+
+    cfg = _degradation_table(catalog, break_last_week=True, weeks=3)
+    report = degradation_report(catalog, cfg, granularity="1 week")
+    assert bool(report.insufficient_history.all())
+    assert not bool(report.degraded.any())
+
+
+def test_monitor_task_with_degradation(tmp_path):
+    import yaml
+
+    from distributed_forecasting_tpu.data.catalog import DatasetCatalog
+    from distributed_forecasting_tpu.tasks.monitor import MonitorTask
+
+    root = str(tmp_path)
+    catalog = DatasetCatalog(f"{root}/warehouse")
+    catalog.create_catalog("hackathon")
+    catalog.create_schema("hackathon", "sales")
+    cfg = _degradation_table(catalog, break_last_week=True)
+    conf = {
+        "env": {"root": root},
+        "monitor": {"name": "m",
+                    "table": "hackathon.sales.finegrain_forecasts",
+                    "granularities": ["1 day", "1 week"],
+                    "slicing_cols": ["store"],
+                    "degradation": True},
+    }
+    out = MonitorTask(init_conf=conf).launch()
+    assert out["n_degraded"] >= 1
+
+
+def test_degradation_bias_flags_both_directions(catalog):
+    """bias degrades in BOTH directions: a severe under-forecast (strongly
+    negative bias) must alert just like an over-forecast."""
+    from distributed_forecasting_tpu.monitoring import degradation_report
+
+    rng = np.random.default_rng(8)
+    T = 70
+    dates = pd.date_range("2024-01-01", periods=T)
+    y = 50 + 10 * rng.random(T)
+    yhat = y + rng.normal(0, 0.5, T)
+    yhat[-7:] = y[-7:] - 30.0   # strong UNDER-forecast in the last week
+    catalog.save_table("hackathon.sales.finegrain_forecasts", pd.DataFrame(
+        {"ds": dates, "store": 1, "item": 1, "y": y, "yhat": yhat,
+         "yhat_lower": yhat - 5, "yhat_upper": yhat + 5}
+    ))
+    cfg = MonitorConfig(name="m", table="hackathon.sales.finegrain_forecasts",
+                        granularities=("1 week",), slicing_cols=())
+    report = degradation_report(catalog, cfg, metric="bias",
+                                granularity="1 week")
+    assert bool(report.degraded.any()), report
+
+
+def test_degradation_latest_unmeasured_surfaces(catalog):
+    """A NaN latest window (missing prediction -> rmse NaN) must report
+    latest_unmeasured, not silently score an older window as latest."""
+    from distributed_forecasting_tpu.monitoring import degradation_report
+
+    rng = np.random.default_rng(9)
+    T = 70
+    dates = pd.date_range("2024-01-01", periods=T)
+    y = 50 + 10 * rng.random(T)
+    yhat = y + rng.normal(0, 0.5, T)
+    yhat[-3] = np.nan
+    catalog.save_table("hackathon.sales.finegrain_forecasts", pd.DataFrame(
+        {"ds": dates, "store": 1, "item": 1, "y": y, "yhat": yhat,
+         "yhat_lower": yhat - 5, "yhat_upper": yhat + 5}
+    ))
+    cfg = MonitorConfig(name="m", table="hackathon.sales.finegrain_forecasts",
+                        granularities=("1 week",), slicing_cols=())
+    report = degradation_report(catalog, cfg, metric="rmse",
+                                granularity="1 week")
+    row = report.iloc[0]
+    assert bool(row.latest_unmeasured)
+    assert not bool(row.degraded)
+
+
+def test_degradation_coverage_requires_interval_columns(catalog):
+    from distributed_forecasting_tpu.monitoring import degradation_report
+
+    rng = np.random.default_rng(10)
+    T = 70
+    dates = pd.date_range("2024-01-01", periods=T)
+    y = 50 + 10 * rng.random(T)
+    catalog.save_table("hackathon.sales.finegrain_forecasts", pd.DataFrame(
+        {"ds": dates, "store": 1, "item": 1, "y": y, "yhat": y + 1.0}
+    ))
+    cfg = MonitorConfig(name="m", table="hackathon.sales.finegrain_forecasts",
+                        granularities=("1 week",), slicing_cols=())
+    with pytest.raises(ValueError, match="coverage"):
+        degradation_report(catalog, cfg, metric="coverage",
+                           granularity="1 week")
