@@ -22,6 +22,8 @@ if __name__ == "__main__":
                 "slicing_cols": ["store", "item"],
                 # score residual z-anomalies against the model's own band
                 "anomalies": True,
+                # latest window's realized accuracy vs its own history
+                "degradation": True,
             },
         }
     )
@@ -63,3 +65,16 @@ if __name__ == "__main__":
     else:
         print("\ndrift: single table version — scan appears at the next "
               "training snapshot")
+
+    # --- degradation: did the LATEST window break from its history? --------
+    deg = task.catalog.read_table(
+        "hackathon.sales.finegrain_forecasts_degradation"
+    )
+    n_deg = int(deg.degraded.sum())
+    print(f"\ndegradation: {n_deg}/{len(deg)} slices broke from their "
+          f"trailing-window baseline (robust z > 3)")
+    show = deg[deg.slice_key == ":all"][
+        ["latest_window", "latest_value", "baseline_median", "z_score",
+         "degraded"]
+    ]
+    print(show.to_string(index=False))
