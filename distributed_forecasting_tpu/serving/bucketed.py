@@ -69,6 +69,10 @@ class BucketedForecaster:
         return self.forecasters[0].model
 
     @property
+    def family(self) -> str:
+        return self.model
+
+    @property
     def serving_schema(self) -> str:
         return self.forecasters[0].serving_schema
 

@@ -72,6 +72,19 @@ class MultiModelForecaster:
         return cls(fcs, assignment)
 
     @property
+    def family(self) -> str:
+        return "auto:" + ",".join(self.models)
+
+    @property
+    def day0(self) -> int:
+        # all members were fit on the SAME batch grid (from_fit contract)
+        return self.forecasters[self.models[0]].day0
+
+    @property
+    def day1(self) -> int:
+        return self.forecasters[self.models[0]].day1
+
+    @property
     def serving_schema(self) -> str:
         """Ensemble output adds the winning-family column to the base schema."""
         return self.forecasters[self.models[0]].serving_schema + ", model string"
@@ -276,6 +289,19 @@ class BlendedForecaster:
                 batch, params_by_family[name], name, cfg
             )
         return cls(fcs, blend.weights, models=blend.models)
+
+    @property
+    def family(self) -> str:
+        return "blend:" + ",".join(self.models)
+
+    @property
+    def day0(self) -> int:
+        # all members were fit on the SAME batch grid (from_fit contract)
+        return self.forecasters[self.models[0]].day0
+
+    @property
+    def day1(self) -> int:
+        return self.forecasters[self.models[0]].day1
 
     @property
     def serving_schema(self) -> str:

@@ -225,6 +225,12 @@ class BatchForecaster:
 
     # -- inference ----------------------------------------------------------
     @property
+    def family(self) -> str:
+        """Registry model_family tag — uniform accessor across the four
+        serving classes so DeployTask never duck-types artifact kinds."""
+        return self.model
+
+    @property
     def serving_schema(self) -> str:
         """The schema string the reference stores as a model-version tag
         (``03_deploy.py:44-58``) — single source for artifact meta and the

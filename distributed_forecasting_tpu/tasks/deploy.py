@@ -19,7 +19,6 @@ Conf::
 
 from __future__ import annotations
 
-import json
 import os
 
 from distributed_forecasting_tpu.tasks.common import Task
@@ -48,8 +47,13 @@ class DeployTask(Task):
             run = self.tracker.get_run(eid, run_id)
 
         art = run.artifact_path("forecaster")
-        with open(os.path.join(art, "forecaster.json")) as f:
-            meta = json.load(f)
+        # load through the format-aware loader (single / mixed-family /
+        # blended / bucketed artifacts all deploy through this task) —
+        # which also makes deploy VERIFY the artifact actually loads
+        # before a version pointing at it exists in the registry
+        from distributed_forecasting_tpu.serving import load_forecaster
+
+        fc = load_forecaster(art)
         version = self.registry.register_model(
             model_name,
             art,
@@ -57,9 +61,12 @@ class DeployTask(Task):
             tags={
                 "udf": "batched",  # one batched model, not one per series
                 "reviewed": dep.get("tags", {}).get("reviewed", "false"),
-                "serving_schema": meta.get("serving_schema", ""),
+                "serving_schema": fc.serving_schema,
                 "source_experiment": experiment,
-                "model_family": meta.get("model", ""),
+                # every serving class exposes .family (no duck-typing here:
+                # "blend:..."/"auto:..." for composites, the family name
+                # for single/bucketed artifacts)
+                "model_family": fc.family,
             },
         )
         for k, v in dep.get("tags", {}).items():

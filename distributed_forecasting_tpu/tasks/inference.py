@@ -34,7 +34,7 @@ Conf::
 
 from __future__ import annotations
 
-from distributed_forecasting_tpu.serving import BatchForecaster
+from distributed_forecasting_tpu.serving import resolve_from_registry
 from distributed_forecasting_tpu.tasks.common import Task
 
 
@@ -45,11 +45,15 @@ class InferenceTask(Task):
         inf = self.conf.get("inference", {})
         model_name = inf.get("model_name", "ForecastingBatchModel")
 
-        version = self.registry.latest_version(model_name, stage=inf.get("stage"))
-        forecaster = BatchForecaster.load(version.artifact_dir)
+        # the ONE registry->forecaster resolution (shared with the HTTP
+        # scorer): format-aware loading — single, mixed-family, blended,
+        # bucketed — plus the forecaster/-subdir fallback
+        forecaster, version = resolve_from_registry(
+            self.registry, model_name, stage=inf.get("stage")
+        )
         self.logger.info(
             "loaded %s v%d (%d series)", model_name, version.version,
-            len(forecaster.keys),
+            forecaster.n_series,
         )
 
         request = self.catalog.read_table(inp.get("table", "hackathon.sales.test_raw"))
@@ -57,6 +61,15 @@ class InferenceTask(Task):
         xreg = None
         reg = inf.get("regressors")
         if reg:
+            if not hasattr(forecaster, "day0"):
+                # composite artifacts (bucketed) have no single shared grid
+                # to resolve covariates onto — a clear error beats an
+                # AttributeError three frames deep
+                raise ValueError(
+                    "inference.regressors requires a single-batch forecaster "
+                    f"artifact; {type(forecaster).__name__} has no shared "
+                    "day grid"
+                )
             # covariate values over the artifact's full grid (see
             # data.tensorize.regressors_for_grid) — the future values the
             # curve model needs, resolved from the catalog like the request
