@@ -1,0 +1,130 @@
+"""Ingest-time data-quality report for the long sales format.
+
+The reference leans on Spark's typed read (``schema="date date, store int,
+item int, sales int"``, ``02_training.py:33``) for schema enforcement and
+nothing else — duplicates, negatives, and calendar gaps flow straight into
+the fits.  This framework's tensorize is deliberately forgiving (duplicate
+(key, date) rows sum, gaps become mask=0), which is right for the fit path
+but wrong as the ONLY line of defense: a silently-summed duplicate feed or
+a 40%-gap series is an upstream data incident someone should see.
+
+:func:`quality_report` is the cheap, vectorized pre-pass: one frame in, a
+typed report out — row/series counts, duplicate (store, item, date) rows,
+negative / non-finite sales, per-series calendar gap ratio, short and
+constant series.  ``IngestTask`` runs it by default and logs the issues
+(warn-only; ``validate_strict: true`` turns issues into a hard failure so
+a scheduled pipeline stops before training on a broken feed).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List
+
+import numpy as np
+import pandas as pd
+
+
+@dataclasses.dataclass
+class QualityReport:
+    n_rows: int
+    n_series: int
+    date_min: str
+    date_max: str
+    n_duplicate_rows: int      # extra rows beyond one per (store, item, date)
+    n_negative_sales: int
+    n_nonfinite_sales: int
+    n_short_series: int        # fewer than min_days observed
+    n_constant_series: int     # zero variance over observed days
+    gap_ratio: float           # missing (series, day) cells / span cells
+    issues: List[str]
+
+    @property
+    def ok(self) -> bool:
+        return not self.issues
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def quality_report(
+    df: pd.DataFrame,
+    min_days: int = 60,
+    max_gap_ratio: float = 0.5,
+) -> QualityReport:
+    """Vectorized quality pre-pass over the ``(date, store, item, sales)``
+    long frame; every check is a groupby/reduction, no per-series Python."""
+    # normalize to CALENDAR DAYS first: tensorize floors timestamps to its
+    # day grid and SUMS same-day rows, so an intraday feed ('08:00' and
+    # '20:00' rows) is a duplicate incident even though the raw timestamps
+    # differ — checking at raw precision would miss exactly that class
+    dates = pd.to_datetime(df["date"]).dt.normalize()
+    sales = df["sales"].to_numpy(dtype=float)
+
+    if len(df) == 0:
+        # a 0-row feed is the broken-export case strict mode exists for
+        return QualityReport(
+            n_rows=0, n_series=0, date_min="", date_max="",
+            n_duplicate_rows=0, n_negative_sales=0, n_nonfinite_sales=0,
+            n_short_series=0, n_constant_series=0, gap_ratio=0.0,
+            issues=["empty feed: 0 rows"],
+        )
+
+    grp = df.assign(_d=dates).groupby(["store", "item"], observed=True)
+    counts = grp.size()
+    n_series = int(len(counts))
+
+    dup_mask = df.assign(_d=dates).duplicated(subset=["store", "item", "_d"])
+    n_dup = int(dup_mask.sum())
+    n_neg = int((sales < 0).sum())
+    n_nonfin = int((~np.isfinite(sales)).sum())
+
+    span_days = (grp["_d"].max() - grp["_d"].min()).dt.days + 1
+    observed = grp["_d"].nunique()
+    gap_cells = (span_days - observed).clip(lower=0)
+    gap_ratio = float(gap_cells.sum() / max(int(span_days.sum()), 1))
+
+    n_short = int((observed < min_days).sum())
+    # std() is NaN for single-observation groups — one data point is no
+    # evidence of constancy (newly-launched SKUs), so require >= 2
+    sales_std = grp["sales"].std()
+    n_const = int(((sales_std <= 0.0) & (counts >= 2)).sum())
+
+    issues = []
+    if n_dup:
+        issues.append(
+            f"{n_dup} duplicate (store, item, date) rows — tensorize SUMS "
+            f"them; aggregate upstream if that is not the intent"
+        )
+    if n_neg:
+        issues.append(f"{n_neg} negative sales values")
+    if n_nonfin:
+        issues.append(f"{n_nonfin} non-finite sales values")
+    if n_short:
+        issues.append(
+            f"{n_short}/{n_series} series have under {min_days} observed "
+            f"days (fail-safe fallback will own them)"
+        )
+    if gap_ratio > max_gap_ratio:
+        issues.append(
+            f"calendar gap ratio {gap_ratio:.2f} exceeds {max_gap_ratio} — "
+            f"most of the grid is unobserved; check the feed's date coverage"
+        )
+    if n_const:
+        issues.append(
+            f"{n_const}/{n_series} series are constant over their observed "
+            f"days (dead SKUs or a frozen upstream column)"
+        )
+    return QualityReport(
+        n_rows=int(len(df)),
+        n_series=n_series,
+        date_min=str(dates.min().date()) if len(df) else "",
+        date_max=str(dates.max().date()) if len(df) else "",
+        n_duplicate_rows=n_dup,
+        n_negative_sales=n_neg,
+        n_nonfinite_sales=n_nonfin,
+        n_short_series=n_short,
+        n_constant_series=n_const,
+        gap_ratio=round(gap_ratio, 4),
+        issues=issues,
+    )

@@ -9,6 +9,9 @@ Conf::
 
     input:
       path: /data/train.csv          # .csv or .parquet
+      validate: true                 # data-quality pre-pass (duplicates,
+      validate_min_days: 60          # negatives, gaps, constant series) —
+      validate_strict: false         # warn-only unless strict
     output:
       table: hackathon.sales.raw
 """
@@ -43,6 +46,25 @@ class IngestTask(Task):
             df = load_sales_parquet(path)
         else:
             df = load_sales_csv(path)
+        if bool(inp.get("validate", True)):
+            from distributed_forecasting_tpu.data.quality import quality_report
+
+            report = quality_report(
+                df, min_days=int(inp.get("validate_min_days", 60))
+            )
+            for issue in report.issues:
+                self.logger.warning("data quality: %s", issue)
+            if report.issues and bool(inp.get("validate_strict", False)):
+                raise ValueError(
+                    "input.validate_strict: quality issues in the feed: "
+                    + "; ".join(report.issues)
+                )
+            self.logger.info(
+                "data quality: %d rows, %d series, %s..%s, gap ratio %.3f, "
+                "%d issue(s)",
+                report.n_rows, report.n_series, report.date_min,
+                report.date_max, report.gap_ratio, len(report.issues),
+            )
         version = self.catalog.save_table(table, df)
         self.logger.info("ingested %d rows -> %s (v%s)", len(df), table, version)
         return version

@@ -1,0 +1,107 @@
+"""Ingest-time data-quality report (data/quality)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from distributed_forecasting_tpu.data.quality import quality_report
+
+
+def _clean_frame(T=120, n=3, seed=0):
+    rng = np.random.default_rng(seed)
+    rows = []
+    for item in range(1, n + 1):
+        rows.append(pd.DataFrame(
+            {"date": pd.date_range("2023-01-01", periods=T), "store": 1,
+             "item": item, "sales": 50 + 5 * rng.random(T)}
+        ))
+    return pd.concat(rows, ignore_index=True)
+
+
+def test_clean_frame_reports_ok():
+    rep = quality_report(_clean_frame())
+    assert rep.ok, rep.issues
+    assert rep.n_rows == 360 and rep.n_series == 3
+    assert rep.n_duplicate_rows == 0
+    assert rep.gap_ratio == 0.0
+
+
+def test_each_issue_detected():
+    df = _clean_frame()
+    # duplicates: repeat two rows of series 1
+    df = pd.concat([df, df.iloc[:2]], ignore_index=True)
+    # negatives + non-finite
+    df.loc[5, "sales"] = -3.0
+    df.loc[6, "sales"] = np.nan
+    # constant + short series
+    df = pd.concat([df, pd.DataFrame(
+        {"date": pd.date_range("2023-01-01", periods=10), "store": 2,
+         "item": 9, "sales": 7.0}
+    )], ignore_index=True)
+    rep = quality_report(df, min_days=60)
+    assert rep.n_duplicate_rows == 2
+    assert rep.n_negative_sales == 1
+    assert rep.n_nonfinite_sales == 1
+    assert rep.n_short_series == 1
+    assert rep.n_constant_series == 1
+    assert not rep.ok and len(rep.issues) >= 4
+
+
+def test_gap_ratio_flags_sparse_calendar():
+    rng = np.random.default_rng(1)
+    dates = pd.date_range("2023-01-01", periods=400)[::3]  # 2/3 missing
+    df = pd.DataFrame({"date": dates, "store": 1, "item": 1,
+                       "sales": 50 + rng.random(len(dates))})
+    rep = quality_report(df)
+    assert rep.gap_ratio > 0.6
+    assert any("gap ratio" in s for s in rep.issues)
+
+
+def test_ingest_task_strict_mode(tmp_path):
+    from distributed_forecasting_tpu.tasks.ingest import IngestTask
+
+    df = _clean_frame()
+    df = pd.concat([df, df.iloc[:5]], ignore_index=True)  # duplicates
+    path = str(tmp_path / "feed.csv")
+    df.to_csv(path, index=False)
+
+    conf = {
+        "env": {"root": str(tmp_path / "store")},
+        "input": {"path": path, "validate_strict": True},
+        "output": {"table": "hackathon.sales.raw"},
+    }
+    with pytest.raises(ValueError, match="quality"):
+        IngestTask(init_conf=conf).launch()
+    # warn-only default ingests fine
+    conf["input"]["validate_strict"] = False
+    version = IngestTask(init_conf=conf).launch()
+    assert version
+
+
+def test_intraday_timestamps_are_day_duplicates():
+    """tensorize floors to calendar days and SUMS same-day rows, so an
+    intraday feed is a duplicate incident even at distinct timestamps."""
+    df = pd.DataFrame({
+        "date": ["2023-01-01 08:00", "2023-01-01 20:00", "2023-01-02 00:00"],
+        "store": 1, "item": 1, "sales": [5.0, 6.0, 7.0],
+    })
+    rep = quality_report(df, min_days=1)
+    assert rep.n_duplicate_rows == 1
+    assert any("duplicate" in s for s in rep.issues)
+
+
+def test_empty_feed_is_an_issue():
+    rep = quality_report(pd.DataFrame(
+        columns=["date", "store", "item", "sales"]
+    ))
+    assert not rep.ok
+    assert rep.issues == ["empty feed: 0 rows"]
+
+
+def test_single_observation_series_not_constant():
+    df = _clean_frame()
+    df = pd.concat([df, pd.DataFrame(
+        {"date": ["2023-01-01"], "store": 9, "item": 9, "sales": [4.0]}
+    )], ignore_index=True)
+    rep = quality_report(df, min_days=1)
+    assert rep.n_constant_series == 0
