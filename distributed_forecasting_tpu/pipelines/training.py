@@ -784,8 +784,22 @@ class TrainingPipeline:
                 }
             )
             valid = blend.valid
+            # the pool's CV score as the weighted member scores — the
+            # linear-pool approximation (the pool's own CV error is
+            # bounded above by this for convex metrics); this is what
+            # promotion gates compare (tasks/promote.py)
+            score_mat = blend.scores[list(blend.models)].to_numpy(float)
+            blended_score = np.nansum(blend.weights * score_mat, axis=1)
+            # nansum over an all-NaN row is 0.0 — a "perfect" score for
+            # exactly the BROKEN series; surface NaN instead
+            blended_score = np.where(valid, blended_score, np.nan)
+            val_metric = (
+                float(np.nanmean(blended_score[valid]))
+                if valid.any() else float("nan")
+            )
             run.log_metrics(
                 {
+                    f"val_{metric}": val_metric,
                     "n_invalid_series": float((~valid).sum()),
                     "fit_seconds": fit_seconds,
                     **{f"mean_weight_{name}": w
@@ -793,6 +807,7 @@ class TrainingPipeline:
                 }
             )
             series_table = batch.key_frame()
+            series_table[f"blended_{metric}"] = blended_score
             for i, name in enumerate(blend.models):
                 series_table[f"weight_{name}"] = blend.weights[:, i]
                 series_table[f"{metric}_{name}"] = blend.scores[name].to_numpy()
@@ -819,8 +834,9 @@ class TrainingPipeline:
             "n_failed": int((~np.asarray(result.ok)).sum()),
             "fit_seconds": fit_seconds,
             "mean_weights": blend.mean_weights(),
-            "metrics": {f"mean_weight_{k}": v
-                        for k, v in blend.mean_weights().items()},
+            "metrics": {f"val_{metric}": val_metric,
+                        **{f"mean_weight_{k}": v
+                           for k, v in blend.mean_weights().items()}},
         }
 
     def _log_per_series_runs(self, eid: str, series_table: pd.DataFrame, parent: str):

@@ -6,6 +6,7 @@ from distributed_forecasting_tpu.tasks.deploy import DeployTask
 from distributed_forecasting_tpu.tasks.inference import InferenceTask
 from distributed_forecasting_tpu.tasks.sample_ml import SampleMLTask
 from distributed_forecasting_tpu.tasks.monitor import MonitorTask
+from distributed_forecasting_tpu.tasks.promote import PromoteTask
 from distributed_forecasting_tpu.tasks.reconcile import ReconcileTask
 
 TASK_TYPES = {
@@ -17,6 +18,7 @@ TASK_TYPES = {
     "inference": InferenceTask,
     "sample_ml": SampleMLTask,
     "monitor": MonitorTask,
+    "promote": PromoteTask,
 }
 
 __all__ = [
@@ -27,6 +29,7 @@ __all__ = [
     "DeployTask",
     "InferenceTask",
     "SampleMLTask",
+    "PromoteTask",
     "MonitorTask",
     "ReconcileTask",
     "TASK_TYPES",

@@ -45,6 +45,7 @@ setup(
             "dftpu-serve=distributed_forecasting_tpu.tasks.serve:entrypoint",
             "dftpu-ml=distributed_forecasting_tpu.tasks.sample_ml:entrypoint",
             "dftpu-monitor=distributed_forecasting_tpu.tasks.monitor:entrypoint",
+            "dftpu-promote=distributed_forecasting_tpu.tasks.promote:entrypoint",
             "dftpu-reconcile=distributed_forecasting_tpu.tasks.reconcile:entrypoint",
             "dftpu-workflow=distributed_forecasting_tpu.workflows.runner:main",
         ],

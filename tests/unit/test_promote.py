@@ -1,0 +1,142 @@
+"""Metric-gated promotion (tasks/promote — champion/challenger)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from distributed_forecasting_tpu.tasks.promote import PromoteTask
+
+
+def _train_deploy(root, seed, quality=1.0, model_name="M", stage=None):
+    """One train run + registered version whose val_smape scales with
+    ``quality`` (bigger = worse fit data -> worse metric)."""
+    from distributed_forecasting_tpu.data.catalog import DatasetCatalog
+    from distributed_forecasting_tpu.pipelines.training import TrainingPipeline
+    from distributed_forecasting_tpu.tasks.deploy import DeployTask
+    from distributed_forecasting_tpu.tracking.filestore import FileTracker
+
+    catalog = DatasetCatalog(f"{root}/warehouse")
+    catalog.create_catalog("hackathon")
+    catalog.create_schema("hackathon", "sales")
+    rng = np.random.default_rng(seed)
+    T = 720
+    t = np.arange(T)
+    rows = []
+    for item in (1, 2, 3):
+        y = 50.0 + 8.0 * np.sin(2 * np.pi * t / 7) \
+            + 2.0 * quality * rng.normal(size=T)
+        rows.append(pd.DataFrame(
+            {"date": pd.date_range("2020-01-01", periods=T), "store": 1,
+             "item": item, "sales": y}
+        ))
+    catalog.save_table("hackathon.sales.raw", pd.concat(rows,
+                                                        ignore_index=True))
+    tracker = FileTracker(f"{root}/mlruns")
+    pipe = TrainingPipeline(catalog, tracker)
+    pipe.fine_grained(
+        "hackathon.sales.raw", "hackathon.sales.finegrain_forecasts",
+        model="holt_winters",
+        model_conf={"n_alpha": 3, "n_beta": 2, "n_gamma": 2},
+        cv_conf={"initial": 360, "period": 180, "horizon": 60},
+        horizon=28,
+    )
+    conf = {"env": {"root": root},
+            "deploy": {"experiment": "finegrain_forecasting",
+                       "model_name": model_name}}
+    out = DeployTask(init_conf=conf).launch()
+    if stage:
+        task = DeployTask(init_conf=conf)  # reuse handles
+        task.registry.transition_stage(model_name, out["version"], stage)
+    return out
+
+
+def test_first_promotion_is_unconditional(tmp_path):
+    root = str(tmp_path)
+    _train_deploy(root, seed=0)
+    out = PromoteTask(init_conf={
+        "env": {"root": root},
+        "promote": {"model_name": "M", "candidate_stage": "None"},
+    }).launch()
+    assert out["promoted"] and out["baseline_value"] is None
+
+
+def test_worse_candidate_rejected_and_tagged(tmp_path):
+    root = str(tmp_path)
+    _train_deploy(root, seed=0, quality=1.0, stage="Production")  # champion
+    _train_deploy(root, seed=1, quality=6.0)                      # challenger
+    task = PromoteTask(init_conf={
+        "env": {"root": root},
+        "promote": {"model_name": "M", "candidate_stage": "None",
+                    "tolerance": 0.0},
+    })
+    out = task.launch()
+    assert not out["promoted"]
+    assert out["candidate_value"] > out["baseline_value"]
+    v = task.registry.get_version("M", out["candidate_version"])
+    assert v.tags["promotion_decision"] == "rejected"
+    assert v.stage != "Production"
+    # champion untouched
+    assert task.registry.latest_version("M", stage="Production").version == 1
+
+    # fail_on_reject escalates (the CI-gate mode)
+    with pytest.raises(RuntimeError, match="promotion gate"):
+        PromoteTask(init_conf={
+            "env": {"root": root},
+            "promote": {"model_name": "M", "candidate_stage": "None",
+                        "tolerance": 0.0, "fail_on_reject": True},
+        }).launch()
+
+
+def test_better_candidate_promotes(tmp_path):
+    root = str(tmp_path)
+    _train_deploy(root, seed=0, quality=6.0, stage="Production")  # weak champ
+    _train_deploy(root, seed=1, quality=1.0)                      # strong cand
+    task = PromoteTask(init_conf={
+        "env": {"root": root},
+        "promote": {"model_name": "M", "candidate_stage": "None",
+                    "rule": "improved"},
+    })
+    out = task.launch()
+    assert out["promoted"]
+    assert task.registry.latest_version("M", stage="Production").version == \
+        out["candidate_version"]
+    v = task.registry.get_version("M", out["candidate_version"])
+    assert v.tags["promotion_decision"] == "promoted"
+
+
+def test_tolerance_allows_slightly_worse(tmp_path):
+    root = str(tmp_path)
+    # SAME seed so the quality knob, not noise realization, orders the
+    # metrics: candidate is genuinely (slightly) worse than the champion
+    _train_deploy(root, seed=0, quality=1.0, stage="Production")
+    _train_deploy(root, seed=0, quality=1.15)
+    conf = {"env": {"root": root},
+            "promote": {"model_name": "M", "candidate_stage": "None",
+                        "rule": "not_worse", "tolerance": 0.25}}
+    out = PromoteTask(init_conf=conf).launch()
+    assert out["candidate_value"] > out["baseline_value"], out["reason"]
+    assert out["promoted"], out["reason"]
+    # the same gap fails with zero tolerance (fresh root to reset stages)
+    root2 = str(tmp_path / "second")
+    _train_deploy(root2, seed=0, quality=1.0, stage="Production")
+    _train_deploy(root2, seed=0, quality=1.15)
+    conf2 = {"env": {"root": root2},
+             "promote": {"model_name": "M", "candidate_stage": "None",
+                         "rule": "not_worse", "tolerance": 0.0}}
+    out2 = PromoteTask(init_conf=conf2).launch()
+    assert not out2["promoted"], out2["reason"]
+
+
+def test_higher_better_tolerance_is_lenient_not_strict(tmp_path):
+    """coverage: tolerance must ALLOW a slightly-worse candidate; the
+    sign-flipped b*(1+tol) formulation demanded a BETTER one."""
+    root = str(tmp_path)
+    _train_deploy(root, seed=0, quality=1.0, stage="Production")
+    _train_deploy(root, seed=0, quality=1.0)  # identical coverage
+    out = PromoteTask(init_conf={
+        "env": {"root": root},
+        "promote": {"model_name": "M", "candidate_stage": "None",
+                    "metric": "val_coverage", "rule": "not_worse",
+                    "tolerance": 0.02},
+    }).launch()
+    assert out["promoted"], out["reason"]
