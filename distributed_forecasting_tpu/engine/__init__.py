@@ -12,6 +12,7 @@ from distributed_forecasting_tpu.engine.calibrate import (
     conformal_interval_scale,
 )
 from distributed_forecasting_tpu.engine.season import detect_season_length
+from distributed_forecasting_tpu.engine.order import select_arima_order
 from distributed_forecasting_tpu.engine.blend import (
     BlendResult,
     blend_weights,
@@ -47,6 +48,7 @@ __all__ = [
     "apply_interval_scale",
     "conformal_interval_scale",
     "detect_season_length",
+    "select_arima_order",
     "BlendResult",
     "blend_weights",
     "fit_forecast_blend",

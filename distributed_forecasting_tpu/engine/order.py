@@ -1,0 +1,125 @@
+"""Automatic ARIMA order selection — ``model_conf: {order: auto}``.
+
+pmdarima's ``auto_arima`` (the tool a reference user would reach for next
+to Prophet) steps through (p, d, q) candidates refitting per series; with
+this framework's closed-form Hannan-Rissanen fit, EVERY candidate order is
+one compiled batched fit+CV over all series, so a small grid sweep is
+seconds, not minutes, and needs no stepwise heuristics.
+
+Selection is by rolling-origin CV (the framework's one validation
+currency — information criteria would need exact likelihoods the HR fit
+does not produce, and CV compares across ``d`` where in-sample
+likelihoods cannot).  The winner is the order minimizing the batch-mean
+metric over series with finite scores; the decision table is returned so
+the pipeline can log what lost and by how much.
+
+Like ``season_length: auto`` (engine/season), the result must be STATIC —
+(p, d, q) shape the compiled programs — so selection runs once on the
+host and the config carries plain ints.  Batch-level by design: per-series
+orders would mean one compiled program per distinct order at serving time
+(that is what ``model: auto``'s family dispatch is for).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from distributed_forecasting_tpu.engine.cv import CVConfig, cross_validate
+
+# the default ladder: every (p, q) in a compact box at both d values,
+# skipping the degenerate (0, d, 0) white-noise/drift orders
+DEFAULT_ORDERS: Tuple[Tuple[int, int, int], ...] = tuple(
+    (p, d, q)
+    for d in (0, 1)
+    for p in (0, 1, 2, 3)
+    for q in (0, 1, 2)
+    if (p, q) != (0, 0)
+)
+
+
+def select_arima_order(
+    batch,
+    orders: Sequence[Tuple[int, int, int]] = DEFAULT_ORDERS,
+    base_conf: Optional[dict] = None,
+    metric: str = "smape",
+    cv: CVConfig = CVConfig(),
+    key=None,
+):
+    """CV every candidate (p, d, q); return ``(best_order, table)``.
+
+    ``base_conf``: the rest of the ArimaConfig fields (seasonal terms,
+    method, ...) shared by every candidate.  ``table`` rows:
+    ``((p, d, q), score, n_finite)`` sorted best-first, where ``score``
+    is the batch-mean metric over finite-scoring series.
+    """
+    from distributed_forecasting_tpu.models.arima import ArimaConfig
+
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    base = dict(base_conf or {})
+    base.pop("order", None)
+    rows = []
+    for i, (p, d, q) in enumerate(orders):
+        config = ArimaConfig(p=int(p), d=int(d), q=int(q), **base)
+        res = cross_validate(
+            batch, model="arima", config=config, cv=cv,
+            key=jax.random.fold_in(key, i),
+        )
+        vals = np.asarray(res[metric], dtype=np.float64)
+        finite = np.isfinite(vals)
+        score = float(np.mean(vals[finite])) if finite.any() else np.inf
+        rows.append(((int(p), int(d), int(q)), score, int(finite.sum())))
+    rows.sort(key=lambda r: r[1])
+    best, best_score, _ = rows[0]
+    if not np.isfinite(best_score):
+        raise ValueError(
+            "no candidate order produced a finite CV score — the batch may "
+            "be too short for the CV config, or the series degenerate"
+        )
+    return best, rows
+
+
+def resolve_order_conf(model_conf, batch, cv_conf=None) -> Optional[dict]:
+    """Translate ``order: auto`` (or an explicit ``order: [p, d, q]``) in an
+    arima ``model_conf`` into plain p/d/q fields — the ``_resolve_*_conf``
+    sibling of the season/holiday translators (pipelines/training.py).
+
+    Optional sibling keys (popped here, never reaching ArimaConfig):
+    ``order_candidates`` restricts the ladder; ``order_metric`` picks the
+    selection metric (default smape — set it to match an auto/blend conf's
+    ``metric`` so the two selection mechanisms agree).
+
+    Note on cost: when the pipeline later cross-validates the winning
+    config, that pass re-runs — but against the jit cache (same static
+    config as the sweep's winner), so it costs one execution, not a
+    compile; threading the sweep's per-series metrics through every
+    pipeline path was judged not worth the coupling.
+    """
+    if not model_conf or "order" not in model_conf:
+        return model_conf
+    out = dict(model_conf)
+    spec = out.pop("order")
+    candidates = out.pop("order_candidates", None)
+    metric = out.pop("order_metric", "smape")
+    if isinstance(spec, str) and spec == "auto":
+        base = {k: v for k, v in out.items() if k not in ("p", "d", "q")}
+        cv = CVConfig(**(cv_conf or {}))
+        orders = (
+            tuple(tuple(int(x) for x in o) for o in candidates)
+            if candidates else DEFAULT_ORDERS
+        )
+        (p, d, q), _ = select_arima_order(batch, orders=orders,
+                                          base_conf=base, cv=cv,
+                                          metric=metric)
+        out.update(p=p, d=d, q=q)
+        return out
+    if isinstance(spec, (list, tuple)) and len(spec) == 3:
+        out.update(p=int(spec[0]), d=int(spec[1]), q=int(spec[2]))
+        return out
+    raise ValueError(
+        f"arima order must be 'auto' or a [p, d, q] triple, got {spec!r}"
+    )

@@ -60,6 +60,28 @@ def _config_from_conf(model: str, model_conf: Optional[Dict[str, Any]]):
     )
 
 
+def _resolve_model_conf(
+    model: str,
+    model_conf: Optional[Dict[str, Any]],
+    batch,
+    horizon: int,
+    cv_conf: Optional[Dict[str, Any]] = None,
+) -> Optional[Dict[str, Any]]:
+    """The ONE conf-translation chain — named holidays, season_length:
+    auto, arima order: auto — applied identically on every path that
+    builds a model config from task conf (plain, allocated, auto/blend
+    per-family), so an 'order' key can never reach a config constructor
+    as an unexpected kwarg on one path while working on another."""
+    out = _resolve_season_conf(
+        _resolve_holidays_conf(model_conf, batch, horizon), batch
+    )
+    if model == "arima" and "order" in (out or {}):
+        from distributed_forecasting_tpu.engine.order import resolve_order_conf
+
+        out = resolve_order_conf(out, batch, cv_conf)
+    return out
+
+
 def _resolve_season_conf(
     model_conf: Optional[Dict[str, Any]], batch
 ) -> Optional[Dict[str, Any]]:
@@ -261,15 +283,18 @@ class TrainingPipeline:
         # config AFTER tensorize: a named holiday calendar resolves over the
         # batch's actual date range (+horizon)
         config = _config_from_conf(
-            model,
-            _resolve_season_conf(
-                _resolve_holidays_conf(model_conf, batch, horizon), batch
-            ),
+            model, _resolve_model_conf(model, model_conf, batch, horizon,
+                                       cv_conf)
         )
         if (model_conf or {}).get("season_length") == "auto":
             self.logger.info(
                 "season_length: auto -> detected period %d",
                 config.season_length,
+            )
+        if (model_conf or {}).get("order") == "auto":
+            self.logger.info(
+                "arima order: auto -> selected (p, d, q) = (%d, %d, %d)",
+                config.p, config.d, config.q,
             )
         xreg = None
         if regressors:
@@ -646,10 +671,7 @@ class TrainingPipeline:
         batch = tensorize(df, key_cols=key_cols)
         configs = {
             name: _config_from_conf(
-                name,
-                _resolve_season_conf(
-                    _resolve_holidays_conf(c, batch, horizon), batch
-                ),
+                name, _resolve_model_conf(name, c, batch, horizon, cv_conf)
             )
             for name, c in (mc.get("configs") or {}).items()
         }
@@ -753,10 +775,7 @@ class TrainingPipeline:
         batch = tensorize(df, key_cols=key_cols)
         configs = {
             name: _config_from_conf(
-                name,
-                _resolve_season_conf(
-                    _resolve_holidays_conf(c, batch, horizon), batch
-                ),
+                name, _resolve_model_conf(name, c, batch, horizon, cv_conf)
             )
             for name, c in (mc.get("configs") or {}).items()
         }
@@ -917,7 +936,9 @@ class TrainingPipeline:
             df.groupby(["date", "item"], as_index=False)["sales"].sum()
         )
         batch = tensorize(item_df, key_cols=("item",))
-        config = _config_from_conf(model, _resolve_season_conf(model_conf, batch))
+        config = _config_from_conf(
+            model, _resolve_model_conf(model, model_conf, batch, horizon)
+        )
         key = jax.random.PRNGKey(seed)
         params, result = fit_forecast(
             batch, model=model, config=config, horizon=horizon, key=key

@@ -26,7 +26,11 @@ Librarized equivalent of the reference's training notebook entry point
                                     # scan families (holt_winters, theta)
                                     # accept season_length: auto — the
                                     # dominant period is detected from the
-                                    # batch (engine/season)
+                                    # batch (engine/season); arima accepts
+                                    # order: auto (CV sweep over a (p,d,q)
+                                    # ladder, engine/order) or an explicit
+                                    # order: [p, d, q] triple, optionally
+                                    # order_candidates: [[...], ...]
       cv: {initial: 730, period: 360, horizon: 90}
       horizon: 90
       experiment: finegrain_forecasting
