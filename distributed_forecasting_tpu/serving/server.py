@@ -102,7 +102,9 @@ class _Handler(BaseHTTPRequestHandler):
                 200,
                 {
                     "status": "ok",
-                    "model": getattr(fc, "model", "ensemble"),
+                    # every serving class exposes .family ("blend:..."/
+                    # "auto:..." for composites, the family name otherwise)
+                    "model": fc.family,
                     # n_series, not .keys: the span-bucketed composite has
                     # no top-level key table, only per-bucket routing
                     "n_series": int(fc.n_series),

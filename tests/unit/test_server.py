@@ -267,3 +267,57 @@ def test_bucketed_artifact_serves_health_and_invocations(tmp_path):
         assert code == 200 and len(out["predictions"]) == 14
     finally:
         srv.shutdown()
+
+
+def test_blend_artifact_serves_end_to_end(tmp_path):
+    """A BlendedForecaster artifact loads through the dispatcher and serves
+    /health (family = 'blend:...'), /invocations, and quantiles."""
+    import numpy as np
+    import pandas as pd
+
+    from distributed_forecasting_tpu.data import tensorize
+    from distributed_forecasting_tpu.engine import CVConfig, fit_forecast_blend
+    from distributed_forecasting_tpu.serving import BlendedForecaster
+
+    rng = np.random.default_rng(4)
+    T = 720
+    t = np.arange(T)
+    rows = []
+    for item in (1, 2, 3):
+        rows.append(pd.DataFrame({
+            "date": pd.date_range("2020-01-01", periods=T), "store": 1,
+            "item": item,
+            "sales": 50 + 8 * np.sin(2 * np.pi * t / 7) + rng.normal(0, 1, T),
+        }))
+    batch = tensorize(pd.concat(rows, ignore_index=True))
+    params, blend, _ = fit_forecast_blend(
+        batch, models=("theta", "holt_winters"),
+        cv=CVConfig(initial=360, period=180, horizon=60), horizon=14,
+    )
+    fc = BlendedForecaster.from_fit(batch, params, None, blend)
+    art = str(tmp_path / "blend_art")
+    fc.save(art)
+    loaded = load_forecaster(art)
+    assert isinstance(loaded, BlendedForecaster)
+
+    srv = start_server(loaded, model_version="7")
+    try:
+        code, out = _call(srv, "/health", None)
+        assert code == 200
+        assert out["model"] == "blend:theta,holt_winters"
+        assert out["n_series"] == 3
+        code, out = _call(
+            srv, "/invocations",
+            {"inputs": [{"store": 1, "item": 2}], "horizon": 7},
+        )
+        assert code == 200 and len(out["predictions"]) == 7
+        code, out = _call(
+            srv, "/invocations",
+            {"inputs": [{"store": 1, "item": 1}], "horizon": 7,
+             "quantiles": [0.1, 0.9]},
+        )
+        assert code == 200
+        row = out["predictions"][0]
+        assert row["q0.1"] <= row["q0.9"]
+    finally:
+        srv.shutdown()
