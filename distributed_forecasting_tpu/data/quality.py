@@ -51,14 +51,28 @@ def quality_report(
     df: pd.DataFrame,
     min_days: int = 60,
     max_gap_ratio: float = 0.5,
+    freq: str = "D",
 ) -> QualityReport:
     """Vectorized quality pre-pass over the ``(date, store, item, sales)``
-    long frame; every check is a groupby/reduction, no per-series Python."""
-    # normalize to CALENDAR DAYS first: tensorize floors timestamps to its
-    # day grid and SUMS same-day rows, so an intraday feed ('08:00' and
-    # '20:00' rows) is a duplicate incident even though the raw timestamps
-    # differ — checking at raw precision would miss exactly that class
-    dates = pd.to_datetime(df["date"]).dt.normalize()
+    long frame; every check is a groupby/reduction, no per-series Python.
+
+    ``freq`` matches the cadence the feed will be tensorized at: a weekly
+    feed checked at daily precision would false-alarm a 6/7 "gap ratio"
+    and miss same-week duplicates.  ``min_days`` counts PERIODS of that
+    cadence.
+    """
+    # normalize to the tensorize grid first: tensorize buckets timestamps
+    # to freq periods and SUMS same-period rows, so an intraday feed
+    # ('08:00' and '20:00' rows) is a duplicate incident even though the
+    # raw timestamps differ — checking at raw precision would miss
+    # exactly that class
+    if freq == "D":
+        dates = pd.to_datetime(df["date"]).dt.normalize()
+    else:
+        dates = pd.PeriodIndex(
+            pd.to_datetime(df["date"]), freq=freq
+        ).to_timestamp()
+        dates = pd.Series(dates, index=df.index)
     sales = df["sales"].to_numpy(dtype=float)
 
     if len(df) == 0:
@@ -79,7 +93,16 @@ def quality_report(
     n_neg = int((sales < 0).sum())
     n_nonfin = int((~np.isfinite(sales)).sum())
 
-    span_days = (grp["_d"].max() - grp["_d"].min()).dt.days + 1
+    step_days = {"D": 1, "W": 7}.get(freq)
+    if step_days is not None:
+        span_days = (
+            (grp["_d"].max() - grp["_d"].min()).dt.days // step_days + 1
+        )
+    else:  # monthly periods: count via period arithmetic
+        span_days = (
+            (grp["_d"].max().dt.to_period(freq)
+             - grp["_d"].min().dt.to_period(freq)).apply(lambda o: o.n) + 1
+        )
     observed = grp["_d"].nunique()
     gap_cells = (span_days - observed).clip(lower=0)
     gap_ratio = float(gap_cells.sum() / max(int(span_days.sum()), 1))

@@ -38,12 +38,20 @@ class SeriesBatch:
     Device-side leaves (pytree):
       y:    (S, T) float32  observed values, 0 where unobserved
       mask: (S, T) float32  1.0 where observed, 0.0 where padded/missing
-      day:  (T,)   int32    absolute day number (days since Unix epoch)
+      day:  (T,)   int32    absolute period ordinal — for the default daily
+            cadence this is days since the Unix epoch (the pandas daily
+            Period ordinal); for freq="W"/"M" it is the week/month ordinal
 
     Host-side static metadata:
       keys:  (S, k) int64 numpy array of series keys (e.g. store, item)
       key_names: names of the key columns
-      start_date: ISO date of day[0] (grid origin)
+      start_date: ISO date of day[0]'s period start (grid origin)
+      freq: grid cadence — "D" (default), "W", or "M".  Models are
+            cadence-agnostic (they see a contiguous int grid; horizons,
+            seasonal periods, and CV windows are all in STEPS of this
+            cadence); only date rendering and calendar-bound features
+            (the curve model's weekly/yearly Fourier, holiday calendars,
+            daily regressor grids) depend on it.
     """
 
     y: jax.Array
@@ -52,6 +60,7 @@ class SeriesBatch:
     keys: np.ndarray = dataclasses.field(metadata=dict(static=True))
     key_names: tuple = dataclasses.field(metadata=dict(static=True))
     start_date: str = dataclasses.field(metadata=dict(static=True))
+    freq: str = dataclasses.field(default="D", metadata=dict(static=True))
 
     @property
     def n_series(self) -> int:
@@ -62,8 +71,14 @@ class SeriesBatch:
         return self.y.shape[1]
 
     def dates(self) -> pd.DatetimeIndex:
-        """Reconstruct the shared daily date grid on the host."""
-        return pd.date_range(self.start_date, periods=self.n_time, freq="D")
+        """Reconstruct the shared date grid on the host (period-start
+        timestamps for non-daily cadences)."""
+        if self.freq == "D":
+            return pd.date_range(self.start_date, periods=self.n_time,
+                                 freq="D")
+        return pd.period_range(
+            self.start_date, periods=self.n_time, freq=self.freq
+        ).to_timestamp()
 
     def key_frame(self) -> pd.DataFrame:
         return pd.DataFrame(np.asarray(self.keys), columns=list(self.key_names))
@@ -102,6 +117,37 @@ def _epoch_days(dates) -> np.ndarray:
     return (
         d.values.astype("datetime64[D]") - np.datetime64("1970-01-01", "D")
     ).astype(np.int64)
+
+
+VALID_FREQS = ("D", "W", "M")
+
+
+def period_ordinals(dates, freq: str = "D") -> np.ndarray:
+    """Date-like column -> int64 pandas Period ordinals at ``freq``.
+
+    For "D" this IS days-since-epoch (same integers ``_epoch_days``
+    produces, kept as the fast path); "W"/"M" map every date inside a
+    week/month to that period's ordinal — so tensorizing a daily feed at a
+    coarser freq SUMS it into period buckets (the GROUP BY semantics
+    duplicates already follow).
+    """
+    if freq == "D":
+        return _epoch_days(dates)
+    if freq not in VALID_FREQS:
+        raise ValueError(f"unknown freq {freq!r}; valid: {VALID_FREQS}")
+    return pd.PeriodIndex(pd.to_datetime(dates), freq=freq).asi8
+
+
+def ordinals_to_dates(ordinals, freq: str = "D") -> pd.DatetimeIndex:
+    """Absolute period ordinals -> period-start timestamps — the ONE
+    inverse mapping every long output frame uses (engine
+    ``long_frame_skeleton``, serving)."""
+    arr = np.asarray(ordinals, dtype="int64")
+    if freq == "D":
+        return pd.to_datetime(arr, unit="D", origin="unix")
+    if freq not in VALID_FREQS:
+        raise ValueError(f"unknown freq {freq!r}; valid: {VALID_FREQS}")
+    return pd.PeriodIndex.from_ordinals(arr, freq=freq).to_timestamp()
 
 
 def bucket_by_span(batch: SeriesBatch, max_buckets: int = 4):
@@ -150,6 +196,10 @@ def bucket_by_span(batch: SeriesBatch, max_buckets: int = 4):
         if idx.size == 0:
             continue
         assigned[idx] = True
+        # origin from the trimmed grid's first PERIOD ordinal — shifting
+        # the old start_date by (T - L) days would be ~7x/30x off for
+        # weekly/monthly cadences
+        d0 = int(np.asarray(batch.day[T - L]))
         sub = dataclasses.replace(
             batch,
             y=batch.y[idx, T - L:],
@@ -157,7 +207,7 @@ def bucket_by_span(batch: SeriesBatch, max_buckets: int = 4):
             day=batch.day[T - L:],
             keys=batch.keys[idx],
             start_date=str(
-                (pd.Timestamp(batch.start_date) + pd.Timedelta(days=T - L)).date()
+                pd.Period(ordinal=d0, freq=batch.freq).start_time.date()
             ),
         )
         buckets.append((idx, sub))
@@ -209,6 +259,7 @@ def tensorize(
     value_col: str = "sales",
     dtype=jnp.float32,
     backend: str = "auto",
+    freq: str = "D",
 ) -> SeriesBatch:
     """Long table ``(date, *keys, value)`` -> :class:`SeriesBatch`.
 
@@ -226,14 +277,24 @@ def tensorize(
     equivalence is tested in ``tests/unit/test_native.py``.
     """
     df = df[[date_col, *key_cols, value_col]].copy()
-    day = _epoch_days(df[date_col])
+    day = period_ordinals(df[date_col], freq)
     d0, d1 = int(day.min()), int(day.max())
     T = d1 - d0 + 1
 
     keys_df = df[list(key_cols)].astype(np.int64)
     vals = df[value_col].to_numpy(dtype=np.float64)
 
-    if resolved_backend(n_keys=len(key_cols), backend=backend) == "native":
+    # the C++ fast path speaks epoch-days only; non-daily grids take numpy
+    if backend == "native" and freq != "D":
+        raise ValueError(
+            f"backend='native' supports freq='D' only (the C++ path speaks "
+            f"epoch-days); freq={freq!r} uses the numpy path"
+        )
+    use_native = (
+        freq == "D"
+        and resolved_backend(n_keys=len(key_cols), backend=backend) == "native"
+    )
+    if use_native:
         from distributed_forecasting_tpu.data import native
 
         y32, m, day_grid, uniq = native.tensorize_arrays(
@@ -249,6 +310,7 @@ def tensorize(
             keys=uniq,
             key_names=tuple(key_cols),
             start_date=str(np.datetime64(d0, "D")),
+            freq="D",
         )
 
     uniq, series_idx = np.unique(keys_df.values, axis=0, return_inverse=True)
@@ -260,7 +322,10 @@ def tensorize(
     np.add.at(y, (series_idx, tpos), vals)
     m[series_idx, tpos] = 1.0
 
-    start_date = str(np.datetime64(d0, "D"))
+    if freq == "D":
+        start_date = str(np.datetime64(d0, "D"))
+    else:
+        start_date = str(pd.Period(ordinal=d0, freq=freq).start_time.date())
     return SeriesBatch(
         y=jnp.asarray(y, dtype=dtype),
         mask=jnp.asarray(m, dtype=dtype),
@@ -268,6 +333,7 @@ def tensorize(
         keys=uniq,
         key_names=tuple(key_cols),
         start_date=start_date,
+        freq=freq,
     )
 
 
@@ -310,6 +376,12 @@ def tensorize_regressors(
     Missing days are forward- then back-filled along time (a price stays in
     force until changed); regressors never observed for a series fill 0.
     """
+    if getattr(batch, "freq", "D") != "D":
+        raise ValueError(
+            "regressor tensorization resolves on a daily calendar grid; "
+            f"the batch's cadence is {batch.freq!r} — regressors require "
+            "freq='D'"
+        )
     return regressors_for_grid(
         df,
         day0=int(np.asarray(batch.day[0])),

@@ -81,9 +81,11 @@ def _cv_entry(batch, model, config, key, xreg, what):
         key = jax.random.PRNGKey(0)
     from distributed_forecasting_tpu.engine.fit import (
         validate_changepoint_days,
+        validate_grid_cadence,
         validate_xreg,
     )
 
+    validate_grid_cadence(model, batch)
     validate_changepoint_days(config, batch.day)
     xreg = validate_xreg(fns, model, config, xreg, None, what,
                          trim_to=batch.n_time)

@@ -171,6 +171,27 @@ def validate_xreg(fns, model: str, config, xreg, expected_T, what: str,
     return xreg
 
 
+_CALENDAR_DAILY_MODELS = frozenset({"prophet", "curve", "prophet_ar"})
+
+
+def validate_grid_cadence(model: str, batch) -> None:
+    """Library-level cadence guard: the curve family's weekly/yearly
+    Fourier periods and holiday day-math are CALENDAR-DAILY constructs —
+    fitting them on week/month ordinals silently turns the period-7
+    "weekly" term into a 7-week cycle.  Every engine entry funnels
+    through here (fit_forecast and the CV preamble), so a one-line
+    library call like ``fit_forecast(tensorize(df, freq="W"),
+    model="prophet")`` errors clearly instead of returning
+    plausible-looking garbage; the cadence-agnostic families pass."""
+    if model in _CALENDAR_DAILY_MODELS and getattr(batch, "freq", "D") != "D":
+        raise ValueError(
+            f"model {model!r} is calendar-daily (weekly/yearly Fourier, "
+            f"holiday day-math) but the batch's grid cadence is "
+            f"{batch.freq!r}; use a cadence-agnostic family "
+            f"(holt_winters/arima/theta/croston) or tensorize at freq='D'"
+        )
+
+
 def validate_changepoint_days(config, day) -> None:
     """Static guard for explicit changepoint sites (curve model).
 
@@ -258,6 +279,7 @@ def fit_forecast(
     ``supports_xreg`` and ``config.n_regressors == R``.
     """
     fns = get_model(model)
+    validate_grid_cadence(model, batch)
     config = config if config is not None else fns.config_cls()
     if key is None:
         key = jax.random.PRNGKey(0)
@@ -491,15 +513,16 @@ def fit_forecast_bucketed(
     return bucket_params, result
 
 
-def long_frame_skeleton(keys, key_names, day_all) -> dict:
+def long_frame_skeleton(keys, key_names, day_all, freq: str = "D") -> dict:
     """``[ds, *keys]`` columns of a long (series x day) table — one place
     for the tile/repeat layout so every long output (forecast_frame, the
-    curve model's component_frame) stays aligned."""
+    curve model's component_frame) stays aligned.  ``freq`` maps the
+    period ordinals back to timestamps (data/tensorize.ordinals_to_dates)."""
+    from distributed_forecasting_tpu.data.tensorize import ordinals_to_dates
+
     keys = np.asarray(keys)
     T_all = int(day_all.shape[0])
-    dates = pd.to_datetime(
-        np.asarray(day_all, dtype="int64"), unit="D", origin="unix"
-    )
+    dates = ordinals_to_dates(np.asarray(day_all, dtype="int64"), freq)
     frame = {"ds": np.tile(dates.values, keys.shape[0])}
     for j, name in enumerate(key_names):
         frame[name] = np.repeat(keys[:, j], T_all)
@@ -522,7 +545,8 @@ def forecast_frame(
     m_hist = np.asarray(batch.mask) > 0
     y_full[:, :T_hist] = np.where(m_hist, y_hist, np.nan)
 
-    frame = long_frame_skeleton(batch.keys, batch.key_names, result.day_all)
+    frame = long_frame_skeleton(batch.keys, batch.key_names, result.day_all,
+                                freq=batch.freq)
     frame["y"] = y_full.reshape(-1)
     frame["yhat"] = np.asarray(result.yhat).reshape(-1)
     frame["yhat_upper"] = np.asarray(result.hi).reshape(-1)

@@ -956,7 +956,8 @@ def component_frame(batch, params: CurveParams, config: CurveModelConfig,
     day_all = day_grid(batch.day, horizon)
     comps = decompose(params, day_all, config, xreg=xreg,
                       t_end=batch.day[-1].astype(jnp.float32))
-    frame = long_frame_skeleton(batch.keys, batch.key_names, day_all)
+    frame = long_frame_skeleton(batch.keys, batch.key_names, day_all,
+                                freq=batch.freq)
     for name, vals in comps.items():
         frame[name] = np.asarray(vals).reshape(-1)
     return pd.DataFrame(frame)

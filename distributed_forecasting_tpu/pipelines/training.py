@@ -60,6 +60,44 @@ def _config_from_conf(model: str, model_conf: Optional[Dict[str, Any]]):
     )
 
 
+_CALENDAR_DAILY_FAMILIES = frozenset({"prophet", "curve", "prophet_ar"})
+
+
+def _check_cadence(freq: str, model: str, model_conf, regressors=None,
+                   tuning=None):
+    """Non-daily grids work for every cadence-agnostic family (HW, arima,
+    theta, croston — they see a contiguous step grid), but the curve
+    model's weekly/yearly Fourier, holiday calendars, daily regressor
+    grids, and the tuned path are CALENDAR-DAILY constructs; a clear
+    error here beats silently fitting a 7-step "weekly" seasonality on
+    weekly-cadence data."""
+    if freq == "D":
+        return
+    fams = set()
+    if model in ("auto", "blend"):
+        from distributed_forecasting_tpu.engine.select import DEFAULT_FAMILIES
+
+        fams = set((model_conf or {}).get("families", DEFAULT_FAMILIES))
+    bad = ({model} | fams) & _CALENDAR_DAILY_FAMILIES
+    if bad or (tuning and tuning.get("enabled")):
+        raise ValueError(
+            f"training.freq={freq!r}: the curve model's seasonalities and "
+            f"the tuned path are calendar-daily; use the cadence-agnostic "
+            f"families (holt_winters/arima/theta/croston) or freq: D"
+            + (f" (conf names {sorted(bad)})" if bad else "")
+        )
+    if regressors:
+        raise ValueError(
+            f"training.freq={freq!r}: conf-driven regressors resolve on a "
+            f"daily calendar grid; use freq: D"
+        )
+    if isinstance((model_conf or {}).get("holidays"), (str, dict)):
+        raise ValueError(
+            f"training.freq={freq!r}: holiday calendars are daily; "
+            f"use freq: D"
+        )
+
+
 def _resolve_model_conf(
     model: str,
     model_conf: Optional[Dict[str, Any]],
@@ -94,7 +132,11 @@ def _resolve_season_conf(
     from distributed_forecasting_tpu.engine.season import detect_season_length
 
     out = dict(model_conf)
-    out["season_length"] = detect_season_length(batch)
+    # the no-detectable-period fallback must match the grid cadence: 7 is
+    # the daily-domain default; a weekly/monthly grid falls back to its
+    # natural yearly period instead of a meaningless 7-week/7-month cycle
+    default = {"D": 7, "W": 52, "M": 12}.get(batch.freq, 7)
+    out["season_length"] = detect_season_length(batch, default=default)
     return out
 
 
@@ -203,6 +245,7 @@ class TrainingPipeline:
         regressors: Optional[Dict[str, Any]] = None,
         cv_artifact: bool = False,
         calibrate_intervals: bool = False,
+        freq: str = "D",
     ) -> Dict[str, Any]:
         if regressors:
             from distributed_forecasting_tpu.models.base import get_model
@@ -251,6 +294,8 @@ class TrainingPipeline:
                     "run_cross_validation: the CV residuals ARE the "
                     "calibration set"
                 )
+        _check_cadence(freq, model, model_conf, regressors=regressors,
+                       tuning=tuning)
         if tuning and tuning.get("enabled"):
             if bucketed:
                 raise ValueError(
@@ -271,7 +316,7 @@ class TrainingPipeline:
                     else self._fine_grained_blend)
             return impl(
                 source_table, output_table, model_conf, cv_conf,
-                experiment, horizon, key_cols, seed,
+                experiment, horizon, key_cols, seed, freq=freq,
             )
         from distributed_forecasting_tpu.utils.profiling import PhaseTimer, device_trace
 
@@ -279,7 +324,7 @@ class TrainingPipeline:
         with timer.phase("read"):
             df = self.catalog.read_table(source_table)
         with timer.phase("tensorize"):
-            batch = tensorize(df, key_cols=key_cols)
+            batch = tensorize(df, key_cols=key_cols, freq=freq)
         # config AFTER tensorize: a named holiday calendar resolves over the
         # batch's actual date range (+horizon)
         config = _config_from_conf(
@@ -408,7 +453,11 @@ class TrainingPipeline:
                     # which host data plane produced the tensor (the
                     # phase_tensorize_seconds metric is comparable across
                     # backends; see data/tensorize.py)
-                    "tensorize_backend": resolved_backend(n_keys=len(key_cols)),
+                    # the native path is daily-only; record what actually ran
+                    "tensorize_backend": (
+                        resolved_backend(n_keys=len(key_cols))
+                        if batch.freq == "D" else "pandas"
+                    ),
                 }
             )
             agg = {"fit_seconds": fit_seconds,
@@ -651,6 +700,7 @@ class TrainingPipeline:
         horizon: int,
         key_cols,
         seed: int,
+        freq: str = "D",
     ) -> Dict[str, Any]:
         """Per-series best-of across model families (``engine/select.py``) —
         the cross-family analogue of the AutoML path's per-series tuning.
@@ -668,7 +718,7 @@ class TrainingPipeline:
         cv = CVConfig(**(cv_conf or {}))
 
         df = self.catalog.read_table(source_table)
-        batch = tensorize(df, key_cols=key_cols)
+        batch = tensorize(df, key_cols=key_cols, freq=freq)
         configs = {
             name: _config_from_conf(
                 name, _resolve_model_conf(name, c, batch, horizon, cv_conf)
@@ -754,6 +804,7 @@ class TrainingPipeline:
         horizon: int,
         key_cols,
         seed: int,
+        freq: str = "D",
     ) -> Dict[str, Any]:
         """Per-series weighted cross-family pool (``engine/blend``) — where
         the auto path picks each series' single winner, this combines all
@@ -772,7 +823,7 @@ class TrainingPipeline:
         cv = CVConfig(**(cv_conf or {}))
 
         df = self.catalog.read_table(source_table)
-        batch = tensorize(df, key_cols=key_cols)
+        batch = tensorize(df, key_cols=key_cols, freq=freq)
         configs = {
             name: _config_from_conf(
                 name, _resolve_model_conf(name, c, batch, horizon, cv_conf)
@@ -922,6 +973,7 @@ class TrainingPipeline:
         experiment: str = "allocated_forecasting",
         horizon: int = 90,
         seed: int = 0,
+        freq: str = "D",
     ) -> Dict[str, Any]:
         """Item-level fit + store-share allocation.
 
@@ -930,12 +982,13 @@ class TrainingPipeline:
         historical share ``sales / SUM(sales) OVER (PARTITION BY item)``;
         scale item forecasts down to (store, item) granularity.
         """
+        _check_cadence(freq, model, model_conf)
         df = self.catalog.read_table(source_table)
 
         item_df = (
             df.groupby(["date", "item"], as_index=False)["sales"].sum()
         )
-        batch = tensorize(item_df, key_cols=("item",))
+        batch = tensorize(item_df, key_cols=("item",), freq=freq)
         config = _config_from_conf(
             model, _resolve_model_conf(model, model_conf, batch, horizon)
         )

@@ -126,14 +126,18 @@ class BatchForecaster:
         day0: int,
         day1: int,
         interval_scale: Optional[np.ndarray] = None,
+        freq: str = "D",
     ):
         self.model = model
         self.config = config
         self.params = params
         self.keys = np.asarray(keys)
         self.key_names = tuple(key_names)
-        self.day0 = int(day0)  # first training day (absolute day number)
-        self.day1 = int(day1)  # last training day
+        self.day0 = int(day0)  # first training period ordinal (day number
+        self.day1 = int(day1)  # at the default daily cadence); see freq
+        # grid cadence ("D"/"W"/"M") — horizons are in STEPS of it and ds
+        # columns render as its period-start timestamps
+        self.freq = str(freq)
         # (S,) per-series conformal band scale (engine/calibrate) — applied
         # multiplicatively to both half-bands at predict time; None = the
         # model's parametric bands ship as-is
@@ -165,6 +169,7 @@ class BatchForecaster:
             day0=day0,
             day1=day1,
             interval_scale=interval_scale,
+            freq=batch.freq,
         )
 
     # -- persistence --------------------------------------------------------
@@ -191,6 +196,7 @@ class BatchForecaster:
             "keys": self.keys.tolist(),
             "day0": self.day0,
             "day1": self.day1,
+            "freq": self.freq,
             "serving_schema": self.serving_schema,
         }
         with open(os.path.join(directory, _META_FILE), "w") as f:
@@ -221,6 +227,7 @@ class BatchForecaster:
             day0=meta["day0"],
             day1=meta["day1"],
             interval_scale=interval_scale,
+            freq=meta.get("freq", "D"),  # pre-cadence artifacts are daily
         )
 
     # -- inference ----------------------------------------------------------
@@ -350,8 +357,11 @@ class BatchForecaster:
         """ds + key columns for a long result frame over ``day_all`` —
         shared by predict and predict_quantiles so the date/key assembly
         cannot drift between them."""
+        from distributed_forecasting_tpu.data.tensorize import ordinals_to_dates
+
         T = day_all.shape[0]
-        dates = pd.to_datetime(np.asarray(day_all, dtype="int64"), unit="D")
+        dates = ordinals_to_dates(np.asarray(day_all, dtype="int64"),
+                                  self.freq)
         frame = {"ds": np.tile(dates.values, len(sidx))}
         for j, name in enumerate(self.key_names):
             frame[name] = np.repeat(self.keys[sidx, j], T)

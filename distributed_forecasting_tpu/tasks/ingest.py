@@ -12,6 +12,9 @@ Conf::
       validate: true                 # data-quality pre-pass (duplicates,
       validate_min_days: 60          # negatives, gaps, constant series) —
       validate_strict: false         # warn-only unless strict
+      freq: D                        # cadence the feed will be tensorized
+                                     # at (D | W | M): gap/duplicate checks
+                                     # run at that period precision
     output:
       table: hackathon.sales.raw
 """
@@ -50,7 +53,8 @@ class IngestTask(Task):
             from distributed_forecasting_tpu.data.quality import quality_report
 
             report = quality_report(
-                df, min_days=int(inp.get("validate_min_days", 60))
+                df, min_days=int(inp.get("validate_min_days", 60)),
+                freq=str(inp.get("freq", "D")),
             )
             for issue in report.issues:
                 self.logger.warning("data quality: %s", issue)

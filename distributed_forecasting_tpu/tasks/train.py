@@ -33,6 +33,15 @@ Librarized equivalent of the reference's training notebook entry point
                                     # order_candidates: [[...], ...]
       cv: {initial: 730, period: 360, horizon: 90}
       horizon: 90
+      freq: D                       # grid cadence: D (default) | W | M.
+                                    # Non-daily grids work with the
+                                    # cadence-agnostic families
+                                    # (holt_winters/arima/theta/croston);
+                                    # horizons, CV windows, and seasonal
+                                    # periods are then in STEPS (weeks/
+                                    # months), and ds renders period-start
+                                    # dates.  A daily feed tensorized at
+                                    # W/M is summed into period buckets.
       experiment: finegrain_forecasting
       per_series_runs: false
       cv_artifact: false            # also log the raw per-cutoff CV
@@ -87,6 +96,7 @@ class TrainTask(Task):
                 model_conf=tr.get("model_conf"),
                 experiment=tr.get("experiment", "allocated_forecasting"),
                 horizon=int(tr.get("horizon", 90)),
+                freq=str(tr.get("freq", "D")),
             )
         return pipeline.fine_grained(
             source_table=inp.get("table", "hackathon.sales.raw"),
@@ -103,6 +113,7 @@ class TrainTask(Task):
             regressors=tr.get("regressors"),
             cv_artifact=bool(tr.get("cv_artifact", False)),
             calibrate_intervals=bool(tr.get("calibrate_intervals", False)),
+            freq=str(tr.get("freq", "D")),
         )
 
 
