@@ -60,6 +60,9 @@ class BlendResult:
     scores: pd.DataFrame      # (S, F) per-family CV metric
     metric: str
     valid: np.ndarray         # (S,) bool — at least one family scored finite
+    # (S,) split-conformal band scale for the POOLED forecast, filled by
+    # fit_forecast_blend(calibrate=True); None = uncalibrated
+    interval_scale: Optional[np.ndarray] = None
 
     def mean_weights(self) -> Dict[str, float]:
         return {
@@ -114,6 +117,80 @@ def blend_weights(
     )
 
 
+def _blend_conformal_scale(batch, blend: BlendResult, configs, cv, key):
+    """Split-conformal scale for the POOLED band: blend each family's CV
+    paths with the per-series weights (the same linear rules the final
+    forecast uses), then score the pooled residuals against the pooled
+    half-band — so the calibration set is exactly the forecast being
+    shipped, not any single member's.
+
+    Materializes F sets of (C, S, T) paths (one cross-family CV pass);
+    diagnostics-scale by design, like ``cv_artifact`` — the 50k regime
+    should calibrate per family or not at all.
+    """
+    import jax.numpy as jnp
+
+    from distributed_forecasting_tpu.engine.calibrate import (
+        conformal_scale_from_paths,
+    )
+    from distributed_forecasting_tpu.engine.cv import (
+        _cv_entry,
+        _cv_paths_impl,
+        cutoff_indices,
+    )
+
+    from distributed_forecasting_tpu.engine.calibrate import (
+        config_interval_width,
+    )
+
+    w = blend.weights
+    yhat_b = up_b = None
+    eval_masks = None
+    widths = {}
+    for i, name in enumerate(blend.models):
+        config, k, _ = _cv_entry(batch, name, configs.get(name),
+                                 jax.random.fold_in(key, i), None,
+                                 "fit_forecast_blend(calibrate=True)")
+        widths[name] = config_interval_width(config)
+        cuts = cutoff_indices(batch.n_time, cv)
+        yhat, lo, hi, em, _ = _cv_paths_impl(
+            batch.y, batch.mask, batch.day, k,
+            model=name, config=config, cuts=tuple(cuts), horizon=cv.horizon,
+        )
+        wf = jnp.asarray(w[:, i])[None, :, None]  # broadcast over (C, S, T)
+        if yhat_b is None:
+            yhat_b = wf * yhat
+            up_b = wf * (hi - yhat)
+            eval_masks = em
+        else:
+            yhat_b = yhat_b + wf * yhat
+            up_b = up_b + wf * (hi - yhat)
+    if len(set(widths.values())) > 1:
+        # a pooled band calibrated "at 95%" while one member prices 80%
+        # would be a silent, ill-defined target — make the choice explicit
+        raise ValueError(
+            f"calibrate=True needs ONE interval_width across the pool, got "
+            f"{widths}; align the member configs"
+        )
+    return np.asarray(conformal_scale_from_paths(
+        batch.y, yhat_b, yhat_b + up_b, eval_masks,
+        interval_width=next(iter(widths.values())),
+    ))
+
+
+def blend_band_floor(models) -> object:
+    """The pooled band's hard floor: the loosest bound EVERY member
+    guarantees (min over declared floors), or None when any member is
+    unbounded below — shared by the engine result and serving so the two
+    cannot drift."""
+    from distributed_forecasting_tpu.models.base import get_model
+
+    floors = [get_model(name).band_floor for name in models]
+    if any(f is None for f in floors):
+        return None
+    return min(floors)
+
+
 def fit_forecast_blend(
     batch: SeriesBatch,
     models: Sequence[str] = DEFAULT_FAMILIES,
@@ -124,11 +201,15 @@ def fit_forecast_blend(
     key: Optional[jax.Array] = None,
     blend: Optional[BlendResult] = None,
     temperature: float = 1.0,
+    calibrate: bool = False,
 ) -> Tuple[Dict[str, object], BlendResult, ForecastResult]:
     """Weight per series, fit every family on full history, combine.
 
     Returns ``(params_by_family, blend, result)``; the params dict plus
-    ``blend.weights`` feed ``serving.BlendedForecaster``.
+    ``blend.weights`` feed ``serving.BlendedForecaster``.  With
+    ``calibrate=True`` the pooled band is split-conformal calibrated from
+    the pooled CV residuals (``blend.interval_scale``; applied to the
+    returned result's bands).
     """
     configs = configs or {}
     if key is None:
@@ -137,6 +218,12 @@ def fit_forecast_blend(
         blend = blend_weights(
             batch, models=models, configs=configs, metric=metric, cv=cv,
             key=key, temperature=temperature,
+        )
+    if calibrate and blend.interval_scale is None:
+        blend = dataclasses.replace(
+            blend,
+            interval_scale=_blend_conformal_scale(batch, blend, configs, cv,
+                                                  jax.random.fold_in(key, 77)),
         )
 
     params_by_family: Dict[str, object] = {}
@@ -167,7 +254,17 @@ def fit_forecast_blend(
             dn = dn + wf * (res.yhat - res.lo)
             ok = ok & carries_ok
     ok = ok & jnp.asarray(blend.valid)
+    lo_b, hi_b = yhat - dn, yhat + up
+    if blend.interval_scale is not None:
+        from distributed_forecasting_tpu.engine.calibrate import (
+            apply_interval_scale,
+        )
+
+        _, lo_b, hi_b = apply_interval_scale(
+            yhat, lo_b, hi_b, jnp.asarray(blend.interval_scale),
+            floor=blend_band_floor(blend.models),
+        )
     result = ForecastResult(
-        yhat=yhat, lo=yhat - dn, hi=yhat + up, ok=ok, day_all=day_all
+        yhat=yhat, lo=lo_b, hi=hi_b, ok=ok, day_all=day_all
     )
     return params_by_family, blend, result

@@ -276,10 +276,10 @@ class TrainingPipeline:
             # scoped to the plain path: the CV pass that calibration reuses
             # runs there; silently ignoring the flag elsewhere would ship
             # uncalibrated bands the operator believes are calibrated
-            if model in ("auto", "blend") or (tuning and tuning.get("enabled")):
+            if model == "auto" or (tuning and tuning.get("enabled")):
                 raise ValueError(
-                    "training.calibrate_intervals is only supported on the "
-                    "plain fine-grained path (not model='auto'/'blend' or "
+                    "training.calibrate_intervals is supported on the plain "
+                    "and model='blend' paths (not model='auto' or "
                     "tuning.enabled)"
                 )
             if bucketed:
@@ -288,7 +288,9 @@ class TrainingPipeline:
                     "with training.bucketed — the bucketed artifact has no "
                     "shared series axis to carry per-series scales"
                 )
-            if not run_cross_validation:
+            if not run_cross_validation and model != "blend":
+                # the blend path always runs its own CV pass (weights AND
+                # calibration), so the flag is irrelevant there
                 raise ValueError(
                     "training.calibrate_intervals requires "
                     "run_cross_validation: the CV residuals ARE the "
@@ -312,9 +314,13 @@ class TrainingPipeline:
                     f"training.bucketed is not supported together with "
                     f"model={model!r} — pooled fits run on the shared grid"
                 )
-            impl = (self._fine_grained_auto if model == "auto"
-                    else self._fine_grained_blend)
-            return impl(
+            if model == "blend":
+                return self._fine_grained_blend(
+                    source_table, output_table, model_conf, cv_conf,
+                    experiment, horizon, key_cols, seed, freq=freq,
+                    calibrate_intervals=calibrate_intervals,
+                )
+            return self._fine_grained_auto(
                 source_table, output_table, model_conf, cv_conf,
                 experiment, horizon, key_cols, seed, freq=freq,
             )
@@ -805,6 +811,7 @@ class TrainingPipeline:
         key_cols,
         seed: int,
         freq: str = "D",
+        calibrate_intervals: bool = False,
     ) -> Dict[str, Any]:
         """Per-series weighted cross-family pool (``engine/blend``) — where
         the auto path picks each series' single winner, this combines all
@@ -834,7 +841,7 @@ class TrainingPipeline:
         params_by_family, blend, result = fit_forecast_blend(
             batch, models=families, configs=configs, metric=metric, cv=cv,
             horizon=horizon, key=jax.random.PRNGKey(seed),
-            temperature=temperature,
+            temperature=temperature, calibrate=calibrate_intervals,
         )
         jax.block_until_ready(result.yhat)
         fit_seconds = time.time() - t_start
@@ -878,6 +885,11 @@ class TrainingPipeline:
             )
             series_table = batch.key_frame()
             series_table[f"blended_{metric}"] = blended_score
+            if blend.interval_scale is not None:
+                series_table["interval_scale"] = blend.interval_scale
+                run.log_metrics({"interval_scale_mean": float(
+                    np.nanmean(blend.interval_scale[valid])
+                ) if valid.any() else float("nan")})
             for i, name in enumerate(blend.models):
                 series_table[f"weight_{name}"] = blend.weights[:, i]
                 series_table[f"{metric}_{name}"] = blend.scores[name].to_numpy()

@@ -227,6 +227,7 @@ class MultiModelForecaster:
 
 _BLEND_META_FILE = "blend.json"
 _BLEND_WEIGHTS_FILE = "blend_weights.npy"
+_BLEND_SCALE_FILE = "blend_interval_scale.npy"
 
 
 class BlendedForecaster:
@@ -247,6 +248,7 @@ class BlendedForecaster:
         forecasters: Dict[str, BatchForecaster],
         weights: np.ndarray,
         models: Optional[tuple] = None,
+        interval_scale: Optional[np.ndarray] = None,
     ):
         if not forecasters:
             raise ValueError("need at least one family forecaster")
@@ -268,6 +270,20 @@ class BlendedForecaster:
                 f"— one row per series, one column per family — got "
                 f"{self.weights.shape}"
             )
+        # (S,) conformal scale for the POOLED band (engine/blend
+        # calibrate=True) — applied after blending, mirroring
+        # BatchForecaster.interval_scale
+        self.interval_scale = (
+            None if interval_scale is None
+            else np.asarray(interval_scale, dtype=np.float32)
+        )
+        if self.interval_scale is not None and (
+            self.interval_scale.shape != (self.keys.shape[0],)
+        ):
+            raise ValueError(
+                f"interval_scale must be ({self.keys.shape[0]},), got "
+                f"{self.interval_scale.shape}"
+            )
 
     @classmethod
     def from_fit(cls, batch, params_by_family, configs, blend
@@ -288,7 +304,8 @@ class BlendedForecaster:
             fcs[name] = BatchForecaster.from_fit(
                 batch, params_by_family[name], name, cfg
             )
-        return cls(fcs, blend.weights, models=blend.models)
+        return cls(fcs, blend.weights, models=blend.models,
+                   interval_scale=blend.interval_scale)
 
     @property
     def family(self) -> str:
@@ -317,6 +334,11 @@ class BlendedForecaster:
         for name, fc in self.forecasters.items():
             fc.save(os.path.join(directory, name))
         np.save(os.path.join(directory, _BLEND_WEIGHTS_FILE), self.weights)
+        scale_path = os.path.join(directory, _BLEND_SCALE_FILE)
+        if self.interval_scale is not None:
+            np.save(scale_path, self.interval_scale)
+        elif os.path.exists(scale_path):
+            os.remove(scale_path)  # never resurrect a stale scale
         with open(os.path.join(directory, _BLEND_META_FILE), "w") as f:
             json.dump({"models": list(self.models)}, f)
 
@@ -329,7 +351,10 @@ class BlendedForecaster:
             for name in meta["models"]
         }
         weights = np.load(os.path.join(directory, _BLEND_WEIGHTS_FILE))
-        return cls(fcs, weights, models=tuple(meta["models"]))
+        scale_path = os.path.join(directory, _BLEND_SCALE_FILE)
+        scale = np.load(scale_path) if os.path.exists(scale_path) else None
+        return cls(fcs, weights, models=tuple(meta["models"]),
+                   interval_scale=scale)
 
     def warmup(self, horizon: int = 90, sizes=(1,)) -> int:
         """Every family serves every request, so each warms the requested
@@ -384,8 +409,20 @@ class BlendedForecaster:
                 out["yhat"] += w * yh
                 out["_up"] += up
                 out["_dn"] += dn
-        out["yhat_upper"] = out["yhat"] + out.pop("_up")
-        out["yhat_lower"] = out["yhat"] - out.pop("_dn")
+        up, dn = out.pop("_up"), out.pop("_dn")
+        if self.interval_scale is not None:
+            from distributed_forecasting_tpu.engine.blend import (
+                blend_band_floor,
+            )
+
+            T_rows = len(out) // sidx.size
+            sc = np.repeat(self.interval_scale[sidx], T_rows)
+            up, dn = sc * up, sc * dn
+            floor = blend_band_floor(self.models)
+            if floor is not None:
+                dn = np.minimum(dn, out["yhat"].to_numpy() - floor)
+        out["yhat_upper"] = out["yhat"] + up
+        out["yhat_lower"] = out["yhat"] - dn
         return out[["ds", *self.key_names, "yhat", "yhat_upper", "yhat_lower"]]
 
     def predict_quantiles(
@@ -411,10 +448,16 @@ class BlendedForecaster:
         if sidx.size == 0:
             return pd.DataFrame(columns=["ds", *self.key_names, *qcols])
         req = pd.DataFrame(self.keys[sidx], columns=list(self.key_names))
+        # conformal scaling spreads levels around the pooled median, so it
+        # is priced alongside when calibration is on and dropped after
+        priced = tuple(quantiles)
+        if self.interval_scale is not None and 0.5 not in priced:
+            priced = tuple(sorted((*priced, 0.5)))
+        pcols = quantile_columns(priced)
         out = None
         for i, name in enumerate(self.models):
             part = self.forecasters[name].predict_quantiles(
-                req, quantiles=quantiles, horizon=horizon,
+                req, quantiles=priced, horizon=horizon,
                 include_history=include_history, key=key,
                 **self._family_kwargs(name, xreg),
             )
@@ -422,9 +465,21 @@ class BlendedForecaster:
             w = np.repeat(self.weights[sidx, i], T_rows)
             if out is None:
                 out = part[["ds", *self.key_names]].copy()
-                for c in qcols:
+                for c in pcols:
                     out[c] = w * part[c].to_numpy()
             else:
-                for c in qcols:
+                for c in pcols:
                     out[c] += w * part[c].to_numpy()
-        return out
+        if self.interval_scale is not None:
+            from distributed_forecasting_tpu.engine.blend import (
+                blend_band_floor,
+            )
+
+            T_rows = len(out) // sidx.size
+            sc = np.repeat(self.interval_scale[sidx], T_rows)
+            med = out["q0.5"].to_numpy().copy()
+            floor = blend_band_floor(self.models)
+            for c in pcols:
+                scaled = med + sc * (out[c].to_numpy() - med)
+                out[c] = scaled if floor is None else np.maximum(scaled, floor)
+        return out[["ds", *self.key_names, *qcols]]

@@ -216,9 +216,28 @@ def test_pipeline_blend_path(tmp_path, mixed_batch):
         atol=1e-3,
     )
 
+    # blend + calibration is SUPPORTED (pooled-band conformal scale in the
+    # artifact); auto remains unsupported and must say so
+    out_cal = pipe.fine_grained(
+        "hackathon.sales.raw", "hackathon.sales.blend_cal",
+        model="blend",
+        model_conf={"families": ["theta", "holt_winters"],
+                    "configs": {"holt_winters": {"n_alpha": 3, "n_beta": 2,
+                                                 "n_gamma": 2}}},
+        cv_conf={"initial": 360, "period": 180, "horizon": 60},
+        horizon=28,
+        calibrate_intervals=True,
+    )
+    run_cal = tracker.get_run(out_cal["experiment_id"], out_cal["run_id"])
+    fc_cal = load_forecaster(run_cal.artifact_path("forecaster"))
+    assert fc_cal.interval_scale is not None
+    assert fc_cal.interval_scale.shape == (4,)
+    # calibrated serving bands differ from uncalibrated by the scale
+    served_cal = fc_cal.predict(req, horizon=7)
+    assert np.isfinite(served_cal["yhat_lower"]).all()
     with pytest.raises(ValueError, match="calibrate_intervals"):
         pipe.fine_grained(
-            "hackathon.sales.raw", "x.y.z", model="blend",
+            "hackathon.sales.raw", "x.y.z", model="auto",
             calibrate_intervals=True,
         )
 
@@ -326,3 +345,60 @@ def dc_replace_weights(blend, weights):
     import dataclasses
 
     return dataclasses.replace(blend, weights=weights)
+
+
+def test_blend_calibration_scales_pooled_band(mixed_batch):
+    """calibrate=True: the pooled band gets a per-series conformal scale
+    computed from the POOLED CV residuals; result bands carry it."""
+    params, blend, res = fit_forecast_blend(
+        mixed_batch, models=("theta", "holt_winters"), cv=CV, horizon=14,
+        calibrate=True,
+    )
+    assert blend.interval_scale is not None
+    assert blend.interval_scale.shape == (mixed_batch.n_series,)
+    assert np.isfinite(blend.interval_scale).all()
+    # the same fit WITHOUT calibration has bands differing exactly by the
+    # per-series scale factor
+    _, blend0, res0 = fit_forecast_blend(
+        mixed_batch, models=("theta", "holt_winters"), cv=CV, horizon=14,
+    )
+    up = np.asarray(res.hi - res.yhat)
+    up0 = np.asarray(res0.hi - res0.yhat)
+    ratio = up[:, -1] / np.maximum(up0[:, -1], 1e-9)
+    np.testing.assert_allclose(ratio, blend.interval_scale, rtol=1e-4)
+
+
+def test_blend_calibration_respects_member_floors(mixed_batch):
+    """An all-croston pool floors at 0: widening (s > 1) must not push
+    engine or served lower bounds negative; and mixed interval widths in
+    the pool are an explicit error, not a silent pick."""
+    import dataclasses as dc
+
+    from distributed_forecasting_tpu.engine.blend import blend_band_floor
+    from distributed_forecasting_tpu.models import CrostonConfig, ThetaConfig
+    from distributed_forecasting_tpu.serving import BlendedForecaster
+
+    assert blend_band_floor(("croston",)) == 0.0
+    assert blend_band_floor(("croston", "theta")) is None
+
+    params, blend, res = fit_forecast_blend(
+        mixed_batch, models=("croston",), cv=CV, horizon=14, calibrate=True,
+    )
+    # force a widening scale and re-apply through serving
+    blend2 = dc.replace(
+        blend, interval_scale=np.full(mixed_batch.n_series, 5.0,
+                                      dtype=np.float32)
+    )
+    fc = BlendedForecaster.from_fit(mixed_batch, params, None, blend2)
+    req = pd.DataFrame({"store": [1], "item": [6]})  # intermittent series
+    out = fc.predict(req, horizon=14)
+    assert (out["yhat_lower"].to_numpy() >= -1e-6).all()
+    outq = fc.predict_quantiles(req, quantiles=(0.05, 0.95), horizon=14)
+    assert (outq["q0.05"].to_numpy() >= -1e-6).all()
+
+    with pytest.raises(ValueError, match="interval_width"):
+        fit_forecast_blend(
+            mixed_batch, models=("theta", "croston"),
+            configs={"croston": CrostonConfig(interval_width=0.8)},
+            cv=CV, horizon=7, calibrate=True,
+        )
