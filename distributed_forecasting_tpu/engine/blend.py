@@ -128,9 +128,8 @@ def _blend_conformal_scale(batch, blend: BlendResult, configs, cv, key):
     diagnostics-scale by design, like ``cv_artifact`` — the 50k regime
     should calibrate per family or not at all.
     """
-    import jax.numpy as jnp
-
     from distributed_forecasting_tpu.engine.calibrate import (
+        config_interval_width,
         conformal_scale_from_paths,
     )
     from distributed_forecasting_tpu.engine.cv import (
@@ -139,19 +138,28 @@ def _blend_conformal_scale(batch, blend: BlendResult, configs, cv, key):
         cutoff_indices,
     )
 
-    from distributed_forecasting_tpu.engine.calibrate import (
-        config_interval_width,
-    )
-
-    w = blend.weights
-    yhat_b = up_b = None
-    eval_masks = None
+    # resolve configs (cheap) and fail fast on mixed widths BEFORE any
+    # expensive CV path materializes: a pooled band calibrated "at 95%"
+    # while one member prices 80% would be a silent, ill-defined target
+    resolved = {}
     widths = {}
     for i, name in enumerate(blend.models):
         config, k, _ = _cv_entry(batch, name, configs.get(name),
                                  jax.random.fold_in(key, i), None,
                                  "fit_forecast_blend(calibrate=True)")
+        resolved[name] = (config, k)
         widths[name] = config_interval_width(config)
+    if len(set(widths.values())) > 1:
+        raise ValueError(
+            f"calibrate=True needs ONE interval_width across the pool, got "
+            f"{widths}; align the member configs"
+        )
+
+    w = blend.weights
+    yhat_b = up_b = None
+    eval_masks = None
+    for i, name in enumerate(blend.models):
+        config, k = resolved[name]
         cuts = cutoff_indices(batch.n_time, cv)
         yhat, lo, hi, em, _ = _cv_paths_impl(
             batch.y, batch.mask, batch.day, k,
@@ -165,13 +173,6 @@ def _blend_conformal_scale(batch, blend: BlendResult, configs, cv, key):
         else:
             yhat_b = yhat_b + wf * yhat
             up_b = up_b + wf * (hi - yhat)
-    if len(set(widths.values())) > 1:
-        # a pooled band calibrated "at 95%" while one member prices 80%
-        # would be a silent, ill-defined target — make the choice explicit
-        raise ValueError(
-            f"calibrate=True needs ONE interval_width across the pool, got "
-            f"{widths}; align the member configs"
-        )
     return np.asarray(conformal_scale_from_paths(
         batch.y, yhat_b, yhat_b + up_b, eval_masks,
         interval_width=next(iter(widths.values())),

@@ -39,6 +39,25 @@ if os.environ.get("DFTPU_TEST_PLATFORM", "cpu") == "cpu":
 import pytest  # noqa: E402
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _release_compiled_executables():
+    """Drop jit caches at each module's teardown.
+
+    One pytest process compiles hundreds of XLA executables across the
+    suite; their code/data segments are separate mmaps, and the process
+    eventually exhausts ``vm.max_map_count`` (default 65530) — observed as
+    deterministic 'LLVM compilation error: Cannot allocate memory' +
+    SIGSEGV late in the session once the suite grew past ~300 tests, with
+    >100 GB RAM free.  Clearing per MODULE keeps within-module cache hits
+    (where the sharing actually happens) while bounding the process-wide
+    mapping count.
+    """
+    yield
+    import jax
+
+    jax.clear_caches()
+
+
 @pytest.fixture(scope="session")
 def sales_df_small():
     """10-series fixture dataset (BASELINE config #1 scale)."""
