@@ -22,7 +22,6 @@ orders would mean one compiled program per distinct order at serving time
 
 from __future__ import annotations
 
-import dataclasses
 from typing import Optional, Sequence, Tuple
 
 import jax
