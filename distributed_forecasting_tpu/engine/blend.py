@@ -102,6 +102,13 @@ def blend_weights(
         base = np.maximum(table, 0.0)
     else:
         base = 1.0 / np.maximum(table, _EPS)
+    # normalize by the per-row max before the temperature power: base can
+    # reach ~1/_EPS, and e.g. 1e9**34 overflows float64 to inf (inf/inf ->
+    # NaN weights).  Weights are scale-invariant under the row
+    # normalization below, so dividing by the max first changes nothing
+    # except keeping every temperature finite
+    rowmax = np.where(finite, base, 0.0).max(axis=1, keepdims=True)
+    base = base / np.maximum(rowmax, _EPS)
     # finite mask applied AFTER the temperature power: 0**0 == 1 would
     # hand a non-finite family equal weight at temperature=0
     inv = np.where(finite, base ** temperature, 0.0)

@@ -120,19 +120,23 @@ def _cv_paths(y, mask, day, key, model, config, cuts, horizon, xreg):
     return yhat, lo, hi, eval_masks, train_masks
 
 
-def _cv_metric_means(y, yhat, lo, hi, eval_masks, train_masks):
+def _cv_metric_means(y, yhat, lo, hi, eval_masks, train_masks, mase_m=7):
     """Per-series CV-mean metric dict from the (C, S, T) paths — the ONE
     metric assembly for all three cross_validate routes (fused, fused+
     calibrate, materializing), including MASE against each cutoff's own
-    training window."""
+    training window (``mase_m`` = the cadence's seasonal-naive lag,
+    ``metrics.seasonal_naive_lag(batch.freq)``)."""
     y_b = jnp.broadcast_to(y[None], yhat.shape)
     per_cut = metrics_ops.compute_all(y_b, yhat, eval_masks, lo=lo, hi=hi)
-    per_cut["mase"] = metrics_ops.mase(y_b, yhat, eval_masks, train_masks)
+    per_cut["mase"] = metrics_ops.mase(y_b, yhat, eval_masks, train_masks,
+                                       m=mase_m)
     return {name: jnp.mean(v, axis=0) for name, v in per_cut.items()}  # (S,)
 
 
-@partial(jax.jit, static_argnames=("model", "config", "cuts", "horizon"))
-def _cv_impl(y, mask, day, key, model, config, cuts, horizon, xreg=None):
+@partial(jax.jit,
+         static_argnames=("model", "config", "cuts", "horizon", "mase_m"))
+def _cv_impl(y, mask, day, key, model, config, cuts, horizon, xreg=None,
+             mase_m=7):
     """Whole CV pass as ONE compiled program: mask construction, every
     cutoff's fit+forecast, metric reductions.  No host round trips inside
     — device scalar pulls cost tens of ms on remote-attached TPUs (see
@@ -140,7 +144,8 @@ def _cv_impl(y, mask, day, key, model, config, cuts, horizon, xreg=None):
     yhat, lo, hi, eval_masks, train_masks = _cv_paths(
         y, mask, day, key, model, config, cuts, horizon, xreg
     )
-    return _cv_metric_means(y, yhat, lo, hi, eval_masks, train_masks)
+    return _cv_metric_means(y, yhat, lo, hi, eval_masks, train_masks,
+                            mase_m=mase_m)
 
 
 @partial(jax.jit, static_argnames=("model", "config", "cuts", "horizon"))
@@ -174,9 +179,10 @@ def _calibration_outputs(y, y_b, yhat, lo, hi, eval_masks, model, config):
     return scale, cov_c
 
 
-@partial(jax.jit, static_argnames=("model", "config", "cuts", "horizon"))
+@partial(jax.jit,
+         static_argnames=("model", "config", "cuts", "horizon", "mase_m"))
 def _cv_calibrate_impl(y, mask, day, key, model, config, cuts, horizon,
-                       xreg=None):
+                       xreg=None, mase_m=7):
     """CV metrics + conformal calibration as ONE compiled program.
 
     The calibrate-without-frame route must not fall back to materializing
@@ -187,7 +193,8 @@ def _cv_calibrate_impl(y, mask, day, key, model, config, cuts, horizon,
     yhat, lo, hi, eval_masks, train_masks = _cv_paths(
         y, mask, day, key, model, config, cuts, horizon, xreg
     )
-    out = _cv_metric_means(y, yhat, lo, hi, eval_masks, train_masks)
+    out = _cv_metric_means(y, yhat, lo, hi, eval_masks, train_masks,
+                           mase_m=mase_m)
     y_b = jnp.broadcast_to(y[None], yhat.shape)
     scale, cov_c = _calibration_outputs(
         y, y_b, yhat, lo, hi, eval_masks, model, config
@@ -282,6 +289,7 @@ def cross_validate(
     config, key, xreg = _cv_entry(batch, model, config, key, xreg,
                                   "cross_validate")
     cuts = cutoff_indices(batch.n_time, cv)
+    mase_m = metrics_ops.seasonal_naive_lag(getattr(batch, "freq", "D"))
     if return_frame:
         # diagnostics-scale route: paths materialize on host for the frame
         # anyway, so metrics/calibration compute from the same arrays
@@ -290,7 +298,8 @@ def cross_validate(
             model=model, config=config, cuts=tuple(cuts), horizon=cv.horizon,
             xreg=xreg,
         )
-        out = _cv_metric_means(batch.y, yhat, lo, hi, eval_masks, train_masks)
+        out = _cv_metric_means(batch.y, yhat, lo, hi, eval_masks, train_masks,
+                               mase_m=mase_m)
         out["_n_cutoffs"] = len(cuts)
         if calibrate:
             y_b = jnp.broadcast_to(batch.y[None], yhat.shape)
@@ -305,7 +314,7 @@ def cross_validate(
         impl(
             batch.y, batch.mask, batch.day, key,
             model=model, config=config, cuts=tuple(cuts), horizon=cv.horizon,
-            xreg=xreg,
+            xreg=xreg, mase_m=mase_m,
         )
     )
     out["_n_cutoffs"] = len(cuts)

@@ -98,7 +98,18 @@ def resolve_order_conf(model_conf, batch, cv_conf=None) -> Optional[dict]:
     compile; threading the sweep's per-series metrics through every
     pipeline path was judged not worth the coupling.
     """
-    if not model_conf or "order" not in model_conf:
+    if not model_conf:
+        return model_conf
+    if "order" not in model_conf:
+        stray = [k for k in ("order_candidates", "order_metric")
+                 if k in model_conf]
+        if stray:
+            # without "order" these would pass through to ArimaConfig and
+            # die as an opaque unexpected-keyword TypeError
+            raise ValueError(
+                f"{' / '.join(stray)} only take effect alongside an "
+                f"'order' key (e.g. order: auto) — add one or drop them"
+            )
         return model_conf
     out = dict(model_conf)
     spec = out.pop("order")
@@ -117,6 +128,15 @@ def resolve_order_conf(model_conf, batch, cv_conf=None) -> Optional[dict]:
         out.update(p=p, d=d, q=q)
         return out
     if isinstance(spec, (list, tuple)) and len(spec) == 3:
+        if candidates is not None or "order_metric" in (model_conf or {}):
+            # a leftover pin next to an intended sweep: silently running
+            # only the pinned order would let the user believe the grid
+            # was searched
+            raise ValueError(
+                f"order: {list(spec)} pins the order — order_candidates/"
+                f"order_metric would be ignored; use order: auto to sweep "
+                f"or drop them"
+            )
         out.update(p=int(spec[0]), d=int(spec[1]), q=int(spec[2]))
         return out
     raise ValueError(

@@ -75,6 +75,19 @@ def mdape(y, yhat, mask):
     return masked_median(ape, ok)
 
 
+# per-cadence seasonal-naive lag for MASE, M4-competition convention:
+# daily grids score against the weekly naive (m=7, the retail-domain
+# default), weekly against the 1-step naive, monthly against last year's
+# month.  Threaded from batch.freq by every CV route so "MASE < 1 beats
+# seasonal-naive" stays true on non-daily grids.
+MASE_LAGS = {"D": 7, "W": 1, "M": 12}
+
+
+def seasonal_naive_lag(freq: str = "D") -> int:
+    """The MASE naive lag for a grid cadence (see ``MASE_LAGS``)."""
+    return MASE_LAGS.get(freq, 1)
+
+
 def mase(y, yhat, eval_mask, train_mask, m: int = 7):
     """Mean absolute SCALED error (Hyndman-Koehler; the M-competition
     standard the reference's metric set lacks): eval-window MAE divided by
@@ -84,8 +97,10 @@ def mase(y, yhat, eval_mask, train_mask, m: int = 7):
     means beating seasonal-naive out of sample.
 
     ``train_mask``/``eval_mask``: the rolling-origin window masks
-    (``engine.cv.cv_windows``); ``m``: the naive season (7 = weekly, the
-    domain default).  Leading batch axes broadcast like every metric here.
+    (``engine.cv.cv_windows``); ``m``: the naive season in GRID STEPS —
+    pass :func:`seasonal_naive_lag` of the batch cadence (7 on daily
+    grids; a daily-minded 7 on a weekly grid would be a 7-week naive).
+    Leading batch axes broadcast like every metric here.
     """
     dy = jnp.abs(y[..., m:] - y[..., : -m])
     both = train_mask[..., m:] * train_mask[..., : -m]

@@ -179,9 +179,11 @@ def sharded_cv_metrics(
     idx = jnp.arange(T)
     cut_steps = jnp.asarray(cuts, dtype=jnp.int32)
     t_ends = batch.day[cut_steps].astype(jnp.float32)
-    # same metric set as engine.cv.cross_validate (incl. mase) — consumers
-    # treat the sharded and single-chip CV routes as interchangeable
+    # same metric set as engine.cv.cross_validate (incl. mase at the
+    # cadence's naive lag) — consumers treat the sharded and single-chip
+    # CV routes as interchangeable
     metric_names = sorted(list(metrics_ops.METRIC_FNS) + ["coverage", "mase"])
+    mase_m = metrics_ops.seasonal_naive_lag(getattr(batch, "freq", "D"))
 
     def local_cv(y, mask, day, cut_steps, t_ends, key, *xr):
         k0 = jax.random.fold_in(key, jax.lax.axis_index(SERIES_AXIS))
@@ -198,7 +200,8 @@ def sharded_cv_metrics(
                 params = fns.fit(y, train_mask, day, config)
                 yhat, lo, hi = fns.forecast(params, day, t_end, config, k)
             m = metrics_ops.compute_all(y, yhat, eval_mask, lo=lo, hi=hi)
-            m["mase"] = metrics_ops.mase(y, yhat, eval_mask, train_mask)
+            m["mase"] = metrics_ops.mase(y, yhat, eval_mask, train_mask,
+                                         m=mase_m)
             return jnp.stack([m[n] for n in metric_names])
 
         keys = jax.random.split(k0, len(cuts))

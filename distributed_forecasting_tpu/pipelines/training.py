@@ -51,6 +51,25 @@ _METRICS = ("mse", "rmse", "mae", "mape", "smape", "mdape", "coverage",
 _PER_SERIES_RUNS_WARN = 2000
 
 
+def _comparability_params(batch, cv):
+    """Promotion-gate comparability stamp: the CV protocol and data span
+    behind this run's ``val_*`` metrics.  ``tasks/promote.py`` compares
+    these between candidate and champion runs — scores measured on
+    different history windows or CV configs are not strictly comparable
+    (the data, not the model, may explain a difference), and the gate
+    warns (or refuses) when they differ.
+
+    ``cv``: the CVConfig actually used (not the raw conf, which could
+    drift from what ran); None when CV was skipped."""
+    dates = batch.dates()
+    return {
+        "cv_protocol": (f"{cv.initial}/{cv.period}/{cv.horizon}"
+                        if cv is not None else "none"),
+        "data_span": (f"{dates[0].date()}..{dates[-1].date()}"
+                      f":{getattr(batch, 'freq', 'D')}"),
+    }
+
+
 def _config_from_conf(model: str, model_conf: Optional[Dict[str, Any]]):
     fns = get_model(model)
     # YAML sequences arrive as lists; configs are static jit args and must be
@@ -113,7 +132,13 @@ def _resolve_model_conf(
     out = _resolve_season_conf(
         _resolve_holidays_conf(model_conf, batch, horizon), batch
     )
-    if model == "arima" and "order" in (out or {}):
+    # trigger on ANY order* key, not just "order": resolve_order_conf owns
+    # the clear rejection of order_candidates/order_metric without "order"
+    # (gating on "order" alone would let the stray keys fall through to
+    # ArimaConfig as an opaque unexpected-keyword TypeError)
+    if model == "arima" and any(
+        k in (out or {}) for k in ("order", "order_candidates", "order_metric")
+    ):
         from distributed_forecasting_tpu.engine.order import resolve_order_conf
 
         out = resolve_order_conf(out, batch, cv_conf)
@@ -365,9 +390,9 @@ class TrainingPipeline:
         t_start = time.time()
         key = jax.random.PRNGKey(seed)
         cv_metrics = None
+        cv = CVConfig(**(cv_conf or {})) if run_cross_validation else None
         with device_trace(trace_dir):
             if run_cross_validation:
-                cv = CVConfig(**(cv_conf or {}))
                 with timer.phase("cross_validation"):
                     if cv_artifact:
                         # one CV pass yields metrics AND the raw frame
@@ -464,6 +489,7 @@ class TrainingPipeline:
                         resolved_backend(n_keys=len(key_cols))
                         if batch.freq == "D" else "pandas"
                     ),
+                    **_comparability_params(batch, cv),
                 }
             )
             agg = {"fit_seconds": fit_seconds,
@@ -648,6 +674,7 @@ class TrainingPipeline:
                     "selection_metric": search.metric,
                     "n_series": batch.n_series,
                     "horizon": horizon,
+                    **_comparability_params(batch, cv),
                 }
             )
             # mean over healthy series with a finite CV score — a fallback
@@ -750,6 +777,7 @@ class TrainingPipeline:
                     "selection_metric": metric,
                     "n_series": batch.n_series,
                     "horizon": horizon,
+                    **_comparability_params(batch, cv),
                 }
             )
             counts = selection.counts()
@@ -858,6 +886,7 @@ class TrainingPipeline:
                     "temperature": temperature,
                     "n_series": batch.n_series,
                     "horizon": horizon,
+                    **_comparability_params(batch, cv),
                 }
             )
             valid = blend.valid

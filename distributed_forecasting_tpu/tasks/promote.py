@@ -22,6 +22,9 @@ Conf::
                                       #   2% worse and still pass
       fail_on_reject: false           # true -> a rejected candidate fails
                                       #   the workflow (CI-gate style)
+      require_comparable: false       # true -> refuse (not just warn) when
+                                      #   the two runs' cv_protocol or
+                                      #   data_span params differ
 
 No champion in ``target_stage`` yet => the candidate promotes
 unconditionally (first deployment).  Higher-is-better metrics (coverage)
@@ -46,8 +49,14 @@ def _is_higher_better(metric: str) -> bool:
     return name in _HIGHER_BETTER
 
 
+# run params (pipelines/training._comparability_params) that must match
+# between candidate and champion for their val_* metrics to be strictly
+# comparable; a mismatch means the DATA changed, not (only) the model
+_COMPARABILITY_KEYS = ("cv_protocol", "data_span")
+
+
 class PromoteTask(Task):
-    def _run_metric(self, version, metric: str) -> float:
+    def _run(self, version):
         exp_name = (version.tags or {}).get("source_experiment")
         if not exp_name:
             raise KeyError(
@@ -58,7 +67,9 @@ class PromoteTask(Task):
         eid = self.tracker.get_experiment_by_name(exp_name)
         if eid is None:
             raise KeyError(f"experiment {exp_name!r} not found")
-        run = self.tracker.get_run(eid, version.run_id)
+        return self.tracker.get_run(eid, version.run_id)
+
+    def _run_metric(self, run, version, metric: str) -> float:
         metrics = run.metrics()
         if metric not in metrics:
             raise KeyError(
@@ -95,7 +106,8 @@ class PromoteTask(Task):
         else:
             candidate = self.registry.latest_version(model_name,
                                                      stage=cand_stage)
-        cand_metric = self._run_metric(candidate, metric)
+        cand_run = self._run(candidate)
+        cand_metric = self._run_metric(cand_run, candidate, metric)
 
         try:
             baseline = self.registry.latest_version(model_name, stage=target)
@@ -111,7 +123,48 @@ class PromoteTask(Task):
                 f"candidate v{candidate.version} already holds {target}"
             )
         else:
-            base_metric = self._run_metric(baseline, metric)
+            base_run = self._run(baseline)
+            base_metric = self._run_metric(base_run, baseline, metric)
+            # a champion trained months earlier saw a different history
+            # window (and maybe CV config) — its val_* is then not strictly
+            # comparable to the candidate's, and the gate could decide on
+            # the data change rather than the model
+            cp, bp = cand_run.params(), base_run.params()
+            legacy = [
+                name for name, params in
+                (("champion", bp), ("candidate", cp))
+                if not any(k in params for k in _COMPARABILITY_KEYS)
+            ]
+            if legacy:
+                # a run from before comparability stamping (either side —
+                # e.g. a pinned older candidate): unknown, not mismatched —
+                # warn but never refuse, or the flag would block every
+                # promotion involving such a run until a retrain
+                self.logger.warning(
+                    "%s run(s) predate comparability stamping (no "
+                    "cv_protocol/data_span params) — cannot check whether "
+                    "the runs scored the same window",
+                    " and ".join(legacy),
+                )
+                mismatch = []
+            else:
+                mismatch = [
+                    f"{k}: candidate={cp.get(k)!r} champion={bp.get(k)!r}"
+                    for k in _COMPARABILITY_KEYS if cp.get(k) != bp.get(k)
+                ]
+            if mismatch:
+                msg = (
+                    f"candidate and champion runs are not strictly "
+                    f"comparable ({'; '.join(mismatch)}) — the gate may "
+                    f"reflect the data change, not the model"
+                )
+                if bool(pr.get("require_comparable", False)):
+                    raise RuntimeError(
+                        msg + " (require_comparable is set; retrain the "
+                        "champion on the current window, or unset "
+                        "require_comparable to gate with a warning)"
+                    )
+                self.logger.warning(msg)
             c, b = cand_metric, base_metric
             if higher_better:
                 c, b = -c, -b  # orient so smaller is better

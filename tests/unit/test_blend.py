@@ -402,3 +402,16 @@ def test_blend_calibration_respects_member_floors(mixed_batch):
             configs={"croston": CrostonConfig(interval_width=0.8)},
             cv=CV, horizon=7, calibrate=True,
         )
+
+
+def test_huge_temperature_stays_finite(mixed_batch):
+    # inverse errors are floored at 1e-9, so unnormalized bases reach ~1e9
+    # and base**34 used to overflow float64 -> inf/inf -> NaN weights; the
+    # per-row max-normalization keeps any temperature finite
+    sharp = blend_weights(mixed_batch, models=FAMILIES, cv=CV,
+                          temperature=200.0)
+    w = sharp.weights
+    assert np.isfinite(w).all()
+    np.testing.assert_allclose(w.sum(axis=1), 1.0, rtol=1e-9)
+    # and the advertised limit holds: winner-take-all where scores separate
+    assert (w[:2].max(axis=1) > 0.999).all()

@@ -136,3 +136,29 @@ def test_mase_nan_on_constant_training_window():
     ev = jnp.zeros((1, T)).at[:, 90:].set(1.0)
     v = np.asarray(M.mase(y, jnp.ones_like(y) * 5.0, ev, train))
     assert np.isnan(v[0]), v
+
+
+def test_seasonal_naive_lag_per_cadence():
+    # M4 convention threaded from batch.freq by every CV route: daily
+    # scores against the weekly naive, weekly against the 1-step naive,
+    # monthly against last year's month
+    assert M.seasonal_naive_lag("D") == 7
+    assert M.seasonal_naive_lag("W") == 1
+    assert M.seasonal_naive_lag("M") == 12
+    assert M.seasonal_naive_lag("?") == 1
+
+
+def test_mase_lag_changes_the_denominator():
+    rng = np.random.default_rng(0)
+    y = np.cumsum(rng.normal(size=60))[None, :]
+    mask = np.ones_like(y)
+    steps = np.arange(60)
+    train = mask * (steps < 40)
+    ev = mask * (steps >= 40)
+    yhat = np.concatenate([y[:, :1], y[:, :-1]], axis=1)  # 1-step naive
+    m1 = np.asarray(M.mase(y, yhat, ev, train, m=1))
+    m7 = np.asarray(M.mase(y, yhat, ev, train, m=7))
+    assert np.isfinite(m1).all() and np.isfinite(m7).all()
+    # a random walk's 1-step increments are smaller than its 7-step ones,
+    # so the m=7 denominator is larger and the score smaller
+    assert (m7 < m1).all()

@@ -119,3 +119,36 @@ def test_order_resolves_on_allocated_and_auto_paths(tmp_path):
         horizon=14,
     )
     assert out2["n_failed"] == 0
+
+
+def test_stray_order_keys_rejected():
+    """order_candidates/order_metric without 'order' used to fall through
+    to ArimaConfig and die as an opaque unexpected-keyword TypeError."""
+    from distributed_forecasting_tpu.engine.order import resolve_order_conf
+
+    with pytest.raises(ValueError, match="order_candidates"):
+        resolve_order_conf({"order_candidates": [[1, 0, 0]], "p": 1}, None)
+    with pytest.raises(ValueError, match="order_metric"):
+        resolve_order_conf({"order_metric": "mape", "p": 1}, None)
+
+
+def test_stray_order_keys_rejected_on_pipeline_path():
+    """The guard must fire from the pipeline's conf-translation chain too —
+    gating the resolve call on 'order' alone let stray keys fall through."""
+    from distributed_forecasting_tpu.pipelines.training import (
+        _resolve_model_conf,
+    )
+
+    with pytest.raises(ValueError, match="order_candidates"):
+        _resolve_model_conf(
+            "arima", {"order_candidates": [[1, 0, 0]], "p": 1}, None, 28
+        )
+
+
+def test_sweep_keys_next_to_pinned_order_rejected():
+    """order: [p,d,q] + order_candidates is a contradiction — refusing
+    beats silently skipping the sweep the user asked for."""
+    with pytest.raises(ValueError, match="pins the order"):
+        resolve_order_conf(
+            {"order": [1, 0, 0], "order_candidates": [[2, 1, 1]]}, None
+        )

@@ -7,7 +7,8 @@ import pytest
 from distributed_forecasting_tpu.tasks.promote import PromoteTask
 
 
-def _train_deploy(root, seed, quality=1.0, model_name="M", stage=None):
+def _train_deploy(root, seed, quality=1.0, model_name="M", stage=None,
+                  T=720):
     """One train run + registered version whose val_smape scales with
     ``quality`` (bigger = worse fit data -> worse metric)."""
     from distributed_forecasting_tpu.data.catalog import DatasetCatalog
@@ -19,7 +20,6 @@ def _train_deploy(root, seed, quality=1.0, model_name="M", stage=None):
     catalog.create_catalog("hackathon")
     catalog.create_schema("hackathon", "sales")
     rng = np.random.default_rng(seed)
-    T = 720
     t = np.arange(T)
     rows = []
     for item in (1, 2, 3):
@@ -140,3 +140,25 @@ def test_higher_better_tolerance_is_lenient_not_strict(tmp_path):
                     "tolerance": 0.02},
     }).launch()
     assert out["promoted"], out["reason"]
+
+
+def test_incomparable_runs_warn_then_refuse_when_required(tmp_path):
+    """Candidate and champion trained on different history windows: their
+    val_* metrics may reflect the data change, not the model.  Default is
+    warn-and-proceed; require_comparable refuses."""
+    root = str(tmp_path)
+    _train_deploy(root, seed=0, quality=6.0, stage="Production", T=720)
+    _train_deploy(root, seed=1, quality=1.0, T=900)  # longer history
+    out = PromoteTask(init_conf={
+        "env": {"root": root},
+        "promote": {"model_name": "M", "candidate_stage": "None"},
+    }).launch()
+    assert out["promoted"]  # warn-only default still gates on the metric
+
+    _train_deploy(root, seed=2, quality=1.0, T=960)
+    with pytest.raises(RuntimeError, match="not strictly comparable"):
+        PromoteTask(init_conf={
+            "env": {"root": root},
+            "promote": {"model_name": "M", "candidate_stage": "None",
+                        "require_comparable": True},
+        }).launch()
