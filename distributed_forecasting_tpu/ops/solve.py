@@ -81,6 +81,58 @@ def masked_gram(X: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
     return G
 
 
+def batched_cho_solve(
+    A: jnp.ndarray, b: jnp.ndarray, chunk: int | None = None
+) -> jnp.ndarray:
+    """Solve the batched SPD systems ``A[s] x[s] = b[s]``.
+
+    A: (S, F, F), b: (S, F) -> (S, F), via Cholesky.
+
+    TPU lowering detail: the batched triangular solve stack-allocates its
+    inverted diagonal blocks in scoped VMEM, and at design widths past the
+    MXU tile the allocation can exceed the 16 MB scoped limit — observed on
+    v5e at F=81, S=500 (holidays + monthly seasonality + yearly_order=15):
+    ``InvertDiagBlocksLowerTriangular`` wanted 17.45 MB and compilation
+    failed (harvest log ``test_tpu_20260731T161002``).  F <= 64 is proven
+    fine on hardware at S=500 and S=8192 (the headline and scale paths), so
+    those stay one batched call; for F > 64 the batch is solved in
+    VMEM-sized chunks under ``lax.map`` — sequential over ~2M-element
+    slabs, which bounds the scoped allocation regardless of S and F.  The
+    solve is a small fraction of the fit (scripts/phase_split.py), so the
+    sequential chunks cost noise.  ``DFTPU_CHOL_CHUNK`` overrides the chunk
+    size (0 forces the single batched call).
+    """
+    S, F = b.shape
+    if chunk is None:
+        env = os.environ.get("DFTPU_CHOL_CHUNK")
+        if env is not None:
+            chunk = int(env)
+        else:
+            # ~2M f32 elements per chunk -> ~8 MB, ~11 MB with the observed
+            # 1.33x scoped-allocation overhead: comfortably under 16 MB
+            chunk = max(8, 2_000_000 // (F * F))
+    if chunk <= 0 or F <= 64 or S <= chunk:
+        chol = jax.scipy.linalg.cho_factor(A, lower=True)
+        return jax.scipy.linalg.cho_solve(chol, b[..., None])[..., 0]
+    n_chunks = -(-S // chunk)
+    pad = n_chunks * chunk - S
+    if pad:
+        eye = jnp.broadcast_to(jnp.eye(F, dtype=A.dtype), (pad, F, F))
+        A = jnp.concatenate([A, eye], axis=0)
+        b = jnp.concatenate([b, jnp.zeros((pad, F), b.dtype)], axis=0)
+
+    def solve_one(ab):
+        A1, b1 = ab
+        chol = jax.scipy.linalg.cho_factor(A1, lower=True)
+        return jax.scipy.linalg.cho_solve(chol, b1[..., None])[..., 0]
+
+    out = jax.lax.map(
+        solve_one,
+        (A.reshape(n_chunks, chunk, F, F), b.reshape(n_chunks, chunk, F)),
+    )
+    return out.reshape(n_chunks * chunk, F)[:S]
+
+
 def ridge_solve_batch(
     X: jnp.ndarray,
     y: jnp.ndarray,
@@ -116,9 +168,7 @@ def ridge_solve_batch(
     else:
         D = (lam + jitter)[:, :, None] * jnp.eye(F)[None, :, :]
     A = G + D
-    chol = jax.scipy.linalg.cho_factor(A, lower=True)
-    beta = jax.scipy.linalg.cho_solve(chol, b[..., None])[..., 0]
-    return beta
+    return batched_cho_solve(A, b)
 
 
 def yule_walker_masked(

@@ -26,8 +26,14 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     bash scripts/tpu_window.sh >> "$LOG" 2>&1
     rc=$?
     note "harvest finished rc=$rc"
-    touch scripts/tpu_logs/WINDOW_DONE
-    exit 0
+    if [ "$rc" -eq 0 ]; then
+      touch scripts/tpu_logs/WINDOW_DONE
+      exit 0
+    fi
+    # a window that opened and then died mid-harvest must NOT consume the
+    # only attempt: mark the failure and keep watching the remaining budget
+    touch scripts/tpu_logs/WINDOW_FAILED
+    note "harvest failed; resuming watch"
   fi
   note "probe failed; sleeping ${PROBE_EVERY}s"
   sleep "$PROBE_EVERY"

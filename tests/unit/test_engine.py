@@ -1,5 +1,6 @@
 import dataclasses
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -119,3 +120,76 @@ def test_cross_validate_return_frame_single_pass(batch_small):
         )
     assert both["_n_cutoffs"] == plain["_n_cutoffs"]
     assert len(frame) > 0 and {"cutoff", "yhat"} <= set(frame.columns)
+
+
+class TestBatchedCholSolveChunking:
+    """VMEM-bounded chunked Cholesky (ops/solve.batched_cho_solve): the F>64
+    chunked path must agree exactly with the single batched call (the TPU
+    scoped-VMEM fix for the F=81 extended design must not change numerics)."""
+
+    def _spd_problem(self, S, F, seed=0):
+        rng = np.random.default_rng(seed)
+        Q = rng.normal(size=(S, F, F)).astype(np.float32)
+        A = np.einsum("sfk,sgk->sfg", Q, Q) + 3.0 * np.eye(F, dtype=np.float32)
+        b = rng.normal(size=(S, F)).astype(np.float32)
+        return jnp.asarray(A), jnp.asarray(b)
+
+    def test_chunked_matches_direct_with_padding(self):
+        from distributed_forecasting_tpu.ops.solve import batched_cho_solve
+
+        A, b = self._spd_problem(37, 81)  # 37 % 16 != 0 -> exercises padding
+        direct = batched_cho_solve(A, b, chunk=0)
+        chunked = batched_cho_solve(A, b, chunk=16)
+        np.testing.assert_allclose(
+            np.asarray(chunked), np.asarray(direct), rtol=1e-5, atol=1e-5
+        )
+
+    def test_wide_design_defaults_to_chunking(self):
+        """F=81 at S=500 (the shape that blew scoped VMEM on v5e) solves and
+        matches the direct path under the default chunk choice."""
+        from distributed_forecasting_tpu.ops.solve import batched_cho_solve
+
+        A, b = self._spd_problem(500, 81, seed=1)
+        default = batched_cho_solve(A, b)  # default chunk: 2M elems / F^2
+        direct = batched_cho_solve(A, b, chunk=0)
+        np.testing.assert_allclose(
+            np.asarray(default), np.asarray(direct), rtol=1e-5, atol=1e-5
+        )
+
+    def test_narrow_design_stays_direct(self, monkeypatch):
+        """F<=64 must never take the lax.map detour (hardware-proven paths)."""
+        from distributed_forecasting_tpu.ops import solve as solve_mod
+
+        called = {"map": False}
+        orig_map = jax.lax.map
+
+        def spy_map(*a, **k):
+            called["map"] = True
+            return orig_map(*a, **k)
+
+        monkeypatch.setattr(jax.lax, "map", spy_map)
+        A, b = self._spd_problem(500, 64, seed=2)
+        solve_mod.batched_cho_solve(A, b)
+        assert not called["map"]
+
+    def test_extended_design_fit_runs_chunked(self, batch_small):
+        """The exact conf that failed on v5e (holidays + monthly + yearly 15)
+        fits end to end through the chunked solve."""
+        from distributed_forecasting_tpu.data.holidays import (
+            us_holiday_spec_for_range,
+        )
+        from distributed_forecasting_tpu.engine import fit_forecast
+        from distributed_forecasting_tpu.models.prophet_glm import (
+            CurveModelConfig,
+        )
+
+        cfg = CurveModelConfig(
+            holidays=us_holiday_spec_for_range("2013-01-01", "2018-12-31"),
+            extra_seasonalities=(("monthly", 30.5, 5),),
+            yearly_order=15,
+        )
+        params, res = fit_forecast(
+            batch_small, model="prophet", config=cfg, horizon=30
+        )
+        assert bool(res.ok.all())
+        assert np.isfinite(np.asarray(res.yhat)).all()
