@@ -14,6 +14,8 @@ tests and benchmarks can run hermetically with a known ground truth.
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 import pandas as pd
 
@@ -43,8 +45,27 @@ def load_sales_csv(path: str) -> pd.DataFrame:
     Uses the native C++ parser (``native/dftpu_native.cpp``) when available —
     the default ingest flow's replacement for the JVM CSV reader the
     reference uses (``02_training.py:30-35``) — falling back to pandas.
+
+    ``.csv.gz`` inputs (the committed real-shaped dataset,
+    ``datasets/store_item_demand.csv.gz``) are decompressed to a temp file
+    so the native parser still does the parse; pandas handles gz natively
+    on the fallback path.
     """
     from distributed_forecasting_tpu.data import native
+
+    if path.endswith(".gz") and native.is_available():
+        import gzip
+        import shutil
+        import tempfile
+
+        with tempfile.NamedTemporaryFile(suffix=".csv", delete=False) as tmp:
+            try:
+                with gzip.open(path, "rb") as src:
+                    shutil.copyfileobj(src, tmp)
+                tmp.close()
+                return load_sales_csv(tmp.name)
+            finally:
+                os.unlink(tmp.name)
 
     if native.is_available() and _native_csv_layout_ok(path):
         try:

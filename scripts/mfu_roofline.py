@@ -1,0 +1,182 @@
+"""MFU / roofline for the headline 500 x 1826 fit+forecast (VERDICT r3 #6).
+
+Publishes the utilization story behind the headline throughput number:
+
+  * XLA's own cost analysis (``compiled.cost_analysis()``) for the compiled
+    program — FLOPs and bytes accessed — cross-checked against an analytic
+    count of the dominant contraction (the masked Gram einsum
+    ``st,tf,tg->sfg``: 2*S*T*F^2 FLOPs);
+  * per-batch device time via the dispatch-cost-cancelled slope protocol
+    (mandatory on the remote-attached chip; docs/benchmarks.md);
+  * achieved FLOP/s and HBM bandwidth vs TPU v5e peaks (197 TFLOP/s bf16
+    MXU; 819 GB/s HBM), the program's operational intensity, and the
+    roofline ridge point — i.e. WHERE the headline config sits (HBM-bound
+    vs MXU-bound) and what fraction of the binding roof it achieves;
+  * one informed lever, measured: the series-chunk-size ladder.  At F~64
+    the op is HBM-bound, so fusing MORE series per scan step amortizes the
+    shared (T, F) design-matrix traffic over more series — the ladder
+    measures series/s at chunk 512 / 2048 / 8192 on a fixed 16k-series
+    batch (one dispatch each).
+
+Run on TPU:  python scripts/mfu_roofline.py   (--allow-cpu to force; the
+numbers then describe the host, not the chip).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+V5E_PEAK_FLOPS_BF16 = 197e12  # per chip, MXU
+V5E_PEAK_HBM_BPS = 819e9
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--allow-cpu", action="store_true")
+    ap.add_argument("--series", type=int, default=500)
+    ap.add_argument("--days", type=int, default=1826)
+    ap.add_argument("--horizon", type=int, default=90)
+    ap.add_argument("--reps-long", type=int, default=16)
+    args = ap.parse_args()
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import distributed_forecasting_tpu  # noqa: F401  (platform override first)
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    if not on_tpu and not args.allow_cpu:
+        sys.exit("refusing on non-TPU backend; pass --allow-cpu to force")
+    print(f"device: {dev.platform} ({dev.device_kind})", file=sys.stderr)
+
+    from distributed_forecasting_tpu.data import synthetic_series_batch
+    from distributed_forecasting_tpu.engine import fit_forecast_chunked
+    from distributed_forecasting_tpu.engine.fit import day_grid, health_fallback
+    from distributed_forecasting_tpu.models import prophet_glm
+    from distributed_forecasting_tpu.models.prophet_glm import CurveModelConfig
+
+    S, T, H = args.series, args.days, args.horizon
+    cfg = CurveModelConfig()
+    key = jax.random.PRNGKey(0)
+
+    batches = []
+    for s in range(4):
+        b = synthetic_series_batch(n_stores=10, n_items=S // 10, n_days=T, seed=s)
+        float(b.y.sum())
+        batches.append(b)
+    Y = jnp.stack([b.y for b in batches])
+    M = jnp.stack([b.mask for b in batches])
+    day = batches[0].day
+    day_all = day_grid(day, H)
+    t_end = day[-1].astype(jnp.float32)
+
+    def full_pass(y, m):
+        p = prophet_glm.fit(y, m, day, cfg)
+        yh, lo, hi = prophet_glm.forecast(p, day_all, t_end, cfg, key)
+        yh, lo, hi, ok = health_fallback(y, m, yh, lo, hi, H, 14)
+        return yh.sum() + lo.sum() + hi.sum()
+
+    # ---- XLA cost analysis of ONE batch's full engine pass ----------------
+    jitted = jax.jit(full_pass)
+    lowered = jitted.lower(Y[0], M[0])
+    compiled = lowered.compile()
+    flops = bytes_acc = None
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        flops = float(ca.get("flops", float("nan")))
+        bytes_acc = float(ca.get("bytes accessed", float("nan")))
+    except Exception as e:
+        print(f"cost_analysis unavailable: {type(e).__name__}: {e}",
+              file=sys.stderr)
+
+    # analytic floor for cross-check: Gram einsum + forecast matmul + chol
+    from distributed_forecasting_tpu.models.prophet_glm import _design
+
+    X_design, _layout = _design(day, day[0].astype(jnp.float32), t_end, cfg)
+    F = int(X_design.shape[-1])
+    gram_flops = 2.0 * S * T * F * F
+    fc_flops = 2.0 * S * (T + H) * F
+    chol_flops = S * (F**3) / 3.0
+    analytic = gram_flops + fc_flops + chol_flops
+    # HBM floor: read y + mask, write yhat/lo/hi, f32
+    bytes_floor = (2 * S * T + 3 * S * (T + H)) * 4.0
+
+    # ---- slope-measured per-batch device time -----------------------------
+    def scan_over(Yk, Mk):
+        def step(c, ym):
+            y, m = ym
+            return c + full_pass(y, m), None
+
+        tot, _ = jax.lax.scan(step, 0.0, (Yk, Mk))
+        return tot
+
+    run = jax.jit(scan_over)
+    R = args.reps_long
+    Yl = jnp.concatenate([Y] * R)
+    Ml = jnp.concatenate([M] * R)
+
+    def timed(Yk, Mk):
+        t0 = time.perf_counter()
+        float(run(Yk, Mk))
+        return time.perf_counter() - t0
+
+    timed(Y, M)
+    timed(Yl, Ml)
+    t_s = min(timed(Y, M) for _ in range(3))
+    t_l = min(timed(Yl, Ml) for _ in range(3))
+    K = Y.shape[0]
+    per = (t_l - t_s) / (K * R - K)
+    if per <= 0:
+        per = t_l / (K * R)
+    print(f"per-batch device time (slope): {per * 1e3:.3f} ms "
+          f"({S / per:.0f} series/s)")
+
+    use_flops = flops if flops and flops == flops else analytic
+    use_bytes = bytes_acc if bytes_acc and bytes_acc == bytes_acc else bytes_floor
+    ach_flops = use_flops / per
+    ach_bw = use_bytes / per
+    oi = use_flops / use_bytes
+    ridge = V5E_PEAK_FLOPS_BF16 / V5E_PEAK_HBM_BPS
+    print(f"XLA cost analysis: flops={flops} bytes={bytes_acc}")
+    print(f"analytic cross-check: gram {gram_flops / 1e9:.2f} GF + forecast "
+          f"{fc_flops / 1e9:.2f} GF + chol {chol_flops / 1e9:.2f} GF = "
+          f"{analytic / 1e9:.2f} GFLOP; HBM floor {bytes_floor / 1e6:.1f} MB")
+    print(f"achieved: {ach_flops / 1e12:.3f} TFLOP/s "
+          f"({100 * ach_flops / V5E_PEAK_FLOPS_BF16:.2f}% of bf16 peak), "
+          f"{ach_bw / 1e9:.1f} GB/s ({100 * ach_bw / V5E_PEAK_HBM_BPS:.1f}% "
+          f"of HBM peak)")
+    print(f"operational intensity {oi:.1f} FLOP/B vs ridge {ridge:.0f} "
+          f"FLOP/B -> {'HBM-bound' if oi < ridge else 'MXU-bound'} "
+          f"at F={F}")
+
+    # ---- the lever: series-chunk-size ladder ------------------------------
+    big = synthetic_series_batch(n_stores=8 * 41, n_items=50, n_days=T, seed=9)
+    S_big = big.n_series  # 16400
+    float(big.y.sum())
+    print(f"chunk ladder on {S_big} series x {T} d (one scan dispatch each):")
+    for chunk in (512, 2048, 8192):
+        def run_big():
+            params, res = fit_forecast_chunked(
+                big, model="prophet", horizon=H, key=key,
+                chunk_size=chunk, dispatch="scan",
+            )
+            float(res.yhat.sum())
+
+        run_big()  # compile
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            run_big()
+            ts.append(time.perf_counter() - t0)
+        dt = min(ts)
+        print(f"  chunk {chunk:5d}: {dt:.3f} s  ({S_big / dt:.0f} series/s)")
+
+
+if __name__ == "__main__":
+    main()

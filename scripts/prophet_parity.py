@@ -1,0 +1,185 @@
+"""Measure the headline accuracy claim against REAL Prophet (VERDICT r3 #3).
+
+BASELINE.md's target is "<=5% CV-MAPE delta vs Prophet", and the reference's
+model IS Prophet with this exact config (``notebooks/prophet/02_training.py:162-186``):
+multiplicative seasonality, weekly+yearly, linear growth, 95% intervals,
+rolling-origin CV initial=730d / period=360d / horizon=90d.  This script runs
+that config through the real ``prophet`` package per series AND through this
+framework's batched ``prophet_glm`` (same CV windows), then prints the
+per-series CV MAPE comparison and the headline delta.
+
+Requires ``pip install -e .[prophet]`` — prophet is not baked into the TPU
+image (zero egress), so this runs in the CI lane ``prophetParity`` or on any
+workstation.  Without prophet installed it exits with a clear message.
+
+Datasets:
+  * the hermetic 10-series fixture (2 stores x 5 items x 4 y) — fast;
+  * ``--real N``: the first N series of the committed real-shaped dataset
+    (datasets/store_item_demand.csv.gz; default 50 — real Prophet costs
+    ~2-5 s per series-cutoff, the batched engine milliseconds total).
+
+Output: per-dataset table + one JSON line
+``{"dataset", "prophet_mape", "glm_mape", "rel_delta", "within_5pct"}``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import warnings
+
+
+def prophet_cv_mape(df_series, horizon=90):
+    """Real-Prophet rolling-origin CV MAPE for ONE series' (ds, y) frame.
+
+    Mirrors the reference training cell: Prophet(interval_width=0.95,
+    growth='linear', daily_seasonality=False, weekly_seasonality=True,
+    yearly_seasonality=True, seasonality_mode='multiplicative') and
+    prophet.diagnostics.cross_validation(initial=730d, period=360d,
+    horizon=90d), scored as mean |y-yhat|/|y| over the horizon points
+    (y=0 rows excluded — MAPE is undefined there; the framework's masked
+    MAPE makes the same exclusion).
+    """
+    import numpy as np
+    import pandas as pd
+    from prophet import Prophet
+    from prophet.diagnostics import cross_validation
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m = Prophet(
+            interval_width=0.95,
+            growth="linear",
+            daily_seasonality=False,
+            weekly_seasonality=True,
+            yearly_seasonality=True,
+            seasonality_mode="multiplicative",
+        )
+        import logging
+
+        logging.getLogger("prophet").setLevel(logging.ERROR)
+        logging.getLogger("cmdstanpy").setLevel(logging.ERROR)
+        m.fit(df_series)
+        cv_df = cross_validation(
+            m,
+            initial="730 days",
+            period="360 days",
+            horizon=f"{horizon} days",
+            disable_tqdm=True,
+        )
+    nz = cv_df["y"].abs() > 1e-9
+    ape = (cv_df["y"] - cv_df["yhat"]).abs()[nz] / cv_df["y"].abs()[nz]
+    return float(ape.mean())
+
+
+def glm_cv_mape_batch(batch):
+    """The framework's CV MAPE per series (same windows: CVConfig default)."""
+    import jax
+    import numpy as np
+
+    from distributed_forecasting_tpu.engine.cv import CVConfig, cross_validate
+
+    m = cross_validate(batch, model="prophet", cv=CVConfig(),
+                       key=jax.random.PRNGKey(0))
+    return np.asarray(m["mape"])
+
+
+def compare(name, df_long, results):
+    import numpy as np
+    import pandas as pd
+
+    from distributed_forecasting_tpu.data import tensorize
+
+    batch = tensorize(df_long)
+    t0 = time.perf_counter()
+    glm_mape = glm_cv_mape_batch(batch)
+    t_glm = time.perf_counter() - t0
+
+    keys = np.asarray(batch.keys)
+    prophet_mapes = []
+    t0 = time.perf_counter()
+    for idx in range(batch.n_series):
+        store, item = int(keys[idx][0]), int(keys[idx][1])
+        sub = df_long[(df_long["store"] == store) & (df_long["item"] == item)]
+        dfp = pd.DataFrame({"ds": sub["date"].values, "y": sub["sales"].values})
+        try:
+            prophet_mapes.append(prophet_cv_mape(dfp))
+        except Exception as e:  # a series Prophet cannot fit: record + skip
+            print(f"  [prophet failed on ({store},{item}): "
+                  f"{type(e).__name__}: {e}]", file=sys.stderr)
+            prophet_mapes.append(float("nan"))
+    t_pr = time.perf_counter() - t0
+    prophet_mapes = np.asarray(prophet_mapes)
+
+    ok = np.isfinite(prophet_mapes) & np.isfinite(glm_mape)
+    p_mean = float(prophet_mapes[ok].mean())
+    g_mean = float(glm_mape[ok].mean())
+    rel = (g_mean - p_mean) / p_mean
+    wins = int((glm_mape[ok] <= prophet_mapes[ok]).sum())
+    print(f"\n== {name}: {int(ok.sum())}/{batch.n_series} series compared ==")
+    print(f"  real Prophet CV MAPE (mean): {p_mean:.4f}   [{t_pr:.0f}s wall]")
+    print(f"  prophet_glm  CV MAPE (mean): {g_mean:.4f}   [{t_glm:.1f}s wall]")
+    print(f"  relative delta: {100 * rel:+.2f}%  "
+          f"({'WITHIN' if rel <= 0.05 else 'OUTSIDE'} the <=5% target; "
+          f"negative = glm better)")
+    print(f"  per-series: glm <= prophet on {wins}/{int(ok.sum())}")
+    results.append({
+        "dataset": name,
+        "prophet_mape": round(p_mean, 5),
+        "glm_mape": round(g_mean, 5),
+        "rel_delta": round(rel, 5),
+        "within_5pct": bool(rel <= 0.05),
+        "n_series": int(ok.sum()),
+        "prophet_wall_s": round(t_pr, 1),
+        "glm_wall_s": round(t_glm, 2),
+    })
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--real", type=int, default=50,
+                    help="series from the committed real dataset (0 = skip)")
+    ap.add_argument("--skip-synthetic", action="store_true")
+    args = ap.parse_args()
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    try:
+        import prophet  # noqa: F401
+    except ImportError:
+        sys.exit("prophet not installed: pip install -e '.[prophet]' "
+                 "(this lane runs in CI job prophetParity)")
+    os.environ.setdefault("DFTPU_PLATFORM", "cpu")
+    import distributed_forecasting_tpu  # noqa: F401
+
+    from distributed_forecasting_tpu.data.dataset import (
+        load_sales_csv,
+        synthetic_store_item_sales,
+    )
+
+    results = []
+    if not args.skip_synthetic:
+        df = synthetic_store_item_sales(n_stores=2, n_items=5, n_days=1461,
+                                        seed=0)
+        compare("synthetic 10-series fixture", df, results)
+
+    if args.real > 0:
+        path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "datasets", "store_item_demand.csv.gz")
+        df = load_sales_csv(path)
+        # first N series in (store, item) order
+        keys = df[["store", "item"]].drop_duplicates().sort_values(
+            ["store", "item"]).head(args.real)
+        df = df.merge(keys, on=["store", "item"])
+        compare(f"real-shaped dataset, first {args.real} series", df,
+                results)
+
+    for r in results:
+        print(json.dumps(r))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,101 @@
+"""Measured sweep costs for ``order: auto`` and ``season_length: auto``.
+
+VERDICT r3 #7: README's "a small grid sweep is seconds, not minutes" claim
+for the compiled-per-candidate designs had no measured line.  This script
+times both auto-selections at the headline shape (500 series x 1826 days)
+and prints compile-count x candidate-cost so docs/benchmarks.md can carry
+numbers:
+
+  * ``order: auto`` — ``engine/order.select_arima_order`` CVs every
+    (p, d, q) candidate as ONE batched fit+CV over all 500 series; each
+    distinct order is one XLA compile (static shapes), so the sweep cost =
+    n_orders x (compile + device CV).  Both the cold sweep (compiles
+    included — what a user pays once) and the warm sweep (steady-state
+    re-selection, e.g. a retrain task on fresh data with the same grid)
+    are reported.
+  * ``season_length: auto`` — ``engine/season.detect_season_length`` is a
+    host-side ACF scorer over candidate periods; one pass, no per-candidate
+    compiles.
+
+Reference analogue: pmdarima's stepwise auto_arima refits per series
+per candidate (minutes for 500 series); hyperopt TPE costs one sequential
+trial per point (reference automl notebook).
+
+Run on TPU: python scripts/sweep_cost.py   (--allow-cpu to force).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--allow-cpu", action="store_true")
+    ap.add_argument("--series", type=int, default=500)
+    ap.add_argument("--days", type=int, default=1826)
+    ap.add_argument("--max-orders", type=int, default=0,
+                    help="truncate the candidate grid (0 = full; smoke use)")
+    args = ap.parse_args()
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import distributed_forecasting_tpu  # noqa: F401  (platform override first)
+    import jax
+
+    dev = jax.devices()[0]
+    if dev.platform != "tpu" and not args.allow_cpu:
+        sys.exit("refusing on non-TPU backend; pass --allow-cpu to force")
+    print(f"device: {dev.platform} ({dev.device_kind})", file=sys.stderr)
+
+    from distributed_forecasting_tpu.data import synthetic_series_batch
+    from distributed_forecasting_tpu.engine.order import (
+        DEFAULT_ORDERS,
+        select_arima_order,
+    )
+    from distributed_forecasting_tpu.engine.season import detect_season_length
+
+    batch = synthetic_series_batch(
+        n_stores=10, n_items=args.series // 10, n_days=args.days, seed=3
+    )
+    float(batch.y.sum())
+    S = batch.n_series
+
+    # ---- order: auto ------------------------------------------------------
+    orders = DEFAULT_ORDERS
+    if args.max_orders > 0:
+        orders = DEFAULT_ORDERS[: args.max_orders]
+    n = len(orders)
+    t0 = time.perf_counter()
+    best_cold, table = select_arima_order(
+        batch, orders=orders, key=jax.random.PRNGKey(0)
+    )
+    t_cold = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    best_warm, _ = select_arima_order(
+        batch, orders=orders, key=jax.random.PRNGKey(1)
+    )
+    t_warm = time.perf_counter() - t0
+    print(
+        f"order:auto  {n} candidate (p,d,q) x {S} series x {args.days} d: "
+        f"cold {t_cold:.1f}s ({t_cold / n:.2f}s/candidate incl. compile), "
+        f"warm {t_warm:.1f}s ({t_warm / n:.2f}s/candidate) -> best "
+        f"{best_cold}"
+    )
+    top = ", ".join(f"{o}={s:.4f}" for o, s, _ in table[:3])
+    print(f"  top-3: {top}")
+
+    # ---- season_length: auto ---------------------------------------------
+    t0 = time.perf_counter()
+    m = detect_season_length(batch)
+    t_season = time.perf_counter() - t0
+    print(
+        f"season_length:auto  ACF scan over {S} series: {t_season:.2f}s "
+        f"-> detected {m}"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -23,7 +23,7 @@ note "watcher up (pid $$, probe every ${PROBE_EVERY}s)"
 while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   if timeout 90 python -c "import jax, jax.numpy as jnp; d=jax.devices()[0]; assert d.platform=='tpu', d; print(float(jnp.ones((256,256)).sum()))" >> "$LOG" 2>&1; then
     note "probe OK — launching harvest"
-    bash scripts/tpu_window.sh >> "$LOG" 2>&1
+    bash "${DFTPU_WINDOW_SCRIPT:-scripts/tpu_window.sh}" >> "$LOG" 2>&1
     rc=$?
     note "harvest finished rc=$rc"
     if [ "$rc" -eq 0 ]; then

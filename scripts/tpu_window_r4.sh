@@ -1,0 +1,45 @@
+#!/bin/bash
+# Round-4 follow-up harvest: everything still owed to the chip after the
+# main window (scripts/tpu_window.sh) ran.  Cheapest/highest-value first:
+#   1. integration tier — must go green with the chunked-Cholesky VMEM fix
+#   2. MFU/roofline + chunk-ladder lever (scripts/mfu_roofline.py)
+#   3. sweep costs: order:auto + season_length:auto (scripts/sweep_cost.py)
+#   4. slim gram F=256 rung (reduced reps; the F<=192 trend is already
+#      decision-grade, this is a bonus attempt at the crossover)
+#   5. phase-split retry with smaller scans (hung at defaults twice)
+# Usage: bash scripts/tpu_window_r4.sh
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p scripts/tpu_logs
+ts=$(date +%Y%m%dT%H%M%S)
+
+echo "== probe =="
+if ! timeout 90 python -c "import jax, jax.numpy as jnp; d=jax.devices()[0]; assert d.platform=='tpu', d; print('TPU OK', d.device_kind, float(jnp.ones((256,256)).sum()))"; then
+  echo "tunnel not healthy; aborting (nothing written)"
+  exit 1
+fi
+
+echo "== 1/5 integration tier (make test-tpu) =="
+timeout 1500 make test-tpu 2>&1 | tee "scripts/tpu_logs/test_tpu_${ts}.log"
+rc=${PIPESTATUS[0]}
+echo "test-tpu rc=$rc" | tee -a "scripts/tpu_logs/test_tpu_${ts}.log"
+
+echo "== 2/5 MFU / roofline =="
+timeout 1200 python scripts/mfu_roofline.py 2>&1 \
+  | tee "scripts/tpu_logs/mfu_${ts}.log"
+
+echo "== 3/5 sweep costs =="
+timeout 1500 python scripts/sweep_cost.py 2>&1 \
+  | tee "scripts/tpu_logs/sweep_${ts}.log"
+
+echo "== 4/5 slim gram F=256 =="
+timeout 1200 python scripts/gram_winregime.py --widths 256 --staged 2 \
+  --reps-long 6 2>&1 | tee "scripts/tpu_logs/gram256_${ts}.log"
+
+echo "== 5/5 phase split (small scans) =="
+timeout 900 python scripts/phase_split.py --reps-long 4 2>&1 \
+  | tee "scripts/tpu_logs/phase_split_${ts}.log"
+
+echo "== done: logs in scripts/tpu_logs/*_${ts}.* =="
+# overall rc: the integration tier is the must-pass
+exit "$rc"

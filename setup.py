@@ -33,6 +33,11 @@ setup(
         # run against an actual mlflow file/sqlite store
         # (tests/optional/test_mlflow_real.py; CI job mlflowInterop)
         "mlflow": ["mlflow>=2.0"],
+        # Prophet parity lane: measures the headline accuracy claim
+        # (BASELINE.md: <=5% CV-MAPE delta vs Prophet) against the REAL
+        # prophet package (tests/optional/test_prophet_parity.py;
+        # scripts/prophet_parity.py; CI job prophetParity)
+        "prophet": ["prophet>=1.1"],
     },
     entry_points={
         "console_scripts": [
