@@ -9,11 +9,21 @@ scoring smape over CV folds, one process per series
 On TPU the search is just more batch: candidate prior scales are TRACED
 inputs to the curve-model fit (see ``models/prophet_glm._prior_precision``),
 so all trials x all series x all CV cutoffs run inside one compiled program
-per seasonality mode — no TPE needed when the full random-search sweep costs
-less than one Stan fit.  Selection is per-series argmin of CV-mean smape
-(matching the reference's per-series tuning granularity), followed by one
-refit of every series with its own winning scales (a per-series (S, F) ridge
-precision — one more batched solve).
+per seasonality mode — a full random-search sweep costs less than one Stan
+fit.  Selection is per-series argmin of CV-mean smape (matching the
+reference's per-series tuning granularity), followed by one refit of every
+series with its own winning scales (a per-series (S, F) ridge precision —
+one more batched solve).
+
+ADAPTIVE search (``adaptive_rounds > 1``) recovers TPE's
+exploit-the-posterior behavior the TPU-native way: after the log-uniform
+round, each further round resamples every series' scales log-normally
+AROUND THAT SERIES' OWN INCUMBENT with a geometrically shrinking width —
+per-series zoom, the same granularity hyperopt gets from one TPE process
+per series, at batch cost: prior scales are data, so every round reuses
+ONE compiled program per mode ((n_trials, S)-shaped trials; the incumbent
+update is an elementwise min).  Round 0 explores the box; later rounds
+exploit; the box clips every proposal.
 
 Fault tolerance: a trial whose metrics go non-finite scores +inf and can
 never win (``train_with_fail_safe`` semantics, ``...py:131-136``).
@@ -52,6 +62,13 @@ class HyperSearchConfig:
     hol_scale_range: Tuple[float, float] = (0.01, 10.0)
     modes: Tuple[str, ...] = ("additive", "multiplicative")
     seed: int = 0
+    # adaptive zoom (TPE-parity): total rounds including the log-uniform
+    # round; each later round samples per-series log-normal around that
+    # series' incumbent with width zoom_sigma * zoom_factor**(round-1),
+    # clipped to the box.  1 = plain random search.
+    adaptive_rounds: int = 1
+    zoom_sigma: float = 0.8
+    zoom_factor: float = 0.5
 
 
 @dataclasses.dataclass
@@ -118,43 +135,89 @@ def tune_curve_model(
     xreg = validate_xreg(get_model("prophet"), "prophet", base_config, xreg,
                          None, "tune_curve_model", trim_to=batch.n_time)
     key = jax.random.PRNGKey(search.seed)
-    k_cp, k_seas, k_hol = jax.random.split(key, 3)
-    cp_scales = _log_uniform(k_cp, *search.cp_scale_range, search.n_trials)
-    seas_scales = _log_uniform(k_seas, *search.seas_scale_range, search.n_trials)
-    hol_scales = _log_uniform(k_hol, *search.hol_scale_range, search.n_trials)
-
     S = batch.n_series
-    all_scores = []  # list of (n_trials, S) per mode
-    trial_rows = []
-    for mode in search.modes:
-        cfg = dataclasses.replace(base_config, seasonality_mode=mode)
-        scores = _cv_scores(batch, cfg, cv, cp_scales, seas_scales, hol_scales,
-                            search.metric, xreg=xreg)
-        all_scores.append(np.asarray(scores))
-        for t in range(search.n_trials):
-            trial_rows.append(
-                {
-                    "mode": mode,
-                    "changepoint_prior_scale": float(cp_scales[t]),
-                    "seasonality_prior_scale": float(seas_scales[t]),
-                    "holidays_prior_scale": float(hol_scales[t]),
-                    f"mean_{search.metric}": float(np.mean(all_scores[-1][t])),
-                }
-            )
+    n = search.n_trials
+    ranges = (search.cp_scale_range, search.seas_scale_range,
+              search.hol_scale_range)
 
-    stacked = np.stack(all_scores)  # (n_modes, n_trials, S)
-    flat = stacked.reshape(-1, S)
-    best_flat = np.argmin(flat, axis=0)  # (S,)
-    best_mode_idx = best_flat // search.n_trials
-    best_trial_idx = best_flat % search.n_trials
-    cp_np = np.asarray(cp_scales)
-    seas_np = np.asarray(seas_scales)
-    hol_np = np.asarray(hol_scales)
-    best_cp = cp_np[best_trial_idx]
-    best_seas = seas_np[best_trial_idx]
-    best_hol = hol_np[best_trial_idx]
+    # per-series incumbent state; round 0 always updates it (inf scores lose
+    # to anything finite; the geometric box midpoints only survive if every
+    # single trial went non-finite for a series)
+    best_score = np.full(S, np.inf)
+    best_cp, best_seas, best_hol = (
+        np.full(S, float(np.sqrt(lo * hi))) for lo, hi in ranges
+    )
+    best_mode_idx = np.zeros(S, dtype=int)
+
+    trial_rows = []
+    rounds = max(1, int(search.adaptive_rounds))
+    for r in range(rounds):
+        if r == 0:
+            key, k_cp, k_seas, k_hol = jax.random.split(key, 4)
+            trials = [
+                _log_uniform(k, lo, hi, n)  # (n,) shared across series
+                for k, (lo, hi) in zip((k_cp, k_seas, k_hol), ranges)
+            ]
+        else:
+            # zoom: per-series log-normal around each series' incumbent,
+            # geometrically narrowing, clipped to the box.  (n, S)-shaped
+            # trial values are DATA, so every zoom round reuses the same
+            # compiled program per mode.
+            sigma = search.zoom_sigma * search.zoom_factor ** (r - 1)
+            key, k_cp, k_seas, k_hol = jax.random.split(key, 4)
+            trials = []
+            for k, (lo, hi), inc in zip(
+                (k_cp, k_seas, k_hol), ranges, (best_cp, best_seas, best_hol)
+            ):
+                eps = jax.random.normal(k, (n, S))
+                prop = jnp.exp(jnp.log(jnp.asarray(inc))[None, :] + sigma * eps)
+                trials.append(jnp.clip(prop, lo, hi))
+        cp_t, seas_t, hol_t = trials
+        cp_np, seas_np, hol_np = (np.asarray(v) for v in trials)
+
+        for mi, mode in enumerate(search.modes):
+            cfg = dataclasses.replace(base_config, seasonality_mode=mode)
+            scores = np.asarray(
+                _cv_scores(batch, cfg, cv, cp_t, seas_t, hol_t,
+                           search.metric, xreg=xreg)
+            )  # (n, S)
+            for t in range(n):
+                finite = np.isfinite(scores[t])
+                trial_rows.append(
+                    {
+                        "round": r,
+                        "mode": mode,
+                        # zoom rounds carry per-series scales; the table
+                        # reports the geometric mean as the trial's location
+                        "changepoint_prior_scale": float(
+                            np.exp(np.mean(np.log(cp_np[t])))
+                        ),
+                        "seasonality_prior_scale": float(
+                            np.exp(np.mean(np.log(seas_np[t])))
+                        ),
+                        "holidays_prior_scale": float(
+                            np.exp(np.mean(np.log(hol_np[t])))
+                        ),
+                        f"mean_{search.metric}": float(
+                            np.mean(scores[t][finite])
+                        ) if finite.any() else float("inf"),
+                    }
+                )
+            t_best = np.argmin(scores, axis=0)  # (S,)
+            sc = scores[t_best, np.arange(S)]
+            upd = sc < best_score
+
+            def pick(vals, t_best=t_best):
+                return vals[t_best] if vals.ndim == 1 else vals[t_best,
+                                                               np.arange(S)]
+
+            best_cp = np.where(upd, pick(cp_np), best_cp)
+            best_seas = np.where(upd, pick(seas_np), best_seas)
+            best_hol = np.where(upd, pick(hol_np), best_hol)
+            best_mode_idx = np.where(upd, mi, best_mode_idx)
+            best_score = np.minimum(best_score, sc)
+
     best_mode = np.asarray(search.modes)[best_mode_idx]
-    best_score = flat[best_flat, np.arange(S)]
 
     # refit every series with its own winning scales, once per mode (mode is
     # a static code path); serving keeps per-mode params + a mode vector.

@@ -603,6 +603,9 @@ class TrainingPipeline:
             n_trials=int(tuning.get("n_trials", 8)),
             metric=tuning.get("metric", "smape"),
             seed=int(tuning.get("seed", 0)),
+            # TPE-parity adaptive zoom: rounds > 1 resample per series
+            # around incumbents with shrinking width (engine/hyper.py)
+            adaptive_rounds=int(tuning.get("adaptive_rounds", 1)),
         )
         cv = CVConfig(**(cv_conf or {}))
 
@@ -708,9 +711,10 @@ class TrainingPipeline:
         table_df = forecast_frame(batch, result)
         version = self.catalog.save_table(output_table, table_df)
         self.logger.info(
-            "tuned fit: %d series, %d trials x %d modes in %.2fs -> %s v%s",
-            batch.n_series, search.n_trials, len(modes), fit_seconds,
-            output_table, version,
+            "tuned fit: %d series, %d trials x %d modes x %d rounds in "
+            "%.2fs -> %s v%s",
+            batch.n_series, search.n_trials, len(modes),
+            search.adaptive_rounds, fit_seconds, output_table, version,
         )
         return {
             "experiment_id": eid,

@@ -88,6 +88,9 @@ def glm_cv_mape_batch(batch):
 
 
 def compare(name, df_long, results):
+    """Run the full comparison protocol on one dataset; appends the summary
+    dict to ``results`` AND returns it (the optional test lane asserts on
+    the returned dict so the protocol lives in exactly one place)."""
     import numpy as np
     import pandas as pd
 
@@ -126,7 +129,7 @@ def compare(name, df_long, results):
           f"({'WITHIN' if rel <= 0.05 else 'OUTSIDE'} the <=5% target; "
           f"negative = glm better)")
     print(f"  per-series: glm <= prophet on {wins}/{int(ok.sum())}")
-    results.append({
+    summary = {
         "dataset": name,
         "prophet_mape": round(p_mean, 5),
         "glm_mape": round(g_mean, 5),
@@ -135,7 +138,9 @@ def compare(name, df_long, results):
         "n_series": int(ok.sum()),
         "prophet_wall_s": round(t_pr, 1),
         "glm_wall_s": round(t_glm, 2),
-    })
+    }
+    results.append(summary)
+    return summary
 
 
 def main() -> None:

@@ -206,3 +206,64 @@ def test_per_series_runs_scale_guard(monkeypatch):
     # above the cap override it proceeds (and hits the fake tracker)
     with pytest.raises(AssertionError):
         pipe._log_per_series_runs("e", big, "parent")
+
+
+class TestAdaptiveZoom:
+    """adaptive_rounds > 1: per-series log-normal zoom around incumbents
+    (the TPU-native TPE replacement) must only ever improve the per-series
+    best and must keep proposals inside the box."""
+
+    @pytest.fixture(scope="class")
+    def runs(self, batch_small):
+        cv = CVConfig(initial=730, period=180, horizon=90)
+        plain = tune_curve_model(
+            batch_small,
+            search=HyperSearchConfig(n_trials=4, seed=3, adaptive_rounds=1),
+            cv=cv,
+        )
+        adaptive = tune_curve_model(
+            batch_small,
+            search=HyperSearchConfig(n_trials=4, seed=3, adaptive_rounds=3),
+            cv=cv,
+        )
+        return plain, adaptive
+
+    def test_adaptive_never_worse_per_series(self, runs):
+        plain, adaptive = runs
+        # same seed => identical round 0; zoom rounds take elementwise min,
+        # so every series' adaptive best <= its random-search best
+        assert (adaptive.best_score <= plain.best_score + 1e-9).all()
+
+    def test_adaptive_improves_somewhere(self, runs):
+        plain, adaptive = runs
+        assert adaptive.best_score.mean() < plain.best_score.mean() + 1e-9
+        assert (adaptive.best_score < plain.best_score - 1e-12).any()
+
+    def test_trial_table_rounds(self, runs):
+        _, adaptive = runs
+        assert set(adaptive.trials["round"]) == {0, 1, 2}
+        # 3 rounds x 4 trials x 2 modes
+        assert len(adaptive.trials) == 24
+
+    def test_proposals_respect_box(self, runs):
+        _, adaptive = runs
+        s = HyperSearchConfig()
+        assert (adaptive.best_cp_scale >= s.cp_scale_range[0] - 1e-12).all()
+        assert (adaptive.best_cp_scale <= s.cp_scale_range[1] + 1e-12).all()
+        assert (adaptive.best_seas_scale >= s.seas_scale_range[0] - 1e-12).all()
+        assert (adaptive.best_seas_scale <= s.seas_scale_range[1] + 1e-12).all()
+
+    def test_refit_usable(self, runs, batch_small):
+        import jax
+
+        from distributed_forecasting_tpu.models import prophet_glm
+
+        _, adaptive = runs
+        mode = adaptive.config.seasonality_mode
+        params = adaptive.mode_params[mode]
+        day_all = batch_small.day
+        yhat, lo, hi = prophet_glm.forecast(
+            params, day_all, day_all[-1].astype("float32"),
+            adaptive.config, jax.random.PRNGKey(0),
+        )
+        assert np.isfinite(np.asarray(yhat)).all()
