@@ -606,6 +606,8 @@ class TrainingPipeline:
             # TPE-parity adaptive zoom: rounds > 1 resample per series
             # around incumbents with shrinking width (engine/hyper.py)
             adaptive_rounds=int(tuning.get("adaptive_rounds", 1)),
+            zoom_sigma=float(tuning.get("zoom_sigma", 0.8)),
+            zoom_factor=float(tuning.get("zoom_factor", 0.5)),
         )
         cv = CVConfig(**(cv_conf or {}))
 

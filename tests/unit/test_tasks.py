@@ -477,3 +477,37 @@ def test_platform_override(monkeypatch):
     import jax
 
     assert jax.default_backend() == "cpu"
+
+
+def test_committed_workflows_yml_is_valid():
+    """Every workflow in conf/workflows.yml parses, resolves to known task
+    types, topo-sorts without cycles, and its conf_files exist — so a typo
+    in the committed DAGs fails here, not at launch time."""
+    import os
+
+    from distributed_forecasting_tpu.tasks import TASK_TYPES
+    from distributed_forecasting_tpu.utils.config import load_conf
+    from distributed_forecasting_tpu.workflows.runner import WorkflowRunner
+
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    spec = load_conf(os.path.join(repo, "conf", "workflows.yml"))
+    names = [w["name"] for w in spec["workflows"]]
+    assert "forecasting-e2e" in names
+    assert "real-data-e2e" in names
+    runner = WorkflowRunner(spec)
+    for wf in spec["workflows"]:
+        order = runner._topo_order(wf.get("tasks", []))
+        assert len(order) == len(wf["tasks"]), wf["name"]
+        for node in wf["tasks"]:
+            assert node.get("task") in TASK_TYPES, (
+                f"{wf['name']}:{node['name']} unknown task {node.get('task')}"
+            )
+            if node.get("conf_file"):
+                assert os.path.exists(os.path.join(repo, node["conf_file"])), (
+                    f"{wf['name']}:{node['name']} missing {node['conf_file']}"
+                )
+    # the real-data workflow's input file is the committed dataset
+    real = next(w for w in spec["workflows"] if w["name"] == "real-data-e2e")
+    etl = next(t for t in real["tasks"] if t["name"] == "etl")
+    assert os.path.exists(os.path.join(repo, etl["conf"]["input"]["path"]))
