@@ -36,7 +36,8 @@ def _compose(left, right):
     return A2 @ A1, (A2 @ c1[..., None])[..., 0] + c2
 
 
-def blocked_prefix(compose, elems, identity, block_size: int, project=None):
+def blocked_prefix(compose, elems, identity, block_size: int, project=None,
+                   return_carry: bool = False):
     """All prefix compositions ``e_1 (x) ... (x) e_t`` of an associative
     operator, blocked over the leading (time) axis.
 
@@ -56,13 +57,22 @@ def blocked_prefix(compose, elems, identity, block_size: int, project=None):
     keeping parallel depth log2(block_size) + T/block_size.  Used by
     ``affine_scan`` (affine pairs, projected to states) and ``ops/pkalman``
     (5-tuple Kalman filtering elements, projected to mean/cov).
+
+    ``return_carry=True`` additionally returns the TOTAL composition of all
+    T elements (identity padding is a no-op, so the carry is exact) as
+    ``(carry, projected)`` — the cross-device two-phase scan's phase-1
+    reduce, at no extra compute.
     """
     if project is None:
         project = lambda full: full
     leaves = jax.tree_util.tree_leaves(elems)
     T = leaves[0].shape[0]
     if T <= block_size:
-        return project(jax.lax.associative_scan(compose, elems))
+        full = jax.lax.associative_scan(compose, elems)
+        if return_carry:
+            carry = jax.tree_util.tree_map(lambda f: f[-1], full)
+            return carry, project(full)
+        return project(full)
     nb = -(-T // block_size)
     pad = nb * block_size - T
     if pad:
@@ -92,10 +102,13 @@ def blocked_prefix(compose, elems, identity, block_size: int, project=None):
         return new_carry, project(full)
 
     carry0 = jax.tree_util.tree_map(lambda i: i[0], identity)
-    _, out = jax.lax.scan(block_step, carry0, blocked)
-    return jax.tree_util.tree_map(
+    carry, out = jax.lax.scan(block_step, carry0, blocked)
+    out = jax.tree_util.tree_map(
         lambda f: f.reshape(nb * block_size, *f.shape[2:])[:T], out
     )
+    if return_carry:
+        return carry, out
+    return out
 
 
 def affine_scan(
@@ -137,4 +150,95 @@ def affine_scan_batched(A, c, x0):
     fn = affine_scan
     for _ in range(A.ndim - 3):
         fn = jax.vmap(fn)
+    return fn(A, c, x0)
+
+
+def _local_total(A, c, block_size: int):
+    """Compose-reduce of a chunk's affine maps — the chunk's TOTAL map —
+    without materializing cumulative (T, d, d) maps beyond one block
+    (``blocked_prefix`` with an empty projection; only the carry is kept)."""
+    d = c.shape[-1]
+    identity = (
+        jnp.eye(d, dtype=A.dtype)[None],
+        jnp.zeros((1, d), c.dtype),
+    )
+    carry, _ = blocked_prefix(
+        _compose, (A, c), identity, block_size,
+        project=lambda full: (), return_carry=True,
+    )
+    return carry
+
+
+def affine_scan_time_sharded(
+    A: jnp.ndarray,
+    c: jnp.ndarray,
+    x0: jnp.ndarray,
+    mesh,
+    axis_name: str = "series",
+    block_size: int = 1024,
+) -> jnp.ndarray:
+    """``affine_scan`` with the TIME axis sharded across the device mesh —
+    CROSS-CHIP sequence parallelism for state-space recurrences (SURVEY.md
+    §5 long-context: the state-space analogue of ring attention's sequence
+    sharding, without the cargo cult — forecasting recurrences carry a
+    (d,)-state, not attention KV, so the right collective is a carry
+    exchange, not a ring of KV blocks).
+
+    Standard two-phase parallel scan over the mesh:
+
+      1. each device compose-reduces its local T/D chunk to ONE total
+         affine map (blocked, so no (T, d, d) materialization);
+      2. the D per-device totals are ``all_gather``-ed (tiny: D x (d^2+d)
+         floats over ICI), every device computes the exclusive prefix of
+         the devices before it and applies it to ``x0`` — its effective
+         initial state;
+      3. each device runs the on-chip blocked prefix scan
+         (:func:`affine_scan`) from that state.
+
+    Two passes over local data + one tiny collective: T can exceed single-
+    chip HBM by the mesh factor.  A: (T, d, d), c: (T, d) globally; the
+    mesh size must divide T evenly (pad with identity maps A=I, c=0 to a
+    multiple — padded states replicate the last real state).  Returns
+    (T, d) sharded
+    the same way.  Equivalence vs the single-device scan is tested on the
+    8-device CPU mesh (``tests/unit/test_pscan.py``).
+    """
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    D = mesh.shape[axis_name]
+    T, d = c.shape
+    if T % D != 0:
+        raise ValueError(
+            f"the mesh's {D} devices must divide the time axis T={T} "
+            f"evenly; pad with identity maps (A=eye, c=0) to a multiple"
+        )
+
+    def local(Al, cl, x0l):
+        with jax.default_matmul_precision("float32"):
+            tot = _local_total(Al, cl, block_size)
+            totA = jax.lax.all_gather(tot[0], axis_name)  # (D, d, d)
+            totc = jax.lax.all_gather(tot[1], axis_name)  # (D, d)
+            pref = jax.lax.associative_scan(_compose, (totA, totc))
+            idx = jax.lax.axis_index(axis_name)
+            prevA = jnp.where(
+                idx == 0,
+                jnp.eye(d, dtype=Al.dtype),
+                jnp.take(pref[0], idx - 1, axis=0, mode="clip"),
+            )
+            prevc = jnp.where(
+                idx == 0,
+                jnp.zeros(d, cl.dtype),
+                jnp.take(pref[1], idx - 1, axis=0, mode="clip"),
+            )
+            x_eff = (prevA @ x0l[:, None])[..., 0] + prevc
+            return affine_scan(Al, cl, x_eff, block_size)
+
+    fn = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(axis_name), P(axis_name), P()),
+        out_specs=P(axis_name),
+        check_rep=False,
+    )
     return fn(A, c, x0)
