@@ -105,3 +105,15 @@ def test_ingest_task_accepts_gz(tmp_path, monkeypatch):
     df = task.catalog.read_table("test.sales.raw_real")
     assert len(df) == 913000
     assert version is not None
+
+
+def test_gz_pandas_fallback(monkeypatch):
+    """Without the native library the gz path must fall through to pandas
+    (which reads gzip transparently) and produce the same frame shape."""
+    from distributed_forecasting_tpu.data import native
+    from distributed_forecasting_tpu.data.dataset import load_sales_csv
+
+    monkeypatch.setattr(native, "is_available", lambda: False)
+    df = load_sales_csv(DATASET)
+    assert len(df) == 913000
+    assert list(df.columns) == ["date", "store", "item", "sales"]
