@@ -153,22 +153,12 @@ def _filter(y, mask, alpha, beta, gamma, m, mode, phi=1.0):
     return (l, b, s), mse, preds
 
 
-def parallel_filter(y, mask, alpha, beta, gamma, m, phi=1.0):
-    """Additive HW filter via parallel prefix over time (O(log T) depth).
-
-    The sequential ``_filter`` is a lax.scan — fine at T~2k, but serial depth
-    T dominates for very long series.  The additive update is affine in the
-    state x = [l, b, s_0..s_{m-1}]:  x_t = A_t x_{t-1} + c_t, with A_t
-    depending only on (observed_t, slot_t) — so the whole trajectory is an
-    associative scan over affine maps (ops/pscan.py), the time-dimension
-    parallelism story of this framework (SURVEY.md §5).
-
-    Returns (final_state_tuple, mse, preds) matching ``_filter`` semantics
-    (mode='additive', same ``phi`` damping — the prior-trend coefficients
-    of the affine maps each carry the phi factor).
-    """
-    from distributed_forecasting_tpu.ops.pscan import affine_scan
-
+def _affine_elems(y, mask, alpha, beta, gamma, m, phi=1.0):
+    """The additive HW update as per-step affine maps x_t = A_t x_{t-1} + c_t
+    over the state x = [l, b, s_0..s_{m-1}] — shared by the on-chip parallel
+    prefix (:func:`parallel_filter`) and the cross-chip time-sharded variant
+    (:func:`parallel_filter_time_sharded`).  Returns (A (T,d,d), c (T,d),
+    x0 (d,), e (T,m) one-hot slots)."""
     T = y.shape[0]
     d = m + 2
     idx = jnp.arange(T) % m
@@ -228,8 +218,12 @@ def parallel_filter(y, mask, alpha, beta, gamma, m, phi=1.0):
 
     l0, b0, s0 = _init_state(y, mask, m, "additive")
     x0 = jnp.concatenate([jnp.stack([l0, b0]), s0])
-    states = affine_scan(A, c, x0)  # (T, d) after each step
+    return A, c, x0, e
 
+
+def _filter_outputs(states, x0, e, y, mask, phi):
+    """(final_state_tuple, mse, preds) from the scanned state trajectory —
+    the shared tail of both parallel filters, matching ``_filter``."""
     prev = jnp.concatenate([x0[None], states[:-1]], axis=0)  # state before t
     preds = prev[:, 0] + phi * prev[:, 1] + jnp.sum(prev[:, 2:] * e, axis=1)
     err = (y - preds) * mask
@@ -237,6 +231,73 @@ def parallel_filter(y, mask, alpha, beta, gamma, m, phi=1.0):
     mse = jnp.sum(err**2) / n
     xT = states[-1]
     return (xT[0], xT[1], xT[2:]), mse, preds
+
+
+def parallel_filter(y, mask, alpha, beta, gamma, m, phi=1.0):
+    """Additive HW filter via parallel prefix over time (O(log T) depth).
+
+    The sequential ``_filter`` is a lax.scan — fine at T~2k, but serial depth
+    T dominates for very long series.  The additive update is affine in the
+    state x = [l, b, s_0..s_{m-1}]:  x_t = A_t x_{t-1} + c_t, with A_t
+    depending only on (observed_t, slot_t) — so the whole trajectory is an
+    associative scan over affine maps (ops/pscan.py), the time-dimension
+    parallelism story of this framework (SURVEY.md §5).
+
+    Returns (final_state_tuple, mse, preds) matching ``_filter`` semantics
+    (mode='additive', same ``phi`` damping — the prior-trend coefficients
+    of the affine maps each carry the phi factor).
+    """
+    from distributed_forecasting_tpu.ops.pscan import affine_scan
+
+    A, c, x0, e = _affine_elems(y, mask, alpha, beta, gamma, m, phi)
+    states = affine_scan(A, c, x0)  # (T, d) after each step
+    return _filter_outputs(states, x0, e, y, mask, phi)
+
+
+def parallel_filter_time_sharded(y, mask, alpha, beta, gamma, m, mesh,
+                                 axis_name="series", phi=1.0):
+    """:func:`parallel_filter` with the TIME axis sharded across a device
+    mesh — the model-level entry to cross-chip sequence parallelism
+    (ops/pscan.affine_scan_time_sharded): one very long series' filter pass
+    can span every chip, T growing with the mesh.  Same return contract as
+    ``_filter``/``parallel_filter``.
+
+    T must be a multiple of the mesh size.  To extend a shorter series,
+    pad at the OPS level with identity maps (A=eye, c=0 —
+    ``affine_scan_time_sharded``'s recipe); masked (mask=0) steps are NOT
+    state-preserving here — the prediction map still advances the level by
+    ``phi * trend`` each step, so a mask-0 tail drifts the returned final
+    state.
+
+    The whole pass (affine-element build + two-phase scan) runs under one
+    ``jit`` with the (T, d, d) element tensors sharding-constrained to the
+    mesh axis, so GSPMD lays them out sharded from the start — the
+    elements are never materialized whole on one device, keeping the
+    memory claim (T beyond one chip's HBM) real.  Equivalence vs the
+    sequential filter is tested on the 8-device virtual mesh
+    (tests/unit/test_pscan.py)."""
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_forecasting_tpu.ops.pscan import affine_scan_time_sharded
+
+    shard = NamedSharding(mesh, P(axis_name))
+
+    @jax.jit
+    def run(y, mask, alpha, beta, gamma, phi):
+        A, c, x0, e = _affine_elems(y, mask, alpha, beta, gamma, m, phi)
+        A = jax.lax.with_sharding_constraint(A, shard)
+        c = jax.lax.with_sharding_constraint(
+            c, NamedSharding(mesh, P(axis_name))
+        )
+        states = affine_scan_time_sharded(A, c, x0, mesh,
+                                          axis_name=axis_name)
+        return _filter_outputs(states, x0, e, y, mask, phi)
+
+    # NOTE: the jit closure is rebuilt per call (mesh/m/axis_name are
+    # captured), so each call pays a trace-cache miss — fine for the
+    # one-pass-per-fit long-T regime this entry exists for.
+    return run(y, mask, alpha, beta, gamma, phi)
 
 
 def _candidate_grid(cfg: HoltWintersConfig):
