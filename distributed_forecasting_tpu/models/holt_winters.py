@@ -287,9 +287,7 @@ def parallel_filter_time_sharded(y, mask, alpha, beta, gamma, m, mesh,
     def run(y, mask, alpha, beta, gamma, phi):
         A, c, x0, e = _affine_elems(y, mask, alpha, beta, gamma, m, phi)
         A = jax.lax.with_sharding_constraint(A, shard)
-        c = jax.lax.with_sharding_constraint(
-            c, NamedSharding(mesh, P(axis_name))
-        )
+        c = jax.lax.with_sharding_constraint(c, shard)
         states = affine_scan_time_sharded(A, c, x0, mesh,
                                           axis_name=axis_name)
         return _filter_outputs(states, x0, e, y, mask, phi)

@@ -37,7 +37,7 @@ def _compose(left, right):
 
 
 def blocked_prefix(compose, elems, identity, block_size: int, project=None,
-                   return_carry: bool = False):
+                   return_carry: bool = False, initial=None):
     """All prefix compositions ``e_1 (x) ... (x) e_t`` of an associative
     operator, blocked over the leading (time) axis.
 
@@ -61,14 +61,29 @@ def blocked_prefix(compose, elems, identity, block_size: int, project=None,
     ``return_carry=True`` additionally returns the TOTAL composition of all
     T elements (identity padding is a no-op, so the carry is exact) as
     ``(carry, projected)`` — the cross-device two-phase scan's phase-1
-    reduce, at no extra compute.
+    reduce, at no extra compute.  ``initial`` (a single element, no leading
+    axis) left-composes into every prefix — phase 3 of the cross-device
+    scan starts each shard from the carried prefix of the shards before it;
+    with ``initial`` set, the returned carry is ``initial (x) total``, not
+    the bare chunk total.
     """
     if project is None:
         project = lambda full: full
     leaves = jax.tree_util.tree_leaves(elems)
     T = leaves[0].shape[0]
+    carry0 = (
+        jax.tree_util.tree_map(lambda i: i[0], identity)
+        if initial is None else initial
+    )
     if T <= block_size:
         full = jax.lax.associative_scan(compose, elems)
+        if initial is not None:
+            full = compose(
+                jax.tree_util.tree_map(
+                    lambda c, p: jnp.broadcast_to(c, p.shape), carry0, full
+                ),
+                full,
+            )
         if return_carry:
             carry = jax.tree_util.tree_map(lambda f: f[-1], full)
             return carry, project(full)
@@ -101,7 +116,6 @@ def blocked_prefix(compose, elems, identity, block_size: int, project=None,
         new_carry = jax.tree_util.tree_map(lambda f: f[-1], full)
         return new_carry, project(full)
 
-    carry0 = jax.tree_util.tree_map(lambda i: i[0], identity)
     carry, out = jax.lax.scan(block_step, carry0, blocked)
     out = jax.tree_util.tree_map(
         lambda f: f.reshape(nb * block_size, *f.shape[2:])[:T], out
@@ -153,20 +167,88 @@ def affine_scan_batched(A, c, x0):
     return fn(A, c, x0)
 
 
-def _local_total(A, c, block_size: int):
-    """Compose-reduce of a chunk's affine maps — the chunk's TOTAL map —
-    without materializing cumulative (T, d, d) maps beyond one block
-    (``blocked_prefix`` with an empty projection; only the carry is kept)."""
-    d = c.shape[-1]
-    identity = (
-        jnp.eye(d, dtype=A.dtype)[None],
-        jnp.zeros((1, d), c.dtype),
+def time_sharded_prefix(
+    compose,
+    elems,
+    identity,
+    mesh,
+    axis_name: str = "series",
+    block_size: int = 1024,
+    project=None,
+    project_args=(),
+    carry_to_project: bool = False,
+):
+    """Generic two-phase prefix scan of ANY associative operator with the
+    leading (time) axis sharded across the device mesh — cross-chip
+    sequence parallelism for whatever :func:`blocked_prefix` runs on chip
+    (affine maps, Kalman 5-tuples, ...).
+
+      1. each device compose-reduces its local T/D chunk to one total
+         element (``blocked_prefix(..., return_carry=True)`` with an empty
+         projection — no cumulative materialization);
+      2. the D totals ride one ``all_gather`` over ICI and every device
+         takes the exclusive prefix of the devices before it;
+      3. each device re-runs its blocked prefix with that carry as
+         ``initial``, projecting per-step outputs as usual.
+
+    ``project(full, *project_args)`` maps full prefix elements to per-step
+    outputs; ``project_args`` are replicated arrays passed through the
+    shard_map explicitly (closures over traced arrays are not allowed
+    inside shard_map).  With ``carry_to_project=True`` the carried element
+    is NOT composed into the per-step maps; instead the projection is
+    called as ``project(local_full, carry, *project_args)`` — for
+    operators whose output is cheap to seed from the carry (the affine
+    scan folds it into x0 once instead of paying an extra (d, d) matmul
+    per step).  T must be a multiple of the mesh size — pad with identity
+    elements upstream.  Outputs come back sharded on the same axis.
+    """
+    if project is None:
+        project = lambda full: full
+    leaves = jax.tree_util.tree_leaves(elems)
+    T = leaves[0].shape[0]
+    D = mesh.shape[axis_name]
+    if T % D != 0:
+        raise ValueError(
+            f"the mesh's {D} devices must divide the time axis T={T} "
+            f"evenly; pad with identity elements to a multiple"
+        )
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def local(elems_local, *pargs):
+        carry, _ = blocked_prefix(
+            compose, elems_local, identity, block_size,
+            project=lambda full: (), return_carry=True,
+        )
+        gathered = jax.tree_util.tree_map(
+            lambda x: jax.lax.all_gather(x, axis_name), carry
+        )
+        pref = jax.lax.associative_scan(compose, gathered)
+        idx = jax.lax.axis_index(axis_name)
+        prev = jax.tree_util.tree_map(
+            lambda p, i: jnp.where(
+                idx == 0, i[0], jnp.take(p, idx - 1, axis=0, mode="clip")
+            ),
+            pref, identity,
+        )
+        if carry_to_project:
+            return blocked_prefix(
+                compose, elems_local, identity, block_size,
+                project=lambda full: project(full, prev, *pargs),
+            )
+        return blocked_prefix(
+            compose, elems_local, identity, block_size,
+            project=lambda full: project(full, *pargs), initial=prev,
+        )
+
+    fn = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(axis_name),) + tuple(P() for _ in project_args),
+        out_specs=P(axis_name),
+        check_rep=False,
     )
-    carry, _ = blocked_prefix(
-        _compose, (A, c), identity, block_size,
-        project=lambda full: (), return_carry=True,
-    )
-    return carry
+    return fn(elems, *project_args)
 
 
 def affine_scan_time_sharded(
@@ -192,8 +274,9 @@ def affine_scan_time_sharded(
          floats over ICI), every device computes the exclusive prefix of
          the devices before it and applies it to ``x0`` — its effective
          initial state;
-      3. each device runs the on-chip blocked prefix scan
-         (:func:`affine_scan`) from that state.
+      3. each device folds that carry into ``x0`` once (its effective
+         initial state) and projects its on-chip blocked prefix
+         (``time_sharded_prefix(carry_to_project=True)``).
 
     Two passes over local data + one tiny collective: T can exceed single-
     chip HBM by the mesh factor.  A: (T, d, d), c: (T, d) globally; the
@@ -203,42 +286,23 @@ def affine_scan_time_sharded(
     the same way.  Equivalence vs the single-device scan is tested on the
     8-device CPU mesh (``tests/unit/test_pscan.py``).
     """
-    from jax.experimental.shard_map import shard_map
-    from jax.sharding import PartitionSpec as P
-
-    D = mesh.shape[axis_name]
-    T, d = c.shape
-    if T % D != 0:
-        raise ValueError(
-            f"the mesh's {D} devices must divide the time axis T={T} "
-            f"evenly; pad with identity maps (A=eye, c=0) to a multiple"
-        )
-
-    def local(Al, cl, x0l):
-        with jax.default_matmul_precision("float32"):
-            tot = _local_total(Al, cl, block_size)
-            totA = jax.lax.all_gather(tot[0], axis_name)  # (D, d, d)
-            totc = jax.lax.all_gather(tot[1], axis_name)  # (D, d)
-            pref = jax.lax.associative_scan(_compose, (totA, totc))
-            idx = jax.lax.axis_index(axis_name)
-            prevA = jnp.where(
-                idx == 0,
-                jnp.eye(d, dtype=Al.dtype),
-                jnp.take(pref[0], idx - 1, axis=0, mode="clip"),
-            )
-            prevc = jnp.where(
-                idx == 0,
-                jnp.zeros(d, cl.dtype),
-                jnp.take(pref[1], idx - 1, axis=0, mode="clip"),
-            )
-            x_eff = (prevA @ x0l[:, None])[..., 0] + prevc
-            return affine_scan(Al, cl, x_eff, block_size)
-
-    fn = shard_map(
-        local,
-        mesh=mesh,
-        in_specs=(P(axis_name), P(axis_name), P()),
-        out_specs=P(axis_name),
-        check_rep=False,
+    T, d = c.shape  # 2-D contract: batched input must fail loudly here
+    identity = (
+        jnp.eye(d, dtype=A.dtype)[None],
+        jnp.zeros((1, d), c.dtype),
     )
-    return fn(A, c, x0)
+
+    def to_states(full, carry, x0_rep):
+        # fold the carried cross-device prefix into x0 ONCE (x_eff), then
+        # project local cumulative maps — no per-step carry composition
+        A_cum, c_cum = full
+        prevA, prevc = carry
+        x_eff = (prevA @ x0_rep[:, None])[..., 0] + prevc
+        return (A_cum @ x_eff[None, :, None])[..., 0] + c_cum
+
+    with jax.default_matmul_precision("float32"):
+        return time_sharded_prefix(
+            _compose, (A, c), identity, mesh, axis_name=axis_name,
+            block_size=block_size, project=to_states, project_args=(x0,),
+            carry_to_project=True,
+        )
