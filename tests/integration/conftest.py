@@ -8,6 +8,8 @@ assume a real TPU (or other non-CPU) JAX backend and are skipped otherwise:
 """
 
 import os
+import subprocess
+import sys
 
 import pytest
 
@@ -15,6 +17,45 @@ import pytest
 # parent conftest honors DFTPU_TEST_PLATFORM != cpu by leaving JAX_PLATFORMS
 # alone.
 os.environ.setdefault("DFTPU_TEST_PLATFORM", "tpu")
+
+# Fail FAST when the tunnel is dead: jax.devices() on a degraded remote
+# backend hangs for many minutes IN-PROCESS (observed: 25 min burned on the
+# first trivial device check, 2026-07-31 17:03 window attempt), eating the
+# harvest window's timeout budget.  A subprocess probe with a hard timeout
+# (bench.py's pattern) detects the hang without poisoning this process's
+# not-yet-initialized backend; the whole tier then exits in ~90 s instead.
+_PROBE = (
+    "import jax, jax.numpy as jnp; d = jax.devices()[0]; "
+    "assert d.platform != 'cpu', d; print(float(jnp.ones((256, 256)).sum()))"
+)
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _tunnel_fast_fail():
+    """Session-scoped autouse (NOT pytest_sessionstart: a sub-directory
+    conftest only registers at collection time, after session start, so
+    the hook would silently no-op under ``pytest tests/``).  As a fixture
+    it fires before the first integration test on every invocation path."""
+    try:
+        timeout = float(os.environ.get("DFTPU_TPU_PROBE_TIMEOUT", "90"))
+    except ValueError:
+        timeout = 90.0  # malformed env: probe with the default, don't crash
+    if timeout <= 0:  # escape hatch: skip the probe entirely
+        return
+    try:
+        subprocess.run(
+            [sys.executable, "-c", _PROBE],
+            capture_output=True, timeout=timeout, check=True,
+        )
+    except subprocess.TimeoutExpired:
+        pytest.exit(
+            f"accelerator probe hung >{timeout:.0f}s — tunnel degraded; "
+            f"aborting the integration tier early (set "
+            f"DFTPU_TPU_PROBE_TIMEOUT=0 to skip this gate)",
+            returncode=2,
+        )
+    except subprocess.CalledProcessError:
+        pass  # no accelerator at all: let the per-test skip report it
 
 
 @pytest.fixture(scope="session")
