@@ -82,6 +82,50 @@ def main() -> None:
     )
     gathered = multihost_utils.process_allgather(local_stats)  # (P, 2)
     total, count = np.asarray(gathered).sum(axis=0)
+
+    # --- cross-PROCESS sequence parallelism: the time-sharded scan over
+    # the GLOBAL mesh (every process holds its own T/P slice; the carry
+    # all_gather inside time_sharded_prefix crosses hosts through the
+    # distributed backend — multi-host DCN semantics, not intra-host) ---
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_forecasting_tpu.ops.pscan import (
+        affine_scan,
+        affine_scan_time_sharded,
+    )
+    from distributed_forecasting_tpu.parallel.mesh import SERIES_AXIS, make_mesh
+
+    rng = np.random.default_rng(13)
+    d_state = 3
+    T_seq = 64 * n_global
+    A_np = (0.8 * rng.uniform(-1, 1, (T_seq, d_state, d_state)) / d_state
+            + 0.5 * np.eye(d_state)).astype(np.float32)
+    c_np = rng.normal(size=(T_seq, d_state)).astype(np.float32)
+    x0_np = rng.normal(size=d_state).astype(np.float32)
+
+    mesh = make_mesh()  # every global device, the production series axis
+    lo = args.process_id * (T_seq // args.num_processes)
+    hi = lo + T_seq // args.num_processes
+    A_g = multihost_utils.host_local_array_to_global_array(
+        A_np[lo:hi], mesh, P(SERIES_AXIS)
+    )
+    c_g = multihost_utils.host_local_array_to_global_array(
+        c_np[lo:hi], mesh, P(SERIES_AXIS)
+    )
+    x0_g = multihost_utils.host_local_array_to_global_array(
+        x0_np, mesh, P()
+    )
+    out_g = affine_scan_time_sharded(A_g, c_g, x0_g, mesh)
+    # every process checks ITS local shard against the full single-host
+    # reference (the inputs are replicated by construction: same seed)
+    ref = np.asarray(affine_scan(jnp.asarray(A_np), jnp.asarray(c_np),
+                                 jnp.asarray(x0_np)))
+    local_rows = np.concatenate(
+        [np.asarray(s.data) for s in
+         sorted(out_g.addressable_shards, key=lambda s: s.index[0].start)]
+    )
+    sp_delta = float(np.max(np.abs(local_rows - ref[lo:hi])))
+
     print(json.dumps({
         "process_id": args.process_id,
         "processes": jax.process_count(),
@@ -89,6 +133,8 @@ def main() -> None:
         "n_local_series": int(batch.n_series),
         "global_mean_mape": round(float(total / count), 6),
         "all_ok": bool(np.asarray(res.ok).all()),
+        "sp_T": T_seq,
+        "sp_max_delta": sp_delta,
     }), flush=True)
 
 

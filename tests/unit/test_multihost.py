@@ -86,6 +86,12 @@ def test_two_process_distributed_fit_and_allgather():
     assert a["all_ok"] and b["all_ok"]
     # both hosts computed the SAME global mean through the collective
     assert a["global_mean_mape"] == b["global_mean_mape"]
+    # cross-process sequence parallelism: the time-sharded scan (carry
+    # all_gather crossing hosts) reproduced the single-host scan on BOTH
+    # processes' shards
+    assert a["sp_T"] == b["sp_T"] == 8 * 64
+    assert a["sp_max_delta"] <= 1e-3, a["sp_max_delta"]
+    assert b["sp_max_delta"] <= 1e-3, b["sp_max_delta"]
 
     # and it matches a single-process full-batch fit (fits are per-series
     # independent, so sharding must not change the numbers)
