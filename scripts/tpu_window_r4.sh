@@ -11,6 +11,10 @@
 set -u
 cd "$(dirname "$0")/.."
 mkdir -p scripts/tpu_logs
+# persistent XLA compilation cache: window budget goes to measuring,
+# not recompiling shapes previous windows already built
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-$PWD/.jax_cache}"
+mkdir -p "$JAX_COMPILATION_CACHE_DIR"
 ts=$(date +%Y%m%dT%H%M%S)
 
 echo "== probe =="
