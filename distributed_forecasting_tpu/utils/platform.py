@@ -19,12 +19,22 @@ import os
 def apply_platform_override() -> str | None:
     """Apply ``DFTPU_PLATFORM`` if set; returns the platform or None.
 
-    Safe to call repeatedly.  Raises if a DIFFERENT backend was already
-    initialized: the config update is silently ignored post-init (it is a
-    plain config value with no re-init hook), and logging a fake success
-    while the process stays on a hung accelerator would defeat the escape
-    hatch's purpose — callers must invoke this at process entry, before
-    any device access.
+    Safe to call repeatedly, and LAZY: it only records the platform in jax
+    config — it never initializes the XLA backend itself.  That matters for
+    multi-host bring-up: ``jax.distributed.initialize()`` must run before
+    any backend init, and the Task harness applies this override first
+    (``tasks/common.py``), so an eager ``jax.default_backend()`` here would
+    kill every distributed launch whose environment carries the override
+    (the documented configuration during accelerator outages).  The config
+    route is sufficient — ``jax_platforms`` governs backend selection at
+    whatever point the first genuine device access happens.
+
+    The one case verified eagerly is the one that NEEDS eager detection: a
+    backend already initialized to a different platform.  The config update
+    is silently ignored post-init (plain config value, no re-init hook),
+    and logging a fake success while the process stays on a hung
+    accelerator would defeat the escape hatch's purpose — so that raises.
+    Detection reads the xla_bridge backend cache without populating it.
     """
     plat = os.environ.get("DFTPU_PLATFORM")
     if not plat:
@@ -32,11 +42,30 @@ def apply_platform_override() -> str | None:
     import jax
 
     jax.config.update("jax_platforms", plat)
-    actual = jax.default_backend()  # initializes the backend NOW if not yet
-    if actual != plat:
-        raise RuntimeError(
-            f"DFTPU_PLATFORM={plat!r} requested but the JAX backend was "
-            f"already initialized to {actual!r} — set the override before "
-            f"any jax.devices()/array use in this process"
+    try:
+        from jax._src import xla_bridge
+
+        already_initialized = bool(xla_bridge._backends)
+    except (ImportError, AttributeError):
+        # private surface moved under a jax upgrade: stay lazy (the config
+        # update above still governs selection) but say loudly that the
+        # too-late-override guard is gone rather than silently skipping it
+        import warnings
+
+        warnings.warn(
+            "jax._src.xla_bridge._backends is unavailable under this jax "
+            "version — DFTPU_PLATFORM too-late-override detection disabled",
+            RuntimeWarning,
         )
+        already_initialized = False
+    if already_initialized:
+        # backend(s) exist already — default_backend() is a cached lookup
+        # here, not an init
+        actual = jax.default_backend()
+        if actual != plat:
+            raise RuntimeError(
+                f"DFTPU_PLATFORM={plat!r} requested but the JAX backend was "
+                f"already initialized to {actual!r} — set the override before "
+                f"any jax.devices()/array use in this process"
+            )
     return plat
