@@ -12,8 +12,10 @@ native:
 test: native
 	env -u PALLAS_AXON_POOL_IPS python -m pytest tests/unit -x -q
 
+# no -x: hardware windows are scarce — one red test must not blind the rest
+# of the suite (round-3 ran 5/9, round-4 stopped at the first failure)
 test-tpu:
-	DFTPU_TEST_PLATFORM=tpu python -m pytest tests/integration -x -q
+	DFTPU_TEST_PLATFORM=tpu python -m pytest tests/integration -q
 
 bench:
 	python bench.py
