@@ -23,7 +23,7 @@ Forecast intervals use the standard HW(A,A) variance recursion
 from __future__ import annotations
 
 import dataclasses
-from functools import partial
+from functools import lru_cache, partial
 from typing import ClassVar
 
 import jax
@@ -273,9 +273,19 @@ def parallel_filter_time_sharded(y, mask, alpha, beta, gamma, m, mesh,
     ``jit`` with the (T, d, d) element tensors sharding-constrained to the
     mesh axis, so GSPMD lays them out sharded from the start — the
     elements are never materialized whole on one device, keeping the
-    memory claim (T beyond one chip's HBM) real.  Equivalence vs the
-    sequential filter is tested on the 8-device virtual mesh
-    (tests/unit/test_pscan.py)."""
+    memory claim (T beyond one chip's HBM) real.  The jitted closure is
+    cached per ``(mesh, axis_name, m)``, so callers looping over many
+    series of the same shape hit the trace cache instead of recompiling.
+    Equivalence vs the sequential filter is tested on the 8-device virtual
+    mesh (tests/unit/test_pscan.py)."""
+    return _time_sharded_run(mesh, axis_name, m)(
+        y, mask, alpha, beta, gamma, phi
+    )
+
+
+@lru_cache(maxsize=32)
+def _time_sharded_run(mesh, axis_name: str, m: int):
+    """Jitted time-sharded filter body, one per (mesh, axis_name, m)."""
     from jax.sharding import NamedSharding
     from jax.sharding import PartitionSpec as P
 
@@ -292,10 +302,7 @@ def parallel_filter_time_sharded(y, mask, alpha, beta, gamma, m, mesh,
                                           axis_name=axis_name)
         return _filter_outputs(states, x0, e, y, mask, phi)
 
-    # NOTE: the jit closure is rebuilt per call (mesh/m/axis_name are
-    # captured), so each call pays a trace-cache miss — fine for the
-    # one-pass-per-fit long-T regime this entry exists for.
-    return run(y, mask, alpha, beta, gamma, phi)
+    return run
 
 
 def _candidate_grid(cfg: HoltWintersConfig):

@@ -31,6 +31,7 @@ state); equivalence is tested in ``tests/unit/test_pkalman.py``.
 
 from __future__ import annotations
 
+from functools import lru_cache
 from typing import NamedTuple
 
 import jax
@@ -254,19 +255,30 @@ def parallel_kalman_filter_time_sharded(
     The element build and post-processing run under one ``jit`` with the
     (T, r, r) element tensors sharding-constrained to the mesh axis, so
     GSPMD lays them out sharded from the start.  T must be a multiple of
-    the mesh size.  Same outputs as the sequential filter; equivalence is
-    tested on the 8-device virtual mesh (tests/unit/test_pkalman.py).
+    the mesh size.  The jitted closure is cached per
+    ``(mesh, axis_name, block_size)``, so callers looping over many series
+    of the same shape hit the trace cache instead of recompiling.  Same
+    outputs as the sequential filter; equivalence is tested on the
+    8-device virtual mesh (tests/unit/test_pkalman.py).
     """
+    return _time_sharded_run(mesh, axis_name, block_size)(
+        z, mask, T_mat, RRt, P0
+    )
+
+
+@lru_cache(maxsize=32)
+def _time_sharded_run(mesh, axis_name: str, block_size: int):
+    """Jitted time-sharded Kalman body, one per (mesh, axis_name, block)."""
     from jax.sharding import NamedSharding
     from jax.sharding import PartitionSpec as P
 
     from distributed_forecasting_tpu.ops.pscan import time_sharded_prefix
 
-    r = T_mat.shape[0]
     shard = NamedSharding(mesh, P(axis_name))
 
     @jax.jit
     def run(z, mask, T_mat, RRt, P0):
+        r = T_mat.shape[0]
         with jax.default_matmul_precision("float32"):
             elems, S0, Sq, t_row = _build_elements(z, mask, T_mat, RRt, P0)
             elems = jax.tree_util.tree_map(
@@ -280,6 +292,4 @@ def parallel_kalman_filter_time_sharded(
             return _filter_outputs(m_filt, P_filt, z, mask, T_mat, RRt, P0,
                                    S0, Sq, t_row)
 
-    # NOTE: per-call jit closure (mesh/axis_name captured) — a trace-cache
-    # miss per call, fine for the one-pass-per-fit long-T regime
-    return run(z, mask, T_mat, RRt, P0)
+    return run

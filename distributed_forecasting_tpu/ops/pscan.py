@@ -37,7 +37,7 @@ def _compose(left, right):
 
 
 def blocked_prefix(compose, elems, identity, block_size: int, project=None,
-                   return_carry: bool = False, initial=None):
+                   initial=None):
     """All prefix compositions ``e_1 (x) ... (x) e_t`` of an associative
     operator, blocked over the leading (time) axis.
 
@@ -58,14 +58,12 @@ def blocked_prefix(compose, elems, identity, block_size: int, project=None,
     ``affine_scan`` (affine pairs, projected to states) and ``ops/pkalman``
     (5-tuple Kalman filtering elements, projected to mean/cov).
 
-    ``return_carry=True`` additionally returns the TOTAL composition of all
-    T elements (identity padding is a no-op, so the carry is exact) as
-    ``(carry, projected)`` — the cross-device two-phase scan's phase-1
-    reduce, at no extra compute.  ``initial`` (a single element, no leading
-    axis) left-composes into every prefix — phase 3 of the cross-device
-    scan starts each shard from the carried prefix of the shards before it;
-    with ``initial`` set, the returned carry is ``initial (x) total``, not
-    the bare chunk total.
+    ``initial`` (a single element, no leading axis) left-composes into
+    every prefix — phase 3 of the cross-device scan starts each shard from
+    the carried prefix of the shards before it.  When only the TOTAL
+    composition is wanted (phase 1 of that scan), use
+    :func:`blocked_total` — a tree reduction, cheaper than any all-prefix
+    scan.
     """
     if project is None:
         project = lambda full: full
@@ -84,9 +82,6 @@ def blocked_prefix(compose, elems, identity, block_size: int, project=None,
                 ),
                 full,
             )
-        if return_carry:
-            carry = jax.tree_util.tree_map(lambda f: f[-1], full)
-            return carry, project(full)
         return project(full)
     nb = -(-T // block_size)
     pad = nb * block_size - T
@@ -116,13 +111,44 @@ def blocked_prefix(compose, elems, identity, block_size: int, project=None,
         new_carry = jax.tree_util.tree_map(lambda f: f[-1], full)
         return new_carry, project(full)
 
-    carry, out = jax.lax.scan(block_step, carry0, blocked)
-    out = jax.tree_util.tree_map(
+    _, out = jax.lax.scan(block_step, carry0, blocked)
+    return jax.tree_util.tree_map(
         lambda f: f.reshape(nb * block_size, *f.shape[2:])[:T], out
     )
-    if return_carry:
-        return carry, out
-    return out
+
+
+def blocked_total(compose, elems, identity):
+    """TOTAL composition ``e_1 (x) ... (x) e_T`` of an associative operator —
+    a pairwise tree reduction: T-1 compose ops at log2(T) parallel depth,
+    versus the ~2T ops an all-prefix ``associative_scan`` spends when only
+    the last element is wanted.  Phase 1 of the cross-device two-phase scan
+    (:func:`time_sharded_prefix`) is exactly that case.  Memory stays
+    bounded without blocking: each round halves the live working set, so
+    the largest temporary is T/2 elements.
+
+    ``identity`` is a pytree with leading axis 1 holding the operator's
+    identity (pads T to a power of two; identity composition is a no-op).
+    """
+    x = elems
+    T = jax.tree_util.tree_leaves(x)[0].shape[0]
+    n = 1 << max(0, T - 1).bit_length()  # next power of two >= T
+    if n != T:
+        x = jax.tree_util.tree_map(
+            lambda e, i: jnp.concatenate(
+                [e, jnp.broadcast_to(i, (n - T, *e.shape[1:]))]
+            ),
+            x, identity,
+        )
+    while n > 1:
+        half = n // 2
+        paired = jax.tree_util.tree_map(
+            lambda e: e.reshape(half, 2, *e.shape[1:]), x
+        )
+        left = jax.tree_util.tree_map(lambda p: p[:, 0], paired)
+        right = jax.tree_util.tree_map(lambda p: p[:, 1], paired)
+        x = compose(left, right)  # left = earlier element of the pair
+        n = half
+    return jax.tree_util.tree_map(lambda e: e[0], x)
 
 
 def affine_scan(
@@ -184,8 +210,9 @@ def time_sharded_prefix(
     (affine maps, Kalman 5-tuples, ...).
 
       1. each device compose-reduces its local T/D chunk to one total
-         element (``blocked_prefix(..., return_carry=True)`` with an empty
-         projection — no cumulative materialization);
+         element (:func:`blocked_total` — a pairwise tree reduction, T-1
+         compose ops, no all-prefix scan and no cumulative
+         materialization);
       2. the D totals ride one ``all_gather`` over ICI and every device
          takes the exclusive prefix of the devices before it;
       3. each device re-runs its blocked prefix with that carry as
@@ -216,10 +243,7 @@ def time_sharded_prefix(
     from jax.sharding import PartitionSpec as P
 
     def local(elems_local, *pargs):
-        carry, _ = blocked_prefix(
-            compose, elems_local, identity, block_size,
-            project=lambda full: (), return_carry=True,
-        )
+        carry = blocked_total(compose, elems_local, identity)
         gathered = jax.tree_util.tree_map(
             lambda x: jax.lax.all_gather(x, axis_name), carry
         )
