@@ -1,0 +1,88 @@
+"""M5-protocol scorer tests (scripts/m5_protocol.py).
+
+The WRMSSE implementation is the repo's external accuracy yardstick
+(docs/benchmarks.md "External protocol" section), so its math is pinned
+here by hand-computed cases: the M5 scale (active-period lag-1 squared
+diffs), never-active exclusion, per-level sales weighting, and the
+perfect-forecast zero.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "scripts"))
+
+from m5_protocol import level_sums, rmsse, wrmsse  # noqa: E402
+
+
+def test_rmsse_hand_computed():
+    # one row: train [0, 0, 2, 4, 4], first_active=2, active diffs are
+    # (4-2)^2, (4-4)^2 -> scale = (4 + 0) / 2 = 2
+    y_tr = np.array([[0.0, 0.0, 2.0, 4.0, 4.0]])
+    y_ev = np.array([[5.0, 3.0]])
+    yhat = np.array([[4.0, 4.0]])           # mse = (1 + 1) / 2 = 1
+    out = rmsse(y_tr, y_ev, yhat)
+    np.testing.assert_allclose(out, [np.sqrt(1.0 / 2.0)])
+
+
+def test_rmsse_never_active_is_nan():
+    y_tr = np.zeros((1, 6))
+    out = rmsse(y_tr, np.ones((1, 2)), np.ones((1, 2)))
+    assert np.isnan(out[0])
+
+
+def test_rmsse_perfect_forecast_is_zero():
+    rng = np.random.default_rng(0)
+    y_tr = rng.poisson(5, (4, 30)).astype(float)
+    y_ev = rng.poisson(5, (4, 7)).astype(float)
+    out = rmsse(y_tr, y_ev, y_ev.copy())
+    np.testing.assert_allclose(out, 0.0)
+
+
+def test_level_sums_shapes_and_totals():
+    rng = np.random.default_rng(1)
+    x = rng.poisson(3, (6, 10)).astype(float)
+    stores = np.array([0, 0, 0, 1, 1, 1])
+    items = np.array([0, 1, 2, 0, 1, 2])
+    lv = level_sums(x, stores, items)
+    assert lv["total"].shape == (1, 10)
+    assert lv["store"].shape == (2, 10)
+    assert lv["item"].shape == (3, 10)
+    assert lv["store_item"].shape == (6, 10)
+    np.testing.assert_allclose(lv["total"][0], x.sum(axis=0))
+    np.testing.assert_allclose(lv["store"][0], x[:3].sum(axis=0))
+    np.testing.assert_allclose(lv["item"][1], x[[1, 4]].sum(axis=0))
+
+
+def test_wrmsse_weighting_prefers_high_sales_rows():
+    # two independent store-item rows; the forecast is wrong ONLY on the
+    # high-sales row -> WRMSSE must exceed the case where the error sits
+    # on the low-sales row (sales-weighted within level)
+    T, h = 60, 28
+    t = np.arange(T + h)
+    big = 100.0 + 0.0 * t
+    small = 1.0 + 0.0 * t
+    # add movement so the lag-1 scale is nonzero
+    rng = np.random.default_rng(2)
+    big = big + rng.normal(0, 5, T + h)
+    small = small + rng.normal(0, 0.5, T + h)
+    y = np.stack([big, small])
+    stores = np.array([0, 1])
+    items = np.array([0, 1])
+    y_tr, y_ev = y[:, :T], y[:, T:]
+
+    miss_big = y_ev.copy()
+    miss_big[0] += 20.0
+    miss_small = y_ev.copy()
+    miss_small[1] += 0.2 * 20.0 / 100.0  # proportionally tiny miss
+    w_big, _ = wrmsse(y_tr, y_ev, miss_big, stores, items)
+    w_small, _ = wrmsse(y_tr, y_ev, miss_small, stores, items)
+    assert w_big > w_small
+    perfect, per_level = wrmsse(y_tr, y_ev, y_ev.copy(), stores, items)
+    assert perfect == 0.0
+    assert set(per_level) == {"total", "store", "item", "store_item"}
