@@ -124,9 +124,14 @@ def _read_backend_cache() -> dict | None:
 
 
 def _write_backend_cache(platform: str) -> None:
+    # atomic replace: the watcher (scripts/tpu_watch.sh) also writes this
+    # file through here on every healthy probe, and a reader catching a
+    # half-written file would fall back to the cold 360 s window — the
+    # exact premature-CPU-fallback the cache exists to prevent
     try:
         os.makedirs(os.path.dirname(_BACKEND_CACHE), exist_ok=True)
-        with open(_BACKEND_CACHE, "w") as f:
+        tmp = _BACKEND_CACHE + ".tmp"
+        with open(tmp, "w") as f:
             json.dump(
                 {
                     "platform": platform,
@@ -135,6 +140,7 @@ def _write_backend_cache(platform: str) -> None:
                 },
                 f,
             )
+        os.replace(tmp, _BACKEND_CACHE)
     except Exception:
         pass  # cache is best-effort; never fail the bench over it
 

@@ -21,7 +21,9 @@ mkdir -p "$JAX_COMPILATION_CACHE_DIR"
 ts=$(date +%Y%m%dT%H%M%S)
 
 echo "== probe =="
-if ! timeout 90 python -c "import jax, jax.numpy as jnp; d=jax.devices()[0]; assert d.platform=='tpu', d; print('TPU OK', d.device_kind, float(jnp.ones((256,256)).sum()))"; then
+# 180 s probe margin everywhere (watcher, this gate, the integration-tier
+# conftest): healthy-but-congested first init has been seen past 90 s
+if ! timeout 180 python -c "import jax, jax.numpy as jnp; d=jax.devices()[0]; assert d.platform=='tpu', d; print('TPU OK', d.device_kind, float(jnp.ones((256,256)).sum()))"; then
   echo "tunnel not healthy; aborting (nothing written)"
   exit 1
 fi
