@@ -130,7 +130,8 @@ def _write_backend_cache(platform: str) -> None:
     # exact premature-CPU-fallback the cache exists to prevent
     try:
         os.makedirs(os.path.dirname(_BACKEND_CACHE), exist_ok=True)
-        tmp = _BACKEND_CACHE + ".tmp"
+        tmp = _BACKEND_CACHE + f".{os.getpid()}.tmp"  # writer-unique: the
+        # watcher and the bench slot can both be writing concurrently
         with open(tmp, "w") as f:
             json.dump(
                 {

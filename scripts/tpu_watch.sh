@@ -26,7 +26,7 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     # bench.py's cache (its writer — one schema owner, atomic replace) so
     # the official bench slot sizes its retry window for a
     # recently-healthy tunnel even if the harvest below fails
-    python -c "import bench; bench._write_backend_cache('tpu')" >> "$LOG" 2>&1
+    timeout 180 python -c "import bench; bench._write_backend_cache('tpu')" >> "$LOG" 2>&1
     note "probe OK — launching harvest"
     bash "${DFTPU_WINDOW_SCRIPT:-scripts/tpu_window_r5.sh}" >> "$LOG" 2>&1
     rc=$?
