@@ -17,7 +17,23 @@ import numpy as np
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__)))), "scripts"))
 
-from m5_protocol import level_sums, rmsse, wrmsse  # noqa: E402
+from m5_protocol import (  # noqa: E402
+    level_sums,
+    naive_forecast,
+    rmsse,
+    snaive_forecast,
+    wrmsse,
+)
+
+
+def test_benchmark_methods_match_m5_definitions():
+    y_tr = np.array([[1.0, 2, 3, 4, 5, 6, 7, 8, 9]])
+    n = naive_forecast(y_tr, h=5)
+    np.testing.assert_array_equal(n, [[9.0, 9, 9, 9, 9]])
+    s = snaive_forecast(y_tr, h=10, m=7)
+    # last seasonal week [3..9] repeated, truncated to h
+    np.testing.assert_array_equal(
+        s, [[3.0, 4, 5, 6, 7, 8, 9, 3, 4, 5]])
 
 
 def test_rmsse_hand_computed():

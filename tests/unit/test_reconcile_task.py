@@ -53,6 +53,85 @@ def test_reconcile_bottom_up(trained_store):
     np.testing.assert_allclose(total, bottom, rtol=1e-4)
 
 
+def test_reconcile_mint(trained_store):
+    """method: mint — the measured-best M5 configuration as a job: direct
+    per-level fits from history + CV-variance MinT.  Coherence must be
+    exact and unknown weight modes must fail loudly."""
+    task = ReconcileTask(
+        init_conf={
+            **trained_store,
+            "input": {"history_table": "hackathon.sales.raw"},
+            "output": {"table": "hackathon.sales.reconciled_mint"},
+            "reconcile": {"method": "mint", "model": "theta",
+                          "weights": "cv", "horizon": 14,
+                          "cv": {"initial": 300, "period": 90,
+                                 "horizon": 30}},
+        }
+    )
+    out = task.launch()
+    assert out["method"] == "mint" and out["weights"] == "cv"
+    assert out["n_nodes"] == 1 + 2 + 3 + 6
+    assert out["n_days"] == 14
+    table = task.catalog.read_table("hackathon.sales.reconciled_mint")
+    assert set(table["method"]) == {"mint_cv"}
+    # MinT coherence holds on EVERY forecast day, all levels
+    for ds, day_rows in table.groupby("ds"):
+        total = float(day_rows[day_rows.node == "total"].yhat.iloc[0])
+        bottom = day_rows[day_rows.node.str.contains("store_.*_item_")].yhat
+        np.testing.assert_allclose(total, bottom.sum(), rtol=1e-3)
+        stores = day_rows[day_rows.node.str.fullmatch("store_[0-9]+")].yhat
+        np.testing.assert_allclose(stores.sum(), total, rtol=1e-3)
+
+    with pytest.raises(ValueError, match="cv|struct"):
+        ReconcileTask(
+            init_conf={
+                **trained_store,
+                "input": {"history_table": "hackathon.sales.raw"},
+                "output": {"table": "hackathon.sales.bad"},
+                "reconcile": {"method": "mint", "weights": "typo"},
+            }
+        ).launch()
+
+
+def test_mint_node_batch_preserves_bottom_masks():
+    """Aggregate rows are fully observed sums of OBSERVED bottoms; bottom
+    rows keep their own mask so a late-launching series' gap is never fit
+    as observed zero sales (round-5 review finding)."""
+    import jax.numpy as jnp
+
+    from distributed_forecasting_tpu.data.dataset import (
+        synthetic_store_item_sales,
+    )
+    from distributed_forecasting_tpu.data.tensorize import tensorize
+    from distributed_forecasting_tpu.reconcile import Hierarchy
+    from distributed_forecasting_tpu.tasks.reconcile import mint_node_batch
+
+    batch = tensorize(synthetic_store_item_sales(
+        n_stores=2, n_items=3, n_days=120, seed=5))
+    # carve a launch gap into the first bottom series
+    import dataclasses
+
+    mask = np.asarray(batch.mask).copy()
+    mask[0, :80] = 0.0
+    batch = dataclasses.replace(batch, mask=jnp.asarray(mask))
+    h = Hierarchy.from_keys(np.asarray(batch.keys))
+    nodes = mint_node_batch(batch, h)
+
+    n_agg = h.n_nodes - h.n_bottom
+    assert nodes.y.shape == (h.n_nodes, batch.n_time)
+    # aggregates: fully observed
+    np.testing.assert_array_equal(np.asarray(nodes.mask[:n_agg]), 1.0)
+    # bottoms: the original masks, gap included
+    np.testing.assert_array_equal(np.asarray(nodes.mask[n_agg:]), mask)
+    # aggregate values are sums of OBSERVED bottoms (the gap contributes 0)
+    np.testing.assert_allclose(
+        np.asarray(nodes.y[0]),
+        (np.asarray(batch.y) * mask).sum(axis=0), rtol=1e-5)
+    # bottom values keep their raw y (mask governs observation, not value)
+    np.testing.assert_allclose(np.asarray(nodes.y[n_agg:]),
+                               np.asarray(batch.y), rtol=1e-6)
+
+
 def test_reconcile_top_down(trained_store):
     task = ReconcileTask(
         init_conf={
