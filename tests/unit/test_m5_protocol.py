@@ -13,11 +13,15 @@ import os
 import sys
 
 import numpy as np
+import pytest
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__)))), "scripts"))
 
 from m5_protocol import (  # noqa: E402
+    H,
+    committed_dataset_split,
+    eval_forecast,
     level_sums,
     naive_forecast,
     rmsse,
@@ -73,6 +77,32 @@ def test_level_sums_shapes_and_totals():
     np.testing.assert_allclose(lv["total"][0], x.sum(axis=0))
     np.testing.assert_allclose(lv["store"][0], x[:3].sum(axis=0))
     np.testing.assert_allclose(lv["item"][1], x[[1, 4]].sum(axis=0))
+
+
+@pytest.mark.slow
+def test_theta_beats_m5_benchmarks_on_committed_dataset():
+    """The published claim (docs/benchmarks.md "External protocol"):
+    theta beats BOTH of the M5 competition's benchmark methods on the
+    committed dataset.  A model or scorer regression that breaks the
+    ordering fails here, not in the next judge run.  Data handling comes
+    from the protocol script's own helpers, so test and published
+    numbers cannot drift apart."""
+    import jax
+
+    from distributed_forecasting_tpu.engine import fit_forecast
+
+    batch, hist, yb, keys = committed_dataset_split()
+    T = batch.n_time
+    y_tr, y_ev = yb[:, : T - H], yb[:, T - H :]
+    _, res = fit_forecast(hist, model="theta", horizon=H,
+                          key=jax.random.PRNGKey(0))
+    th, _ = wrmsse(y_tr, y_ev, eval_forecast(res.yhat, T),
+                   keys[:, 0], keys[:, 1])
+    na, _ = wrmsse(y_tr, y_ev, naive_forecast(y_tr), keys[:, 0], keys[:, 1])
+    sn, _ = wrmsse(y_tr, y_ev, snaive_forecast(y_tr), keys[:, 0], keys[:, 1])
+    assert th < sn < na, (th, sn, na)
+    # loose absolute pin so a silent scorer rescale cannot pass unnoticed
+    assert 0.9 < th < 1.2, th
 
 
 def test_wrmsse_weighting_prefers_high_sales_rows():
