@@ -221,15 +221,15 @@ def main() -> None:
     # remaining probes are skipped.  The clock starts AFTER backend
     # selection — in round 2 it started before, so a 180 s outage probe ate
     # the budget and starved the BASELINE scale/long-T probes (VERDICT r2
-    # #2).  Probe order likewise puts BASELINE configs (CV, scale, arima,
-    # long-T) before the pallas comparison, so exhaustion trims
-    # comparisons, not obligations.  Belt AND suspenders against driver
-    # timeouts: the headline JSON line is printed BEFORE the probes (see
-    # below), so even a hard kill mid-probe leaves the artifact on stdout.
+    # #2).  Probe order puts the cheapest BASELINE configs first (CV,
+    # scale, arima, long-T), so exhaustion trims from the tail.  Belt AND
+    # suspenders against driver timeouts: the headline JSON line is
+    # printed BEFORE the probes (see below), so even a hard kill
+    # mid-probe leaves the artifact on stdout.
     t_bench0 = time.perf_counter()
     # 600 s default: the healthy-tunnel run of 2026-07-31 measured ~300 s
     # for CV + 50k-scale staging + arima compiles alone (arima's two scan
-    # lengths compile ~18 s + ~36 s), which starved long-T and pallas at
+    # lengths compile ~18 s + ~36 s), which starved the long-T probe at
     # the old 300 s default even with the tunnel up.  600 s fits the whole
     # suite with margin; a driver hard-kill mid-probe still cannot cost the
     # headline line, which is printed before any probe.
@@ -406,8 +406,7 @@ def main() -> None:
     )
 
     # Probe order (VERDICT r2 #2): BASELINE obligations first — CV, scale,
-    # arima, long-T — then the pallas comparison last, so a tight budget
-    # trims the comparison, never a BASELINE config.
+    # arima, long-T — so a tight budget never costs a BASELINE config.
 
     # ---- CV probe: the reference's hottest loop (500 series x 3 cutoffs) --
     try:
@@ -575,48 +574,9 @@ def main() -> None:
         print(f"[bench] long-T probe failed: {type(e).__name__}: {e}",
               file=sys.stderr)
 
-    # ---- pallas-vs-einsum probe (same slope protocol; VERDICT r1 #2) ------
-    # LAST: a comparison, not a BASELINE obligation — first to go under a
-    # tight budget.  TPU only: the CPU fallback runs the kernel in interpret
-    # mode, which is orders of magnitude slower and would dominate the
-    # bench's wall time without measuring anything about the target chip.
-    try:
-        if not on_tpu:
-            raise RuntimeError("skipped on non-TPU backend (interpret mode)")
-        if not budget_left():
-            raise RuntimeError("probe budget exhausted")
-        from distributed_forecasting_tpu.engine.fit import (
-            _fit_forecast_impl,
-            _fit_forecast_scan_impl,
-        )
-        from distributed_forecasting_tpu.models import prophet_glm
-
-        def clear_caches():
-            prophet_glm.fit.clear_cache()
-            _fit_forecast_impl.clear_cache()
-            _fit_forecast_scan_impl.clear_cache()
-
-        os.environ["DFTPU_GRAM_BACKEND"] = "pallas"
-        clear_caches()
-        pallas_sps = slope_series_per_s(
-            big_1, big_16, "prophet", label="pallas gram slope"
-        )
-        ratio = pallas_sps / series_per_s
-        print(
-            f"[bench] pallas/einsum throughput ratio: x{ratio:.2f} "
-            f"({'pallas' if ratio > 1 else 'einsum'} wins; default is einsum "
-            f"per ops/solve.py measurement)",
-            file=sys.stderr,
-        )
-    except Exception as e:  # never let the probe kill the headline number
-        print(f"[bench] pallas probe failed: {type(e).__name__}: {e}",
-              file=sys.stderr)
-    finally:
-        os.environ.pop("DFTPU_GRAM_BACKEND", None)
-        try:
-            clear_caches()
-        except Exception:
-            pass
+    # (A pallas-vs-einsum probe ran here through round 4.  The hand kernel
+    # lost at every completed width — x0.79/x0.93/x0.99 at F=64/128/192 on
+    # chip — and was retired in round 5; ops/solve.py records the ladder.)
 
 if __name__ == "__main__":
     main()

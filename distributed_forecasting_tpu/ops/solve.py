@@ -24,24 +24,20 @@ import jax
 import jax.numpy as jnp
 
 
-def _gram_backend() -> str:
-    """'einsum' (default) or 'pallas' — see ops/pallas_gram.py.
-
-    The default follows the measurement (VERDICT r1 #2).  On TPU v5e with a
-    dispatch-cost-cancelled protocol (the full 500 x 1826 fit+forecast run
-    inside a lax.scan at scan lengths 6 and 96, per-batch time = the slope),
-    the einsum path runs the whole engine pass in ~3.7 ms/batch vs ~4.6 ms
-    for the pallas Gram kernel, reproducibly across interleaved trials —
-    XLA's own fusion of the ``w`` broadcast into the MXU matmul beats the
-    hand-written kernel by ~20%, so einsum stays the default on every
-    platform.  (An earlier apparent 2x pallas win was an ordering artifact
-    of per-dispatch timing through a ~66 ms remote-attach round trip; see
-    bench.py.)  Read at trace time so a run can still opt in via
-    DFTPU_GRAM_BACKEND=pallas.
-    """
-    return os.environ.get("DFTPU_GRAM_BACKEND", "einsum")
-
-
+# The Gram path is einsum-only BY MEASUREMENT.  A hand-written Pallas
+# Gram kernel (ops/pallas_gram.py, retired round 5) was benchmarked on
+# TPU v5e across three rounds with the dispatch-cost-cancelled slope
+# protocol and LOST at every width that completed: full-engine-pass
+# x0.79 at F=64, x0.93 at F=128, x0.99 at F=192
+# (scripts/tpu_logs/gram_winregime_20260731T161002.log, reproduced
+# across interleaved trials on two harvest days); the F=256 rung
+# exceeded a 1800 s on-chip stage timeout twice (Mosaic compile).  XLA's
+# own fusion of the mask/weight broadcast into the MXU matmul beats the
+# hand kernel everywhere a conf-reachable design lives (F <= ~150), so
+# the kernel and its DFTPU_GRAM_BACKEND flag were deleted rather than
+# kept "in case" — docs/benchmarks.md "Gram backend" records the ladder.
+# (An earlier apparent 2x pallas win was an ordering artifact of
+# per-dispatch timing through a ~66 ms remote-attach round trip.)
 def _gram_dtype():
     """'f32' (default) or 'bf16' — input precision for the Gram build.
 
@@ -152,13 +148,6 @@ def ridge_solve_batch(
     if X.ndim == 3:
         G = jnp.einsum("st,stf,stg->sfg", w, X, X, optimize=True)
         b = jnp.einsum("st,stf->sf", w * y, X, optimize=True)
-    elif _gram_backend() == "pallas":
-        from distributed_forecasting_tpu.ops.pallas_gram import (
-            masked_gram_moments_pallas,
-        )
-
-        interpret = jax.default_backend() == "cpu"
-        G, b = masked_gram_moments_pallas(X, w, y, interpret=interpret)
     else:
         G = masked_gram(X, w)
         b = jnp.einsum("st,tf->sf", w * y, X, optimize=True)

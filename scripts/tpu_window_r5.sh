@@ -8,8 +8,10 @@
 #      falling back to CPU a fourth time, and leaves harvest evidence
 #   3. MFU/roofline + chunk-ladder lever (scripts/mfu_roofline.py)
 #   4. sweep costs: order:auto + season_length:auto (scripts/sweep_cost.py)
-#   5. slim gram F=256 rung — LAST ATTEMPT: a third timeout retires the
-#      pallas kernel (verdict #5: data point or deletion, no third "queued")
+# (A 5th stage — the slim gram F=256 rung — was planned as the pallas
+# kernel's last attempt; the tunnel stayed dead past the decision point
+# and the kernel was retired on the existing three-round measurement
+# instead.  ops/solve.py records the ladder.)
 # Usage: bash scripts/tpu_window_r5.sh
 set -u
 cd "$(dirname "$0")/.."
@@ -28,7 +30,7 @@ if ! timeout 180 python -c "import jax, jax.numpy as jnp; d=jax.devices()[0]; as
   exit 1
 fi
 
-echo "== 1/5 integration tier (make test-tpu, full suite) =="
+echo "== 1/4 integration tier (make test-tpu, full suite) =="
 timeout 2400 make test-tpu 2>&1 | tee "scripts/tpu_logs/test_tpu_${ts}.log"
 rc=${PIPESTATUS[0]}
 echo "test-tpu rc=$rc" | tee -a "scripts/tpu_logs/test_tpu_${ts}.log"
@@ -41,22 +43,18 @@ if [ -n "${DFTPU_WINDOW_DEADLINE:-}" ] && [ "$(date +%s)" -ge "$DFTPU_WINDOW_DEA
   exit "$rc"
 fi
 
-echo "== 2/5 bench (refreshes last_good_backend for the driver's slot) =="
+echo "== 2/4 bench (refreshes last_good_backend for the driver's slot) =="
 timeout 1200 python bench.py > "scripts/tpu_logs/bench_${ts}.json" \
   2> "scripts/tpu_logs/bench_${ts}.log"
 echo "bench rc=$? headline: $(cat scripts/tpu_logs/bench_${ts}.json)"
 
-echo "== 3/5 MFU / roofline =="
+echo "== 3/4 MFU / roofline =="
 timeout 1200 python scripts/mfu_roofline.py 2>&1 \
   | tee "scripts/tpu_logs/mfu_${ts}.log"
 
-echo "== 4/5 sweep costs =="
+echo "== 4/4 sweep costs =="
 timeout 1500 python scripts/sweep_cost.py 2>&1 \
   | tee "scripts/tpu_logs/sweep_${ts}.log"
-
-echo "== 5/5 slim gram F=256 (final attempt before retirement) =="
-timeout 1200 python scripts/gram_winregime.py --widths 256 --staged 2 \
-  --reps-long 6 2>&1 | tee "scripts/tpu_logs/gram256_${ts}.log"
 
 echo "== done: logs in scripts/tpu_logs/*_${ts}.* =="
 # overall rc: the integration tier is the must-pass

@@ -156,8 +156,9 @@ def test_quantiles_on_device(tpu_device, batch500):
 def test_extended_design_on_device(tpu_device, batch500):
     """The widest design the conf surface can produce — US holidays +
     custom monthly seasonality + saturating logistic bounds — compiles and
-    fits on real hardware in one fused pass (the large-F regime the Pallas
-    win-regime measurement targets; scripts/gram_winregime.py)."""
+    fits on real hardware in one fused pass (the large-F regime; the
+    round-4 run caught the scoped-VMEM overflow here — docs/benchmarks.md
+    "Gram backend" carries the width-ladder record)."""
     import jax
 
     from distributed_forecasting_tpu.data.holidays import (
