@@ -116,38 +116,42 @@ def _objective_fn(t, A, A_s, X, y_s, tau: float, beta_sd: float,
     At_As = A * t[:, None] - A_s  # d(trend)/d(delta), (T, S)
 
     def f(theta):
-        k, m = theta[0], theta[1]
-        delta = theta[2 : 2 + S]
-        beta = theta[2 + S : 2 + S + F]
-        log_sigma = theta[-1]
-        sigma = np.exp(log_sigma)
-        g = (k + A @ delta) * t + (m - A_s @ delta)
-        season = 1.0 + X @ beta
-        mu = g * season
-        err = y_s - mu
-        inv_s2 = 1.0 / sigma**2
-        val = (
-            0.5 * inv_s2 * float(err @ err)
-            + T * log_sigma
-            + float(np.sum(np.abs(delta))) / tau
-            + 0.5 * float(beta @ beta) / beta_sd**2
-            + 0.5 * sigma**2 / sigma_sd**2
-        )
-        if not np.isfinite(val):
-            # a wild line-search step (sigma underflow / mu overflow):
-            # return a huge finite value with a zero gradient so L-BFGS-B
-            # backtracks instead of propagating NaNs into its history
-            return 1e15, np.zeros_like(theta)
-        dmu = -err * inv_s2          # dL/dmu, (T,)
-        ds = dmu * season            # dL/d(trend)
-        dg = dmu * g                 # dL/d(season term X beta)
-        grad = np.empty_like(theta)
-        grad[0] = float(ds @ t)
-        grad[1] = float(np.sum(ds))
-        grad[2 : 2 + S] = At_As.T @ ds + np.sign(delta) / tau
-        grad[2 + S : 2 + S + F] = X.T @ dg + beta / beta_sd**2
-        grad[-1] = -inv_s2 * float(err @ err) + T + sigma**2 / sigma_sd**2
-        return val, grad
+        # errstate: a wild line-search step can underflow sigma to 0 (1/0
+        # divide) or overflow mu; the non-finite guard below handles those
+        # steps correctly, so the transient RuntimeWarnings are pure noise
+        with np.errstate(divide="ignore", over="ignore", invalid="ignore"):
+            k, m = theta[0], theta[1]
+            delta = theta[2 : 2 + S]
+            beta = theta[2 + S : 2 + S + F]
+            log_sigma = theta[-1]
+            sigma = np.exp(log_sigma)
+            g = (k + A @ delta) * t + (m - A_s @ delta)
+            season = 1.0 + X @ beta
+            mu = g * season
+            err = y_s - mu
+            inv_s2 = 1.0 / sigma**2
+            val = (
+                0.5 * inv_s2 * float(err @ err)
+                + T * log_sigma
+                + float(np.sum(np.abs(delta))) / tau
+                + 0.5 * float(beta @ beta) / beta_sd**2
+                + 0.5 * sigma**2 / sigma_sd**2
+            )
+            if not np.isfinite(val):
+                # a wild line-search step (sigma underflow / mu overflow):
+                # return a huge finite value with a zero gradient so L-BFGS-B
+                # backtracks instead of propagating NaNs into its history
+                return 1e15, np.zeros_like(theta)
+            dmu = -err * inv_s2          # dL/dmu, (T,)
+            ds = dmu * season            # dL/d(trend)
+            dg = dmu * g                 # dL/d(season term X beta)
+            grad = np.empty_like(theta)
+            grad[0] = float(ds @ t)
+            grad[1] = float(np.sum(ds))
+            grad[2 : 2 + S] = At_As.T @ ds + np.sign(delta) / tau
+            grad[2 + S : 2 + S + F] = X.T @ dg + beta / beta_sd**2
+            grad[-1] = -inv_s2 * float(err @ err) + T + sigma**2 / sigma_sd**2
+            return val, grad
 
     return f
 

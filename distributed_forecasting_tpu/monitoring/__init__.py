@@ -1,4 +1,8 @@
 from distributed_forecasting_tpu.monitoring.monitor import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
     MonitorConfig,
     MonitorRegistry,
     detect_anomalies,
@@ -8,4 +12,5 @@ from distributed_forecasting_tpu.monitoring.monitor import (
 )
 
 __all__ = ["MonitorConfig", "MonitorRegistry", "detect_anomalies",
-           "drift_report", "degradation_report", "run_monitor"]
+           "drift_report", "degradation_report", "run_monitor",
+           "Counter", "Gauge", "Histogram", "MetricsRegistry"]

@@ -23,6 +23,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -487,3 +488,182 @@ def degradation_report(
     out_name = output_table or f"{config.table}_degradation"
     catalog.save_table(out_name, report)
     return report
+
+
+# ---------------------------------------------------------------------------
+# Live process metrics (counters/gauges/histograms + Prometheus exposition)
+#
+# The table-based monitors above close the loop on MODEL quality, offline.
+# The serving path needs the other half of the reference's monitoring story:
+# live process telemetry — request counters, queue depth, latency and
+# coalesced-batch-size distributions — scraped from the scorer itself
+# (serving/server.py's GET /metrics).  These are deliberately tiny,
+# dependency-free, thread-safe primitives in the Prometheus data model, not
+# a client-library vendoring: the image carries no prometheus_client, and a
+# scorer needs exactly counters, gauges and fixed-bucket histograms.
+# ---------------------------------------------------------------------------
+
+
+def _fmt_value(v: float) -> str:
+    """Prometheus sample value: integral floats render as integers."""
+    f = float(v)
+    return str(int(f)) if f.is_integer() and abs(f) < 1e15 else repr(f)
+
+
+class Counter:
+    """Monotonically increasing counter (thread-safe)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError("counters only go up; use a Gauge")
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def render(self, name: str) -> List[str]:
+        return [f"{name} {_fmt_value(self._value)}"]
+
+    def snapshot(self) -> float:
+        return self._value
+
+
+class Gauge:
+    """Settable instantaneous value (thread-safe)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.inc(-amount)
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def render(self, name: str) -> List[str]:
+        return [f"{name} {_fmt_value(self._value)}"]
+
+    def snapshot(self) -> float:
+        return self._value
+
+
+class Histogram:
+    """Fixed-bucket histogram in the Prometheus cumulative-``le`` model.
+
+    Buckets are upper bounds; every observation also lands in the implicit
+    ``+Inf`` bucket, and ``sum``/``count`` ride along so scrapers can derive
+    means and quantile estimates.
+    """
+
+    def __init__(self, buckets: Tuple[float, ...]) -> None:
+        if not buckets:
+            raise ValueError("histogram needs at least one bucket bound")
+        self._uppers = tuple(sorted(float(b) for b in buckets))
+        self._counts = [0] * (len(self._uppers) + 1)  # +1 = +Inf
+        self._sum = 0.0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        i = len(self._uppers)
+        for j, ub in enumerate(self._uppers):
+            if v <= ub:
+                i = j
+                break
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += v
+
+    @property
+    def count(self) -> int:
+        return sum(self._counts)
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def cumulative_buckets(self) -> List[Tuple[str, int]]:
+        out, running = [], 0
+        for ub, c in zip(self._uppers, self._counts):
+            running += c
+            out.append((f"{ub:g}", running))
+        out.append(("+Inf", running + self._counts[-1]))
+        return out
+
+    def render(self, name: str) -> List[str]:
+        lines = [
+            f'{name}_bucket{{le="{le}"}} {c}'
+            for le, c in self.cumulative_buckets()
+        ]
+        lines.append(f"{name}_sum {_fmt_value(self._sum)}")
+        lines.append(f"{name}_count {self.count}")
+        return lines
+
+    def snapshot(self) -> Dict:
+        return {
+            "count": self.count,
+            "sum": self._sum,
+            "buckets": dict(self.cumulative_buckets()),
+        }
+
+
+class MetricsRegistry:
+    """Named metrics + Prometheus text exposition (format 0.0.4).
+
+    One registry per scorer process; ``render_prometheus()`` is what the
+    ``GET /metrics`` endpoint returns, ``snapshot()`` is the JSON-friendly
+    view tests and in-process consumers use.
+    """
+
+    def __init__(self) -> None:
+        self._metrics: Dict[str, Tuple[str, str, object]] = {}
+        self._lock = threading.Lock()
+
+    def _register(self, name: str, kind: str, help_text: str, metric):
+        with self._lock:
+            if name in self._metrics:
+                raise ValueError(f"metric {name!r} already registered")
+            self._metrics[name] = (kind, help_text, metric)
+        return metric
+
+    def counter(self, name: str, help_text: str = "") -> Counter:
+        return self._register(name, "counter", help_text, Counter())
+
+    def gauge(self, name: str, help_text: str = "") -> Gauge:
+        return self._register(name, "gauge", help_text, Gauge())
+
+    def histogram(
+        self, name: str, buckets: Tuple[float, ...], help_text: str = ""
+    ) -> Histogram:
+        return self._register(name, "histogram", help_text, Histogram(buckets))
+
+    def render_prometheus(self) -> str:
+        lines: List[str] = []
+        for name, (kind, help_text, metric) in self._metrics.items():
+            if help_text:
+                lines.append(f"# HELP {name} {help_text}")
+            lines.append(f"# TYPE {name} {kind}")
+            lines.extend(metric.render(name))
+        return "\n".join(lines) + "\n"
+
+    def snapshot(self) -> Dict:
+        return {
+            name: metric.snapshot()
+            for name, (_, _, metric) in self._metrics.items()
+        }

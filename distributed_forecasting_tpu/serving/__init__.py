@@ -1,4 +1,11 @@
 from distributed_forecasting_tpu.serving.predictor import BatchForecaster
+from distributed_forecasting_tpu.serving.batcher import (
+    BatchingConfig,
+    QueueFullError,
+    RequestBatcher,
+    ServingMetrics,
+    ShuttingDownError,
+)
 from distributed_forecasting_tpu.serving.bucketed import BucketedForecaster
 from distributed_forecasting_tpu.serving.ensemble import (
     BlendedForecaster,
@@ -14,10 +21,15 @@ from distributed_forecasting_tpu.serving.server import (
 
 __all__ = [
     "BatchForecaster",
+    "BatchingConfig",
     "BucketedForecaster",
     "MultiModelForecaster",
     "BlendedForecaster",
     "ForecastServer",
+    "QueueFullError",
+    "RequestBatcher",
+    "ServingMetrics",
+    "ShuttingDownError",
     "load_forecaster",
     "resolve_from_registry",
     "serve",

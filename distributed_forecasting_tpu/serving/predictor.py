@@ -113,8 +113,34 @@ def _bucket_ladder(sizes) -> tuple:
     return tuple(ladder)
 
 
+def result_block_index(out: pd.DataFrame, key_names) -> tuple:
+    """``(T, {key tuple: block index})`` for a long predict result frame.
+
+    Every serving predict returns one contiguous ``T``-row block per series
+    (``_frame_skeleton`` tiles dates per series); the micro-batching
+    coalescer (``serving/batcher.py``) uses this map to scatter a merged
+    result back into per-request slices: request ``r``'s rows are its keys'
+    blocks concatenated in ``r``'s own first-occurrence order — exactly what
+    a solo ``predict(r)`` would have returned.
+    """
+    uniq = out[list(key_names)].drop_duplicates()
+    n = len(uniq)
+    if n == 0:
+        return 0, {}
+    T = len(out) // n
+    return T, {tuple(row): i for i, row in enumerate(uniq.itertuples(index=False))}
+
+
 class BatchForecaster:
     """Loads once, predicts every requested series in one compiled call."""
+
+    # predict/predict_quantiles return request-order per-series T-row blocks
+    # that are BIT-IDENTICAL across request-size buckets (vectorized along
+    # the series axis, no cross-series reductions) — the property the
+    # serving coalescer needs to merge concurrent requests and scatter
+    # byte-identical slices back.  Composite forecasters (ensemble/
+    # bucketed) reorder rows by member family, so they don't set this.
+    coalesce_safe = True
 
     def __init__(
         self,

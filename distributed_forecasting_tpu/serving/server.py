@@ -16,15 +16,23 @@ image, and none needed for a single-model scorer):
   GET  /schema            -> serving schema + key names (the tag the
                              reference stores on the model version,
                              03_deploy.py:44-58)
+  GET  /metrics           -> Prometheus text exposition: request/dispatch/
+                             rejection/timeout counters, queue-depth gauge,
+                             latency + coalesced-batch-size histograms
   POST /invocations       -> {"inputs": [{"store": 1, "item": 2}, ...],
                               "horizon": 90, "include_history": false}
                           -> {"predictions": [...]} (records of the output
                              frame; unknown series -> 404 unless
-                             "on_missing": "skip")
+                             "on_missing": "skip"; with micro-batching
+                             enabled, a full queue -> 429 and a request
+                             outliving request_timeout_s -> 503)
 
 ``serve`` blocks; ``start_server`` returns the live server for tests/
 embedding.  Model resolution goes through the registry exactly like the
 reference's ``predict_udf`` (latest version, optionally stage-filtered).
+Concurrent-request coalescing (``serving/batcher.py``) is OFF by default;
+pass a ``BatchingConfig(enabled=True, ...)`` (conf: ``serving.batching``)
+to merge concurrent ``/invocations`` into shared device dispatches.
 """
 
 from __future__ import annotations
@@ -32,12 +40,21 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time
+from concurrent.futures import TimeoutError as _FutureTimeoutError
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 import numpy as np
 import pandas as pd
 
+from distributed_forecasting_tpu.serving.batcher import (
+    BatchingConfig,
+    QueueFullError,
+    RequestBatcher,
+    ServingMetrics,
+    ShuttingDownError,
+)
 from distributed_forecasting_tpu.serving.ensemble import (
     BlendedForecaster,
     MultiModelForecaster,
@@ -84,11 +101,13 @@ class _Handler(BaseHTTPRequestHandler):
     server_version = "dftpu-serve/1.0"
 
     # the forecaster and metadata ride on the server object
-    def _send(self, code: int, payload: dict) -> None:
+    def _send(self, code: int, payload: dict, extra_headers=()) -> None:
         body = json.dumps(payload).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for name, value in extra_headers:
+            self.send_header(name, value)
         self.end_headers()
         self.wfile.write(body)
 
@@ -121,6 +140,14 @@ class _Handler(BaseHTTPRequestHandler):
                     "serving_schema": fc.serving_schema,
                 },
             )
+        elif self.path == "/metrics":
+            body = self.server.metrics.render().encode()
+            self.send_response(200)
+            self.send_header(
+                "Content-Type", "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
         else:
             self._send(404, {"error": f"no route {self.path}"})
 
@@ -128,6 +155,16 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path not in ("/invocations", "/predict"):
             self._send(404, {"error": f"no route {self.path}"})
             return
+        metrics = self.server.metrics
+        metrics.requests.inc()
+        t0 = time.monotonic()
+        try:
+            self._invoke()
+        finally:
+            metrics.latency.observe(time.monotonic() - t0)
+
+    def _invoke(self):
+        metrics = self.server.metrics
         try:
             length = int(self.headers.get("Content-Length", "0"))
             req = json.loads(self.rfile.read(length) or b"{}")
@@ -194,22 +231,14 @@ class _Handler(BaseHTTPRequestHandler):
                                   "interval (0.001, 0.999)"},
                     )
                     return
-                out = self.server.forecaster.predict_quantiles(
-                    frame,
-                    quantiles=quantiles,
-                    horizon=horizon,
-                    include_history=bool(req.get("include_history", False)),
-                    on_missing=req.get("on_missing", "raise"),
-                    xreg=xreg,
-                )
-            else:
-                out = self.server.forecaster.predict(
-                    frame,
-                    horizon=horizon,
-                    include_history=bool(req.get("include_history", False)),
-                    on_missing=req.get("on_missing", "raise"),
-                    xreg=xreg,
-                )
+            out = self.server.execute(
+                frame,
+                horizon=horizon,
+                include_history=bool(req.get("include_history", False)),
+                quantiles=quantiles,
+                on_missing=req.get("on_missing", "raise"),
+                xreg=xreg,
+            )
             out["ds"] = out["ds"].astype(str)
             keys = list(self.server.forecaster.key_names)
             n_series = int(out[keys].drop_duplicates().shape[0]) if len(out) else 0
@@ -222,23 +251,110 @@ class _Handler(BaseHTTPRequestHandler):
             )
         except UnknownSeriesError as e:
             self._send(404, {"error": str(e)})
+        except QueueFullError as e:
+            # admission control: shed load NOW so clients can back off,
+            # instead of stacking handler threads behind a saturated chip
+            metrics.rejections.inc()
+            self._send(429, {"error": str(e)},
+                       extra_headers=(("Retry-After", "1"),))
+        except (TimeoutError, _FutureTimeoutError) as e:
+            # the request outlived request_timeout_s (queued or in flight)
+            metrics.timeouts.inc()
+            self._send(503, {"error": f"request timed out: {e}" if str(e)
+                             else "request timed out"})
+        except ShuttingDownError as e:
+            self._send(503, {"error": str(e)})
         except (ValueError, TypeError, KeyError, json.JSONDecodeError) as e:
             # TypeError covers JSON-legal but wrong-typed fields, e.g.
             # "horizon": null / [90]
             self._send(400, {"error": f"{type(e).__name__}: {e}"})
         except Exception as e:  # noqa: BLE001 — scorer must not die mid-request
+            metrics.errors.inc()
             self.server.logger.exception("invocation failed")
             self._send(500, {"error": f"{type(e).__name__}: {e}"})
 
 
 class ForecastServer(ThreadingHTTPServer):
     daemon_threads = True
+    # socketserver's default listen backlog is 5 — a burst of concurrent
+    # clients (the exact traffic micro-batching exists for) gets connection
+    # resets before a handler ever runs.  128 matches the admission-control
+    # story: shedding load is the batcher's 429, not the kernel's RST.
+    request_queue_size = 128
 
-    def __init__(self, addr, forecaster, model_version: Optional[str] = None):
+    def __init__(
+        self,
+        addr,
+        forecaster,
+        model_version: Optional[str] = None,
+        batching: Optional[BatchingConfig] = None,
+    ):
         super().__init__(addr, _Handler)
         self.forecaster = forecaster
         self.model_version = model_version
         self.logger = get_logger("ForecastServer")
+        self.metrics = ServingMetrics()
+        self.batching = batching
+        self.batcher: Optional[RequestBatcher] = None
+        if batching is not None and batching.enabled:
+            self.batcher = RequestBatcher(forecaster, batching, self.metrics)
+            self.logger.info(
+                "micro-batching on: max_batch_size=%d max_wait_ms=%g "
+                "max_queue_depth=%d request_timeout_s=%g",
+                batching.max_batch_size, batching.max_wait_ms,
+                batching.max_queue_depth, batching.request_timeout_s,
+            )
+
+    def execute(
+        self,
+        frame,
+        horizon: int,
+        include_history: bool,
+        quantiles,
+        on_missing: str,
+        xreg,
+    ):
+        """Run one parsed /invocations request — through the coalescer when
+        batching is on, as a direct forecaster call otherwise (both paths
+        feed the same dispatch/batch-size metrics, so /metrics tells the
+        coalescing story in either mode)."""
+        if self.batcher is not None:
+            fut = self.batcher.submit(
+                frame,
+                horizon=horizon,
+                include_history=include_history,
+                quantiles=quantiles,
+                on_missing=on_missing,
+                xreg=xreg,
+            )
+            # the batcher already fails queued requests at their deadline;
+            # this wait is the backstop for a request stuck IN a dispatch
+            return fut.result(timeout=self.batching.request_timeout_s)
+        self.metrics.dispatches.inc()
+        self.metrics.batch_size.observe(1)
+        if quantiles is not None:
+            return self.forecaster.predict_quantiles(
+                frame,
+                quantiles=quantiles,
+                horizon=horizon,
+                include_history=include_history,
+                on_missing=on_missing,
+                xreg=xreg,
+            )
+        return self.forecaster.predict(
+            frame,
+            horizon=horizon,
+            include_history=include_history,
+            on_missing=on_missing,
+            xreg=xreg,
+        )
+
+    def shutdown(self):
+        """Graceful: drain the batching queue (every queued request gets its
+        response) BEFORE stopping the accept loop and closing the socket."""
+        if self.batcher is not None:
+            self.batcher.close()
+        super().shutdown()
 
 
 def start_server(
@@ -246,10 +362,11 @@ def start_server(
     host: str = "127.0.0.1",
     port: int = 0,
     model_version: Optional[str] = None,
+    batching: Optional[BatchingConfig] = None,
 ) -> ForecastServer:
     """Start serving on a background thread; returns the server (its
     ``server_address[1]`` is the bound port — port=0 picks a free one)."""
-    srv = ForecastServer((host, port), forecaster, model_version)
+    srv = ForecastServer((host, port), forecaster, model_version, batching)
     t = threading.Thread(target=srv.serve_forever, daemon=True)
     t.start()
     return srv
@@ -260,7 +377,8 @@ def serve(
     host: str = "0.0.0.0",
     port: int = 8080,
     model_version: Optional[str] = None,
+    batching: Optional[BatchingConfig] = None,
 ) -> None:
-    srv = ForecastServer((host, port), forecaster, model_version)
+    srv = ForecastServer((host, port), forecaster, model_version, batching)
     srv.logger.info("serving on %s:%d", host, port)
     srv.serve_forever()

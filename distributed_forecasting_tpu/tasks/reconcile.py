@@ -181,11 +181,13 @@ class ReconcileTask(Task):
             cv = CVConfig(**rc.get("cv", {}))
             m = cross_validate(nodes, model=model, cv=cv, key=key)
             var = np.asarray(m["mse"])
-            var = np.where(
-                np.isfinite(var) & (var > 0), var,
-                np.nanmedian(var[np.isfinite(var)]) if
-                np.isfinite(var).any() else 1.0,
-            )
+            # fallback median over POSITIVE finite values only: constant
+            # series CV to exactly-zero MSE, and a zero median would let
+            # those nodes keep var=0 and grab 1e12 WLS weight through the
+            # 1e-12 clamp in reconcile_forecasts
+            good = np.isfinite(var) & (var > 0)
+            fallback = float(np.median(var[good])) if good.any() else 1.0
+            var = np.where(good, var, fallback)
             error_var = jnp.asarray(var)
         coherent = reconcile_forecasts(h, base, error_var=error_var)
 

@@ -18,10 +18,17 @@ Conf::
       warmup_horizon: 90      # buckets before accepting traffic, so the
                               # first request of each size doesn't pay the
                               # compile inside its latency
+      batching:               # optional micro-batching coalescer
+        enabled: true         # default false: one dispatch per request
+        max_batch_size: 64    # requests merged into one device dispatch
+        max_wait_ms: 5        # coalescing window after the first arrival
+        max_queue_depth: 256  # admission control: 429 past this
+        request_timeout_s: 30 # 503 for requests that outlive this
 """
 
 from __future__ import annotations
 
+from distributed_forecasting_tpu.serving.batcher import BatchingConfig
 from distributed_forecasting_tpu.serving.server import resolve_from_registry, serve
 from distributed_forecasting_tpu.tasks.common import Task
 
@@ -31,6 +38,9 @@ class ServeTask(Task):
         conf = self.conf.get("serving", {})
         name = conf.get("model_name", "ForecastingBatchModel")
         stage = conf.get("stage")
+        # parse the batching block BEFORE the expensive registry load so a
+        # conf typo fails in milliseconds, not after artifact resolution
+        batching = BatchingConfig.from_conf(conf.get("batching"))
         forecaster, version = resolve_from_registry(self.registry, name, stage=stage)
         sizes = conf.get("warmup_sizes")
         if sizes:
@@ -45,15 +55,17 @@ class ServeTask(Task):
                 "warmed %d request-size bucket(s) in %.1fs", n, time.time() - t0
             )
         self.logger.info(
-            "serving %s v%s (%d series) on %s:%s",
+            "serving %s v%s (%d series) on %s:%s (micro-batching %s)",
             name, version.version, forecaster.n_series,
             conf.get("host", "0.0.0.0"), conf.get("port", 8080),
+            "on" if batching.enabled else "off",
         )
         serve(
             forecaster,
             host=conf.get("host", "0.0.0.0"),
             port=int(conf.get("port", 8080)),
             model_version=str(version.version),
+            batching=batching,
         )
 
 

@@ -16,6 +16,35 @@ from __future__ import annotations
 import os
 
 
+def _initialized_backends() -> dict | None:
+    """The xla_bridge backend cache WITHOUT populating it, or None when no
+    probe resolves under this jax version.
+
+    Probe chain: the canonical private module first, then the long-standing
+    ``jax.lib.xla_bridge`` alias (the closest thing to a public route to the
+    same cache).  Detection must stay lazy — every genuinely public API that
+    names the current backend (``jax.devices``, ``jax.default_backend``,
+    ``jax.extend.backend.get_backend``) *initializes* one, which is exactly
+    what the too-late-override guard exists to avoid.  A unit test
+    (tests/unit/test_tasks.py) pins this to not return None so a jax bump
+    that moves the cache fails loudly instead of silently degrading."""
+    import jax  # noqa: F401  (both probe routes hang off the jax package)
+
+    try:
+        from jax._src import xla_bridge
+
+        return xla_bridge._backends
+    except (ImportError, AttributeError):
+        pass
+    try:
+        backends = jax.lib.xla_bridge._backends
+        if isinstance(backends, dict):
+            return backends
+    except AttributeError:
+        pass
+    return None
+
+
 def apply_platform_override() -> str | None:
     """Apply ``DFTPU_PLATFORM`` if set; returns the platform or None.
 
@@ -42,22 +71,21 @@ def apply_platform_override() -> str | None:
     import jax
 
     jax.config.update("jax_platforms", plat)
-    try:
-        from jax._src import xla_bridge
-
-        already_initialized = bool(xla_bridge._backends)
-    except (ImportError, AttributeError):
-        # private surface moved under a jax upgrade: stay lazy (the config
+    backends = _initialized_backends()
+    if backends is None:
+        # every probe route moved under a jax upgrade: stay lazy (the config
         # update above still governs selection) but say loudly that the
         # too-late-override guard is gone rather than silently skipping it
         import warnings
 
         warnings.warn(
-            "jax._src.xla_bridge._backends is unavailable under this jax "
+            "jax xla_bridge backend cache is unavailable under this jax "
             "version — DFTPU_PLATFORM too-late-override detection disabled",
             RuntimeWarning,
         )
         already_initialized = False
+    else:
+        already_initialized = bool(backends)
     if already_initialized:
         # backend(s) exist already — default_backend() is a cached lookup
         # here, not an init

@@ -1,0 +1,203 @@
+"""Serving-path load benchmark: sequential dispatch vs micro-batching.
+
+ISSUE #1 acceptance: the win from the request coalescer
+(``serving/batcher.py``) must be measured, not asserted.  This script fits
+a small artifact, starts the SAME forecaster behind two live HTTP servers —
+micro-batching disabled, then enabled — fires K concurrent clients at each
+(every client scores its own series, the worst case for coalescing dedup),
+and prints one JSON line with both modes' throughput and latency
+percentiles plus an exact-equality check of the coalesced responses against
+per-request responses.
+
+Both modes share one process and one compile cache, and every request-size
+bucket the coalescer can produce is warmed before timing, so the comparison
+isolates dispatch behavior: N threads -> N solo device dispatches vs N
+threads -> ~N/K merged dispatches.
+
+Run (CPU backend is fine — the dispatch overhead being amortized exists on
+every backend):
+
+    JAX_PLATFORMS=cpu python scripts/bench_serving.py --clients 16
+
+Output: one JSON line on stdout, e.g. speedup = batched throughput /
+unbatched throughput; docs/serving.md carries a measured row.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+import threading
+import time
+import urllib.request
+
+
+def _call(port: int, payload: dict) -> bytes:
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/invocations",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=120) as r:
+        return r.read()
+
+
+def _metrics(port: int) -> str:
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=30
+    ) as r:
+        return r.read().decode()
+
+
+def _percentile(sorted_vals, q: float) -> float:
+    if not sorted_vals:
+        return float("nan")
+    i = min(int(q * len(sorted_vals)), len(sorted_vals) - 1)
+    return sorted_vals[i]
+
+
+def run_mode(fc, payloads, n_requests: int, batching) -> dict:
+    from distributed_forecasting_tpu.serving import start_server
+
+    srv = start_server(fc, batching=batching)
+    port = srv.server_address[1]
+    K = len(payloads)
+    latencies = [[] for _ in range(K)]
+    bodies = [None] * K
+    spans = [None] * K
+    barrier = threading.Barrier(K)
+
+    def client(i: int) -> None:
+        barrier.wait()
+        t_start = time.perf_counter()
+        for _ in range(n_requests):
+            t0 = time.perf_counter()
+            body = _call(port, payloads[i])
+            latencies[i].append(time.perf_counter() - t0)
+            if bodies[i] is None:
+                bodies[i] = body
+        spans[i] = (t_start, time.perf_counter())
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(K)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = max(t1 for _, t1 in spans) - min(t0 for t0, _ in spans)
+    text = _metrics(port)
+    dispatches = int(re.search(r"serving_dispatches_total (\d+)", text).group(1))
+    requests = int(re.search(r"serving_requests_total (\d+)", text).group(1))
+    srv.shutdown()
+    lat = sorted(x for per_client in latencies for x in per_client)
+    return {
+        "throughput_rps": round(K * n_requests / wall, 2),
+        "wall_s": round(wall, 3),
+        "p50_ms": round(1e3 * _percentile(lat, 0.50), 2),
+        "p95_ms": round(1e3 * _percentile(lat, 0.95), 2),
+        "p99_ms": round(1e3 * _percentile(lat, 0.99), 2),
+        "requests": requests,
+        "dispatches": dispatches,
+        "mean_batch": round(requests / max(dispatches, 1), 2),
+        "_bodies": bodies,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--clients", type=int, default=16)
+    ap.add_argument("--requests", type=int, default=16,
+                    help="requests per client per mode")
+    ap.add_argument("--series", type=int, default=32,
+                    help="trained series (>= clients so each client owns one)")
+    ap.add_argument("--days", type=int, default=400)
+    ap.add_argument("--horizon", type=int, default=14)
+    ap.add_argument("--model", default="theta",
+                    help="fast-fitting family; the dispatch story is the same")
+    ap.add_argument("--max-wait-ms", type=float, default=4.0)
+    args = ap.parse_args()
+
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import distributed_forecasting_tpu  # noqa: F401  (platform override first)
+    from distributed_forecasting_tpu.data import (
+        synthetic_store_item_sales,
+        tensorize,
+    )
+    from distributed_forecasting_tpu.engine import fit_forecast
+    from distributed_forecasting_tpu.serving import (
+        BatchForecaster,
+        BatchingConfig,
+    )
+
+    from distributed_forecasting_tpu.models.base import get_model
+
+    n_items = max(1, (args.series + 3) // 4)
+    df = synthetic_store_item_sales(
+        n_stores=4, n_items=n_items, n_days=args.days, seed=7)
+    batch = tensorize(df)
+    cfg = get_model(args.model).config_cls()
+    params, _ = fit_forecast(
+        batch, model=args.model, config=cfg, horizon=args.horizon)
+    fc = BatchForecaster.from_fit(batch, params, args.model, cfg)
+
+    S = fc.n_series
+    K = min(args.clients, S)
+    keys = fc.keys
+    payloads = [
+        {
+            "inputs": [
+                {name: int(v) for name, v in zip(fc.key_names, keys[i % S])}
+            ],
+            "horizon": args.horizon,
+        }
+        for i in range(K)
+    ]
+
+    # warm every bucket the coalescer can produce (1..K) plus the solo path
+    sizes = [1]
+    b = 2
+    while b <= K:
+        sizes.append(b)
+        b <<= 1
+    if K not in sizes:
+        sizes.append(K)
+    fc.warmup(horizon=args.horizon, sizes=sizes)
+
+    unbatched = run_mode(fc, payloads, args.requests, batching=None)
+    batched = run_mode(
+        fc, payloads, args.requests,
+        batching=BatchingConfig(
+            enabled=True,
+            max_batch_size=max(K, 1),
+            max_wait_ms=args.max_wait_ms,
+            max_queue_depth=4 * max(K, 1),
+            request_timeout_s=120.0,
+        ),
+    )
+
+    exact = all(
+        u == b for u, b in zip(unbatched.pop("_bodies"), batched.pop("_bodies"))
+    )
+    out = {
+        "bench": "serving_microbatch",
+        "model": args.model,
+        "clients": K,
+        "requests_per_client": args.requests,
+        "series": S,
+        "horizon": args.horizon,
+        "unbatched": unbatched,
+        "batched": batched,
+        "speedup": round(
+            batched["throughput_rps"] / unbatched["throughput_rps"], 2),
+        "exact_match": bool(exact),
+    }
+    print(json.dumps(out))
+    if not exact:
+        sys.exit("coalesced responses diverged from per-request responses")
+
+
+if __name__ == "__main__":
+    main()

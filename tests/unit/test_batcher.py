@@ -1,0 +1,298 @@
+"""Micro-batching coalescer tests (serving/batcher.py): grouping, scatter
+correctness, admission control, timeouts, drain-on-close, failure isolation,
+and the metrics primitives it publishes — all against a fake forecaster, so
+nothing here compiles or touches a device."""
+
+import threading
+import time
+
+import pandas as pd
+import pytest
+
+from distributed_forecasting_tpu.monitoring import MetricsRegistry
+from distributed_forecasting_tpu.serving.batcher import (
+    BatchingConfig,
+    QueueFullError,
+    RequestBatcher,
+    ServingMetrics,
+    ShuttingDownError,
+)
+
+
+class FakeForecaster:
+    """Deterministic stand-in for BatchForecaster: T rows per requested key,
+    yhat a pure function of (key, step), so per-request scatter slices are
+    checkable; records every call's key count; can block on an event or
+    raise on poison keys to exercise the failure paths."""
+
+    key_names = ("store", "item")
+    coalesce_safe = True
+
+    def __init__(self, block_event=None, poison=frozenset()):
+        self.calls = []  # list of key counts, one per predict call
+        self.block_event = block_event
+        self.poison = frozenset(poison)
+        self.started = threading.Event()
+
+    def predict(self, frame, horizon=90, include_history=False,
+                on_missing="raise", xreg=None):
+        keys = [tuple(r) for r in frame[list(self.key_names)].itertuples(
+            index=False)]
+        self.calls.append(len(keys))
+        self.started.set()
+        if self.block_event is not None:
+            assert self.block_event.wait(10), "test forgot to release the fake"
+        bad = [k for k in keys if k in self.poison]
+        if bad:
+            raise ValueError(f"poison keys {bad}")
+        rows = [
+            {"ds": f"2026-01-{t + 1:02d}", "store": s, "item": i,
+             "yhat": 1000.0 * s + 10.0 * i + t}
+            for (s, i) in keys
+            for t in range(horizon)
+        ]
+        return pd.DataFrame(rows)
+
+    def predict_quantiles(self, frame, quantiles, horizon=90,
+                          include_history=False, on_missing="raise",
+                          xreg=None):
+        out = self.predict(frame, horizon=horizon,
+                           include_history=include_history,
+                           on_missing=on_missing, xreg=xreg)
+        for q in quantiles:
+            out[f"q{q}"] = out["yhat"]
+        return out
+
+
+def _frame(*keys):
+    return pd.DataFrame(list(keys), columns=["store", "item"])
+
+
+def _expected(fc, keys, horizon):
+    return fc.predict(_frame(*keys), horizon=horizon).reset_index(drop=True)
+
+
+@pytest.fixture
+def cfg():
+    # a window long enough that a tight submit loop always coalesces
+    return BatchingConfig(enabled=True, max_batch_size=16, max_wait_ms=100.0,
+                          max_queue_depth=32, request_timeout_s=5.0)
+
+
+def test_coalesces_one_dispatch_and_scatters_exact_slices(cfg):
+    fc = FakeForecaster()
+    b = RequestBatcher(fc, cfg)
+    try:
+        reqs = [[(1, 1)], [(1, 2)], [(2, 1)], [(2, 2), (1, 1)]]
+        futs = [b.submit(_frame(*keys), horizon=7) for keys in reqs]
+        outs = [f.result(timeout=10) for f in futs]
+    finally:
+        b.close()
+    # 5 requested key instances, 4 unique -> ONE merged dispatch of 4 keys
+    # (fc.calls grew by the _expected() calls below, so check the first)
+    assert fc.calls[0] == 4
+    probe = FakeForecaster()
+    for keys, out in zip(reqs, outs):
+        want = _expected(probe, keys, 7)
+        pd.testing.assert_frame_equal(out.reset_index(drop=True), want)
+        assert list(out.index) == list(range(len(out)))  # scatter reindexes
+
+
+def test_duplicate_key_across_requests_is_dispatched_once(cfg):
+    fc = FakeForecaster()
+    b = RequestBatcher(fc, cfg)
+    try:
+        futs = [b.submit(_frame((1, 1)), horizon=5) for _ in range(6)]
+        outs = [f.result(timeout=10) for f in futs]
+    finally:
+        b.close()
+    assert fc.calls == [1]  # 6 requests, one key, one 1-key dispatch
+    for out in outs:
+        assert len(out) == 5 and (out["yhat"] == outs[0]["yhat"]).all()
+
+
+def test_mixed_signatures_dispatch_separately(cfg):
+    fc = FakeForecaster()
+    b = RequestBatcher(fc, cfg)
+    try:
+        f_a = [b.submit(_frame((1, 1)), horizon=5),
+               b.submit(_frame((1, 2)), horizon=5)]
+        f_b = [b.submit(_frame((2, 1)), horizon=9),
+               b.submit(_frame((2, 2)), horizon=9)]
+        outs_a = [f.result(timeout=10) for f in f_a]
+        outs_b = [f.result(timeout=10) for f in f_b]
+    finally:
+        b.close()
+    assert sorted(fc.calls) == [2, 2]  # one dispatch per horizon group
+    assert all(len(o) == 5 for o in outs_a)
+    assert all(len(o) == 9 for o in outs_b)
+
+
+def test_quantiles_signature_and_result(cfg):
+    fc = FakeForecaster()
+    b = RequestBatcher(fc, cfg)
+    try:
+        f_q = b.submit(_frame((1, 1)), horizon=5, quantiles=(0.1, 0.9))
+        f_p = b.submit(_frame((1, 2)), horizon=5)
+        out_q = f_q.result(timeout=10)
+        out_p = f_p.result(timeout=10)
+    finally:
+        b.close()
+    # point and quantile requests never share a compiled program
+    assert sorted(fc.calls) == [1, 1]
+    assert {"q0.1", "q0.9"} <= set(out_q.columns)
+    assert "q0.1" not in out_p.columns
+
+
+def test_xreg_requests_never_merge(cfg):
+    fc = FakeForecaster()
+    b = RequestBatcher(fc, cfg)
+    try:
+        futs = [b.submit(_frame((1, 1)), horizon=5, xreg=object()),
+                b.submit(_frame((1, 2)), horizon=5, xreg=object())]
+        for f in futs:
+            f.result(timeout=10)
+    finally:
+        b.close()
+    assert fc.calls == [1, 1]
+
+
+def test_non_coalesce_safe_forecaster_goes_solo(cfg):
+    fc = FakeForecaster()
+    fc.coalesce_safe = False  # composites reorder rows by member family
+    b = RequestBatcher(fc, cfg)
+    try:
+        futs = [b.submit(_frame((1, 1)), horizon=5),
+                b.submit(_frame((1, 2)), horizon=5)]
+        for f in futs:
+            f.result(timeout=10)
+    finally:
+        b.close()
+    assert fc.calls == [1, 1]
+
+
+def test_queue_full_raises_queuefullerror():
+    release = threading.Event()
+    fc = FakeForecaster(block_event=release)
+    b = RequestBatcher(fc, BatchingConfig(
+        enabled=True, max_batch_size=4, max_wait_ms=0.0,
+        max_queue_depth=1, request_timeout_s=5.0))
+    try:
+        f1 = b.submit(_frame((1, 1)), horizon=3)
+        assert fc.started.wait(5)          # scheduler is inside predict now
+        f2 = b.submit(_frame((1, 2)), horizon=3)   # fills the 1-deep queue
+        with pytest.raises(QueueFullError):
+            b.submit(_frame((2, 1)), horizon=3)    # -> the server's 429
+    finally:
+        release.set()
+        b.close()
+    assert f1.result(timeout=10) is not None
+    assert f2.result(timeout=10) is not None
+
+
+def test_request_expired_in_queue_gets_timeout():
+    release = threading.Event()
+    fc = FakeForecaster(block_event=release)
+    b = RequestBatcher(fc, BatchingConfig(
+        enabled=True, max_batch_size=4, max_wait_ms=0.0,
+        max_queue_depth=8, request_timeout_s=0.05))
+    try:
+        f1 = b.submit(_frame((1, 1)), horizon=3)
+        assert fc.started.wait(5)
+        f2 = b.submit(_frame((1, 2)), horizon=3)  # waits behind the block
+        time.sleep(0.15)                           # ...past its deadline
+    finally:
+        release.set()
+        b.close()
+    assert f1.result(timeout=10) is not None       # dispatched before expiry
+    with pytest.raises(TimeoutError):              # -> the server's 503
+        f2.result(timeout=10)
+
+
+def test_close_drains_queue_then_rejects(cfg):
+    fc = FakeForecaster()
+    b = RequestBatcher(fc, cfg)
+    futs = [b.submit(_frame((1, i)), horizon=4) for i in range(1, 5)]
+    b.close()  # drain: everything queued still gets its answer
+    for f in futs:
+        assert len(f.result(timeout=10)) == 4
+    with pytest.raises(ShuttingDownError):
+        b.submit(_frame((1, 1)), horizon=4)
+
+
+def test_merged_failure_falls_back_to_solo_dispatches(cfg):
+    fc = FakeForecaster(poison={(9, 9)})
+    b = RequestBatcher(fc, cfg)
+    try:
+        f_good = b.submit(_frame((1, 1)), horizon=4)
+        f_bad = b.submit(_frame((9, 9)), horizon=4)
+        out = f_good.result(timeout=10)
+        with pytest.raises(ValueError, match="poison"):
+            f_bad.result(timeout=10)
+    finally:
+        b.close()
+    # one merged attempt, then one solo retry per member
+    assert fc.calls == [2, 1, 1]
+    assert len(out) == 4  # the good neighbor is unharmed
+
+
+def test_metrics_counters_and_histograms(cfg):
+    fc = FakeForecaster()
+    metrics = ServingMetrics()
+    b = RequestBatcher(fc, cfg, metrics)
+    try:
+        futs = [b.submit(_frame((1, i)), horizon=3) for i in range(1, 5)]
+        for f in futs:
+            f.result(timeout=10)
+    finally:
+        b.close()
+    snap = metrics.snapshot()
+    assert snap["serving_dispatches_total"] == 1
+    assert snap["serving_batch_size"]["count"] == 1
+    assert snap["serving_batch_size"]["buckets"]["4"] >= 1
+    text = metrics.render()
+    assert "# TYPE serving_dispatches_total counter" in text
+    assert "serving_dispatches_total 1" in text
+    assert 'serving_batch_size_bucket{le="+Inf"} 1' in text
+    assert "serving_batch_size_sum 4" in text
+
+
+def test_batching_config_from_conf_and_validation():
+    assert BatchingConfig.from_conf(None) == BatchingConfig()
+    c = BatchingConfig.from_conf({
+        "enabled": True, "max_batch_size": 8, "max_wait_ms": 2,
+        "max_queue_depth": 16, "request_timeout_s": 10})
+    assert c.enabled and c.max_batch_size == 8 and c.max_wait_ms == 2.0
+    # a typo must not silently serve unbatched
+    with pytest.raises(ValueError, match="max_batchsize"):
+        BatchingConfig.from_conf({"max_batchsize": 8})
+    for bad in (dict(max_batch_size=0), dict(max_wait_ms=-1),
+                dict(max_queue_depth=0), dict(request_timeout_s=0)):
+        with pytest.raises(ValueError):
+            BatchingConfig.from_conf(bad)
+
+
+def test_metrics_registry_primitives():
+    r = MetricsRegistry()
+    c = r.counter("c_total", "help line")
+    g = r.gauge("g")
+    h = r.histogram("h_seconds", (0.1, 1.0))
+    with pytest.raises(ValueError):
+        r.counter("c_total")  # duplicate names are a bug, not a merge
+    with pytest.raises(ValueError):
+        c.inc(-1)  # counters only go up
+    c.inc()
+    c.inc(2)
+    g.set(3)
+    g.dec(1)
+    for v in (0.05, 0.5, 5.0):
+        h.observe(v)
+    assert c.value == 3 and g.value == 2
+    assert h.cumulative_buckets() == [("0.1", 1), ("1", 2), ("+Inf", 3)]
+    text = r.render_prometheus()
+    assert "# HELP c_total help line" in text
+    assert "c_total 3" in text
+    assert 'h_seconds_bucket{le="1"} 2' in text
+    assert "h_seconds_count 3" in text
+    snap = r.snapshot()
+    assert snap["h_seconds"]["sum"] == pytest.approx(5.55)

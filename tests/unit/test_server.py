@@ -3,6 +3,8 @@ standing where the reference's PyFunc + per-group model loads stood
 (reference notebooks/prophet/04_inference.py:4-16)."""
 
 import json
+import re
+import time
 import urllib.error
 import urllib.request
 
@@ -321,3 +323,230 @@ def test_blend_artifact_serves_end_to_end(tmp_path):
         assert row["q0.1"] <= row["q0.9"]
     finally:
         srv.shutdown()
+
+
+# --- micro-batching coalescer behind the HTTP surface (serving/batcher.py) --
+
+
+def _raw(srv, path, payload=None):
+    """Like _call but returns (status, raw bytes, headers) — the coalescing
+    equality contract is byte-identical responses, not just equal JSON."""
+    url = f"http://127.0.0.1:{srv.server_address[1]}{path}"
+    if payload is None:
+        req = urllib.request.Request(url)
+    else:
+        req = urllib.request.Request(
+            url, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return r.status, r.read(), dict(r.headers)
+
+
+def test_metrics_endpoint(server):
+    """GET /metrics speaks Prometheus text format and carries the serving
+    counters + histograms even with batching off (the direct path feeds the
+    same dispatch/batch-size metrics)."""
+    _call(server, "/invocations",
+          {"inputs": [{"store": 1, "item": 1}], "horizon": 5})
+    code, body, headers = _raw(server, "/metrics")
+    assert code == 200
+    assert headers["Content-Type"].startswith("text/plain")
+    text = body.decode()
+    for line in (
+        "# TYPE serving_requests_total counter",
+        "# TYPE serving_dispatches_total counter",
+        "# TYPE serving_rejections_total counter",
+        "# TYPE serving_timeouts_total counter",
+        "# TYPE serving_queue_depth gauge",
+        "# TYPE serving_request_latency_seconds histogram",
+        "# TYPE serving_batch_size histogram",
+        'serving_batch_size_bucket{le="1"}',
+        "serving_request_latency_seconds_count",
+    ):
+        assert line in text, f"missing {line!r} in /metrics"
+    # unbatched: every request is its own dispatch
+    n_req = int(re.search(r"serving_requests_total (\d+)", text).group(1))
+    n_disp = int(re.search(r"serving_dispatches_total (\d+)", text).group(1))
+    assert n_req >= 1 and n_disp >= 1
+
+
+def test_batched_server_responses_byte_identical(server):
+    """Concurrent mixed-signature requests through a coalescing server must
+    be byte-for-byte what the unbatched server returns, with fewer device
+    dispatches than requests."""
+    import re as _re
+    import threading as _threading
+
+    from distributed_forecasting_tpu.serving import (
+        BatchingConfig,
+        start_server,
+    )
+
+    payloads = [
+        {"inputs": [{"store": 1, "item": 1}], "horizon": 14},
+        {"inputs": [{"store": 1, "item": 2}], "horizon": 14},
+        {"inputs": [{"store": 2, "item": 1}], "horizon": 14},
+        {"inputs": [{"store": 2, "item": 3}], "horizon": 14},
+        {"inputs": [{"store": 1, "item": 3}, {"store": 2, "item": 2}],
+         "horizon": 14},
+        {"inputs": [{"store": 1, "item": 1}], "horizon": 7,
+         "quantiles": [0.1, 0.9]},
+    ]
+    # ground truth: the module server, sequential solo dispatches
+    want = [_raw(server, "/invocations", p)[1] for p in payloads]
+
+    batched = start_server(
+        server.forecaster,
+        batching=BatchingConfig(enabled=True, max_batch_size=8,
+                                max_wait_ms=100.0, max_queue_depth=32,
+                                request_timeout_s=60.0),
+    )
+    try:
+        got = [None] * len(payloads)
+        barrier = _threading.Barrier(len(payloads))
+
+        def client(i):
+            barrier.wait()
+            got[i] = _raw(batched, "/invocations", payloads[i])[1]
+
+        threads = [_threading.Thread(target=client, args=(i,))
+                   for i in range(len(payloads))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        _, mbody, _ = _raw(batched, "/metrics")
+        text = mbody.decode()
+    finally:
+        batched.shutdown()
+    assert got == want  # byte-identical, request by request
+    n_req = int(_re.search(r"serving_requests_total (\d+)", text).group(1))
+    n_disp = int(_re.search(r"serving_dispatches_total (\d+)", text).group(1))
+    assert n_req == len(payloads)
+    assert n_disp < n_req  # coalescing actually happened
+
+
+def test_batched_server_429_when_queue_full():
+    """Over-depth requests are shed with 429 + Retry-After while earlier
+    requests still complete (admission control end to end)."""
+    import threading as _threading
+
+    from test_batcher import FakeForecaster
+
+    from distributed_forecasting_tpu.serving import (
+        BatchingConfig,
+        start_server,
+    )
+
+    release = _threading.Event()
+    fc = FakeForecaster(block_event=release)
+    srv = start_server(fc, batching=BatchingConfig(
+        enabled=True, max_batch_size=4, max_wait_ms=0.0,
+        max_queue_depth=1, request_timeout_s=30.0))
+    results = {}
+
+    def fire(tag):
+        try:
+            results[tag] = _raw(
+                srv, "/invocations",
+                {"inputs": [{"store": 1, "item": 1}], "horizon": 3})[0]
+        except urllib.error.HTTPError as e:
+            results[tag] = e.code
+
+    try:
+        t_a = _threading.Thread(target=fire, args=("a",))
+        t_a.start()
+        assert fc.started.wait(10)   # a's dispatch is blocked in predict
+        t_b = _threading.Thread(target=fire, args=("b",))
+        t_b.start()
+        for _ in range(100):         # b lands in the 1-deep queue
+            if srv.metrics.queue_depth.value >= 1:
+                break
+            time.sleep(0.01)
+        assert srv.metrics.queue_depth.value >= 1
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _raw(srv, "/invocations",
+                 {"inputs": [{"store": 1, "item": 1}], "horizon": 3})
+        assert e.value.code == 429
+        assert e.value.headers["Retry-After"] == "1"
+        release.set()
+        t_a.join(30)
+        t_b.join(30)
+    finally:
+        release.set()
+        srv.shutdown()
+    assert results == {"a": 200, "b": 200}
+    assert srv.metrics.rejections.value == 1
+
+
+def test_batched_server_503_on_timeout():
+    """A request stuck past request_timeout_s gets 503, not a hung socket."""
+    import threading as _threading
+
+    from test_batcher import FakeForecaster
+
+    from distributed_forecasting_tpu.serving import (
+        BatchingConfig,
+        start_server,
+    )
+
+    release = _threading.Event()
+    fc = FakeForecaster(block_event=release)
+    srv = start_server(fc, batching=BatchingConfig(
+        enabled=True, max_batch_size=4, max_wait_ms=0.0,
+        max_queue_depth=8, request_timeout_s=0.1))
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _raw(srv, "/invocations",
+                 {"inputs": [{"store": 1, "item": 1}], "horizon": 3})
+        assert e.value.code == 503
+        assert "timed out" in json.loads(e.value.read())["error"]
+        assert srv.metrics.timeouts.value == 1
+    finally:
+        release.set()
+        srv.shutdown()
+
+
+def test_batched_server_shutdown_drains_queue():
+    """shutdown() answers everything already queued before closing: the
+    in-flight request AND the queued-behind-it request both get 200."""
+    import threading as _threading
+
+    from test_batcher import FakeForecaster
+
+    from distributed_forecasting_tpu.serving import (
+        BatchingConfig,
+        start_server,
+    )
+
+    release = _threading.Event()
+    fc = FakeForecaster(block_event=release)
+    srv = start_server(fc, batching=BatchingConfig(
+        enabled=True, max_batch_size=4, max_wait_ms=0.0,
+        max_queue_depth=8, request_timeout_s=30.0))
+    results = {}
+
+    def fire(tag):
+        results[tag] = _raw(
+            srv, "/invocations",
+            {"inputs": [{"store": 1, "item": tag}], "horizon": 3})[0]
+
+    t_a = _threading.Thread(target=fire, args=(1,))
+    t_a.start()
+    assert fc.started.wait(10)
+    t_b = _threading.Thread(target=fire, args=(2,))
+    t_b.start()
+    for _ in range(100):
+        if srv.metrics.queue_depth.value >= 1:
+            break
+        time.sleep(0.01)
+    stopper = _threading.Thread(target=srv.shutdown)
+    stopper.start()
+    time.sleep(0.05)      # shutdown is now waiting on the drain
+    release.set()
+    stopper.join(30)
+    t_a.join(30)
+    t_b.join(30)
+    assert not stopper.is_alive()
+    assert results == {1: 200, 2: 200}

@@ -479,6 +479,30 @@ def test_platform_override(monkeypatch):
     assert jax.default_backend() == "cpu"
 
 
+def test_platform_backend_probe_still_resolves():
+    """The too-late-override guard reads jax's private xla_bridge backend
+    cache (no public API exposes it without initializing a backend).  If a
+    jax upgrade moves that cache, the guard silently degrades to a warning —
+    this test makes the bump fail LOUDLY here instead, so whoever upgrades
+    jax re-points the probe chain in utils/platform.py."""
+    from distributed_forecasting_tpu.utils.platform import (
+        _initialized_backends,
+    )
+
+    backends = _initialized_backends()
+    assert backends is not None, (
+        "jax xla_bridge backend-cache probe broke under this jax version — "
+        "update _initialized_backends() in utils/platform.py"
+    )
+    assert isinstance(backends, dict)
+    # the suite initializes the cpu backend in conftest, so the cache the
+    # probe found must be the LIVE one, not an empty lookalike
+    import jax
+
+    jax.default_backend()
+    assert len(_initialized_backends()) >= 1
+
+
 def test_committed_workflows_yml_is_valid():
     """Every workflow in conf/workflows.yml parses, resolves to known task
     types, topo-sorts without cycles, and its conf_files exist — so a typo
