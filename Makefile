@@ -1,15 +1,20 @@
 # Developer workflow — the reference drives deploy/test through a Makefile
 # (its Makefile:1-5 wraps dbx execute/deploy/launch); same shape, no cluster.
 
-.PHONY: install test test-tpu native bench e2e clean
+.PHONY: install lint test test-tpu native bench e2e clean
 
 install:
 	pip install -e ".[local,test]"
 
+# pure-AST static analysis (docs/static-analysis.md) — seconds, CPU-only,
+# never initializes a device; exit 1 on any error-severity finding
+lint:
+	python scripts/dflint.py distributed_forecasting_tpu/
+
 native:
 	$(MAKE) -C native
 
-test: native
+test: lint native
 	env -u PALLAS_AXON_POOL_IPS python -m pytest tests/unit -x -q
 
 # no -x: hardware windows are scarce — one red test must not blind the rest

@@ -1,0 +1,48 @@
+"""dflint — repo-native static analysis for the JAX/TPU invariants this
+codebase relies on (no silent host syncs in hot paths, no tracer leaks, no
+unlocked shared serving state, no config/schema drift).
+
+Pure AST: importing this package must never pull jax/numpy/pandas, so
+``make lint`` stays a sub-second CPU-only check.  CLI: ``scripts/dflint.py``
+(or ``python -m distributed_forecasting_tpu.analysis.cli``); rules, config
+and suppression syntax are documented in docs/static-analysis.md.
+"""
+
+from distributed_forecasting_tpu.analysis.core import (  # noqa: F401
+    REGISTRY,
+    DflintConfig,
+    Finding,
+    analyze,
+    build_project,
+    find_root,
+)
+
+# importing the rule modules populates REGISTRY
+from distributed_forecasting_tpu.analysis import (  # noqa: F401
+    rules_config,
+    rules_jax,
+    rules_purity,
+    rules_threads,
+)
+
+__all__ = [
+    "REGISTRY",
+    "DflintConfig",
+    "Finding",
+    "analyze",
+    "build_project",
+    "find_root",
+    "lint_paths",
+]
+
+
+def lint_paths(paths, root=None, config=None, conf_dir=None):
+    """Convenience wrapper for tests and embedding: lint ``paths`` and
+    return the unsuppressed findings (baseline NOT applied — callers that
+    want the CI behavior go through ``cli.main``)."""
+    import os
+
+    root = root or find_root(paths[0] if paths else os.getcwd())
+    project = build_project(root, paths, config=config, conf_dir=conf_dir)
+    findings, _ = analyze(project)
+    return findings

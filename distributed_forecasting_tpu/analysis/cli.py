@@ -1,0 +1,116 @@
+"""dflint command line — text/JSON output, baseline management, CI codes.
+
+Exit codes: 0 clean (warnings allowed), 1 at least one error-severity
+finding survived suppressions + baseline, 2 bad invocation or bad
+[tool.dflint] config.  ``make lint`` and the tier-1 self-check test both
+drive this entry point, so its behavior IS the CI contract.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from distributed_forecasting_tpu.analysis.core import (
+    REGISTRY,
+    DflintConfig,
+    analyze,
+    apply_baseline,
+    build_project,
+    find_root,
+    load_baseline,
+    write_baseline,
+)
+
+
+def _parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dflint",
+        description=("Repo-native JAX/TPU static analysis "
+                     "(docs/static-analysis.md)"),
+    )
+    p.add_argument("paths", nargs="*",
+                   help="files/directories to lint (default: the "
+                        "distributed_forecasting_tpu package under the root)")
+    p.add_argument("--root", default=None,
+                   help="project root (default: nearest ancestor with a "
+                        "pyproject.toml)")
+    p.add_argument("--conf-dir", default=None,
+                   help="YAML conf tree for config-drift (default: "
+                        "<root>/conf)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="grandfather every current finding into the "
+                        "baseline file and exit 0")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report baselined findings too")
+    p.add_argument("--list-rules", action="store_true")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = _parser().parse_args(argv)
+    if args.list_rules:
+        for name in sorted(REGISTRY):
+            rule = REGISTRY[name]()
+            scope = ", ".join(sorted(rule.dir_names)) or "all modules"
+            print(f"{name:24s} [{rule.default_severity}] scope: {scope}")
+        return 0
+
+    start = args.root or (args.paths[0] if args.paths else os.getcwd())
+    root = os.path.abspath(args.root) if args.root else find_root(start)
+    try:
+        config = DflintConfig.from_pyproject(
+            os.path.join(root, "pyproject.toml"))
+    except ValueError as e:
+        print(f"dflint: config error: {e}", file=sys.stderr)
+        return 2
+
+    targets = args.paths or [os.path.join(root, "distributed_forecasting_tpu")]
+    targets = [t for t in targets if os.path.exists(t)]
+    if not targets:
+        print("dflint: no lint targets exist", file=sys.stderr)
+        return 2
+
+    project = build_project(root, targets, config=config,
+                            conf_dir=args.conf_dir)
+    findings, suppressed = analyze(project)
+
+    baseline_path = os.path.join(root, config.baseline)
+    if args.write_baseline:
+        write_baseline(baseline_path, findings)
+        print(f"dflint: wrote {len(findings)} finding(s) to "
+              f"{os.path.relpath(baseline_path, root)}")
+        return 0
+
+    absorbed = 0
+    if not args.no_baseline:
+        findings, absorbed = apply_baseline(findings,
+                                            load_baseline(baseline_path))
+
+    errors = [f for f in findings if f.severity == "error"]
+    warnings = [f for f in findings if f.severity != "error"]
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [f.to_dict() for f in findings],
+            "counts": {"error": len(errors), "warning": len(warnings)},
+            "suppressed": suppressed,
+            "baselined": absorbed,
+        }, indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        tail = (f"dflint: {len(errors)} error(s), {len(warnings)} "
+                f"warning(s)")
+        if suppressed or absorbed:
+            tail += (f" ({suppressed} suppressed inline, "
+                     f"{absorbed} baselined)")
+        print(tail)
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

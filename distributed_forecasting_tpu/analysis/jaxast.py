@@ -1,0 +1,243 @@
+"""JAX-aware AST helpers shared by the dflint rules.
+
+The rules that police traced code (host-sync, tracer-leak, static-argnum
+drift) all need the same three questions answered per module, without
+importing jax:
+
+1. *alias resolution* — which local name means ``jax.jit`` / ``numpy`` /
+   ``threading.Lock`` here (``import jax.numpy as jnp``, ``from functools
+   import partial``, ...)?  :class:`ImportMap`.
+2. *which functions are trace entry points* — decorated with ``@jax.jit`` /
+   ``@partial(jax.jit, ...)``, or passed to ``jax.jit`` / ``jax.vmap`` /
+   ``jax.pmap`` / ``shard_map`` as a value (``means = jax.jit(shard_map(
+   _fn, ...))``, parallel/sharded.py)?  :func:`jit_entries`.
+3. *which function bodies execute under tracing* — the entry points plus
+   every module-local function they reference by name, transitively
+   (``_cv_impl -> _cv_paths -> cv_windows``, engine/cv.py).
+   :func:`traced_functions`.
+
+Scope is deliberately per-module: cross-module calls (``get_model(model).
+fit``) are dynamic dispatch the AST cannot resolve, and every hot numeric
+module in this repo keeps its jit roots and helpers together, so the
+module-local closure is the right coverage/noise trade-off (documented in
+docs/static-analysis.md).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Tuple
+
+#: canonical dotted names whose argument (or decorated function) is traced
+TRACE_WRAPPERS = frozenset({
+    "jax.jit",
+    "jax.vmap",
+    "jax.pmap",
+    "jax.shard_map",
+    "jax.experimental.shard_map.shard_map",
+})
+
+_PARTIAL = "functools.partial"
+
+FunctionNode = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+class ImportMap:
+    """Local name -> canonical dotted path, from every import in the module
+    (function-local imports included: ``engine/cv.py`` imports numpy inside
+    host-side helpers)."""
+
+    def __init__(self, tree: ast.AST):
+        self.aliases: Dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        self.aliases[a.asname] = a.name
+                    else:
+                        top = a.name.split(".")[0]
+                        self.aliases[top] = top
+            elif isinstance(node, ast.ImportFrom) and node.module and not node.level:
+                for a in node.names:
+                    self.aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+
+    def dotted(self, node: ast.AST) -> Optional[str]:
+        """Canonical dotted name of a Name/Attribute chain rooted at an
+        imported name (``np.random.uniform`` -> ``numpy.random.uniform``);
+        None when the root is not an import (locals, params, builtins)."""
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        base = self.aliases.get(node.id)
+        if base is None:
+            return None
+        parts.append(base)
+        return ".".join(reversed(parts))
+
+
+@dataclasses.dataclass
+class JitEntry:
+    func: ast.AST                 # FunctionDef / AsyncFunctionDef
+    wrapper: str                  # the TRACE_WRAPPERS member that claims it
+    static_names: frozenset       # declared static parameter names
+    explicit_statics: bool        # True when read off a jit decorator/call
+
+
+def _param_names(fn) -> List[str]:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names
+
+
+def _static_names_from_call(call: Optional[ast.Call], fn) -> frozenset:
+    if call is None:
+        return frozenset()
+    names = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            v = kw.value
+            elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            for e in elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    names.add(e.value)
+        elif kw.arg == "static_argnums":
+            v = kw.value
+            elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            params = _param_names(fn)
+            for e in elts:
+                if (isinstance(e, ast.Constant) and isinstance(e.value, int)
+                        and 0 <= e.value < len(params)):
+                    names.add(params[e.value])
+    return frozenset(names)
+
+
+def _wrapper_of(expr: ast.AST, imap: ImportMap,
+                ) -> Optional[Tuple[str, Optional[ast.Call]]]:
+    """Classify a decorator / call-head expression as a trace wrapper.
+
+    Returns (canonical wrapper, the Call carrying static_arg* kwargs or
+    None).  Handles ``jax.jit``, ``partial(jax.jit, ...)`` and
+    ``jax.jit(...)`` (decorator-factory form).
+    """
+    d = imap.dotted(expr)
+    if d in TRACE_WRAPPERS:
+        return d, None
+    if isinstance(expr, ast.Call):
+        head = imap.dotted(expr.func)
+        if head in TRACE_WRAPPERS:
+            return head, expr
+        if head == _PARTIAL and expr.args:
+            inner = imap.dotted(expr.args[0])
+            if inner in TRACE_WRAPPERS:
+                return inner, expr
+    return None
+
+
+def _defs_by_name(tree: ast.AST) -> Dict[str, List[ast.AST]]:
+    out: Dict[str, List[ast.AST]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, FunctionNode):
+            out.setdefault(node.name, []).append(node)
+    return out
+
+
+def jit_entries(tree: ast.AST, imap: ImportMap) -> Dict[ast.AST, JitEntry]:
+    """Every function the module hands to a trace wrapper, however spelled."""
+    defs = _defs_by_name(tree)
+    entries: Dict[ast.AST, JitEntry] = {}
+
+    def claim(fn, wrapper: str, call: Optional[ast.Call], explicit: bool):
+        if fn not in entries:
+            entries[fn] = JitEntry(
+                func=fn,
+                wrapper=wrapper,
+                static_names=_static_names_from_call(call, fn),
+                explicit_statics=explicit,
+            )
+
+    for node in ast.walk(tree):
+        if isinstance(node, FunctionNode):
+            for dec in node.decorator_list:
+                info = _wrapper_of(dec, imap)
+                if info:
+                    claim(node, info[0], info[1],
+                          explicit=info[0] == "jax.jit")
+        elif isinstance(node, ast.Call):
+            info = _wrapper_of(node.func, imap)
+            if info and node.args and isinstance(node.args[0], ast.Name):
+                for fn in defs.get(node.args[0].id, ()):
+                    # statics may ride on the wrapping call itself:
+                    # jax.jit(f, static_argnames=...)
+                    claim(fn, info[0], node, explicit=info[0] == "jax.jit")
+    return entries
+
+
+def traced_functions(tree: ast.AST, imap: ImportMap,
+                     ) -> Tuple[Dict[ast.AST, str], Dict[ast.AST, JitEntry]]:
+    """(function -> how it became traced, entry metadata).
+
+    Reachability is by module-local name reference from the entry points —
+    an over-approximation (referencing without calling counts), which for a
+    linter errs on the side of checking more code.
+    """
+    entries = jit_entries(tree, imap)
+    defs = _defs_by_name(tree)
+    reach: Dict[ast.AST, str] = {
+        fn: f"traced via {e.wrapper}" for fn, e in entries.items()
+    }
+    work = list(entries)
+    while work:
+        fn = work.pop()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name):
+                for cand in defs.get(node.id, ()):
+                    if cand is not fn and cand not in reach:
+                        reach[cand] = f"reached from jitted '{fn.name}'"
+                        work.append(cand)
+    return reach, entries
+
+
+def traced_body_nodes(fn) -> Iterator[ast.AST]:
+    """Walk a traced function's body WITHOUT descending into nested defs —
+    those are reported under their own reachability entry, so a finding
+    never fires twice for one line."""
+    todo: List[ast.AST] = list(fn.body)
+    while todo:
+        node = todo.pop()
+        yield node
+        if isinstance(node, FunctionNode):
+            continue
+        todo.extend(ast.iter_child_nodes(node))
+
+
+def local_bindings(fn) -> frozenset:
+    """Names bound inside the function body: params, assignments, loop and
+    with targets, comprehension variables, local defs and imports.  Anything
+    else referenced is closure/global state."""
+    names = set(_param_names(fn))
+    for node in traced_body_nodes(fn):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, (ast.Store, ast.Del)):
+            names.add(node.id)
+        elif isinstance(node, FunctionNode):
+            names.add(node.name)
+        elif isinstance(node, ast.ClassDef):
+            names.add(node.name)
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            for a in node.names:
+                names.add((a.asname or a.name).split(".")[0])
+    return frozenset(names)
+
+
+def base_name(node: ast.AST) -> Optional[str]:
+    """Peel Attribute/Subscript layers down to the root Name, if any."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None

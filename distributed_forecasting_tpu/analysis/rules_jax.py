@@ -1,0 +1,259 @@
+"""Trace-discipline rules: what must not happen inside jitted code.
+
+Three failure classes, all TPU-expensive and all invisible to unit tests
+that run on CPU with tiny shapes:
+
+* **host-sync-in-hot-path** — ``.item()`` / ``np.asarray`` / ``float()`` on
+  a traced value inside a jit forces a device->host transfer; on the
+  remote-TPU tunnel one such pull costs ~66 ms (docs/benchmarks.md), as
+  much as an entire 500-series fit.
+* **tracer-leak** — mutating closure/global state (or ``print``) inside a
+  traced function runs at trace time, not run time: the side effect fires
+  once per COMPILE, silently disappears on cache hits, and a stored tracer
+  raises ``UnexpectedTracerError`` three calls later in unrelated code.
+* **static-argnum-drift** — a parameter that drives Python control flow
+  (``if``/``while``/``range``) must be declared static, or every call
+  either retraces (int that changed) or fails with a tracer-bool error.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from distributed_forecasting_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    Rule,
+    register,
+)
+from distributed_forecasting_tpu.analysis.jaxast import (
+    ImportMap,
+    base_name,
+    local_bindings,
+    traced_body_nodes,
+    traced_functions,
+)
+
+#: host-transfer spellings: canonical dotted call -> why it stalls
+_HOST_CALLS = {
+    "jax.device_get": "pulls the value to host",
+    "numpy.asarray": "materializes a device array on host",
+    "numpy.array": "materializes a device array on host",
+}
+
+_HOST_METHODS = ("item", "tolist")
+
+_PY_CASTS = ("float", "int", "bool")
+
+
+def _is_static_expr(node: ast.AST, statics: frozenset) -> bool:
+    """Conservatively true when the expression is concrete at trace time:
+    literals, declared-static params (and their attributes), ``len`` of
+    anything (shapes are static), and arithmetic thereof."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.Name):
+        return node.id in statics
+    if isinstance(node, ast.Attribute):
+        return _is_static_expr(node.value, statics)
+    if isinstance(node, ast.Call):
+        return isinstance(node.func, ast.Name) and node.func.id == "len"
+    if isinstance(node, ast.BinOp):
+        return (_is_static_expr(node.left, statics)
+                and _is_static_expr(node.right, statics))
+    if isinstance(node, ast.UnaryOp):
+        return _is_static_expr(node.operand, statics)
+    return False
+
+
+@register
+class HostSyncInHotPath(Rule):
+    name = "host-sync-in-hot-path"
+    dir_names = frozenset({"ops", "engine", "parallel"})
+
+    def check_module(self, module: ModuleInfo, project) -> List[Finding]:
+        imap = ImportMap(module.tree)
+        reach, entries = traced_functions(module.tree, imap)
+        out: List[Finding] = []
+        for fn, how in reach.items():
+            entry = entries.get(fn)
+            statics = entry.static_names if entry else frozenset()
+            for node in traced_body_nodes(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                dotted = imap.dotted(node.func)
+                if (isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _HOST_METHODS
+                        and dotted is None):
+                    out.append(self.finding(
+                        module, node,
+                        f"`.{node.func.attr}()` in '{fn.name}' ({how}) "
+                        f"forces a device->host sync inside traced code; "
+                        f"keep the value on device or hoist to the caller"))
+                elif dotted in _HOST_CALLS:
+                    out.append(self.finding(
+                        module, node,
+                        f"{dotted}() in '{fn.name}' ({how}) "
+                        f"{_HOST_CALLS[dotted]} inside traced code; use "
+                        f"jnp equivalents or hoist to the host-side caller"))
+                elif (isinstance(node.func, ast.Name)
+                        and node.func.id in _PY_CASTS
+                        and node.args
+                        and not _is_static_expr(node.args[0], statics)):
+                    out.append(self.finding(
+                        module, node,
+                        f"{node.func.id}() on a potentially traced value in "
+                        f"'{fn.name}' ({how}) concretizes it (sync or "
+                        f"TracerConversionError); compute with jnp or mark "
+                        f"the argument static"))
+        return out
+
+
+#: method calls that mutate their receiver in place
+_MUTATORS = frozenset({
+    "append", "appendleft", "extend", "insert", "add", "update",
+    "setdefault", "pop", "popleft", "popitem", "remove", "discard",
+    "clear", "sort", "reverse",
+})
+
+
+@register
+class TracerLeak(Rule):
+    name = "tracer-leak"
+    dir_names = frozenset()  # every module: a jit anywhere can leak
+
+    def check_module(self, module: ModuleInfo, project) -> List[Finding]:
+        imap = ImportMap(module.tree)
+        reach, _ = traced_functions(module.tree, imap)
+        out: List[Finding] = []
+        for fn, how in reach.items():
+            local = local_bindings(fn)
+            seen_lines = set()
+
+            def flag(node, msg):
+                if node.lineno not in seen_lines:
+                    seen_lines.add(node.lineno)
+                    out.append(self.finding(module, node, msg))
+
+            for node in traced_body_nodes(fn):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Name)
+                        and node.func.id == "print"
+                        and "print" not in local):
+                    flag(node,
+                         f"print() in '{fn.name}' ({how}) runs at TRACE "
+                         f"time only — it vanishes on cache hits; use "
+                         f"jax.debug.print for runtime values")
+                elif isinstance(node, ast.Global):
+                    flag(node,
+                         f"global declaration in '{fn.name}' ({how}): "
+                         f"assigning a traced value to module state leaks "
+                         f"the tracer past the trace")
+                elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                    targets = (node.targets
+                               if isinstance(node, ast.Assign)
+                               else [node.target])
+                    for t in targets:
+                        if not isinstance(t, (ast.Attribute, ast.Subscript)):
+                            continue
+                        root = base_name(t)
+                        if root is not None and root not in local:
+                            flag(node,
+                                 f"'{fn.name}' ({how}) mutates closure/"
+                                 f"global object '{root}' — the write "
+                                 f"happens at trace time and may store a "
+                                 f"tracer; return the value instead")
+                elif (isinstance(node, ast.Expr)
+                        and isinstance(node.value, ast.Call)
+                        and isinstance(node.value.func, ast.Attribute)
+                        and node.value.func.attr in _MUTATORS):
+                    # only when the result is DISCARDED: a used result
+                    # (`updates, state = opt.update(...)`) is the
+                    # functional-update idiom, not an in-place mutation
+                    call = node.value
+                    root = base_name(call.func.value)
+                    if (root is not None and root not in local
+                            and imap.dotted(call.func) is None):
+                        flag(node,
+                             f"'{fn.name}' ({how}) calls .{call.func.attr}() "
+                             f"on closure/global '{root}' — trace-time side "
+                             f"effect that can capture a tracer")
+        return out
+
+
+#: attribute reads that are concrete at trace time even on traced arrays
+_STATIC_ATTRS = frozenset({"shape", "ndim", "dtype", "size"})
+
+
+def _names_in(node: ast.AST, wanted: frozenset) -> List[str]:
+    """Names from ``wanted`` appearing *directly* in the expression.
+
+    Skipped subtrees, where concretization is legal or undecidable:
+
+    * any ``Call`` — ``len(x)`` and ``x.shape[0]``-style helpers are
+      static, and a wrapper like ``_check_xreg(xreg, ...)`` typically
+      dispatches on pytree STRUCTURE (is it None?), which jit handles; a
+      genuine tracer-bool inside a callee fails loudly at first trace,
+      while the silent failure this rule targets is the direct
+      ``if param:`` / ``range(param)``;
+    * ``x.shape`` / ``.ndim`` / ``.dtype`` / ``.size`` — static metadata;
+    * ``x is None`` / ``x is not None`` — pytree-structure dispatch.
+    """
+    hits: List[str] = []
+    todo = [node]
+    while todo:
+        n = todo.pop()
+        if isinstance(n, ast.Call):
+            continue
+        if isinstance(n, ast.Attribute) and n.attr in _STATIC_ATTRS:
+            continue
+        if isinstance(n, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in n.ops) and all(
+                isinstance(c, ast.Constant) and c.value is None
+                for c in n.comparators):
+            continue
+        if isinstance(n, ast.Name) and n.id in wanted:
+            hits.append(n.id)
+        todo.extend(ast.iter_child_nodes(n))
+    return hits
+
+
+@register
+class StaticArgnumDrift(Rule):
+    name = "static-argnum-drift"
+    dir_names = frozenset()
+
+    def check_module(self, module: ModuleInfo, project) -> List[Finding]:
+        imap = ImportMap(module.tree)
+        _, entries = traced_functions(module.tree, imap)
+        out: List[Finding] = []
+        for fn, entry in entries.items():
+            if not entry.explicit_statics:
+                # vmap/pmap/shard_map have no static story; only jit
+                # declares statics, so only jit entries can drift
+                continue
+            args = fn.args
+            traced_params = frozenset(
+                p.arg for p in args.posonlyargs + args.args + args.kwonlyargs
+            ) - entry.static_names - {"self"}
+            for node in traced_body_nodes(fn):
+                if isinstance(node, (ast.If, ast.While)):
+                    culprits = _names_in(node.test, traced_params)
+                    where = "a Python `if`/`while` test"
+                elif (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Name)
+                        and node.func.id == "range"):
+                    culprits = [c for a in node.args
+                                for c in _names_in(a, traced_params)]
+                    where = "`range()`"
+                else:
+                    continue
+                for name in dict.fromkeys(culprits):
+                    out.append(self.finding(
+                        module, node,
+                        f"jitted '{fn.name}' feeds parameter '{name}' into "
+                        f"{where} without declaring it in static_argnames — "
+                        f"each distinct value retraces (or the trace fails "
+                        f"on a tracer bool)"))
+        return out

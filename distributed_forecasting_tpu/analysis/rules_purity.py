@@ -1,0 +1,67 @@
+"""nondeterminism: numeric paths must be replayable bit-for-bit.
+
+NeuralProphet's reproducibility guidance (PAPERS.md) pins forecast drift on
+hidden nondeterminism; this repo's equivalents are a bare ``np.random.*`` /
+``random.*`` draw or a wall-clock read inside the numeric layers (``ops/``,
+``engine/``, ``models/``).  Randomness there must flow through an explicit
+``jax.random`` key or a seeded ``np.random.default_rng(seed)``, and timing
+belongs to the orchestration layers (``pipelines/``, ``workflows/``,
+``utils/profiling``), which this rule deliberately does not cover.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from distributed_forecasting_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    Rule,
+    register,
+)
+from distributed_forecasting_tpu.analysis.jaxast import ImportMap
+
+#: numpy.random constructors that ARE deterministic once given a seed
+_SEEDABLE = frozenset({"default_rng", "RandomState", "SeedSequence", "Generator"})
+
+_CLOCKS = frozenset({"time.time", "time.time_ns"})
+
+
+@register
+class Nondeterminism(Rule):
+    name = "nondeterminism"
+    dir_names = frozenset({"ops", "engine", "models"})
+
+    def check_module(self, module: ModuleInfo, project) -> List[Finding]:
+        imap = ImportMap(module.tree)
+        out: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = imap.dotted(node.func)
+            if dotted is None:
+                continue
+            if dotted.startswith("numpy.random."):
+                leaf = dotted.rsplit(".", 1)[1]
+                if leaf in _SEEDABLE and node.args and isinstance(
+                        node.args[0], ast.Constant):
+                    continue  # explicit constant seed: reproducible
+                out.append(self.finding(
+                    module, node,
+                    f"{dotted}() in a numeric path draws from global/"
+                    f"unseeded RNG state — thread a jax.random key or a "
+                    f"seeded np.random.default_rng(seed) instead"))
+            elif dotted.startswith("random.") and dotted != "random.seed":
+                out.append(self.finding(
+                    module, node,
+                    f"{dotted}() uses Python's global RNG in a numeric "
+                    f"path — results change run to run; use an explicit "
+                    f"seeded generator"))
+            elif dotted in _CLOCKS:
+                out.append(self.finding(
+                    module, node,
+                    f"{dotted}() reads the wall clock inside a numeric "
+                    f"path — timing belongs in pipelines/workflows; numeric "
+                    f"outputs must not depend on when they ran"))
+        return out

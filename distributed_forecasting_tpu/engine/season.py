@@ -79,7 +79,9 @@ def _acf_scores(y, mask, max_lag: int):
     mu = jnp.sum(dy * dm, axis=1, keepdims=True) / n
     z = (dy - mu) * dm
     T = z.shape[1]
-    L = int(2 ** np.ceil(np.log2(T + max_lag + 1)))  # linear, not circular
+    # static shape math: T comes from z.shape, max_lag is static_argnames —
+    # this int() concretizes trace-time Python ints, never a tracer
+    L = int(2 ** np.ceil(np.log2(T + max_lag + 1)))  # linear, not circular  # dflint: disable=host-sync-in-hot-path
     fz = jnp.fft.rfft(z, n=L, axis=1)
     fm = jnp.fft.rfft(dm, n=L, axis=1)
     num = jnp.fft.irfft(fz * jnp.conj(fz), n=L, axis=1)[:, : max_lag + 1]

@@ -525,13 +525,14 @@ class Counter:
 
     @property
     def value(self) -> float:
-        return self._value
+        with self._lock:
+            return self._value
 
     def render(self, name: str) -> List[str]:
-        return [f"{name} {_fmt_value(self._value)}"]
+        return [f"{name} {_fmt_value(self.value)}"]
 
     def snapshot(self) -> float:
-        return self._value
+        return self.value
 
 
 class Gauge:
@@ -554,13 +555,14 @@ class Gauge:
 
     @property
     def value(self) -> float:
-        return self._value
+        with self._lock:
+            return self._value
 
     def render(self, name: str) -> List[str]:
-        return [f"{name} {_fmt_value(self._value)}"]
+        return [f"{name} {_fmt_value(self.value)}"]
 
     def snapshot(self) -> float:
-        return self._value
+        return self.value
 
 
 class Histogram:
@@ -590,36 +592,51 @@ class Histogram:
             self._counts[i] += 1
             self._sum += v
 
+    def _state(self) -> Tuple[List[int], float]:
+        """One consistent (counts, sum) pair; every read path derives from a
+        single locked snapshot so bucket counts and _sum never tear against
+        a concurrent observe()."""
+        with self._lock:
+            return list(self._counts), self._sum
+
     @property
     def count(self) -> int:
-        return sum(self._counts)
+        counts, _ = self._state()
+        return sum(counts)
 
     @property
     def sum(self) -> float:
-        return self._sum
+        _, total = self._state()
+        return total
 
-    def cumulative_buckets(self) -> List[Tuple[str, int]]:
+    def _cumulative(self, counts: List[int]) -> List[Tuple[str, int]]:
         out, running = [], 0
-        for ub, c in zip(self._uppers, self._counts):
+        for ub, c in zip(self._uppers, counts):
             running += c
             out.append((f"{ub:g}", running))
-        out.append(("+Inf", running + self._counts[-1]))
+        out.append(("+Inf", running + counts[-1]))
         return out
 
+    def cumulative_buckets(self) -> List[Tuple[str, int]]:
+        counts, _ = self._state()
+        return self._cumulative(counts)
+
     def render(self, name: str) -> List[str]:
+        counts, total = self._state()
         lines = [
             f'{name}_bucket{{le="{le}"}} {c}'
-            for le, c in self.cumulative_buckets()
+            for le, c in self._cumulative(counts)
         ]
-        lines.append(f"{name}_sum {_fmt_value(self._sum)}")
-        lines.append(f"{name}_count {self.count}")
+        lines.append(f"{name}_sum {_fmt_value(total)}")
+        lines.append(f"{name}_count {sum(counts)}")
         return lines
 
     def snapshot(self) -> Dict:
+        counts, total = self._state()
         return {
-            "count": self.count,
-            "sum": self._sum,
-            "buckets": dict(self.cumulative_buckets()),
+            "count": sum(counts),
+            "sum": total,
+            "buckets": dict(self._cumulative(counts)),
         }
 
 
@@ -654,8 +671,10 @@ class MetricsRegistry:
         return self._register(name, "histogram", help_text, Histogram(buckets))
 
     def render_prometheus(self) -> str:
+        with self._lock:
+            items = list(self._metrics.items())
         lines: List[str] = []
-        for name, (kind, help_text, metric) in self._metrics.items():
+        for name, (kind, help_text, metric) in items:
             if help_text:
                 lines.append(f"# HELP {name} {help_text}")
             lines.append(f"# TYPE {name} {kind}")
@@ -663,7 +682,6 @@ class MetricsRegistry:
         return "\n".join(lines) + "\n"
 
     def snapshot(self) -> Dict:
-        return {
-            name: metric.snapshot()
-            for name, (_, _, metric) in self._metrics.items()
-        }
+        with self._lock:
+            items = list(self._metrics.items())
+        return {name: metric.snapshot() for name, (_, _, metric) in items}

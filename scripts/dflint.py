@@ -1,0 +1,17 @@
+#!/usr/bin/env python
+"""dflint CLI — repo-native JAX/TPU static analysis.
+
+Usage: python scripts/dflint.py [paths...] [--format json] [--write-baseline]
+See docs/static-analysis.md for the rule catalogue and suppression syntax.
+"""
+
+import os
+import sys
+
+# runnable straight from a checkout, installed or not
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributed_forecasting_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,473 @@
+"""dflint unit tests: every rule has a positive (fixture that MUST be
+flagged) and a negative (idiomatic code that must stay quiet), plus the
+machinery contracts — inline suppressions, the baseline multiset, the
+strict [tool.dflint] pyproject block, CLI exit codes — and a self-check
+that the shipped package lints clean under the committed baseline.
+
+Fixtures are source STRINGS written into tmp trees; nothing here imports
+jax/numpy, and the last test asserts the analysis package itself never
+does either (the `make lint` no-device-init guarantee).
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from distributed_forecasting_tpu.analysis import (
+    DflintConfig,
+    lint_paths,
+)
+from distributed_forecasting_tpu.analysis import cli
+from distributed_forecasting_tpu.analysis.core import (
+    Finding,
+    apply_baseline,
+    is_suppressed,
+)
+
+REPO = Path(__file__).resolve().parents[2]
+
+
+def _write(root: Path, rel: str, text: str) -> Path:
+    p = root / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(text))
+    return p
+
+
+def _lint(root: Path, *rels: str, config=None):
+    return lint_paths([str(root / r) for r in rels], root=str(root),
+                      config=config)
+
+
+# ---------------------------------------------------------------------------
+# host-sync-in-hot-path
+# ---------------------------------------------------------------------------
+
+_HOT = """
+    import jax
+
+    @jax.jit
+    def f(x):
+        return float(x)
+
+    @jax.jit
+    def g(x):
+        return x.item()
+"""
+
+
+def test_host_sync_positive(tmp_path):
+    _write(tmp_path, "ops/hot.py", _HOT)
+    found = _lint(tmp_path, "ops/hot.py")
+    assert [f.rule for f in found] == ["host-sync-in-hot-path"] * 2
+    assert all(f.severity == "error" for f in found)
+
+
+def test_host_sync_scoped_to_hot_dirs(tmp_path):
+    # identical code outside ops/engine/parallel is host-side by design
+    _write(tmp_path, "workflows/hot.py", _HOT)
+    assert _lint(tmp_path, "workflows/hot.py") == []
+
+
+def test_host_sync_negative_static_and_untraced(tmp_path):
+    _write(tmp_path, "ops/ok.py", """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("n",))
+        def f(x, n):
+            return x * float(n + 1)      # static arithmetic: concrete
+
+        def host_side(x):
+            return float(x)              # never traced
+    """)
+    assert _lint(tmp_path, "ops/ok.py") == []
+
+
+def test_host_sync_reaches_callees_of_jit_entries(tmp_path):
+    _write(tmp_path, "engine/deep.py", """
+        import jax
+
+        def inner(x):
+            return x.item()
+
+        @jax.jit
+        def outer(x):
+            return inner(x)
+    """)
+    found = _lint(tmp_path, "engine/deep.py")
+    assert len(found) == 1 and "inner" in found[0].message
+
+
+# ---------------------------------------------------------------------------
+# tracer-leak
+# ---------------------------------------------------------------------------
+
+
+def test_tracer_leak_positive(tmp_path):
+    _write(tmp_path, "models/leaky.py", """
+        import jax
+
+        _acc = []
+
+        @jax.jit
+        def f(x):
+            print("tracing", x)
+            _acc.append(x)
+            return x
+    """)
+    found = _lint(tmp_path, "models/leaky.py")
+    assert [f.rule for f in found] == ["tracer-leak"] * 2
+
+
+def test_tracer_leak_negative_local_and_functional(tmp_path):
+    _write(tmp_path, "models/clean.py", """
+        import jax
+
+        @jax.jit
+        def f(xs, state, opt):
+            acc = []
+            acc.append(xs)                      # local: fine
+            updates, state = opt.update(xs, state)  # result used: functional
+            return acc, state
+    """)
+    assert _lint(tmp_path, "models/clean.py") == []
+
+
+# ---------------------------------------------------------------------------
+# static-argnum-drift
+# ---------------------------------------------------------------------------
+
+
+def test_static_argnum_drift_positive(tmp_path):
+    _write(tmp_path, "engine/drift.py", """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("mode",))
+        def f(x, n, mode):
+            for i in range(n):
+                x = x + i
+            return x
+    """)
+    found = _lint(tmp_path, "engine/drift.py")
+    assert [f.rule for f in found] == ["static-argnum-drift"]
+    assert "'n'" in found[0].message
+
+
+def test_static_argnum_drift_negative(tmp_path):
+    _write(tmp_path, "engine/nodrift.py", """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("n", "mode"))
+        def f(x, xreg, n, mode):
+            if mode == "mul":                  # declared static
+                x = x * 2
+            if xreg is None:                   # pytree structure: legal
+                x = x + 1
+            if len(x) > 4:                     # shapes are static
+                x = x - 1
+            if x.shape[0] > 2:                 # shapes are static
+                x = x - 1
+            for i in range(n):                 # declared static
+                x = x + i
+            return x
+    """)
+    assert _lint(tmp_path, "engine/nodrift.py") == []
+
+
+# ---------------------------------------------------------------------------
+# unlocked-shared-state
+# ---------------------------------------------------------------------------
+
+_RACY = """
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._n = 0
+
+        def bump(self):
+            with self._lock:
+                self._n += 1
+
+        def peek(self):
+            return self._n       # torn read of a lock-guarded attr
+
+        def reset(self):
+            self._n = 0          # unlocked write
+"""
+
+
+def test_unlocked_shared_state_positive(tmp_path):
+    _write(tmp_path, "monitoring/box.py", _RACY)
+    found = _lint(tmp_path, "monitoring/box.py")
+    assert [f.rule for f in found] == ["unlocked-shared-state"] * 2
+    assert {"peek", "reset"} == {f.message.split(".")[1].split()[0]
+                                 for f in found}
+
+
+def test_unlocked_shared_state_negative(tmp_path):
+    _write(tmp_path, "serving/box.py", """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def bump(self):
+                with self._lock:
+                    self._n += 1
+
+            def peek(self):
+                with self._lock:
+                    return self._n
+    """)
+    assert _lint(tmp_path, "serving/box.py") == []
+
+
+# ---------------------------------------------------------------------------
+# nondeterminism
+# ---------------------------------------------------------------------------
+
+_NOISY = """
+    import time
+    import numpy as np
+
+    def jitter(x):
+        return x + np.random.normal()
+
+    def stamp():
+        return time.time()
+"""
+
+
+def test_nondeterminism_positive(tmp_path):
+    _write(tmp_path, "ops/noise.py", _NOISY)
+    found = _lint(tmp_path, "ops/noise.py")
+    assert [f.rule for f in found] == ["nondeterminism"] * 2
+
+
+def test_nondeterminism_scoped_out_of_pipelines(tmp_path):
+    # wall-clock timing in workflows/ is legitimate (latency metrics)
+    _write(tmp_path, "workflows/noise.py", _NOISY)
+    assert _lint(tmp_path, "workflows/noise.py") == []
+
+
+def test_nondeterminism_negative_seeded(tmp_path):
+    _write(tmp_path, "models/seeded.py", """
+        import numpy as np
+
+        def init(x):
+            rng = np.random.default_rng(0)
+            return x + rng.normal()
+    """)
+    assert _lint(tmp_path, "models/seeded.py") == []
+
+
+# ---------------------------------------------------------------------------
+# config-drift
+# ---------------------------------------------------------------------------
+
+
+def test_config_drift_positive_and_negative(tmp_path):
+    _write(tmp_path, "conf/app.yml", """
+        horizon: 90
+        max_batchsize: 8
+    """)
+    _write(tmp_path, "src/consume.py", """
+        def run(conf):
+            return conf.get("horizon")
+    """)
+    found = _lint(tmp_path, "src/consume.py")
+    assert [f.rule for f in found] == ["config-drift"]
+    assert "max_batchsize" in found[0].message
+    assert found[0].path == "conf/app.yml"
+
+
+def test_config_drift_reverse_required_field(tmp_path):
+    _write(tmp_path, "conf/app.yml", """
+        alpha: 0.5
+    """)
+    _write(tmp_path, "src/cfg.py", """
+        import dataclasses
+
+        @dataclasses.dataclass
+        class FitConfig:
+            alpha: float
+            beta: float            # required but unspellable from conf/
+
+            @classmethod
+            def from_conf(cls, conf):
+                return cls(**conf)
+    """)
+    found = _lint(tmp_path, "src/cfg.py")
+    assert len(found) == 1
+    assert found[0].rule == "config-drift"
+    assert found[0].severity == "warning"
+    assert "beta" in found[0].message
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+
+
+def test_suppression_same_line(tmp_path):
+    _write(tmp_path, "ops/s.py", """
+        import jax
+
+        @jax.jit
+        def f(x):
+            return float(x)  # dflint: disable=host-sync-in-hot-path
+    """)
+    assert _lint(tmp_path, "ops/s.py") == []
+
+
+def test_suppression_standalone_line_above(tmp_path):
+    _write(tmp_path, "ops/s.py", """
+        import jax
+
+        @jax.jit
+        def f(x):
+            # dflint: disable=all
+            return float(x)
+    """)
+    assert _lint(tmp_path, "ops/s.py") == []
+
+
+def test_trailing_directive_does_not_govern_next_line():
+    lines = ["y = 1  # dflint: disable=host-sync-in-hot-path",
+             "z = float(x)"]
+    f = Finding(rule="host-sync-in-hot-path", severity="error",
+                path="ops/s.py", line=2, message="m", snippet="z = float(x)")
+    assert not is_suppressed(f, lines)
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+
+def test_baseline_absorbs_one_occurrence_per_entry():
+    mk = lambda line, snip: Finding(  # noqa: E731
+        rule="r", severity="error", path="p.py", line=line,
+        message="m", snippet=snip)
+    baseline = {("r", "p.py", "bad()"): 1}
+    kept, absorbed = apply_baseline([mk(3, "bad()")], baseline)
+    assert kept == [] and absorbed == 1
+    # a SECOND copy of the grandfathered pattern still fails
+    kept, absorbed = apply_baseline([mk(3, "bad()"), mk(9, "bad()")],
+                                    baseline)
+    assert len(kept) == 1 and absorbed == 1
+
+
+# ---------------------------------------------------------------------------
+# [tool.dflint] config strictness
+# ---------------------------------------------------------------------------
+
+
+def test_config_rejects_unknown_keys():
+    with pytest.raises(ValueError, match="diable"):
+        DflintConfig.from_dict({"diable": ["tracer-leak"]})
+
+
+def test_config_rejects_unknown_rule_and_bad_severity():
+    with pytest.raises(ValueError, match="unknown rule"):
+        DflintConfig.from_dict({"disable": ["not-a-rule"]})
+    with pytest.raises(ValueError, match="must be one of"):
+        DflintConfig.from_dict({"severity": {"tracer-leak": "fatal"}})
+
+
+def test_severity_override_downgrades_to_warning(tmp_path):
+    _write(tmp_path, "ops/hot.py", _HOT)
+    cfg = DflintConfig.from_dict(
+        {"severity": {"host-sync-in-hot-path": "warning"}})
+    found = _lint(tmp_path, "ops/hot.py", config=cfg)
+    assert found and all(f.severity == "warning" for f in found)
+
+
+def test_disable_drops_rule(tmp_path):
+    _write(tmp_path, "ops/hot.py", _HOT)
+    cfg = DflintConfig.from_dict({"disable": ["host-sync-in-hot-path"]})
+    assert _lint(tmp_path, "ops/hot.py", config=cfg) == []
+
+
+# ---------------------------------------------------------------------------
+# CLI exit codes + baseline round trip
+# ---------------------------------------------------------------------------
+
+
+def test_cli_flags_violation_then_baseline_then_clean(tmp_path, capsys):
+    _write(tmp_path, "ops/hot.py", _HOT)
+    argv = [str(tmp_path / "ops"), "--root", str(tmp_path)]
+    assert cli.main(argv) == 1
+    assert cli.main(argv + ["--write-baseline"]) == 0
+    assert cli.main(argv) == 0          # grandfathered
+    assert cli.main(argv + ["--no-baseline"]) == 1
+    capsys.readouterr()
+
+
+def test_cli_bad_pyproject_is_usage_error(tmp_path, capsys):
+    _write(tmp_path, "pyproject.toml", """
+        [tool.dflint]
+        diable = ["tracer-leak"]
+    """)
+    _write(tmp_path, "ops/ok.py", "x = 1\n")
+    rc = cli.main([str(tmp_path / "ops"), "--root", str(tmp_path)])
+    assert rc == 2
+    assert "config error" in capsys.readouterr().err
+
+
+def test_cli_json_output(tmp_path, capsys):
+    import json
+
+    _write(tmp_path, "ops/hot.py", _HOT)
+    rc = cli.main([str(tmp_path / "ops"), "--root", str(tmp_path),
+                   "--format", "json"])
+    assert rc == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["counts"]["error"] == 2
+    assert {f["rule"] for f in payload["findings"]} == {
+        "host-sync-in-hot-path"}
+
+
+def test_syntax_error_is_reported(tmp_path, capsys):
+    _write(tmp_path, "ops/broken.py", "def f(:\n")
+    rc = cli.main([str(tmp_path / "ops"), "--root", str(tmp_path)])
+    assert rc == 1
+    assert "syntax-error" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# self-checks on the shipped tree
+# ---------------------------------------------------------------------------
+
+
+def test_package_lints_clean_under_committed_baseline(capsys):
+    rc = cli.main([str(REPO / "distributed_forecasting_tpu"),
+                   "--root", str(REPO)])
+    out = capsys.readouterr().out
+    assert rc == 0, f"dflint regressions:\n{out}"
+
+
+def test_analysis_package_never_imports_accelerator_stack():
+    # `make lint` must stay CPU-only and device-free: importing the
+    # analysis package may not drag in jax/numpy/pandas transitively
+    code = (
+        "import sys; import distributed_forecasting_tpu.analysis; "
+        "mods = {m.split('.')[0] for m in sys.modules}; "
+        "bad = mods & {'jax', 'jaxlib', 'numpy', 'pandas'}; "
+        "sys.exit(1 if bad else 0)"
+    )
+    env = dict(os.environ, PYTHONPATH=str(REPO))
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          cwd=str(REPO))
+    assert proc.returncode == 0
