@@ -28,9 +28,12 @@ barrier for the whole scan.  Per-dispatch latency and the tunnel round-trip floo
 printed to stderr so the gap between "chip throughput" and "one remote call"
 stays visible.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "device"}
-("device" records which backend actually ran, e.g. "tpu:..." or "cpu:cpu"
-after the fallback described in choose_backend).
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "device",
+"compile_cache"} ("device" records which backend actually ran, e.g.
+"tpu:..." or "cpu:cpu" after the fallback described in choose_backend;
+"compile_cache" carries per-family cold-vs-warm program-preparation times
+and the warm-start serving cold-boot number from the fresh-process probe —
+see _compile_cache_probe).
 """
 
 from __future__ import annotations
@@ -68,8 +71,15 @@ print("PLATFORM=" + d.platform)
 """
 
 
-def _probe_backend(force_platform: str | None, timeout: float) -> str | None:
-    """Try to init JAX + run one op in a subprocess; return platform or None.
+def _probe_backend(
+    force_platform: str | None, timeout: float
+) -> tuple[str | None, bool]:
+    """Try to init JAX + run one op in a subprocess.
+
+    Returns (platform_or_None, timed_out): the second flag distinguishes a
+    probe that HUNG for its whole timeout (a dead tunnel — the retry loop
+    shortens subsequent probes, see choose_backend) from one that failed
+    fast (backend raised; full-length retries stay cheap).
 
     Backend init on a remote-attached TPU can *raise* (round-1 failure mode:
     UNAVAILABLE at bench.py:54) or *hang* (observed: jax.devices() blocked
@@ -91,15 +101,15 @@ def _probe_backend(force_platform: str | None, timeout: float) -> str | None:
     except subprocess.TimeoutExpired:
         print(f"[bench] backend probe timed out ({timeout:.0f}s) "
               f"(force={force_platform})", file=sys.stderr)
-        return None
+        return None, True
     for line in p.stdout.splitlines():
         if line.startswith("PLATFORM="):
-            return line.split("=", 1)[1]
+            return line.split("=", 1)[1], False
     tail = (p.stderr or "").strip().splitlines()
     print(f"[bench] backend probe failed (rc={p.returncode}, "
           f"force={force_platform}): {tail[-1] if tail else '?'}",
           file=sys.stderr)
-    return None
+    return None, False
 
 
 # Last-known-good backend cache: written on every successful ambient TPU
@@ -162,6 +172,16 @@ def choose_backend() -> tuple[str, str | None]:
     """
     # healthy first-init is 20-40 s; 180 s is ample margin per probe
     ambient_timeout = float(os.environ.get("DFTPU_BENCH_PROBE_TIMEOUT", "180"))
+    # After one FULL-LENGTH probe has hung for its whole timeout, the tunnel
+    # is down, not slow — a healthy init answers in 20-40 s.  Re-probes cap
+    # at 45 s so the retry loop samples the window often instead of burning
+    # it: r05 spent 360 s on two back-to-back 180 s hangs before falling
+    # back to CPU, where 180 + 45 * k would have covered the same window
+    # with five times the chances to catch a recovery.
+    reprobe_timeout = min(
+        ambient_timeout,
+        float(os.environ.get("DFTPU_BENCH_REPROBE_TIMEOUT", "45")),
+    )
     cache = _read_backend_cache()
     recently_good = bool(
         cache
@@ -181,12 +201,20 @@ def choose_backend() -> tuple[str, str | None]:
         )
     t0 = time.perf_counter()
     delay = 30.0
+    probe_timeout = ambient_timeout
     while True:
-        plat = _probe_backend(None, timeout=ambient_timeout)
+        plat, timed_out = _probe_backend(None, timeout=probe_timeout)
         if plat is not None:
             if plat == "tpu":
                 _write_backend_cache(plat)
             return plat, None
+        if timed_out and probe_timeout > reprobe_timeout:
+            print(
+                f"[bench] full-length probe hung; capping re-probes at "
+                f"{reprobe_timeout:.0f}s for the rest of the window",
+                file=sys.stderr,
+            )
+            probe_timeout = reprobe_timeout
         elapsed = time.perf_counter() - t0
         if elapsed + delay >= window:
             break
@@ -197,7 +225,7 @@ def choose_backend() -> tuple[str, str | None]:
         )
         time.sleep(delay)
         delay = min(delay * 2.0, 240.0)
-    plat = _probe_backend("cpu", timeout=120.0)
+    plat, _ = _probe_backend("cpu", timeout=120.0)
     if plat is not None:
         if cache and cache.get("platform") == "tpu":
             # a CPU artifact on a machine that HAS produced TPU numbers is a
@@ -213,6 +241,176 @@ def choose_backend() -> tuple[str, str | None]:
             )
         return plat, "cpu"
     raise RuntimeError("no JAX backend available (ambient and CPU both failed)")
+
+
+# Compile-cache probe (engine/compile_cache.py): each child is a FRESH
+# process — the unit of the cold-start tax — forced to CPU so the numbers
+# are comparable across rounds regardless of tunnel health.  The child
+# measures program-preparation time (first call minus steady-state run) for
+# a prophet and an arima fit_forecast plus a serving bucket-ladder warmup,
+# and hashes every numeric output so the parent can assert the cached path
+# is byte-identical to the cache-disabled path.
+_CC_PROBE_CODE = """
+import hashlib
+import json
+import os
+import sys
+import time
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_forecasting_tpu.data import (
+    synthetic_store_item_sales,
+    tensorize,
+)
+from distributed_forecasting_tpu.engine import fit_forecast
+from distributed_forecasting_tpu.engine.compile_cache import (
+    CompileCacheConfig,
+    cache_stats,
+    configure_compile_cache,
+)
+from distributed_forecasting_tpu.models.base import get_model
+from distributed_forecasting_tpu.serving.predictor import BatchForecaster
+
+cc_dir = os.environ.get("DFTPU_CC_DIR", "")
+if cc_dir:
+    configure_compile_cache(
+        CompileCacheConfig(enabled=True, directory=cc_dir)
+    )
+
+df = synthetic_store_item_sales(n_stores=2, n_items=5, n_days=365, seed=0)
+batch = tensorize(df)
+key = jax.random.PRNGKey(0)
+digest = hashlib.sha256()
+out = {"families": {}}
+fc = None
+for fam in ("prophet", "arima"):
+    t0 = time.perf_counter()
+    params, res = fit_forecast(batch, model=fam, horizon=90, key=key)
+    jax.block_until_ready(res.yhat)
+    first = time.perf_counter() - t0
+    runs = []
+    for _ in range(2):
+        t0 = time.perf_counter()
+        _, res2 = fit_forecast(batch, model=fam, horizon=90, key=key)
+        jax.block_until_ready(res2.yhat)
+        runs.append(time.perf_counter() - t0)
+    run_s = min(runs)
+    out["families"][fam] = {
+        "first_s": round(first, 4),
+        "run_s": round(run_s, 4),
+        "prep_s": round(max(first - run_s, 0.0), 4),
+    }
+    for a in (res.yhat, res.lo, res.hi):
+        digest.update(np.asarray(a).tobytes())
+    if fam == "prophet":
+        fc = BatchForecaster.from_fit(
+            batch, params, fam, get_model(fam).config_cls()
+        )
+
+t0 = time.perf_counter()
+n = fc.warmup(horizon=90, sizes=(1, 8))
+out["serving"] = {
+    "warmup_s": round(time.perf_counter() - t0, 4),
+    "buckets": n,
+    "from_store": int(getattr(fc, "last_warmup_from_store", 0)),
+}
+import pandas as pd
+req = pd.DataFrame(fc.keys[:8], columns=fc.key_names)
+pred = fc.predict(req, horizon=90)
+for col in pred.select_dtypes("number").columns:
+    digest.update(np.ascontiguousarray(pred[col].to_numpy()).tobytes())
+out["digest"] = digest.hexdigest()
+out["stats"] = cache_stats()
+print("CCPROBE=" + json.dumps(out))
+"""
+
+
+def _cc_probe_child(mode: str, cc_dir: str, timeout: float = 300.0):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["DFTPU_FORCE_PLATFORM"] = "cpu"
+    env["DFTPU_CC_DIR"] = cc_dir
+    # a harvest window's ambient XLA cache would warm the 'cold' and 'off'
+    # children through layer 1 and flatten the very delta being measured
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", _CC_PROBE_CODE],
+            env=env, capture_output=True, text=True, timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        print(f"[bench] compile-cache probe ({mode}) timed out "
+              f"({timeout:.0f}s)", file=sys.stderr)
+        return None
+    for line in p.stdout.splitlines():
+        if line.startswith("CCPROBE="):
+            return json.loads(line.split("=", 1)[1])
+    tail = (p.stderr or "").strip().splitlines()
+    print(f"[bench] compile-cache probe ({mode}) failed (rc={p.returncode}): "
+          f"{tail[-1] if tail else '?'}", file=sys.stderr)
+    return None
+
+
+def _compile_cache_probe():
+    """Cold/warm/disabled cold-boot comparison for the headline JSON.
+
+    Three fresh-process children on CPU: 'cold' populates an empty AOT
+    store, 'warm' reloads from it (the warm-start serving cold-boot
+    number), 'off' runs with the cache disabled (the byte-identity
+    control).  Returns the dict embedded as the headline's
+    ``compile_cache`` field, or None when skipped/failed
+    (``DFTPU_BENCH_CC=0`` skips).
+    """
+    if os.environ.get("DFTPU_BENCH_CC", "1") == "0":
+        return None
+    import shutil
+    import tempfile
+
+    tmp = tempfile.mkdtemp(prefix="dftpu_cc_bench_")
+    try:
+        t0 = time.perf_counter()
+        cold = _cc_probe_child("cold", tmp)
+        warm = _cc_probe_child("warm", tmp)
+        off = _cc_probe_child("off", "")
+        if not (cold and warm and off):
+            return None
+        out = {}
+        for fam in ("prophet", "arima"):
+            c, w = cold["families"][fam], warm["families"][fam]
+            out[fam] = {
+                "cold_prep_s": c["prep_s"],
+                "warm_prep_s": w["prep_s"],
+                "prep_speedup": round(c["prep_s"] / max(w["prep_s"], 1e-4), 1),
+            }
+        cs, ws = cold["serving"], warm["serving"]
+        out["serving_warmup"] = {
+            "cold_s": cs["warmup_s"],
+            "warm_s": ws["warmup_s"],
+            "speedup": round(cs["warmup_s"] / max(ws["warmup_s"], 1e-4), 1),
+            "buckets": ws["buckets"],
+            "from_store": ws["from_store"],
+        }
+        out["outputs_identical"] = (
+            cold["digest"] == warm["digest"] == off["digest"]
+        )
+        print(
+            f"[bench] compile-cache probe ({time.perf_counter() - t0:.0f}s): "
+            f"prophet prep {out['prophet']['cold_prep_s']:.2f}s -> "
+            f"{out['prophet']['warm_prep_s']:.2f}s, arima "
+            f"{out['arima']['cold_prep_s']:.2f}s -> "
+            f"{out['arima']['warm_prep_s']:.2f}s, serving warmup "
+            f"{cs['warmup_s']:.2f}s -> {ws['warmup_s']:.2f}s "
+            f"({ws['from_store']}/{ws['buckets']} buckets from store), "
+            f"outputs identical: {out['outputs_identical']}",
+            file=sys.stderr,
+        )
+        return out
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
 
 
 def main() -> None:
@@ -245,6 +443,12 @@ def main() -> None:
         # make the log self-describing (harvest windows enable it)
         print(f"[bench] persistent compilation cache: {cache_dir}",
               file=sys.stderr)
+
+    # cold/warm/disabled compile-cache children run BEFORE this process
+    # imports jax: they are subprocesses either way, but front-loading them
+    # keeps the parent's backend state untouched while the numbers that go
+    # into the headline line are produced
+    compile_cache = _compile_cache_probe()
 
     import jax
 
@@ -400,6 +604,12 @@ def main() -> None:
                 "unit": "series/s",
                 "vs_baseline": round(series_per_s / TARGET_SERIES_PER_S, 2),
                 "device": f"{dev.platform}:{dev.device_kind}",
+                # per-family program-preparation time, cold vs AOT-store
+                # warm, + the warm-start serving cold-boot number (fresh
+                # CPU-forced child processes; null when the probe was
+                # skipped or failed) — tracks compile latency across
+                # rounds, not just device slope
+                "compile_cache": compile_cache,
             }
         ),
         flush=True,

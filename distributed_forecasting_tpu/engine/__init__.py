@@ -28,8 +28,20 @@ from distributed_forecasting_tpu.engine.select import (
     fit_forecast_auto,
     select_model,
 )
+from distributed_forecasting_tpu.engine.compile_cache import (
+    AOTStore,
+    CompileCacheConfig,
+    aot_call,
+    cache_stats,
+    configure_compile_cache,
+)
 
 __all__ = [
+    "AOTStore",
+    "CompileCacheConfig",
+    "aot_call",
+    "cache_stats",
+    "configure_compile_cache",
     "SelectionResult",
     "fit_forecast_auto",
     "select_model",

@@ -1,0 +1,580 @@
+"""Compile cache: persistent XLA binaries + an AOT executable store.
+
+BENCH_r05 shows program preparation dominating every cold start on the CPU
+fallback: the ARIMA family pays ~10 s of compile for 0.27 s of device work,
+the curve model ~3.1 s for a 0.12 s dispatch — and every ``dftpu-*`` task
+entrypoint and every serving cold boot re-pays the full tax because each is
+a fresh process.  Production per-series-at-scale systems (ARIMA_PLUS,
+arXiv:2510.24452) treat preparation latency as a first-class cost because a
+compiled program is reused across millions of invocations; this module does
+the same in two layers:
+
+1. **Persistent XLA compilation cache** (:func:`configure_compile_cache`
+   layer 1): conf-wired enablement of JAX's on-disk cache
+   (``jax_compilation_cache_dir``), so EVERY jit path — engine fit/CV,
+   serving forecasters, the parallel/sharded variants — transparently
+   reuses XLA binaries across processes.  This removes the XLA backend
+   compile but still pays Python tracing + lowering on each fresh process.
+
+2. **AOT executable store** (:class:`AOTStore` + :func:`aot_call`): the hot
+   entrypoints (``fit_forecast`` per family, the serving bucket-ladder
+   predict, fused CV) are lowered and compiled once via
+   ``jit(...).lower(...).compile()`` and the executable is serialized
+   (``jax.experimental.serialize_executable``) to a keyed on-disk store.
+   A warm process skips tracing AND compiling: it deserializes the
+   executable and calls it directly.  Keys fingerprint (entry name = model
+   family, static config, input shape bucket, backend + topology,
+   jax/jaxlib versions); loads are integrity-checked (sha256 over the
+   payload) and ANY mismatch — corrupt file, version skew, backend change,
+   call failure — falls through to a fresh compile, never an error.
+
+Hit/miss/load-time counters ride the ``monitoring`` registry primitives and
+are appended to the serving ``GET /metrics`` output
+(``serving/batcher.ServingMetrics.render``).
+
+Conf block (``tasks/common.Task`` parses it for every task)::
+
+    compile_cache:
+      enabled: true
+      directory: null          # default <env.root>/compile_cache
+      max_size_mb: 1024        # size cap for both layers
+      eviction_policy: lru     # 'lru' | 'none'
+      aot_store: true          # layer 2 on top of the XLA cache
+      min_compile_time_s: 0.0  # layer-1 write threshold (0: cache all —
+                               # CPU compiles are fast but re-paid per run)
+
+Env activation for process trees that don't parse a conf (bench children,
+ad-hoc scripts): ``DFTPU_COMPILE_CACHE=<dir>`` + :func:`enable_from_env`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import pickle
+import tempfile
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+import jax
+
+from distributed_forecasting_tpu.monitoring.monitor import MetricsRegistry
+from distributed_forecasting_tpu.utils import get_logger
+
+_logger = get_logger("compile_cache")
+
+_FORMAT_VERSION = 1
+_STORE_SUFFIX = ".aot"
+
+# deserialize is ~ms; compile is ~seconds — the two histograms share the
+# registry so /metrics shows the gap the store is buying
+_LOAD_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                 0.25, 0.5, 1.0, 2.5)
+_COMPILE_BUCKETS = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0)
+
+_registry = MetricsRegistry()
+_hits = _registry.counter(
+    "compile_cache_hits_total",
+    "AOT executables served from the on-disk store")
+_misses = _registry.counter(
+    "compile_cache_misses_total",
+    "AOT lookups that fell through to a fresh lower+compile")
+_errors = _registry.counter(
+    "compile_cache_errors_total",
+    "corrupt/incompatible store entries discarded (fall-through)")
+_stores = _registry.counter(
+    "compile_cache_stores_total",
+    "executables serialized into the store")
+_load_seconds = _registry.histogram(
+    "compile_cache_load_seconds", _LOAD_BUCKETS,
+    "deserialize-and-load time per store hit")
+_compile_seconds = _registry.histogram(
+    "compile_cache_compile_seconds", _COMPILE_BUCKETS,
+    "lower+compile time per store miss")
+
+
+def metrics_registry() -> MetricsRegistry:
+    """The cache's telemetry registry — the serving server appends its
+    render to ``GET /metrics`` (serving/batcher.ServingMetrics)."""
+    return _registry
+
+
+def cache_stats() -> Dict[str, float]:
+    """Counter snapshot: hits / misses / errors / stores — the warm-boot
+    report tasks log after warmup and tests assert on."""
+    return {
+        "hits": _hits.value,
+        "misses": _misses.value,
+        "errors": _errors.value,
+        "stores": _stores.value,
+    }
+
+
+@dataclasses.dataclass(frozen=True)
+class CompileCacheConfig:
+    """The ``compile_cache`` conf block (parsed by tasks/common.Task)."""
+
+    enabled: bool = False
+    directory: Optional[str] = None   # None -> <default_root>/compile_cache
+    max_size_mb: int = 1024           # cap for EACH layer's directory
+    eviction_policy: str = "lru"      # 'lru' | 'none'
+    aot_store: bool = True            # layer 2 (explicit executable store)
+    min_compile_time_s: float = 0.0   # layer-1 persistent-cache threshold
+
+    def __post_init__(self):
+        if self.eviction_policy not in ("lru", "none"):
+            raise ValueError(
+                f"eviction_policy must be 'lru' or 'none', got "
+                f"{self.eviction_policy!r}")
+        if self.max_size_mb < 1:
+            raise ValueError(
+                f"max_size_mb must be >= 1, got {self.max_size_mb}")
+        if self.min_compile_time_s < 0:
+            raise ValueError(
+                f"min_compile_time_s must be >= 0, got "
+                f"{self.min_compile_time_s}")
+
+    @classmethod
+    def from_conf(cls, conf: Optional[dict],
+                  default_root: str = ".") -> "CompileCacheConfig":
+        conf = conf or {}
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(conf) - known
+        if unknown:
+            # a typo like max_sizemb must not silently run uncapped
+            raise ValueError(
+                f"unknown compile_cache conf key(s) {sorted(unknown)}; "
+                f"valid: {sorted(known)}")
+        directory = conf.get("directory") or os.path.join(
+            default_root, "compile_cache")
+        return cls(
+            enabled=bool(conf.get("enabled", False)),
+            directory=directory,
+            max_size_mb=int(conf.get("max_size_mb", 1024)),
+            eviction_policy=str(conf.get("eviction_policy", "lru")),
+            aot_store=bool(conf.get("aot_store", True)),
+            min_compile_time_s=float(conf.get("min_compile_time_s", 0.0)),
+        )
+
+
+# -- key fingerprinting ------------------------------------------------------
+
+def backend_fingerprint() -> Dict[str, Any]:
+    """The environment part of every store key: an executable compiled for
+    one (backend, topology, jax/jaxlib) tuple must never load under
+    another — XLA binaries are not portable across any of these."""
+    import jaxlib
+
+    devs = jax.devices()
+    return {
+        "platform": devs[0].platform,
+        "device_kind": devs[0].device_kind,
+        "n_devices": len(devs),
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+    }
+
+
+def _canon(x) -> Any:
+    """Deterministic JSON-able canonicalization of static jit arguments
+    (model configs are frozen dataclasses possibly holding FrozenMaps and
+    tuples).  Class identity is part of the encoding: two config classes
+    with identical field values are different programs."""
+    if isinstance(x, (str, int, float, bool)) or x is None:
+        return x
+    if dataclasses.is_dataclass(x) and not isinstance(x, type):
+        return {
+            "__dataclass__": f"{type(x).__module__}.{type(x).__qualname__}",
+            **{f.name: _canon(getattr(x, f.name))
+               for f in dataclasses.fields(x)},
+        }
+    if isinstance(x, dict):
+        return {str(k): _canon(v) for k, v in sorted(x.items())}
+    try:  # Mapping (FrozenMap) without importing the class here
+        items = x.items()
+    except AttributeError:
+        pass
+    else:
+        return {str(k): _canon(v) for k, v in sorted(items)}
+    if isinstance(x, (tuple, list, frozenset, set)):
+        seq = sorted(x) if isinstance(x, (frozenset, set)) else x
+        return [_canon(v) for v in seq]
+    return f"{type(x).__name__}:{x!r}"
+
+
+def _shape_signature(tree) -> Dict[str, Any]:
+    """Shape-bucket part of the key: dtype+shape of every array leaf plus
+    the pytree structure (a None xreg and a present one are different
+    programs even with identical array leaves)."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    return {
+        "leaves": [
+            f"{getattr(leaf, 'dtype', type(leaf).__name__)}"
+            f"{list(getattr(leaf, 'shape', ()))}"
+            for leaf in leaves
+        ],
+        "treedef": str(treedef),
+    }
+
+
+def fingerprint(entry: str, statics: Optional[dict] = None, tree=None,
+                backend: Optional[dict] = None) -> str:
+    """Store key = sha256 over (entry/family, canonical statics = config
+    fingerprint, shape bucket, backend + topology + jax/jaxlib versions)."""
+    parts = {
+        "format": _FORMAT_VERSION,
+        "entry": entry,
+        "statics": _canon(statics or {}),
+        "shapes": _shape_signature(tree),
+        "backend": backend if backend is not None else backend_fingerprint(),
+    }
+    blob = json.dumps(parts, sort_keys=True).encode()
+    return hashlib.sha256(blob).hexdigest()[:32]
+
+
+# -- the AOT executable store ------------------------------------------------
+
+class AOTStore:
+    """Keyed on-disk store of serialized XLA executables.
+
+    One file per key: a pickled record holding the serialized executable
+    payload, its in/out pytree defs, a sha256 over the payload (integrity
+    check at load), and a human-readable meta block.  Loads that fail for
+    ANY reason — unpicklable file, checksum mismatch, deserialize error —
+    count an error, discard the entry, and return None so the caller falls
+    through to a fresh compile.  Loaded/compiled executables are memoized
+    in-process (the store replaces jit's dispatch cache on the AOT path).
+    """
+
+    def __init__(self, directory: str, max_size_mb: int = 1024,
+                 eviction_policy: str = "lru"):
+        self.directory = directory
+        self.max_size_bytes = int(max_size_mb) * 1024 * 1024
+        self.eviction_policy = eviction_policy
+        os.makedirs(directory, exist_ok=True)
+        self._memo: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def _path(self, key: str, entry: str = "") -> str:
+        slug = "".join(
+            ch if ch.isalnum() or ch in "._-" else "_" for ch in entry
+        )[:48]
+        name = f"{slug}-{key}{_STORE_SUFFIX}" if slug else key + _STORE_SUFFIX
+        return os.path.join(self.directory, name)
+
+    def _find(self, key: str) -> Optional[str]:
+        # entry slug is a debugging nicety; the key suffix is authoritative
+        tail = f"-{key}{_STORE_SUFFIX}"
+        try:
+            for name in os.listdir(self.directory):
+                if name.endswith(tail) or name == key + _STORE_SUFFIX:
+                    return os.path.join(self.directory, name)
+        except OSError:
+            return None
+        return None
+
+    def load(self, key: str):
+        """Deserialize the executable for ``key``; None on any mismatch."""
+        path = self._find(key)
+        if path is None:
+            return None
+        from jax.experimental import serialize_executable
+
+        t0 = time.perf_counter()
+        try:
+            with open(path, "rb") as f:
+                record = pickle.load(f)
+            if record.get("format") != _FORMAT_VERSION:
+                raise ValueError(f"store format {record.get('format')!r}")
+            payload = record["payload"]
+            if hashlib.sha256(payload).hexdigest() != record["sha256"]:
+                raise ValueError("payload checksum mismatch")
+            compiled = serialize_executable.deserialize_and_load(
+                payload, record["in_tree"], record["out_tree"]
+            )
+        except Exception as e:  # corrupt/stale entry: discard, fall through
+            _errors.inc()
+            _logger.warning("discarding cache entry %s (%s: %s)",
+                            os.path.basename(path), type(e).__name__, e)
+            self.invalidate(key)
+            return None
+        _load_seconds.observe(time.perf_counter() - t0)
+        # touch for the LRU sweep: eviction orders by mtime
+        try:
+            os.utime(path, None)
+        except OSError:
+            pass
+        return compiled
+
+    def store(self, key: str, compiled, entry: str = "",
+              meta: Optional[dict] = None) -> bool:
+        """Serialize ``compiled`` under ``key`` (atomic write)."""
+        from jax.experimental import serialize_executable
+
+        try:
+            payload, in_tree, out_tree = serialize_executable.serialize(
+                compiled)
+            record = {
+                "format": _FORMAT_VERSION,
+                "key": key,
+                "entry": entry,
+                "sha256": hashlib.sha256(payload).hexdigest(),
+                "payload": payload,
+                "in_tree": in_tree,
+                "out_tree": out_tree,
+                "meta": {
+                    **backend_fingerprint(),
+                    **(meta or {}),
+                    # human-readable provenance only, never numerics
+                    "created": time.time(),  # dflint: disable=nondeterminism
+                },
+            }
+            fd, tmp = tempfile.mkstemp(dir=self.directory, suffix=".tmp")
+            try:
+                with os.fdopen(fd, "wb") as f:
+                    pickle.dump(record, f, protocol=pickle.HIGHEST_PROTOCOL)
+                os.replace(tmp, self._path(key, entry))
+            finally:
+                if os.path.exists(tmp):
+                    os.remove(tmp)
+        except Exception as e:  # the store is an optimization, never a crash
+            _logger.warning("failed to store %s: %s: %s", entry,
+                            type(e).__name__, e)
+            return False
+        _stores.inc()
+        self.evict()
+        return True
+
+    def invalidate(self, key: str) -> None:
+        with self._lock:
+            self._memo.pop(key, None)
+        path = self._find(key)
+        if path is not None:
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+
+    def evict(self) -> int:
+        """LRU sweep: drop oldest-touched entries until under the cap."""
+        if self.eviction_policy != "lru":
+            return 0
+        try:
+            entries = [
+                (os.path.getmtime(p), os.path.getsize(p), p)
+                for p in (
+                    os.path.join(self.directory, n)
+                    for n in os.listdir(self.directory)
+                    if n.endswith(_STORE_SUFFIX)
+                )
+            ]
+        except OSError:
+            return 0
+        total = sum(sz for _, sz, _ in entries)
+        removed = 0
+        for _, sz, path in sorted(entries):
+            if total <= self.max_size_bytes:
+                break
+            try:
+                os.remove(path)
+                total -= sz
+                removed += 1
+            except OSError:
+                pass
+        return removed
+
+    def get_or_compile(self, key: str, entry: str,
+                       compile_fn: Callable[[], Any]):
+        """Memo -> disk -> fresh compile (stored for the next process).
+
+        ``compile_fn`` may return either a compiled executable or a
+        ``(compiled, storable)`` pair; ``storable=False`` keeps the result
+        in the in-process memo but out of the on-disk store (programs whose
+        executables do not survive serialization — see :func:`aot_call`).
+        """
+        with self._lock:
+            compiled = self._memo.get(key)
+        if compiled is not None:
+            return compiled
+        compiled = self.load(key)
+        if compiled is not None:
+            _hits.inc()
+        else:
+            _misses.inc()
+            t0 = time.perf_counter()
+            result = compile_fn()
+            compiled, storable = (
+                result if isinstance(result, tuple) else (result, True)
+            )
+            _compile_seconds.observe(time.perf_counter() - t0)
+            if storable:
+                self.store(key, compiled, entry=entry)
+        with self._lock:
+            self._memo[key] = compiled
+        return compiled
+
+
+# -- process-global configuration -------------------------------------------
+
+_state_lock = threading.Lock()
+_active_config: Optional[CompileCacheConfig] = None
+_active_store: Optional[AOTStore] = None
+
+
+def configure_compile_cache(
+    config: CompileCacheConfig,
+) -> Optional[AOTStore]:
+    """Apply both cache layers process-wide.
+
+    Layer 1 flips JAX's persistent compilation cache on (directory
+    ``<dir>/xla``, size cap via ``jax_compilation_cache_max_size`` when the
+    eviction policy is 'lru', write thresholds opened up so CPU-sized
+    programs cache too).  Layer 2 opens the AOT store at ``<dir>/aot`` and
+    returns it; :func:`aot_call` picks it up from the module global.
+    ``enabled=False`` tears both layers down (tests rely on this).
+    """
+    global _active_config, _active_store
+    with _state_lock:
+        if not config.enabled:
+            jax.config.update("jax_compilation_cache_dir", None)
+            _active_config, _active_store = None, None
+            return None
+        xla_dir = os.path.join(config.directory, "xla")
+        os.makedirs(xla_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", xla_dir)
+        # CPU programs compile in under the default 1 s threshold and
+        # above the default min size — without these every CPU entry is
+        # silently skipped and the cache only works on TPU
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          float(config.min_compile_time_s))
+        jax.config.update(
+            "jax_compilation_cache_max_size",
+            config.max_size_mb * 1024 * 1024
+            if config.eviction_policy == "lru" else -1,
+        )
+        _active_config = config
+        _active_store = (
+            AOTStore(
+                os.path.join(config.directory, "aot"),
+                max_size_mb=config.max_size_mb,
+                eviction_policy=config.eviction_policy,
+            )
+            if config.aot_store else None
+        )
+        if _active_store is not None:
+            _active_store.evict()
+        return _active_store
+
+
+def enable_from_env() -> Optional[AOTStore]:
+    """Activate from ``DFTPU_COMPILE_CACHE=<dir>`` — the conf-less hook for
+    bench subprocesses and ad-hoc scripts.  No-op when unset or when a conf
+    block already configured the cache."""
+    directory = os.environ.get("DFTPU_COMPILE_CACHE")
+    if not directory or _active_config is not None:
+        return _active_store
+    return configure_compile_cache(
+        CompileCacheConfig(enabled=True, directory=directory)
+    )
+
+
+def get_store() -> Optional[AOTStore]:
+    return _active_store
+
+
+def get_config() -> Optional[CompileCacheConfig]:
+    return _active_config
+
+
+def _has_tracer(tree) -> bool:
+    return any(
+        isinstance(leaf, jax.core.Tracer)
+        for leaf in jax.tree_util.tree_leaves(tree)
+    )
+
+
+def _serializable_lowering(lowered) -> bool:
+    """Whether this program's executable survives serialization on CPU.
+
+    An XLA:CPU custom call (LAPACK solves, FFI kernels) is reloaded by
+    ``deserialize_and_load`` with a dead function pointer and SEGFAULTS —
+    uncatchable — at the first call in the next process.  The framework's
+    own hot programs are custom-call-free on CPU by construction
+    (``ops/solve.py`` routes SPD solves to plain-XLA Cholesky there), so
+    this gate is a backstop for future ops; on other platforms executables
+    serialize correctly and everything passes.
+    """
+    if jax.default_backend() != "cpu":
+        return True
+    try:
+        text = lowered.as_text()
+    except Exception:
+        return True
+    return "stablehlo.custom_call" not in text
+
+
+def aot_call(entry: str, fn, args: tuple = (),
+             static_kwargs: Optional[dict] = None,
+             dynamic_kwargs: Optional[dict] = None):
+    """Call a jitted ``fn`` through the AOT store when one is configured.
+
+    ``fn(*args, **dynamic_kwargs, **static_kwargs)`` must be a valid call
+    with every static argument passable by keyword (the framework's jit
+    entry points all use ``static_argnames``).  On the AOT path the
+    executable is looked up by :func:`fingerprint` and invoked with the
+    dynamic arguments only (statics are baked into the binary).  Bypasses
+    to a plain call when: no store is configured, ``fn`` is not jitted (no
+    ``.lower`` — e.g. arima's plain forecast wrapper), or any argument is
+    a tracer (an outer jit is tracing through — executables cannot run
+    inside a trace).  A stale executable that fails at call time is
+    discarded and the call repeats on the jit path.
+    """
+    static_kwargs = dict(static_kwargs or {})
+    dynamic_kwargs = dict(dynamic_kwargs or {})
+    store = _active_store
+    if (
+        store is None
+        or getattr(fn, "lower", None) is None
+        or _has_tracer((args, dynamic_kwargs))
+    ):
+        return fn(*args, **dynamic_kwargs, **static_kwargs)
+    key = fingerprint(entry, statics=static_kwargs,
+                      tree=(args, dynamic_kwargs))
+
+    def compile_fn():
+        lowered = fn.lower(*args, **dynamic_kwargs, **static_kwargs)
+        if not _serializable_lowering(lowered):
+            # CPU custom calls segfault after a serialize round trip, so
+            # this program stays on layer 1: compile WITH the persistent
+            # cache and keep it out of the store.
+            _logger.info("%s contains CPU custom calls; layer-1 only",
+                         entry)
+            return lowered.compile(), False
+        # An executable served from the layer-1 persistent cache is not
+        # re-serializable: XLA hands back deduped kernels whose symbols the
+        # serialized payload then lacks ("Symbols not found" at the next
+        # process's deserialize).  The store-populating compile must be a
+        # genuine one, so layer 1 is switched off around it — a one-time
+        # cost per key; every later process hits layer 2 directly.
+        prev = jax.config.jax_compilation_cache_dir
+        jax.config.update("jax_compilation_cache_dir", None)
+        try:
+            return lowered.compile(), True
+        finally:
+            jax.config.update("jax_compilation_cache_dir", prev)
+
+    compiled = store.get_or_compile(key, entry, compile_fn)
+    try:
+        return compiled(*args, **dynamic_kwargs)
+    except Exception as e:
+        # deserialized-but-incompatible executable (e.g. runtime drift the
+        # fingerprint missed): count it, drop it, serve the jit path
+        _errors.inc()
+        _logger.warning("AOT call failed for %s (%s: %s); falling through "
+                        "to jit", entry, type(e).__name__, e)
+        store.invalidate(key)
+        return fn(*args, **dynamic_kwargs, **static_kwargs)

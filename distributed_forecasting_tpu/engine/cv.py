@@ -310,11 +310,17 @@ def cross_validate(
             out["_coverage_calibrated"] = cov_c
         return out, _frame_from_paths(batch, cuts, yhat, lo, hi, eval_masks)
     impl = _cv_calibrate_impl if calibrate else _cv_impl
+    # fused CV is an AOT-store entrypoint (engine/compile_cache): warm
+    # processes load the compiled program instead of re-tracing it
+    from distributed_forecasting_tpu.engine.compile_cache import aot_call
+
     out = dict(
-        impl(
-            batch.y, batch.mask, batch.day, key,
-            model=model, config=config, cuts=tuple(cuts), horizon=cv.horizon,
-            xreg=xreg, mase_m=mase_m,
+        aot_call(
+            f"cv{'_calibrate' if calibrate else ''}:{model}", impl,
+            args=(batch.y, batch.mask, batch.day, key),
+            static_kwargs=dict(model=model, config=config, cuts=tuple(cuts),
+                               horizon=cv.horizon, mase_m=mase_m),
+            dynamic_kwargs=dict(xreg=xreg),
         )
     )
     out["_n_cutoffs"] = len(cuts)

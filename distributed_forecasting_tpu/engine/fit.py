@@ -29,6 +29,7 @@ import numpy as np
 import pandas as pd
 
 from distributed_forecasting_tpu.data.tensorize import SeriesBatch
+from distributed_forecasting_tpu.engine.compile_cache import aot_call
 from distributed_forecasting_tpu.models.base import get_model
 
 # shared fail-safe threshold: a series needs at least this many observed
@@ -286,10 +287,15 @@ def fit_forecast(
     validate_changepoint_days(config, batch.day)
     xreg = validate_xreg(fns, model, config, xreg, batch.n_time + horizon,
                          "fit_forecast")
-    params, yhat, lo, hi, ok, day_all = _fit_forecast_impl(
-        batch.y, batch.mask, batch.day, key,
-        model=model, config=config, horizon=horizon, min_points=min_points,
-        xreg=xreg,
+    # routed through the AOT executable store when one is configured
+    # (engine/compile_cache): a warm process skips trace+lower+compile and
+    # calls the deserialized per-(family, config, shape) binary directly
+    params, yhat, lo, hi, ok, day_all = aot_call(
+        f"fit_forecast:{model}", _fit_forecast_impl,
+        args=(batch.y, batch.mask, batch.day, key),
+        static_kwargs=dict(model=model, config=config, horizon=horizon,
+                           min_points=min_points),
+        dynamic_kwargs=dict(xreg=xreg),
     )
     return params, ForecastResult(yhat=yhat, lo=lo, hi=hi, ok=ok, day_all=day_all)
 

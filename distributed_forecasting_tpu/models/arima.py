@@ -41,7 +41,7 @@ import optax
 from jax.scipy.special import ndtri
 
 from distributed_forecasting_tpu.models.base import gaussian_quantiles, register_model
-from distributed_forecasting_tpu.ops.solve import yule_walker_masked
+from distributed_forecasting_tpu.ops.solve import solve_dense, yule_walker_masked
 
 _EPS = 1e-6
 
@@ -313,7 +313,7 @@ def _hannan_rissanen(z, m, ar_lags, ma_lags, p_eff: int, q_eff: int, K: int,
     G = jnp.einsum("stf,stg->sfg", X, X, optimize=True)
     G = G + (ridge * g0 * n_valid)[:, None, None] * jnp.eye(F)[None]
     b = jnp.einsum("stf,st->sf", X, zv, optimize=True)
-    coef = jnp.linalg.solve(G, b[..., None])[..., 0]
+    coef = solve_dense(G, b)
 
     # scatter the lag-set coefficients into dense polynomials
     nar = len(ar_lags)

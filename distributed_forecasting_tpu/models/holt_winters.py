@@ -60,8 +60,11 @@ class HoltWintersConfig:
     # 'pscan' = associative parallel prefix over affine maps (O(log T) depth,
     # additive mode only) — the long-series regime where the scan's serial
     # chain, not the series axis, bounds wall time.  See docs/parallelism.md
-    # for the measured crossover.
-    filter: str = "scan"  # 'scan' | 'pscan'
+    # for the measured crossover.  'auto' picks per trace from (backend, S,
+    # T, grid lanes) via ops/pscan.prefer_pscan — a pinned 'pscan' conf
+    # pessimizes the CPU fallback ~50-100x (BENCH_r05), so prefer 'auto'
+    # unless benchmarking a specific solver.
+    filter: str = "scan"  # 'scan' | 'pscan' | 'auto'
 
 
 @jax.tree_util.register_dataclass
@@ -322,17 +325,33 @@ def fit(y, mask, day, config: HoltWintersConfig) -> HWParams:
     mode = config.seasonality_mode
     A, B, G, P = _candidate_grid(config)
 
-    if config.filter == "pscan":
+    which = config.filter
+    if which == "auto":
+        # Resolved at trace time from the actual backend + problem shape
+        # (batch S, length T, grid-candidate lanes) — a conf that says
+        # 'pscan' pessimizes the CPU fallback ~50-100x (BENCH_r05), and
+        # multiplicative seasonality has no affine form at all.
+        from distributed_forecasting_tpu.ops.pscan import prefer_pscan
+
+        which = "pscan" if (
+            mode == "additive"
+            and prefer_pscan(jax.default_backend(), int(y.shape[0]),
+                             int(y.shape[1]), lanes=int(A.shape[0]))
+        ) else "scan"
+
+    if which == "pscan":
         if mode != "additive":
             raise ValueError(
                 "filter='pscan' supports additive seasonality only "
                 "(the multiplicative update is not affine in the state)"
             )
         filt = lambda ys, ms, a, b, g, p: parallel_filter(ys, ms, a, b, g, m, p)
-    elif config.filter == "scan":
+    elif which == "scan":
         filt = lambda ys, ms, a, b, g, p: _filter(ys, ms, a, b, g, m, mode, p)
     else:
-        raise ValueError(f"unknown filter {config.filter!r}; 'scan' or 'pscan'")
+        raise ValueError(
+            f"unknown filter {config.filter!r}; 'scan', 'pscan', or 'auto'"
+        )
 
     def per_series(ys, ms):
         def score(a, b, g, p):

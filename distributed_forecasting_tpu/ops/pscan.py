@@ -193,6 +193,40 @@ def affine_scan_batched(A, c, x0):
     return fn(A, c, x0)
 
 
+# Below this count of independent batch lanes (series x candidates), the
+# chip still has idle parallelism for the prefix tree's extra O(d) FLOP
+# factor to run for free; past it the lanes alone saturate the device and
+# the sequential scan's lower FLOP count wins.
+_PSCAN_MAX_LANES = 4096
+# Serial-depth threshold: under ~20k steps the lax.scan chain fits wall
+# time comfortably (BENCH_r05's T=2k regime) and the prefix tree's setup
+# cost is not amortized.
+_PSCAN_MIN_TIME = 20_000
+
+
+def prefer_pscan(backend: str, n_series: int, n_time: int,
+                 lanes: int = 1) -> bool:
+    """Heuristic behind ``filter='auto'``: solve the time recurrence with
+    the parallel prefix (:func:`affine_scan`) or a sequential ``lax.scan``?
+
+    ``backend`` is the JAX platform ('cpu'/'gpu'/'tpu'), ``n_series`` the
+    batch size S, ``n_time`` the series length T, and ``lanes`` any extra
+    per-series parallelism (e.g. grid-search candidates) vmapped alongside.
+
+    The prefix trades O(T d^2) FLOPs for O(T d^3) at O(log T) depth — a win
+    only where depth, not FLOPs, bounds wall time.  BENCH_r05 measured
+    pscan at x0.01-0.02 of scan throughput on CPU in BOTH the short-T and
+    long-T regimes (a CPU has no idle lanes for the extra matmul factor),
+    so anything but an accelerator always scans.  On TPU the prefix needs
+    long series (serial depth dominating) AND few enough total batch lanes
+    that the MXU is not already saturated by the series axis.
+    """
+    if backend != "tpu":
+        return False
+    return (n_time >= _PSCAN_MIN_TIME
+            and n_series * max(lanes, 1) <= _PSCAN_MAX_LANES)
+
+
 def time_sharded_prefix(
     compose,
     elems,

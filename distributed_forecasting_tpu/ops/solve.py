@@ -77,6 +77,163 @@ def masked_gram(X: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
     return G
 
 
+# -- SPD solve backend -------------------------------------------------------
+#
+# On CPU, jax lowers cho_factor/cho_solve and jnp.linalg.solve to LAPACK
+# custom calls (potrf/trsm/gesv).  Those run fine, but an XLA:CPU executable
+# containing them cannot survive ``jax.experimental.serialize_executable``:
+# the custom-call thunk is reloaded with a dead function pointer and the
+# first call SEGFAULTS (uncatchable) in the next process.  That poisons the
+# AOT executable store (engine/compile_cache.py layer 2) for exactly the
+# hottest programs — the prophet/arima fits.  Since every system here is
+# small (F <= ~150) and the solve is a measured sliver of the fit
+# (scripts/phase_split.py), CPU uses hand-rolled factorizations built from
+# plain XLA ops (fori_loop/dynamic_slice/einsum): Cholesky where the
+# original code used cho_factor (ridge Grams, SPD by construction) and LU
+# with partial pivoting where it used jnp.linalg.solve (Yule-Walker under
+# pairwise normalization is NOT guaranteed definite).  Fully serializable,
+# numerically the same factorizations LAPACK computes (differences are
+# accumulation-order rounding, ~1e-7 relative).  TPU keeps
+# the native lowering — its executables serialize correctly and the batched
+# triangular solve there is MXU-tuned.  DFTPU_SPD_SOLVER overrides at trace
+# time: 'auto' (default), 'xla', 'lapack'.
+
+_CHOL_FLOOR = 1e-12  # pivot floor: keeps a PSD-but-singular system finite
+
+
+def _use_xla_spd() -> bool:
+    which = os.environ.get("DFTPU_SPD_SOLVER", "auto")
+    if which == "xla":
+        return True
+    if which == "lapack":
+        return False
+    return jax.default_backend() == "cpu"
+
+
+def _cholesky_xla(A: jnp.ndarray) -> jnp.ndarray:
+    """Lower Cholesky of batched small SPD matrices, plain-XLA ops only.
+
+    A: (..., F, F) -> L lower-triangular with A = L L^T.  Unblocked
+    column-at-a-time (Cholesky-Banachiewicz): F sequential steps of
+    O(S F^2) batched work — the right trade at the F <= ~150 this
+    framework reaches.
+    """
+    F = A.shape[-1]
+    idx = jnp.arange(F)
+
+    def body(j, L):
+        a_col = jax.lax.dynamic_slice_in_dim(A, j, 1, axis=-1)[..., 0]
+        row_j = jax.lax.dynamic_slice_in_dim(L, j, 1, axis=-2)[..., 0, :]
+        c = a_col - jnp.einsum("...ik,...k->...i", L, row_j)
+        d2 = jax.lax.dynamic_slice_in_dim(c, j, 1, axis=-1)[..., 0]
+        d = jnp.sqrt(jnp.maximum(d2, _CHOL_FLOOR))
+        col = jnp.where(idx > j, c / d[..., None],
+                        jnp.where(idx == j, d[..., None], 0.0))
+        return L + col[..., :, None] * (idx == j)
+
+    return jax.lax.fori_loop(0, F, body, jnp.zeros_like(A))
+
+
+def _solve_cholesky_xla(A: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Solve batched SPD ``A x = b`` via :func:`_cholesky_xla`.
+
+    A: (..., F, F), b: (..., F) -> (..., F).  Forward then back
+    substitution, masked so shapes stay static; the dot products are exact
+    because the not-yet-solved entries of the accumulator are still zero.
+    """
+    F = b.shape[-1]
+    idx = jnp.arange(F)
+    L = _cholesky_xla(A)
+
+    def fwd(j, y):
+        row_j = jax.lax.dynamic_slice_in_dim(L, j, 1, axis=-2)[..., 0, :]
+        ljj = jax.lax.dynamic_slice_in_dim(row_j, j, 1, axis=-1)[..., 0]
+        bj = jax.lax.dynamic_slice_in_dim(b, j, 1, axis=-1)[..., 0]
+        yj = (bj - jnp.sum(row_j * y, axis=-1)) / ljj
+        return y + yj[..., None] * (idx == j)
+
+    y = jax.lax.fori_loop(0, F, fwd, jnp.zeros_like(b))
+
+    def bwd(jr, x):
+        j = F - 1 - jr
+        col_j = jax.lax.dynamic_slice_in_dim(L, j, 1, axis=-1)[..., 0]
+        ljj = jax.lax.dynamic_slice_in_dim(col_j, j, 1, axis=-1)[..., 0]
+        yj = jax.lax.dynamic_slice_in_dim(y, j, 1, axis=-1)[..., 0]
+        xj = (yj - jnp.sum(col_j * x, axis=-1)) / ljj
+        return x + xj[..., None] * (idx == j)
+
+    return jax.lax.fori_loop(0, F, bwd, jnp.zeros_like(b))
+
+
+def _solve_lu_xla(A: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Batched dense solve via LU with partial pivoting, plain-XLA ops.
+
+    A: (..., F, F), b: (..., F) -> (..., F).  The algorithm LAPACK's gesv
+    runs, expressed as F fori_loop steps of batched masked updates: pivot
+    row by argmax |column|, swap via one-hot outer products (exactly zero
+    when the pivot is already in place), eliminate below, back-substitute.
+    Pivoting matters here: the Yule-Walker system under pairwise
+    normalization is NOT guaranteed positive-definite, and an unpivoted
+    factorization turns near-singular seasonal series into NaNs.
+    """
+    F = b.shape[-1]
+    idx = jnp.arange(F)
+
+    def elim(j, carry):
+        U, y = carry
+        col = jax.lax.dynamic_slice_in_dim(U, j, 1, axis=-1)[..., 0]
+        cand = jnp.where(idx >= j, jnp.abs(col), -jnp.inf)
+        piv_onehot = jax.nn.one_hot(
+            jnp.argmax(cand, axis=-1), F, dtype=U.dtype
+        )
+        j_onehot = (idx == j).astype(U.dtype)
+        row_j = jax.lax.dynamic_slice_in_dim(U, j, 1, axis=-2)[..., 0, :]
+        row_p = jnp.einsum("...k,...kf->...f", piv_onehot, U)
+        d_row = row_p - row_j
+        U = (U + j_onehot[..., :, None] * d_row[..., None, :]
+             - piv_onehot[..., :, None] * d_row[..., None, :])
+        yj = jax.lax.dynamic_slice_in_dim(y, j, 1, axis=-1)[..., 0]
+        yp = jnp.einsum("...k,...k->...", piv_onehot, y)
+        d_y = (yp - yj)[..., None]
+        y = y + j_onehot * d_y - piv_onehot * d_y
+        # eliminate below the (now swapped-in) pivot row
+        row_j = jax.lax.dynamic_slice_in_dim(U, j, 1, axis=-2)[..., 0, :]
+        yj = jax.lax.dynamic_slice_in_dim(y, j, 1, axis=-1)[..., 0]
+        piv = jax.lax.dynamic_slice_in_dim(row_j, j, 1, axis=-1)[..., 0]
+        piv = jnp.where(jnp.abs(piv) < _CHOL_FLOOR,
+                        jnp.where(piv < 0, -_CHOL_FLOOR, _CHOL_FLOOR), piv)
+        col = jax.lax.dynamic_slice_in_dim(U, j, 1, axis=-1)[..., 0]
+        f = jnp.where(idx > j, col / piv[..., None], 0.0)
+        U = U - f[..., :, None] * row_j[..., None, :]
+        y = y - f * yj[..., None]
+        return U, y
+
+    U, y = jax.lax.fori_loop(0, F, elim, (A, b))
+
+    def bwd(jr, x):
+        j = F - 1 - jr
+        row_j = jax.lax.dynamic_slice_in_dim(U, j, 1, axis=-2)[..., 0, :]
+        ujj = jax.lax.dynamic_slice_in_dim(row_j, j, 1, axis=-1)[..., 0]
+        yj = jax.lax.dynamic_slice_in_dim(y, j, 1, axis=-1)[..., 0]
+        xj = (yj - jnp.sum(row_j * x, axis=-1)) / ujj
+        return x + xj[..., None] * (idx == j)
+
+    return jax.lax.fori_loop(0, F, bwd, jnp.zeros_like(b))
+
+
+def solve_dense(A: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Batched small dense solve ``A x = b`` — THE dispatch point for every
+    former ``jnp.linalg.solve`` site (Yule-Walker Toeplitz, ARIMA CSS).
+
+    A: (..., F, F), b: (..., F) -> (..., F).  CPU routes to the
+    serializable plain-XLA pivoted LU; other backends keep the native
+    lowering (see the backend note above).
+    """
+    if _use_xla_spd():
+        return _solve_lu_xla(A, b)
+    return jnp.linalg.solve(A, b[..., None])[..., 0]
+
+
 def batched_cho_solve(
     A: jnp.ndarray, b: jnp.ndarray, chunk: int | None = None
 ) -> jnp.ndarray:
@@ -98,6 +255,10 @@ def batched_cho_solve(
     sequential chunks cost noise.  ``DFTPU_CHOL_CHUNK`` overrides the chunk
     size (0 forces the single batched call).
     """
+    if _use_xla_spd():
+        # the chunking below is a TPU scoped-VMEM concern; the plain-XLA
+        # substitution path has no such allocation and stays one batch
+        return _solve_cholesky_xla(A, b)
     S, F = b.shape
     if chunk is None:
         env = os.environ.get("DFTPU_CHOL_CHUNK")
@@ -207,7 +368,7 @@ def yule_walker_masked(
         + jitter_rel * acov[:, :1, None] * jnp.eye(K)[None]
         + jitter_abs * jnp.eye(K)[None]
     )
-    coef = jnp.linalg.solve(R, acov[:, 1 : K + 1][..., None])[..., 0]
+    coef = solve_dense(R, acov[:, 1 : K + 1])
     return coef, acov
 
 

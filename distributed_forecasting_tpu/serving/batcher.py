@@ -106,7 +106,14 @@ class ServingMetrics:
             "requests coalesced into each dispatch")
 
     def render(self) -> str:
-        return self.registry.render_prometheus()
+        # The compile-cache registry rides along on /metrics so operators
+        # can watch warmup hit/miss behaviour without a second endpoint.
+        from distributed_forecasting_tpu.engine.compile_cache import (
+            metrics_registry,
+        )
+
+        return (self.registry.render_prometheus()
+                + metrics_registry().render_prometheus())
 
     def snapshot(self) -> dict:
         return self.registry.snapshot()

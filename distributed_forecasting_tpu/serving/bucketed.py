@@ -161,6 +161,10 @@ class BucketedForecaster:
         Requests route to per-bucket forecasters by key, so a listed size
         may split into any smaller sub-request — warm the full power-of-two
         ladder up to the largest requested size in every member.
+
+        With a warm AOT store (engine/compile_cache) each bucket loads its
+        serialized executable from disk instead of compiling, so this call
+        drops from seconds per bucket to the deserialize cost.
         """
         from distributed_forecasting_tpu.serving.predictor import _bucket_ladder
 

@@ -123,6 +123,9 @@ class MultiModelForecaster:
         sub-request sizes are unpredictable — warm the FULL power-of-two
         ladder up to the largest requested size in every family, which
         covers any split of a listed size.
+
+        With a warm AOT store (engine/compile_cache) each (family, bucket)
+        program loads from disk instead of compiling.
         """
         from distributed_forecasting_tpu.serving.predictor import (
             _bucket_ladder,

@@ -429,7 +429,16 @@ class BatchForecaster:
         Sizes beyond the trained-series count clamp to S (a serve conf
         sized for a big artifact must not make a small one compile — and
         report — phantom buckets).
+
+        With a compile cache configured (engine/compile_cache), each
+        bucket's program is loaded from the AOT store when present instead
+        of compiled; ``self.last_warmup_from_store`` records how many of
+        the warmed buckets came from disk (the serve task logs it).
         """
+        from distributed_forecasting_tpu.engine.compile_cache import (
+            cache_stats,
+        )
+
         S = self.keys.shape[0]
         buckets = sorted({
             self._bucket(min(max(int(k), 1), S)) for k in sizes
@@ -439,9 +448,11 @@ class BatchForecaster:
         if R:
             T_all = self.day1 - self.day0 + horizon + 1
             xreg = jnp.zeros((T_all, R), jnp.float32)
+        hits0 = cache_stats()["hits"]
         for b in buckets:
             req = pd.DataFrame(self.keys[:b], columns=self.key_names)
             self.predict(req, horizon=horizon, xreg=xreg)
+        self.last_warmup_from_store = int(cache_stats()["hits"] - hits0)
         return len(buckets)
 
     def predict(
@@ -471,9 +482,19 @@ class BatchForecaster:
             )
         fns = get_model(self.model)
         k = int(sidx.size)
-        yhat, lo, hi = fns.forecast(
-            params, day_all, jnp.float32(self.day1), self.config, key,
-            **fc_kwargs,
+        # the bucket-ladder predict is an AOT-store entrypoint
+        # (engine/compile_cache): with a warm store, warmup() and the first
+        # live request of each bucket load the per-(family, config, bucket)
+        # executable from disk instead of trace+compiling it.  Families
+        # whose forecast is a plain wrapper (arima) bypass to jit inside
+        # aot_call and still get the persistent XLA cache.
+        from distributed_forecasting_tpu.engine.compile_cache import aot_call
+
+        yhat, lo, hi = aot_call(
+            f"serving_predict:{self.model}", fns.forecast,
+            args=(params, day_all, jnp.float32(self.day1)),
+            static_kwargs={"config": self.config},
+            dynamic_kwargs={"key": key, **fc_kwargs},
         )
         if scale is not None:
             from distributed_forecasting_tpu.engine.calibrate import (

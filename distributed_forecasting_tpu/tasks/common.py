@@ -81,6 +81,26 @@ class Task(ABC):
                 num_processes=dist.get("num_processes"),
                 process_id=dist.get("process_id"),
             )
+        # Persistent compile cache + AOT executable store, wired before the
+        # task body so every jit/lower in launch() sees it:
+        #
+        #     compile_cache:
+        #       enabled: true
+        #       directory: null          # default <env.root>/compile_cache
+        #       max_size_mb: 1024
+        #       eviction_policy: lru     # lru | none
+        #       aot_store: true
+        #       min_compile_time_s: 0.0
+        cc = self.conf.get("compile_cache") if isinstance(self.conf, dict) else None
+        if cc is not None:
+            from distributed_forecasting_tpu.engine.compile_cache import (
+                CompileCacheConfig,
+                configure_compile_cache,
+            )
+
+            configure_compile_cache(
+                CompileCacheConfig.from_conf(cc, default_root=root)
+            )
 
     # lazy infra handles ----------------------------------------------------
     @property

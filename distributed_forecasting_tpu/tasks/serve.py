@@ -24,6 +24,13 @@ Conf::
         max_wait_ms: 5        # coalescing window after the first arrival
         max_queue_depth: 256  # admission control: 429 past this
         request_timeout_s: 30 # 503 for requests that outlive this
+    compile_cache:            # optional persistent compile cache + AOT
+      enabled: true           # store (engine/compile_cache): warmup loads
+      directory: null         # serialized bucket programs from disk
+      max_size_mb: 1024       # instead of compiling them (parsed by the
+      eviction_policy: lru    # Task base class — see tasks/common.py)
+      aot_store: true
+      min_compile_time_s: 0.0
 """
 
 from __future__ import annotations
@@ -53,6 +60,15 @@ class ServeTask(Task):
             )
             self.logger.info(
                 "warmed %d request-size bucket(s) in %.1fs", n, time.time() - t0
+            )
+            from distributed_forecasting_tpu.engine.compile_cache import (
+                cache_stats,
+            )
+
+            stats = cache_stats()
+            self.logger.info(
+                "compile cache after warmup: %d hit(s), %d miss(es)",
+                stats["hits"], stats["misses"],
             )
         self.logger.info(
             "serving %s v%s (%d series) on %s:%s (micro-batching %s)",

@@ -58,6 +58,13 @@ Librarized equivalent of the reference's training notebook entry point
         per_series: false           # covering history AND horizon days
                                     # (composes with tuning.enabled; not
                                     # with model=auto or path=allocated)
+    compile_cache:                  # optional persistent compile cache +
+      enabled: true                 # AOT executable store: a fresh process
+      directory: null               # reloads each family's fit/CV program
+      max_size_mb: 1024             # from disk instead of recompiling
+      eviction_policy: lru          # (parsed by the Task base class —
+      aot_store: true               # see tasks/common.py and
+      min_compile_time_s: 0.0       # engine/compile_cache.py)
 """
 
 from __future__ import annotations

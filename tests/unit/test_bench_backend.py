@@ -59,38 +59,43 @@ def install_clock(bench):
     return clock
 
 
-def hanging_probe(bench, clock, attempts):
+def hanging_probe(bench, clock, attempts, timeouts=None):
     def probe(force, timeout):
         attempts.append(force)
+        if timeouts is not None and force is None:
+            timeouts.append(timeout)
         if force == "cpu":
-            return "cpu"
+            return "cpu", False
         clock.t += timeout  # a hang eats the whole probe timeout
-        return None
+        return None, True
 
     bench._probe_backend = probe
 
 
 def test_cold_cache_short_window(bench):
-    """No cache -> 360 s window: two hanging 180 s ambient attempts, then CPU."""
+    """No cache -> 360 s window: a hanging 180 s ambient attempt caps the
+    re-probes at 45 s, so the same window fits three attempts, then CPU."""
     clock = install_clock(bench)
-    attempts = []
-    hanging_probe(bench, clock, attempts)
+    attempts, timeouts = [], []
+    hanging_probe(bench, clock, attempts, timeouts)
     plat, force = bench.choose_backend()
     assert (plat, force) == ("cpu", "cpu")
-    assert sum(1 for f in attempts if f is None) == 2
-    assert clock.sleeps == [30.0]
+    assert sum(1 for f in attempts if f is None) == 3
+    assert timeouts == [180.0, 45.0, 45.0]
+    assert clock.sleeps == [30.0, 60.0]
 
 
 def test_fresh_cache_long_window(bench):
-    """TPU seen <24 h ago -> 900 s window: four ambient attempts with backoff."""
+    """TPU seen <24 h ago -> 900 s window: five capped ambient attempts."""
     clock = install_clock(bench)
-    attempts = []
-    hanging_probe(bench, clock, attempts)
+    attempts, timeouts = [], []
+    hanging_probe(bench, clock, attempts, timeouts)
     bench._write_backend_cache("tpu")
     plat, force = bench.choose_backend()
     assert (plat, force) == ("cpu", "cpu")
-    assert sum(1 for f in attempts if f is None) == 4
-    assert clock.sleeps == [30.0, 60.0, 120.0]
+    assert sum(1 for f in attempts if f is None) == 5
+    assert timeouts[0] == 180.0 and set(timeouts[1:]) == {45.0}
+    assert clock.sleeps == [30.0, 60.0, 120.0, 240.0]
 
 
 def test_stale_cache_short_window(bench):
@@ -101,7 +106,30 @@ def test_stale_cache_short_window(bench):
     with open(bench._BACKEND_CACHE, "w") as f:
         json.dump({"platform": "tpu", "ts": real_time.time() - 90000, "iso": "old"}, f)
     bench.choose_backend()
-    assert sum(1 for f in attempts if f is None) == 2
+    assert sum(1 for f in attempts if f is None) == 3
+
+
+def test_fast_failures_keep_full_length_probes(bench):
+    """A probe that FAILS fast (raise, not hang) must not trigger the cap:
+    full-length retries stay cheap and keep the best shot at a recovery."""
+    clock = install_clock(bench)
+    timeouts = []
+
+    def probe(force, timeout):
+        if force == "cpu":
+            return "cpu", False
+        timeouts.append(timeout)
+        clock.t += 1.0  # fails in 1 s, not a hang
+        return None, False
+
+    bench._probe_backend = probe
+    os.environ["DFTPU_BENCH_PROBE_WINDOW"] = "120"
+    try:
+        plat, force = bench.choose_backend()
+    finally:
+        del os.environ["DFTPU_BENCH_PROBE_WINDOW"]
+    assert (plat, force) == ("cpu", "cpu")
+    assert set(timeouts) == {180.0}
 
 
 def test_recovery_mid_window_writes_cache(bench):
@@ -112,9 +140,9 @@ def test_recovery_mid_window_writes_cache(bench):
     def probe(force, timeout):
         state["n"] += 1
         if force is None and state["n"] >= 2:
-            return "tpu"
+            return "tpu", False
         clock.t += timeout
-        return None
+        return None, True
 
     bench._probe_backend = probe
     plat, force = bench.choose_backend()

@@ -1,0 +1,262 @@
+"""Compile cache (engine/compile_cache): the AOT executable store.
+
+Covers the four ISSUE-3 behaviors: round-trip bitwise equivalence of a
+deserialized executable vs a fresh compile, key invalidation on config /
+backend / version change, corrupted-entry fall-through (discard + fresh
+compile, never an error), and warmup-from-store counts on the serving path.
+Everything runs on the hermetic CPU backend; each test tears the process-
+global cache state back down so the rest of the suite sees jit untouched.
+"""
+
+import glob
+import os
+import pickle
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_forecasting_tpu.engine import compile_cache as cc
+
+
+@partial(jax.jit, static_argnames=("scale",))
+def _toy(x, y=None, *, scale=2.0):
+    out = x * scale + jnp.sin(x)
+    if y is not None:
+        out = out + y
+    return out
+
+
+@pytest.fixture
+def cache_dir(tmp_path):
+    """A cache directory + guaranteed teardown of the process-global
+    configuration (other test modules must see plain jit dispatch)."""
+    directory = str(tmp_path / "cc")
+    try:
+        yield directory
+    finally:
+        cc.configure_compile_cache(cc.CompileCacheConfig(enabled=False))
+
+
+def _enable(directory, **kw):
+    return cc.configure_compile_cache(
+        cc.CompileCacheConfig(enabled=True, directory=directory, **kw)
+    )
+
+
+def _aot_entries(directory):
+    return sorted(glob.glob(os.path.join(directory, "aot", "*.aot")))
+
+
+def test_round_trip_bitwise_equivalence(cache_dir):
+    _enable(cache_dir)
+    x = jnp.linspace(-2.0, 3.0, 64, dtype=jnp.float32)
+    reference = np.asarray(_toy(x, scale=3.0))
+
+    s0 = cc.cache_stats()
+    out_cold = cc.aot_call(
+        "toy", _toy, args=(x,),
+        static_kwargs={"scale": 3.0}, dynamic_kwargs={"y": None},
+    )
+    s1 = cc.cache_stats()
+    assert s1["misses"] == s0["misses"] + 1
+    assert s1["stores"] == s0["stores"] + 1
+    assert len(_aot_entries(cache_dir)) == 1
+
+    # fresh store over the same directory = a fresh process: the executable
+    # must come back from DISK, and its output must match the fresh compile
+    # bit for bit
+    _enable(cache_dir)
+    out_warm = cc.aot_call(
+        "toy", _toy, args=(x,),
+        static_kwargs={"scale": 3.0}, dynamic_kwargs={"y": None},
+    )
+    s2 = cc.cache_stats()
+    assert s2["hits"] == s1["hits"] + 1
+    assert s2["misses"] == s1["misses"]
+    assert np.asarray(out_cold).tobytes() == reference.tobytes()
+    assert np.asarray(out_warm).tobytes() == reference.tobytes()
+
+
+def test_key_invalidation_on_config_shape_backend_version():
+    x = jnp.ones((8,), jnp.float32)
+    base = cc.fingerprint("toy", statics={"scale": 3.0}, tree=(x,))
+    # same inputs -> same key (the whole point of an on-disk store)
+    assert base == cc.fingerprint("toy", statics={"scale": 3.0}, tree=(x,))
+    # config fingerprint
+    assert base != cc.fingerprint("toy", statics={"scale": 4.0}, tree=(x,))
+    # shape bucket (same rank, different extent; and same data, new dtype)
+    assert base != cc.fingerprint(
+        "toy", statics={"scale": 3.0}, tree=(jnp.ones((16,), jnp.float32),))
+    assert base != cc.fingerprint(
+        "toy", statics={"scale": 3.0}, tree=(jnp.ones((8,), jnp.int32),))
+    # pytree structure: a None leaf present vs absent is a different program
+    assert base != cc.fingerprint(
+        "toy", statics={"scale": 3.0}, tree=((x,), {"y": None}))
+    # entry name (model family)
+    assert base != cc.fingerprint("other", statics={"scale": 3.0}, tree=(x,))
+    # backend / topology / version skew
+    env = cc.backend_fingerprint()
+    for drift in (
+        {"platform": "tpu"},
+        {"device_kind": "TPU v9"},
+        {"n_devices": env["n_devices"] + 1},
+        {"jax": "0.0.0"},
+        {"jaxlib": "0.0.0"},
+    ):
+        assert base != cc.fingerprint(
+            "toy", statics={"scale": 3.0}, tree=(x,),
+            backend={**env, **drift},
+        ), drift
+
+
+def test_corrupted_entry_falls_through(cache_dir):
+    _enable(cache_dir)
+    x = jnp.arange(16, dtype=jnp.float32)
+    reference = np.asarray(
+        cc.aot_call("toy", _toy, args=(x,), static_kwargs={"scale": 2.0},
+                    dynamic_kwargs={"y": None}))
+    [path] = _aot_entries(cache_dir)
+
+    # flip payload bytes INSIDE an otherwise well-formed record: the sha256
+    # integrity check, not the pickle parser, must catch this one
+    with open(path, "rb") as f:
+        record = pickle.load(f)
+    record["payload"] = bytes(record["payload"][:-8]) + b"\x00" * 8
+    with open(path, "wb") as f:
+        pickle.dump(record, f)
+
+    _enable(cache_dir)  # fresh process: empty memo, must go to disk
+    s0 = cc.cache_stats()
+    out = cc.aot_call("toy", _toy, args=(x,), static_kwargs={"scale": 2.0},
+                      dynamic_kwargs={"y": None})
+    s1 = cc.cache_stats()
+    assert np.asarray(out).tobytes() == reference.tobytes()
+    assert s1["errors"] == s0["errors"] + 1  # discarded the corrupt entry
+    assert s1["misses"] == s0["misses"] + 1  # ...and recompiled
+    assert len(_aot_entries(cache_dir)) == 1  # ...and re-stored it
+
+    # unpicklable garbage (truncated/overwritten file) falls through too
+    [path] = _aot_entries(cache_dir)
+    with open(path, "wb") as f:
+        f.write(b"not a pickle")
+    _enable(cache_dir)
+    s2 = cc.cache_stats()
+    out = cc.aot_call("toy", _toy, args=(x,), static_kwargs={"scale": 2.0},
+                      dynamic_kwargs={"y": None})
+    s3 = cc.cache_stats()
+    assert np.asarray(out).tobytes() == reference.tobytes()
+    assert s3["errors"] == s2["errors"] + 1
+
+
+def test_disabled_cache_bypasses_store(cache_dir):
+    cc.configure_compile_cache(cc.CompileCacheConfig(enabled=False))
+    s0 = cc.cache_stats()
+    x = jnp.ones((4,), jnp.float32)
+    out = cc.aot_call("toy", _toy, args=(x,), static_kwargs={"scale": 2.0},
+                      dynamic_kwargs={"y": None})
+    assert out.shape == (4,)
+    assert cc.cache_stats() == s0
+    assert cc.get_store() is None
+
+
+def test_unjitted_fn_bypasses_store(cache_dir):
+    _enable(cache_dir)
+
+    def plain(x, *, scale=2.0):  # arima's forecast wrapper shape
+        return x * scale
+
+    s0 = cc.cache_stats()
+    out = cc.aot_call("plain", plain, args=(jnp.ones((4,)),),
+                      static_kwargs={"scale": 3.0})
+    assert float(out[0]) == 3.0
+    assert cc.cache_stats() == s0
+    assert not _aot_entries(cache_dir)
+
+
+def test_tracer_args_bypass_store(cache_dir):
+    _enable(cache_dir)
+    s0 = cc.cache_stats()
+
+    @jax.jit
+    def outer(x):
+        # tracing through aot_call must take the plain path: a serialized
+        # executable cannot run inside another program's trace
+        return cc.aot_call("toy", _toy, args=(x,),
+                           static_kwargs={"scale": 2.0},
+                           dynamic_kwargs={"y": None})
+
+    out = outer(jnp.ones((4,), jnp.float32))
+    assert out.shape == (4,)
+    assert cc.cache_stats() == s0
+
+
+def test_warmup_from_store_counts(cache_dir):
+    from distributed_forecasting_tpu.data import (
+        synthetic_store_item_sales,
+        tensorize,
+    )
+    from distributed_forecasting_tpu.engine import fit_forecast
+    from distributed_forecasting_tpu.models.base import get_model
+    from distributed_forecasting_tpu.serving.predictor import BatchForecaster
+
+    _enable(cache_dir)
+    batch = tensorize(synthetic_store_item_sales(
+        n_stores=1, n_items=3, n_days=130, seed=0))
+    params, _ = fit_forecast(batch, model="theta", horizon=30,
+                             key=jax.random.PRNGKey(0))
+    fc = BatchForecaster.from_fit(
+        batch, params, "theta", get_model("theta").config_cls())
+
+    n = fc.warmup(horizon=30, sizes=(1, 2))
+    assert n == 2  # buckets {1, 2}
+    assert fc.last_warmup_from_store == 0  # cold store: everything compiled
+
+    # fresh process: new store over the same directory, jit caches dropped —
+    # the whole ladder must warm from disk
+    _enable(cache_dir)
+    jax.clear_caches()
+    fc2 = BatchForecaster.from_fit(
+        batch, params, "theta", get_model("theta").config_cls())
+    n2 = fc2.warmup(horizon=30, sizes=(1, 2))
+    assert n2 == 2
+    assert fc2.last_warmup_from_store == 2
+
+
+def test_from_conf_validation(tmp_path):
+    root = str(tmp_path)
+    cfg = cc.CompileCacheConfig.from_conf(
+        {"enabled": True, "max_size_mb": 64}, default_root=root)
+    assert cfg.enabled and cfg.max_size_mb == 64
+    assert cfg.directory == os.path.join(root, "compile_cache")
+    with pytest.raises(ValueError, match="unknown compile_cache conf key"):
+        cc.CompileCacheConfig.from_conf({"max_sizemb": 64})
+    with pytest.raises(ValueError, match="eviction_policy"):
+        cc.CompileCacheConfig.from_conf({"eviction_policy": "fifo"})
+    with pytest.raises(ValueError, match="max_size_mb"):
+        cc.CompileCacheConfig.from_conf({"max_size_mb": 0})
+
+
+def test_lru_eviction_order(tmp_path):
+    store = cc.AOTStore(str(tmp_path / "aot"), max_size_mb=1,
+                        eviction_policy="lru")
+    old = os.path.join(store.directory, "old-aaaa.aot")
+    new = os.path.join(store.directory, "new-bbbb.aot")
+    for path in (old, new):
+        with open(path, "wb") as f:
+            f.write(b"x" * 512)
+    past = os.path.getmtime(new) - 1000
+    os.utime(old, (past, past))
+    store.max_size_bytes = 512  # force the sweep without MB-scale payloads
+    assert store.evict() == 1
+    assert not os.path.exists(old)  # oldest-touched goes first
+    assert os.path.exists(new)
+    # policy 'none' never removes anything
+    store2 = cc.AOTStore(str(tmp_path / "aot2"), max_size_mb=1,
+                         eviction_policy="none")
+    with open(os.path.join(store2.directory, "a-cccc.aot"), "wb") as f:
+        f.write(b"x" * 512)
+    store2.max_size_bytes = 1
+    assert store2.evict() == 0

@@ -1,0 +1,131 @@
+"""The plain-XLA SPD solve path (ops/solve.solve_spd).
+
+CPU routes every small normal-equation / Toeplitz solve through a
+hand-rolled Cholesky + substitutions instead of LAPACK custom calls so the
+AOT executable store (engine/compile_cache.py) can serialize the fit
+programs — a deserialized CPU custom call segfaults.  These tests pin (a)
+the factorization's accuracy against the LAPACK reference, (b) that the
+dispatch actually strips custom calls from the lowered hot programs on CPU.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from distributed_forecasting_tpu.ops.solve import (  # noqa: E402
+    _solve_cholesky_xla,
+    _solve_lu_xla,
+    ridge_solve_batch,
+    solve_dense,
+    yule_walker_masked,
+)
+
+
+def _spd_batch(rng, S, F, jitter=0.1):
+    X = rng.standard_normal((S, F, 2 * F)).astype(np.float32)
+    A = X @ np.swapaxes(X, 1, 2) + jitter * np.eye(F, dtype=np.float32)
+    b = rng.standard_normal((S, F)).astype(np.float32)
+    return A, b
+
+
+@pytest.mark.parametrize("S,F", [(1, 1), (7, 5), (50, 33)])
+def test_cholesky_xla_matches_lapack(S, F):
+    rng = np.random.default_rng(0)
+    A, b = _spd_batch(rng, S, F)
+    ref = np.linalg.solve(A, b[..., None])[..., 0]
+    got = np.asarray(_solve_cholesky_xla(jnp.asarray(A), jnp.asarray(b)))
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("S,F", [(1, 1), (7, 5), (50, 33)])
+def test_lu_xla_matches_lapack(S, F):
+    rng = np.random.default_rng(4)
+    # general (non-symmetric) systems: the LU path must not assume SPD
+    A = rng.standard_normal((S, F, F)).astype(np.float32)
+    A = A + F * np.eye(F, dtype=np.float32)  # well-conditioned
+    b = rng.standard_normal((S, F)).astype(np.float32)
+    ref = np.linalg.solve(A, b[..., None])[..., 0]
+    got = np.asarray(_solve_lu_xla(jnp.asarray(A), jnp.asarray(b)))
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_lu_xla_pivots_indefinite_systems():
+    # zero leading pivot + indefinite matrix: unpivoted elimination (and
+    # Cholesky) would NaN; partial pivoting must solve it exactly like LU
+    A = np.array([[[0.0, 2.0, 1.0],
+                   [2.0, -1.0, 0.5],
+                   [1.0, 0.5, -3.0]]], np.float32)
+    b = np.array([[1.0, -2.0, 0.5]], np.float32)
+    ref = np.linalg.solve(A, b[..., None])[..., 0]
+    got = np.asarray(_solve_lu_xla(jnp.asarray(A), jnp.asarray(b)))
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_solve_dense_under_jit_and_vmap():
+    rng = np.random.default_rng(1)
+    A, b = _spd_batch(rng, 9, 12)
+    ref = np.linalg.solve(A, b[..., None])[..., 0]
+    got = np.asarray(jax.jit(solve_dense)(jnp.asarray(A), jnp.asarray(b)))
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+    got_v = np.asarray(
+        jax.vmap(solve_dense)(jnp.asarray(A), jnp.asarray(b))
+    )
+    np.testing.assert_allclose(got_v, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_env_override_forces_lapack(monkeypatch):
+    # the override is read at trace time, so both paths must agree
+    rng = np.random.default_rng(2)
+    A, b = _spd_batch(rng, 4, 6)
+    xla = np.asarray(solve_dense(jnp.asarray(A), jnp.asarray(b)))
+    monkeypatch.setenv("DFTPU_SPD_SOLVER", "lapack")
+    jax.clear_caches()
+    lapack = np.asarray(solve_dense(jnp.asarray(A), jnp.asarray(b)))
+    np.testing.assert_allclose(xla, lapack, rtol=2e-4, atol=2e-4)
+
+
+def test_ridge_and_yule_walker_route_through_dispatch():
+    rng = np.random.default_rng(3)
+    T, F, S = 120, 8, 5
+    X = rng.standard_normal((T, F)).astype(np.float32)
+    y = rng.standard_normal((S, T)).astype(np.float32)
+    w = np.ones((S, T), np.float32)
+    lam = np.full((F,), 0.5, np.float32)
+    beta = np.asarray(ridge_solve_batch(jnp.asarray(X), jnp.asarray(y),
+                                        jnp.asarray(w), jnp.asarray(lam)))
+    G = np.einsum("st,tf,tg->sfg", w, X, X) + np.diag(lam + 1e-6)[None]
+    rhs = np.einsum("st,tf->sf", w * y, X)
+    ref = np.linalg.solve(G, rhs[..., None])[..., 0]
+    np.testing.assert_allclose(beta, ref, rtol=2e-3, atol=2e-3)
+
+    coef, acov = yule_walker_masked(jnp.asarray(y), jnp.asarray(w), K=3,
+                                    jitter_abs=1e-3)
+    assert coef.shape == (S, 3) and acov.shape == (S, 4)
+    assert np.all(np.isfinite(np.asarray(coef)))
+
+
+def test_fit_programs_custom_call_free_on_cpu():
+    # the property the AOT store depends on: no stablehlo.custom_call in
+    # the lowered fit program for any family (CPU backend)
+    if jax.default_backend() != "cpu":
+        pytest.skip("CPU-lowering property")
+    from distributed_forecasting_tpu.data import (
+        synthetic_store_item_sales,
+        tensorize,
+    )
+    from distributed_forecasting_tpu.engine.fit import _fit_forecast_impl
+    from distributed_forecasting_tpu.models.base import get_model
+
+    batch = tensorize(
+        synthetic_store_item_sales(n_stores=1, n_items=2, n_days=150, seed=0)
+    )
+    key = jax.random.PRNGKey(0)
+    for fam in ("prophet", "arima", "theta"):
+        cfg = get_model(fam).config_cls()
+        low = _fit_forecast_impl.lower(
+            batch.y, batch.mask, batch.day, key, xreg=None, model=fam,
+            config=cfg, horizon=28, min_points=8,
+        )
+        assert "stablehlo.custom_call" not in low.as_text(), fam
