@@ -413,7 +413,185 @@ def _compile_cache_probe():
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+_OVERLAP_PROBE_CODE = """
+import hashlib
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+from distributed_forecasting_tpu.data import synthetic_store_item_sales
+from distributed_forecasting_tpu.data.catalog import DatasetCatalog
+from distributed_forecasting_tpu.engine.executor import PipelineConfig
+from distributed_forecasting_tpu.pipelines.training import TrainingPipeline
+from distributed_forecasting_tpu.tracking.filestore import FileTracker
+
+# smoke-sized so the serial leg stays ~2-3 s on one CPU: 200 series x
+# 1000 days keeps the host chain (tensorize + artifact/tracking writes)
+# and the device chain (fused CV + theta fit) the same order of
+# magnitude, which is the regime the executor exists for
+N_EXP = int(os.environ.get("DFTPU_OVERLAP_EXPERIMENTS", "6"))
+N_DAYS = int(os.environ.get("DFTPU_OVERLAP_DAYS", "1000"))
+HORIZON = 28
+CV = {"initial": N_DAYS - 130, "period": 30, "horizon": HORIZON}
+
+root = tempfile.mkdtemp(prefix="dftpu_overlap_")
+try:
+    catalog = DatasetCatalog(os.path.join(root, "catalog"))
+    tracker = FileTracker(os.path.join(root, "tracker"))
+    pl = TrainingPipeline(catalog, tracker)
+    for i in range(N_EXP + 1):  # + the warmup experiment
+        df = synthetic_store_item_sales(
+            n_stores=10, n_items=20, n_days=N_DAYS, seed=100 + i
+        )
+        catalog.save_table("bench.raw.sales%d" % i, df)
+
+    def specs(tag):
+        return [
+            {
+                "source_table": "bench.raw.sales%d" % i,
+                "output_table": "bench.%s.fc%d" % (tag, i),
+                "model": "theta",
+                "cv_conf": CV,
+                "experiment": "%s_%d" % (tag, i),
+                "horizon": HORIZON,
+                "seed": 7,
+            }
+            for i in range(1, N_EXP + 1)
+        ]
+
+    # warmup absorbs the fit/CV compiles; every timed experiment below
+    # reuses the compiled programs (shared shapes)
+    warm = dict(specs("warm")[0], source_table="bench.raw.sales0",
+                output_table="bench.warm.fc0", experiment="warm_0")
+    pl.run_many([warm], pipeline=PipelineConfig(enabled=False))
+
+    t0 = time.perf_counter()
+    serial = pl.run_many(specs("serial"), pipeline=PipelineConfig(enabled=False))
+    t_serial = time.perf_counter() - t0
+    sm = serial["pipeline"]
+
+    t0 = time.perf_counter()
+    piped = pl.run_many(
+        specs("piped"),
+        pipeline=PipelineConfig(enabled=True, max_in_flight=2,
+                                prefetch_depth=1, async_tracking=True),
+    )
+    t_pipe = time.perf_counter() - t0
+    pm = piped["pipeline"]
+
+    def digest(tag):
+        h = hashlib.sha256()
+        for i in range(1, N_EXP + 1):
+            t = catalog.read_table("bench.%s.fc%d" % (tag, i))
+            for col in t.select_dtypes("number").columns:
+                h.update(np.ascontiguousarray(t[col].to_numpy()).tobytes())
+        return h.hexdigest()
+
+    stages = ("pipeline_prep_seconds", "pipeline_dispatch_seconds",
+              "pipeline_pull_seconds", "pipeline_complete_seconds")
+    # the executor overlaps the caller chain (prep + dispatch) with the
+    # writer chain (device pull + completion); with host capacity for
+    # both chains (>= 2 CPUs, or a real accelerator carrying the device
+    # side) wall-clock approaches max(chains), which this projection
+    # computes from the measured SERIAL stage decomposition.  On a
+    # single-CPU host the two chains time-slice one core and measured
+    # efficiency pins at ~1.0 no matter what the executor does.
+    caller = sm[stages[0]] + sm[stages[1]]
+    writer = sm[stages[2]] + sm[stages[3]]
+    out = {
+        "n_experiments": N_EXP,
+        "n_cpus": os.cpu_count(),
+        "serial_s": round(t_serial, 3),
+        "pipelined_s": round(t_pipe, 3),
+        "overlap_efficiency": round(t_serial / max(t_pipe, 1e-6), 2),
+        "projected_efficiency_at_capacity": round(
+            (caller + writer) / max(caller, writer, 1e-6), 2),
+        "device_idle_fraction": pm["pipeline_device_idle_fraction"],
+        "serial_device_idle_fraction": sm["pipeline_device_idle_fraction"],
+        "outputs_identical": digest("serial") == digest("piped"),
+        "serial_stage_seconds": {k: sm[k] for k in stages},
+        "pipelined_stage_seconds": {k: pm[k] for k in stages},
+    }
+    print("OVERLAPPROBE=" + json.dumps(out))
+finally:
+    shutil.rmtree(root, ignore_errors=True)
+"""
+
+
+def _overlap_probe_child(timeout: float = 300.0):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["DFTPU_FORCE_PLATFORM"] = "cpu"
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", _OVERLAP_PROBE_CODE],
+            env=env, capture_output=True, text=True, timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        print(f"[bench] pipeline-overlap probe timed out ({timeout:.0f}s)",
+              file=sys.stderr)
+        return None
+    for line in p.stdout.splitlines():
+        if line.startswith("OVERLAPPROBE="):
+            return json.loads(line.split("=", 1)[1])
+    tail = (p.stderr or "").strip().splitlines()
+    print(f"[bench] pipeline-overlap probe failed (rc={p.returncode}): "
+          f"{tail[-1] if tail else '?'}", file=sys.stderr)
+    return None
+
+
+def _overlap_probe():
+    """Serial-vs-pipelined training wall-clock for the headline JSON.
+
+    One fresh CPU-forced child runs the same >= 6-experiment workload
+    twice through ``TrainingPipeline.run_many`` — executor disabled, then
+    enabled — and digests both output tables (the byte-identity control).
+    Returns the dict embedded as the headline's ``pipeline_overlap``
+    field, or None when skipped/failed (``DFTPU_BENCH_OVERLAP=0`` skips).
+
+    ``overlap_efficiency`` is the measured serial/pipelined ratio;
+    ``projected_efficiency_at_capacity`` is the max(chains) bound from the
+    serial stage decomposition (see the child's comment) — the number the
+    measured ratio converges to once the host has capacity to run the
+    caller and writer chains concurrently.  Single-CPU harnesses (this
+    driver's container is one) pin the measured ratio at ~1.0.
+    """
+    if os.environ.get("DFTPU_BENCH_OVERLAP", "1") == "0":
+        return None
+    t0 = time.perf_counter()
+    out = _overlap_probe_child()
+    if not out:
+        return None
+    print(
+        f"[bench] pipeline-overlap probe ({time.perf_counter() - t0:.0f}s): "
+        f"serial {out['serial_s']:.2f}s -> pipelined "
+        f"{out['pipelined_s']:.2f}s over {out['n_experiments']} experiments "
+        f"(x{out['overlap_efficiency']:.2f} measured on "
+        f"{out['n_cpus']} cpu(s); x"
+        f"{out['projected_efficiency_at_capacity']:.2f} at capacity), "
+        f"device idle {out['device_idle_fraction']:.0%}, "
+        f"outputs identical: {out['outputs_identical']}",
+        file=sys.stderr,
+    )
+    return out
+
+
 def main() -> None:
+    if "--overlap-only" in sys.argv:
+        # CI smoke mode: run just the pipeline-overlap probe (no backend
+        # probing, no jax import in this process) and print its JSON as
+        # the only stdout line; rc 1 when the probe failed to produce one
+        out = _overlap_probe()
+        print(json.dumps({"pipeline_overlap": out}), flush=True)
+        sys.exit(0 if out else 1)
+
     platform, force = choose_backend()
     # soft wall-clock budget for the OPTIONAL probes: once exceeded, the
     # remaining probes are skipped.  The clock starts AFTER backend
@@ -444,11 +622,12 @@ def main() -> None:
         print(f"[bench] persistent compilation cache: {cache_dir}",
               file=sys.stderr)
 
-    # cold/warm/disabled compile-cache children run BEFORE this process
-    # imports jax: they are subprocesses either way, but front-loading them
-    # keeps the parent's backend state untouched while the numbers that go
-    # into the headline line are produced
+    # cold/warm/disabled compile-cache and pipeline-overlap children run
+    # BEFORE this process imports jax: they are subprocesses either way,
+    # but front-loading them keeps the parent's backend state untouched
+    # while the numbers that go into the headline line are produced
     compile_cache = _compile_cache_probe()
+    pipeline_overlap = _overlap_probe()
 
     import jax
 
@@ -610,6 +789,11 @@ def main() -> None:
                 # skipped or failed) — tracks compile latency across
                 # rounds, not just device slope
                 "compile_cache": compile_cache,
+                # serial vs pipelined training wall-clock over >= 6
+                # experiments on a CPU-forced child (overlap_efficiency,
+                # device_idle_fraction, byte-identity control; null when
+                # skipped or failed) — see _overlap_probe
+                "pipeline_overlap": pipeline_overlap,
             }
         ),
         flush=True,

@@ -46,6 +46,31 @@ _HOST_METHODS = ("item", "tolist")
 
 _PY_CASTS = ("float", "int", "bool")
 
+#: the explicit sync spelling, flagged even OUTSIDE traced code in the hot
+#: layers — with the pipelined executor, an ad-hoc ``block_until_ready``
+#: stalls the pipeline; syncs must route through a ``@sanctioned_pull``
+#: function (engine/executor.py device_pull)
+_SYNC_CALL = "jax.block_until_ready"
+
+#: decorator marking a function as a sanctioned device-pull point
+_SANCTIONED_PULL = "sanctioned_pull"
+
+
+def _decorator_names(fn) -> frozenset:
+    """Terminal names of a function's decorators (``@sanctioned_pull``,
+    ``@executor.sanctioned_pull`` and ``@sanctioned_pull(...)`` all yield
+    ``sanctioned_pull``)."""
+    names = set()
+    for dec in fn.decorator_list:
+        node = dec
+        while isinstance(node, ast.Call):
+            node = node.func
+        if isinstance(node, ast.Attribute):
+            names.add(node.attr)
+        elif isinstance(node, ast.Name):
+            names.add(node.id)
+    return frozenset(names)
+
 
 def _is_static_expr(node: ast.AST, statics: frozenset) -> bool:
     """Conservatively true when the expression is concrete at trace time:
@@ -69,8 +94,19 @@ def _is_static_expr(node: ast.AST, statics: frozenset) -> bool:
 
 @register
 class HostSyncInHotPath(Rule):
+    """Two scans:
+
+    1. host-transfer spellings INSIDE traced code (the original rule);
+    2. explicit ``jax.block_until_ready`` / ``.block_until_ready()``
+       anywhere in the hot layers, traced or not — the pipelined executor
+       (engine/executor.py) owns WHEN the host waits, so a stray sync
+       de-pipelines the flow silently.  The escape hatch is structural, not
+       a suppression: decorate the one function that is *supposed* to block
+       with ``@sanctioned_pull`` and route every sync through it.
+    """
+
     name = "host-sync-in-hot-path"
-    dir_names = frozenset({"ops", "engine", "parallel"})
+    dir_names = frozenset({"ops", "engine", "parallel", "pipelines"})
 
     def check_module(self, module: ModuleInfo, project) -> List[Finding]:
         imap = ImportMap(module.tree)
@@ -107,6 +143,36 @@ class HostSyncInHotPath(Rule):
                         f"'{fn.name}' ({how}) concretizes it (sync or "
                         f"TracerConversionError); compute with jnp or mark "
                         f"the argument static"))
+        out.extend(self._explicit_syncs(module, imap, module.tree,
+                                        "<module>", False))
+        return out
+
+    def _explicit_syncs(self, module: ModuleInfo, imap: ImportMap,
+                        node: ast.AST, owner: str,
+                        exempt: bool) -> List[Finding]:
+        """Scan 2: explicit sync calls outside ``@sanctioned_pull``."""
+        out: List[Finding] = []
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                ex = exempt or _SANCTIONED_PULL in _decorator_names(child)
+                out.extend(self._explicit_syncs(
+                    module, imap, child, child.name, ex))
+                continue
+            if isinstance(child, ast.Call) and not exempt:
+                dotted = imap.dotted(child.func)
+                if dotted == _SYNC_CALL or (
+                        dotted is None
+                        and isinstance(child.func, ast.Attribute)
+                        and child.func.attr == "block_until_ready"):
+                    out.append(self.finding(
+                        module, child,
+                        f"explicit block_until_ready in '{owner}' stalls "
+                        f"the host outside the executor's sanctioned pull "
+                        f"points — route the sync through a "
+                        f"@sanctioned_pull function (engine/executor.py "
+                        f"device_pull) so pipelining stays intact"))
+            out.extend(self._explicit_syncs(module, imap, child, owner,
+                                            exempt))
         return out
 
 

@@ -35,8 +35,26 @@ from distributed_forecasting_tpu.engine.compile_cache import (
     cache_stats,
     configure_compile_cache,
 )
+from distributed_forecasting_tpu.engine.executor import (
+    ExperimentHandle,
+    PipelineConfig,
+    TrainingExecutor,
+    configure_pipeline,
+    device_pull,
+    pipeline_config,
+    prefetch_to_device,
+    sanctioned_pull,
+)
 
 __all__ = [
+    "ExperimentHandle",
+    "PipelineConfig",
+    "TrainingExecutor",
+    "configure_pipeline",
+    "device_pull",
+    "pipeline_config",
+    "prefetch_to_device",
+    "sanctioned_pull",
     "AOTStore",
     "CompileCacheConfig",
     "aot_call",

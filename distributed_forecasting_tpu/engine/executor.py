@@ -1,0 +1,479 @@
+"""Pipelined training executor: overlap host prep, device compute, I/O.
+
+JAX dispatch is asynchronous: a compiled computation returns immediately and
+the arrays it hands back are futures backed by in-flight device buffers; the
+host only stalls when something forces a concrete value.  The training
+pipeline used to serialize anyway — tensorize, dispatch CV, block, dispatch
+fit, block, then serialize artifacts and write tracking rows — so the device
+idled through every host stage and the host idled through every device stage.
+
+:class:`TrainingExecutor` runs one experiment as a three-stage pipeline:
+
+* **stage A — prep (caller thread):** host-side input preparation (catalog
+  read, tensorize, config/covariate resolution).
+* **stage B — dispatch (caller thread):** device compute launched WITHOUT
+  intermediate ``block_until_ready``; the returned state carries in-flight
+  arrays.
+* **stage C — pull + complete (writer thread):** the one sanctioned
+  synchronization point (:func:`device_pull`) followed by host completion:
+  conformal scaling, artifact serialization, tracker writes, table saves.
+
+Stage C drains on a single background writer thread in submission order, so
+tracking and catalog writes stay exactly as ordered as the serial path while
+the caller thread preps and dispatches the next experiment — the device
+computes experiment *i+1* while the host serializes experiment *i*.  Even on
+the CPU backend this overlap is real: XLA executes in its own thread pool
+with the GIL released, and pandas/parquet/json I/O in stage C releases it
+too.
+
+Knobs (``pipeline:`` conf block, parsed by the Task base):
+
+* ``max_in_flight`` bounds dispatched-but-uncompleted experiments (device
+  memory bound; the caller blocks in ``submit`` when the bound is reached);
+* ``prefetch_depth`` is the double-buffering depth of
+  :func:`prefetch_to_device` used by the span-bucketed fit path;
+* ``async_tracking: false`` (or ``enabled: false``) degrades to fully
+  synchronous inline execution — the serial reference that the determinism
+  suite compares the pipelined path against.
+
+Error contract: an exception in stage C fails that experiment — it is stored
+on the experiment's handle (``handle.result()`` re-raises it), recorded as
+the executor's first error, and re-raised to the caller from ``flush()`` /
+``close()`` and from any later ``submit()``.  A tracking write that raises
+therefore cannot vanish into the writer thread.  ``close()`` is idempotent;
+as a context manager the executor suppresses its own re-raise when the body
+is already unwinding with an exception.
+
+The pipelined path's contract is byte-identical outputs to the serial path:
+per-experiment computation is unchanged, only *when* the host waits moves.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import logging
+import queue
+import threading
+import time
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+
+def sanctioned_pull(fn):
+    """Mark ``fn`` as a sanctioned device-synchronization point.
+
+    dflint's ``host-sync-in-hot-path`` rule flags explicit
+    ``jax.block_until_ready`` calls in the hot layers; decorating the one
+    function that is *supposed* to block exempts it (see
+    ``analysis/rules_jax.py``).  The marker attribute lets runtime code and
+    tests verify the annotation as well.
+    """
+    fn.__dftpu_sanctioned_pull__ = True
+    return fn
+
+
+@sanctioned_pull
+def device_pull(tree):
+    """THE sanctioned sync point: wait for every array in ``tree``.
+
+    ``jax.block_until_ready`` walks arbitrary pytrees and ignores non-array
+    leaves, so stage-B state dicts can mix device arrays with host objects
+    (configs, DataFrames, timers) and be pulled wholesale.
+    """
+    return jax.block_until_ready(tree)
+
+
+@dataclasses.dataclass(frozen=True)
+class PipelineConfig:
+    """Conf-wired knobs for the pipelined training executor.
+
+    Mirrors ``CompileCacheConfig``: built from the ``pipeline:`` conf block
+    by the Task base, installed process-wide via :func:`configure_pipeline`.
+    """
+
+    enabled: bool = True
+    max_in_flight: int = 2
+    prefetch_depth: int = 1
+    async_tracking: bool = True
+
+    def __post_init__(self):
+        if self.max_in_flight < 1:
+            raise ValueError(
+                f"pipeline.max_in_flight must be >= 1, got {self.max_in_flight}")
+        if self.prefetch_depth < 0:
+            raise ValueError(
+                f"pipeline.prefetch_depth must be >= 0, got {self.prefetch_depth}")
+
+    @classmethod
+    def from_conf(cls, conf: Optional[Dict[str, Any]]) -> "PipelineConfig":
+        if conf is None:
+            return cls()
+        if not isinstance(conf, dict):
+            raise ValueError(f"pipeline conf must be a mapping, got {type(conf)}")
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(conf) - known
+        if unknown:
+            raise ValueError(
+                f"unknown pipeline conf keys: {sorted(unknown)} "
+                f"(known: {sorted(known)})")
+        return cls(
+            enabled=bool(conf.get("enabled", True)),
+            max_in_flight=int(conf.get("max_in_flight", 2)),
+            prefetch_depth=int(conf.get("prefetch_depth", 1)),
+            async_tracking=bool(conf.get("async_tracking", True)),
+        )
+
+
+_config_lock = threading.Lock()
+_config: PipelineConfig = PipelineConfig()
+
+
+def configure_pipeline(config: PipelineConfig) -> PipelineConfig:
+    """Install ``config`` as the process-wide pipeline configuration."""
+    global _config
+    with _config_lock:
+        _config = config
+    return config
+
+
+def pipeline_config() -> PipelineConfig:
+    """Current process-wide :class:`PipelineConfig`."""
+    with _config_lock:
+        return _config
+
+
+class ExperimentHandle:
+    """Future-like handle for one submitted experiment."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._done = threading.Event()
+        self._result: Any = None
+        self._error: Optional[BaseException] = None
+
+    def _finish(self, result: Any = None,
+                error: Optional[BaseException] = None) -> None:
+        self._result = result
+        self._error = error
+        self._done.set()
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        """Block until the experiment completes; re-raise its stage-C error."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"experiment {self.name!r} not complete after {timeout}s")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+_STOP = object()
+
+
+class TrainingExecutor:
+    """Bounded three-stage pipeline over independent training experiments.
+
+    ``submit(name, prep, dispatch, complete)`` runs ``prep`` and ``dispatch``
+    on the caller thread (stage A/B), then hands the dispatched state to the
+    single background writer thread, which performs :func:`device_pull`
+    followed by ``complete`` (stage C) in strict submission order.  The
+    semaphore bounds dispatched-but-uncompleted experiments at
+    ``max_in_flight``.
+    """
+
+    def __init__(self, config: Optional[PipelineConfig] = None,
+                 metrics: Optional[Any] = None):
+        self.config = config if config is not None else pipeline_config()
+        if metrics is None:
+            from distributed_forecasting_tpu.monitoring.monitor import (
+                pipeline_metrics,
+            )
+            metrics = pipeline_metrics()
+        self.metrics = metrics
+        self._async = bool(self.config.enabled and self.config.async_tracking)
+        self._slots = threading.Semaphore(self.config.max_in_flight)
+        self._queue: "queue.Queue" = queue.Queue()
+        self._worker: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self._closed = False
+        self._first_error: Optional[BaseException] = None
+        self._in_flight = 0
+        # device-occupancy accounting: union of [dispatch, pull-end]
+        # intervals (conservative — the device may finish before the pull
+        # observes it, so idle_fraction is a lower bound on true idleness)
+        self._busy_seconds = 0.0
+        self._busy_until: Optional[float] = None
+        self._first_dispatch: Optional[float] = None
+        self._last_pull: Optional[float] = None
+        self._stage_totals: Dict[str, float] = {
+            "prep": 0.0, "dispatch": 0.0, "pull": 0.0, "complete": 0.0}
+        self._n_submitted = 0
+        self._n_completed = 0
+
+    # -- accounting -------------------------------------------------------
+
+    def _record_dispatch(self, t_start: float) -> None:
+        with self._lock:
+            if self._first_dispatch is None:
+                self._first_dispatch = t_start
+            if self._busy_until is None or t_start > self._busy_until:
+                self._busy_until = t_start
+
+    def _record_pull_end(self, t_end: float) -> None:
+        with self._lock:
+            if self._busy_until is not None and t_end > self._busy_until:
+                self._busy_seconds += t_end - self._busy_until
+                self._busy_until = t_end
+            self._last_pull = t_end
+
+    def _observe(self, stage: str, seconds: float) -> None:
+        with self._lock:
+            self._stage_totals[stage] += seconds
+        self.metrics.observe_stage(stage, seconds)
+
+    def _set_in_flight(self, delta: int) -> None:
+        with self._lock:
+            self._in_flight += delta
+            self.metrics.set_in_flight(self._in_flight)
+
+    # -- submission -------------------------------------------------------
+
+    def submit(self, name: str,
+               prep: Callable[[], Any],
+               dispatch: Callable[[Any], Any],
+               complete: Callable[[Any], Any]) -> ExperimentHandle:
+        """Run one experiment through the pipeline; returns its handle.
+
+        ``prep()`` -> prepared;  ``dispatch(prepared)`` -> state (with
+        in-flight device arrays);  ``complete(state)`` -> result, called
+        after :func:`device_pull` on the writer thread (or inline when the
+        pipeline is disabled).  Errors in prep/dispatch raise immediately in
+        the caller; errors in complete surface via the handle, ``flush`` and
+        ``close``.
+        """
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("TrainingExecutor is closed")
+            self._n_submitted += 1
+        self._raise_if_failed()
+        self.metrics.inc_experiments()
+        handle = ExperimentHandle(name)
+        if not self._async:
+            return self._run_serial(handle, prep, dispatch, complete)
+
+        self._ensure_worker()
+        self._slots.acquire()
+        try:
+            t0 = time.perf_counter()
+            prepared = prep()
+            t1 = time.perf_counter()
+            self._observe("prep", t1 - t0)
+            self._record_dispatch(t1)
+            state = dispatch(prepared)
+            t2 = time.perf_counter()
+            self._observe("dispatch", t2 - t1)
+        except BaseException:
+            self._slots.release()
+            raise
+        self._set_in_flight(+1)
+        self._queue.put((handle, state, complete))
+        return handle
+
+    def _run_serial(self, handle: ExperimentHandle, prep, dispatch,
+                    complete) -> ExperimentHandle:
+        # Inline reference path: identical stage structure and accounting,
+        # no thread — what the determinism suite compares against.
+        t0 = time.perf_counter()
+        prepared = prep()
+        t1 = time.perf_counter()
+        self._observe("prep", t1 - t0)
+        self._record_dispatch(t1)
+        state = dispatch(prepared)
+        t2 = time.perf_counter()
+        self._observe("dispatch", t2 - t1)
+        try:
+            state = device_pull(state)
+            t3 = time.perf_counter()
+            self._record_pull_end(t3)
+            self._observe("pull", t3 - t2)
+            self._inject_stage_seconds(state, t1 - t0, t2 - t1, t3 - t2)
+            result = complete(state)
+            t4 = time.perf_counter()
+            self._observe("complete", t4 - t3)
+            with self._lock:
+                self._n_completed += 1
+            handle._finish(result=result)
+        except BaseException as exc:
+            self.metrics.inc_errors()
+            with self._lock:
+                if self._first_error is None:
+                    self._first_error = exc
+            handle._finish(error=exc)
+            raise
+        return handle
+
+    def _inject_stage_seconds(self, state: Any, prep_s: float,
+                              dispatch_s: float, pull_s: float) -> None:
+        # Surface per-stage timings to the completion closure (which merges
+        # them into the run's timer-phase summary) without widening its
+        # signature.  Timing metrics are outside the byte-identity contract.
+        if isinstance(state, dict):
+            state["pipeline_stage_seconds"] = {
+                "prep": prep_s, "dispatch": dispatch_s, "pull": pull_s}
+
+    # -- writer thread ----------------------------------------------------
+
+    def _ensure_worker(self) -> None:
+        with self._lock:
+            if self._worker is None:
+                self._worker = threading.Thread(
+                    target=self._drain, name="dftpu-pipeline-writer",
+                    daemon=True)
+                self._worker.start()
+
+    def _drain(self) -> None:
+        while True:
+            task = self._queue.get()
+            if task is _STOP:
+                self._queue.task_done()
+                return
+            handle, state, complete = task
+            try:
+                t0 = time.perf_counter()
+                state = device_pull(state)
+                t1 = time.perf_counter()
+                self._record_pull_end(t1)
+                self._observe("pull", t1 - t0)
+                self._inject_stage_seconds(state, 0.0, 0.0, t1 - t0)
+                result = complete(state)
+                t2 = time.perf_counter()
+                self._observe("complete", t2 - t1)
+                with self._lock:
+                    self._n_completed += 1
+                handle._finish(result=result)
+            except BaseException as exc:  # noqa: BLE001 — must not kill the writer
+                logger.exception("pipeline stage C failed for %r", handle.name)
+                self.metrics.inc_errors()
+                with self._lock:
+                    if self._first_error is None:
+                        self._first_error = exc
+                handle._finish(error=exc)
+            finally:
+                self._set_in_flight(-1)
+                self._slots.release()
+                self._queue.task_done()
+
+    # -- lifecycle --------------------------------------------------------
+
+    def _raise_if_failed(self) -> None:
+        with self._lock:
+            err = self._first_error
+        if err is not None:
+            raise err
+
+    def flush(self) -> None:
+        """Wait for every submitted experiment's stage C; re-raise errors."""
+        self._queue.join()
+        self.metrics.set_device_idle_fraction(self.device_idle_fraction())
+        self._raise_if_failed()
+
+    def close(self) -> None:
+        """Drain, stop the writer thread, re-raise the first stage-C error.
+
+        Idempotent; after the first call ``submit`` raises.
+        """
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            worker = self._worker
+        if worker is not None:
+            self._queue.put(_STOP)
+            worker.join()
+        self.metrics.set_device_idle_fraction(self.device_idle_fraction())
+        self._raise_if_failed()
+
+    def __enter__(self) -> "TrainingExecutor":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc_type is not None:
+            # body already unwinding: drain quietly, don't mask its error
+            try:
+                self.close()
+            except BaseException:  # noqa: BLE001 — deliberate: keep body's exception
+                logger.exception("pipeline close raised during unwind")
+        else:
+            self.close()
+
+    # -- metrics ----------------------------------------------------------
+
+    def device_idle_fraction(self) -> float:
+        """Fraction of the dispatch->last-pull window the device sat idle.
+
+        Busy time is the union of per-experiment [dispatch-start, pull-end]
+        intervals — a conservative over-estimate of busyness (the device may
+        drain before the pull observes it), so the reported idle fraction is
+        a lower bound.  Returns 0.0 before any experiment completes.
+        """
+        with self._lock:
+            if self._first_dispatch is None or self._last_pull is None:
+                return 0.0
+            window = self._last_pull - self._first_dispatch
+            if window <= 0.0:
+                return 0.0
+            return max(0.0, min(1.0, 1.0 - self._busy_seconds / window))
+
+    def stage_metrics(self) -> Dict[str, float]:
+        """Aggregate per-stage seconds plus occupancy numbers."""
+        with self._lock:
+            out = {f"pipeline_{k}_seconds": round(v, 4)
+                   for k, v in self._stage_totals.items()}
+            out["pipeline_n_experiments"] = float(self._n_submitted)
+            out["pipeline_n_completed"] = float(self._n_completed)
+            out["pipeline_max_in_flight"] = float(self.config.max_in_flight)
+            out["pipeline_async"] = 1.0 if self._async else 0.0
+        out["pipeline_device_idle_fraction"] = round(
+            self.device_idle_fraction(), 4)
+        return out
+
+
+def prefetch_to_device(items: Iterable[Any], depth: Optional[int] = None,
+                       place: Callable[[Any], Any] = jax.device_put,
+                       ) -> Iterator[Any]:
+    """Double-buffered ``device_put`` over ``items``.
+
+    Keeps up to ``depth`` transfers in flight ahead of the consumer
+    (``device_put`` is itself asynchronous, so "in flight" means the host
+    has issued the copy and moved on).  ``depth=0`` degrades to plain
+    placement with no lookahead.  Order is preserved.
+    """
+    if depth is None:
+        depth = pipeline_config().prefetch_depth
+    it = iter(items)
+    buf: "collections.deque" = collections.deque()
+    for item in it:
+        buf.append(place(item))
+        if len(buf) > depth:
+            yield buf.popleft()
+    while buf:
+        yield buf.popleft()
+
+
+__all__ = [
+    "ExperimentHandle",
+    "PipelineConfig",
+    "TrainingExecutor",
+    "configure_pipeline",
+    "device_pull",
+    "pipeline_config",
+    "prefetch_to_device",
+    "sanctioned_pull",
+]

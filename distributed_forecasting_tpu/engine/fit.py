@@ -474,10 +474,16 @@ def fit_forecast_bucketed(
       carry that series' earliest in-window value.
     """
     from distributed_forecasting_tpu.data.tensorize import bucket_by_span
+    from distributed_forecasting_tpu.engine.executor import prefetch_to_device
 
     if key is None:
         key = jax.random.PRNGKey(0)
     buckets = bucket_by_span(batch, max_buckets=max_buckets)
+    # double-buffered device placement: bucket i+1's transfer is issued
+    # while bucket i fits (depth from the pipeline: conf block; device_put
+    # only moves the pytree's array leaves, values are unchanged)
+    bucket_indices = [idx for idx, _ in buckets]
+    prefetched = prefetch_to_device(sub for _, sub in buckets)
     S, T = batch.n_series, batch.n_time
     T_all = T + horizon
     fns = get_model(model)
@@ -491,7 +497,7 @@ def fit_forecast_bucketed(
     hi = jnp.zeros((S, T_all))
     ok = jnp.zeros((S,), bool)
     bucket_params = []
-    for i, (idx, sub) in enumerate(buckets):
+    for i, (idx, sub) in enumerate(zip(bucket_indices, prefetched)):
         xr = None
         if xreg is not None:
             # bucket grid = last L history days + horizon: a contiguous

@@ -250,6 +250,56 @@ class TrainingPipeline:
         self.tracker = tracker
         self.logger = get_logger("TrainingPipeline")
 
+    # -------------------------------------------------------------- pipeline
+    def _run_stages(self, name: str, prep, dispatch, complete, executor):
+        """Route one experiment's prep/dispatch/complete stages.
+
+        With a caller-owned executor (``run_many``), submit and return the
+        :class:`~distributed_forecasting_tpu.engine.executor.ExperimentHandle`
+        — the caller flushes and collects.  Standalone, run through a local
+        executor under the process-wide ``pipeline:`` config and block for
+        the result, so the public API stays synchronous.
+        """
+        from distributed_forecasting_tpu.engine.executor import (
+            TrainingExecutor,
+        )
+
+        if executor is not None:
+            return executor.submit(name, prep, dispatch, complete)
+        ex = TrainingExecutor()
+        with ex:
+            handle = ex.submit(name, prep, dispatch, complete)
+        return handle.result()
+
+    def run_many(self, specs, pipeline=None) -> Dict[str, Any]:
+        """Pipeline several independent experiments through one executor.
+
+        ``specs``: iterable of keyword dicts for :meth:`fine_grained`.  While
+        experiment *i*'s completion stage (artifact serialization, tracker
+        writes, table save) drains on the writer thread, experiment *i+1* is
+        already tensorizing and dispatching — the overlap bench.py's probe
+        measures.  Stage C keeps submission order, so tracker/catalog write
+        order matches a serial loop.
+
+        Returns ``{"results": [...], "pipeline": stage_metrics}`` with
+        results in submission order.  The first completion failure is
+        re-raised after the pipeline drains (remaining experiments still
+        complete; their handles carry their own outcomes).
+        """
+        from distributed_forecasting_tpu.engine.executor import (
+            TrainingExecutor,
+        )
+
+        ex = TrainingExecutor(config=pipeline)
+        with ex:
+            handles = [
+                self.fine_grained(**spec, _executor=ex) for spec in specs
+            ]
+        return {
+            "results": [h.result() for h in handles],
+            "pipeline": ex.stage_metrics(),
+        }
+
     # ------------------------------------------------------------------ fine
     def fine_grained(
         self,
@@ -271,7 +321,12 @@ class TrainingPipeline:
         cv_artifact: bool = False,
         calibrate_intervals: bool = False,
         freq: str = "D",
+        _executor=None,
     ) -> Dict[str, Any]:
+        # ``_executor``: internal pipelining hook (see run_many / engine/
+        # executor.py).  When a TrainingExecutor is passed, this submits the
+        # experiment and returns its ExperimentHandle instead of blocking —
+        # validation errors still raise immediately on this thread.
         if regressors:
             from distributed_forecasting_tpu.models.base import get_model
 
@@ -332,6 +387,7 @@ class TrainingPipeline:
             return self._fine_grained_tuned(
                 source_table, output_table, model_conf, cv_conf, tuning,
                 experiment, horizon, key_cols, regressors=regressors,
+                _executor=_executor,
             )
         if model in ("auto", "blend"):
             if bucketed:
@@ -344,222 +400,268 @@ class TrainingPipeline:
                     source_table, output_table, model_conf, cv_conf,
                     experiment, horizon, key_cols, seed, freq=freq,
                     calibrate_intervals=calibrate_intervals,
+                    _executor=_executor,
                 )
             return self._fine_grained_auto(
                 source_table, output_table, model_conf, cv_conf,
                 experiment, horizon, key_cols, seed, freq=freq,
+                _executor=_executor,
             )
         from distributed_forecasting_tpu.utils.profiling import PhaseTimer, device_trace
 
-        timer = PhaseTimer()
-        with timer.phase("read"):
-            df = self.catalog.read_table(source_table)
-        with timer.phase("tensorize"):
-            batch = tensorize(df, key_cols=key_cols, freq=freq)
-        # config AFTER tensorize: a named holiday calendar resolves over the
-        # batch's actual date range (+horizon)
-        config = _config_from_conf(
-            model, _resolve_model_conf(model, model_conf, batch, horizon,
-                                       cv_conf)
-        )
-        if (model_conf or {}).get("season_length") == "auto":
-            self.logger.info(
-                "season_length: auto -> detected period %d",
-                config.season_length,
-            )
-        if (model_conf or {}).get("order") == "auto":
-            self.logger.info(
-                "arima order: auto -> selected (p, d, q) = (%d, %d, %d)",
-                config.p, config.d, config.q,
-            )
-        xreg = None
-        if regressors:
-            # conf-driven covariates (Prophet add_regressor parity at the
-            # task layer): a catalog table with date (+ key cols when
-            # per_series) + the named columns, covering history AND horizon
-            with timer.phase("tensorize_regressors"):
-                xreg, config = _load_regressors(
-                    self.catalog, regressors, batch, horizon, config
-                )
-        self.logger.info(
-            "fine-grained fit: %d series x %d days, model=%s%s",
-            batch.n_series, batch.n_time, model,
-            f", {config.n_regressors} regressors" if xreg is not None else "",
-        )
+        # Three pipeline stages (engine/executor.py).  prep and dispatch run
+        # on the caller thread; complete runs after the sanctioned
+        # device_pull — on the writer thread when pipelined, inline when not.
+        # The stages share one mutable state dict; the split moves WHEN the
+        # host waits, never WHAT is computed (byte-identity contract).
 
-        t_start = time.time()
-        key = jax.random.PRNGKey(seed)
-        cv_metrics = None
-        cv = CVConfig(**(cv_conf or {})) if run_cross_validation else None
-        with device_trace(trace_dir):
-            if run_cross_validation:
-                with timer.phase("cross_validation"):
-                    if cv_artifact:
-                        # one CV pass yields metrics AND the raw frame
-                        cv_metrics, cv_frame = cross_validate(
-                            batch, model=model, config=config, cv=cv,
-                            key=key, xreg=xreg, return_frame=True,
-                            calibrate=calibrate_intervals,
+        def prep() -> Dict[str, Any]:
+            timer = PhaseTimer()
+            with timer.phase("read"):
+                df = self.catalog.read_table(source_table)
+            with timer.phase("tensorize"):
+                batch = tensorize(df, key_cols=key_cols, freq=freq)
+            # config AFTER tensorize: a named holiday calendar resolves over
+            # the batch's actual date range (+horizon)
+            config = _config_from_conf(
+                model, _resolve_model_conf(model, model_conf, batch, horizon,
+                                           cv_conf)
+            )
+            if (model_conf or {}).get("season_length") == "auto":
+                self.logger.info(
+                    "season_length: auto -> detected period %d",
+                    config.season_length,
+                )
+            if (model_conf or {}).get("order") == "auto":
+                self.logger.info(
+                    "arima order: auto -> selected (p, d, q) = (%d, %d, %d)",
+                    config.p, config.d, config.q,
+                )
+            xreg = None
+            if regressors:
+                # conf-driven covariates (Prophet add_regressor parity at the
+                # task layer): a catalog table with date (+ key cols when
+                # per_series) + the named columns, covering history AND horizon
+                with timer.phase("tensorize_regressors"):
+                    xreg, config = _load_regressors(
+                        self.catalog, regressors, batch, horizon, config
+                    )
+            self.logger.info(
+                "fine-grained fit: %d series x %d days, model=%s%s",
+                batch.n_series, batch.n_time, model,
+                f", {config.n_regressors} regressors" if xreg is not None
+                else "",
+            )
+            return {"timer": timer, "batch": batch, "config": config,
+                    "xreg": xreg}
+
+        def dispatch(state: Dict[str, Any]) -> Dict[str, Any]:
+            timer, batch = state["timer"], state["batch"]
+            config, xreg = state["config"], state["xreg"]
+            t_start = time.time()
+            key = jax.random.PRNGKey(seed)
+            cv_metrics = None
+            cv_frame = None
+            cv = CVConfig(**(cv_conf or {})) if run_cross_validation else None
+            buckets = params = None
+            # every launch below is asynchronous: the phase timers measure
+            # dispatch (host trace + launch) only; device wall-clock lands in
+            # fit_seconds / pipeline_pull_seconds at the sanctioned pull
+            with device_trace(trace_dir):
+                if run_cross_validation:
+                    with timer.phase("cross_validation"):
+                        if cv_artifact:
+                            # one CV pass yields metrics AND the raw frame
+                            cv_metrics, cv_frame = cross_validate(
+                                batch, model=model, config=config, cv=cv,
+                                key=key, xreg=xreg, return_frame=True,
+                                calibrate=calibrate_intervals,
+                            )
+                        else:
+                            cv_metrics = cross_validate(
+                                batch, model=model, config=config, cv=cv,
+                                key=key, xreg=xreg,
+                                calibrate=calibrate_intervals,
+                            )
+                with timer.phase("fit_forecast"):
+                    if bucketed:
+                        # ragged batches: span buckets on trimmed grids (CV
+                        # above stays on the shared grid — short buckets may
+                        # not cover the CV `initial` window, and masks keep
+                        # it correct)
+                        from distributed_forecasting_tpu.engine import (
+                            fit_forecast_bucketed,
+                        )
+
+                        buckets, result = fit_forecast_bucketed(
+                            batch, model=model, config=config,
+                            horizon=horizon, key=key, xreg=xreg,
                         )
                     else:
-                        cv_metrics = cross_validate(
-                            batch, model=model, config=config, cv=cv, key=key,
-                            xreg=xreg, calibrate=calibrate_intervals,
+                        params, result = fit_forecast(
+                            batch, model=model, config=config,
+                            horizon=horizon, key=key, xreg=xreg,
                         )
-                    jax.block_until_ready(cv_metrics["mape"])
-            with timer.phase("fit_forecast"):
+            state.update(t_start=t_start, cv=cv, cv_metrics=cv_metrics,
+                         cv_frame=cv_frame, buckets=buckets, params=params,
+                         result=result)
+            return state
+
+        def complete(state: Dict[str, Any]) -> Dict[str, Any]:
+            timer, batch = state["timer"], state["batch"]
+            config = state["config"]
+            cv, cv_metrics = state["cv"], state["cv_metrics"]
+            buckets, params = state["buckets"], state["params"]
+            result = state["result"]
+            interval_scale = None
+            if calibrate_intervals:
+                # widen/tighten the shipped bands by the CV-conformal factor —
+                # the forecast table and the serving artifact carry calibrated
+                # bands; the logged val_coverage stays the RAW band's coverage
+                # and val_coverage_calibrated (from cv.py's calibrate branch)
+                # reports the calibrated one, so the before/after is visible
+                import dataclasses as _dc
+
+                from distributed_forecasting_tpu.engine import (
+                    apply_interval_scale,
+                )
+                from distributed_forecasting_tpu.models.base import get_model
+
+                interval_scale = cv_metrics["_interval_scale"]
+                _, lo_c, hi_c = apply_interval_scale(
+                    result.yhat, result.lo, result.hi, interval_scale,
+                    floor=get_model(model).band_floor,
+                )
+                result = _dc.replace(result, lo=lo_c, hi=hi_c)
+            fit_seconds = time.time() - state["t_start"]
+
+            ok = np.asarray(result.ok)
+            n_failed = int((~ok).sum())
+            if n_failed == batch.n_series:
+                # the reference's automl post-pass raises when nothing trained
+                # (notebooks/automl/...py:151-156)
+                raise RuntimeError("no series trained successfully")
+
+            eid = self.tracker.create_experiment(experiment)
+            with self.tracker.start_run(
+                eid,
+                run_name=f"batched_{model}_fit",
+                tags={"model": model, "partial_model": str(n_failed > 0)},
+            ) as run:
+                from distributed_forecasting_tpu.models import prophet_glm
+
                 if bucketed:
-                    # ragged batches: span buckets on trimmed grids (CV above
-                    # stays on the shared grid — short buckets may not cover
-                    # the CV `initial` window, and masks keep it correct)
-                    from distributed_forecasting_tpu.engine import (
-                        fit_forecast_bucketed,
-                    )
+                    import dataclasses as _dc
 
-                    buckets, result = fit_forecast_bucketed(
-                        batch, model=model, config=config, horizon=horizon,
-                        key=key, xreg=xreg,
-                    )
-                    params = None
+                    run.log_params(_dc.asdict(config))
+                    run.log_params({"n_buckets": len(buckets)})
+                elif model in ("prophet", "curve"):
+                    run.log_params(prophet_glm.extract_params(params, config))
                 else:
-                    params, result = fit_forecast(
-                        batch, model=model, config=config, horizon=horizon,
-                        key=key, xreg=xreg,
+                    import dataclasses as _dc
+
+                    run.log_params(_dc.asdict(config))
+                from distributed_forecasting_tpu.data.tensorize import (
+                    resolved_backend,
+                )
+
+                run.log_params(
+                    {
+                        "n_series": batch.n_series,
+                        "n_time": batch.n_time,
+                        "horizon": horizon,
+                        "n_failed_series": n_failed,
+                        # which host data plane produced the tensor (the
+                        # phase_tensorize_seconds metric is comparable across
+                        # backends; see data/tensorize.py)
+                        # the native path is daily-only; record what ran
+                        "tensorize_backend": (
+                            resolved_backend(n_keys=len(key_cols))
+                            if batch.freq == "D" else "pandas"
+                        ),
+                        **_comparability_params(batch, cv),
+                    }
+                )
+                agg = {"fit_seconds": fit_seconds,
+                       "series_per_second":
+                           batch.n_series / max(fit_seconds, 1e-9)}
+                agg.update(timer.metrics())  # per-phase wall-clock tracing
+                ps = state.get("pipeline_stage_seconds")
+                if ps:
+                    # executor stage timings next to the phase_* summary
+                    # (timing metrics sit outside the byte-identity contract)
+                    agg.update({f"pipeline_{k}_seconds": round(float(v), 4)
+                                for k, v in ps.items()})
+                series_table = batch.key_frame()
+                series_table["fit_ok"] = ok
+                if cv_metrics is not None:
+                    for name in _METRICS:
+                        vals = np.asarray(cv_metrics[name])
+                        series_table[name] = vals
+                        # nanmean: a per-series NaN (e.g. mase on a constant
+                        # training window) must not poison the aggregate
+                        agg[f"val_{name}"] = float(np.nanmean(vals[ok])) if ok.any() else float("nan")
+                    agg["n_cv_cutoffs"] = cv_metrics["_n_cutoffs"]
+                if interval_scale is not None:
+                    scales = np.asarray(interval_scale)
+                    series_table["interval_scale"] = scales
+                    agg["interval_scale_mean"] = float(np.mean(scales[ok])) if ok.any() else float("nan")
+                    # raw val_coverage stays above; this is the shipped band's
+                    cov_c = np.asarray(cv_metrics["_coverage_calibrated"])
+                    series_table["coverage_calibrated"] = cov_c
+                    agg["val_coverage_calibrated"] = float(np.mean(cov_c[ok])) if ok.any() else float("nan")
+                run.log_metrics(agg)
+                run.log_table("series_metrics.parquet", series_table)
+                if cv_artifact and run_cross_validation:
+                    # raw per-cutoff forecasts (Prophet diagnostics shape),
+                    # computed in the cross_validation phase above — opt-in:
+                    # at 500x1826x3 it is a ~2.7M-row parquet
+                    run.log_table("cv_forecasts.parquet", state["cv_frame"])
+
+                if bucketed:
+                    from distributed_forecasting_tpu.serving import (
+                        BucketedForecaster,
                     )
-                jax.block_until_ready(result.yhat)
-        interval_scale = None
-        if calibrate_intervals:
-            # widen/tighten the shipped bands by the CV-conformal factor —
-            # the forecast table and the serving artifact carry calibrated
-            # bands; the logged val_coverage stays the RAW band's coverage
-            # and val_coverage_calibrated (from cv.py's calibrate branch)
-            # reports the calibrated one, so the before/after is visible
-            import dataclasses as _dc
 
-            from distributed_forecasting_tpu.engine import apply_interval_scale
-            from distributed_forecasting_tpu.models.base import get_model
+                    forecaster = BucketedForecaster.from_bucketed_fit(
+                        buckets, model, config
+                    )
+                else:
+                    forecaster = BatchForecaster.from_fit(
+                        batch, params, model, config,
+                        interval_scale=interval_scale,
+                    )
+                forecaster.save(run.artifact_path("forecaster"))
 
-            interval_scale = cv_metrics["_interval_scale"]
-            _, lo_c, hi_c = apply_interval_scale(
-                result.yhat, result.lo, result.hi, interval_scale,
-                floor=get_model(model).band_floor,
+                if per_series_runs:
+                    self._log_per_series_runs(eid, series_table, run.run_id)
+
+                run_id = run.run_id
+
+            table_df = forecast_frame(batch, result)
+            version = self.catalog.save_table(output_table, table_df)
+            self.logger.info(
+                "wrote %s (version %s): %d rows; fit %.2fs (%.1f series/s); "
+                "%d/%d series ok",
+                output_table, version, len(table_df), fit_seconds,
+                agg["series_per_second"], batch.n_series - n_failed,
+                batch.n_series,
             )
-            result = _dc.replace(result, lo=lo_c, hi=hi_c)
-        fit_seconds = time.time() - t_start
+            if n_failed:
+                self.logger.warning(
+                    "partial model: %d series fell back", n_failed)
+            return {
+                "experiment_id": eid,
+                "run_id": run_id,
+                "table_version": version,
+                "n_series": batch.n_series,
+                "n_failed": n_failed,
+                "fit_seconds": fit_seconds,
+                "metrics": {k: v for k, v in agg.items()},
+            }
 
-        ok = np.asarray(result.ok)
-        n_failed = int((~ok).sum())
-        if n_failed == batch.n_series:
-            # the reference's automl post-pass raises when nothing trained
-            # (notebooks/automl/...py:151-156)
-            raise RuntimeError("no series trained successfully")
-
-        eid = self.tracker.create_experiment(experiment)
-        with self.tracker.start_run(
-            eid,
-            run_name=f"batched_{model}_fit",
-            tags={"model": model, "partial_model": str(n_failed > 0)},
-        ) as run:
-            from distributed_forecasting_tpu.models import prophet_glm
-
-            if bucketed:
-                import dataclasses as _dc
-
-                run.log_params(_dc.asdict(config))
-                run.log_params({"n_buckets": len(buckets)})
-            elif model in ("prophet", "curve"):
-                run.log_params(prophet_glm.extract_params(params, config))
-            else:
-                import dataclasses as _dc
-
-                run.log_params(_dc.asdict(config))
-            from distributed_forecasting_tpu.data.tensorize import resolved_backend
-
-            run.log_params(
-                {
-                    "n_series": batch.n_series,
-                    "n_time": batch.n_time,
-                    "horizon": horizon,
-                    "n_failed_series": n_failed,
-                    # which host data plane produced the tensor (the
-                    # phase_tensorize_seconds metric is comparable across
-                    # backends; see data/tensorize.py)
-                    # the native path is daily-only; record what actually ran
-                    "tensorize_backend": (
-                        resolved_backend(n_keys=len(key_cols))
-                        if batch.freq == "D" else "pandas"
-                    ),
-                    **_comparability_params(batch, cv),
-                }
-            )
-            agg = {"fit_seconds": fit_seconds,
-                   "series_per_second": batch.n_series / max(fit_seconds, 1e-9)}
-            agg.update(timer.metrics())  # per-phase wall-clock tracing
-            series_table = batch.key_frame()
-            series_table["fit_ok"] = ok
-            if cv_metrics is not None:
-                for name in _METRICS:
-                    vals = np.asarray(cv_metrics[name])
-                    series_table[name] = vals
-                    # nanmean: a per-series NaN (e.g. mase on a constant
-                    # training window) must not poison the aggregate
-                    agg[f"val_{name}"] = float(np.nanmean(vals[ok])) if ok.any() else float("nan")
-                agg["n_cv_cutoffs"] = cv_metrics["_n_cutoffs"]
-            if interval_scale is not None:
-                scales = np.asarray(interval_scale)
-                series_table["interval_scale"] = scales
-                agg["interval_scale_mean"] = float(np.mean(scales[ok])) if ok.any() else float("nan")
-                # raw val_coverage stays above; this is the shipped band's
-                cov_c = np.asarray(cv_metrics["_coverage_calibrated"])
-                series_table["coverage_calibrated"] = cov_c
-                agg["val_coverage_calibrated"] = float(np.mean(cov_c[ok])) if ok.any() else float("nan")
-            run.log_metrics(agg)
-            run.log_table("series_metrics.parquet", series_table)
-            if cv_artifact and run_cross_validation:
-                # raw per-cutoff forecasts (Prophet diagnostics shape),
-                # computed in the cross_validation phase above — opt-in: at
-                # 500x1826x3 it is a ~2.7M-row parquet
-                run.log_table("cv_forecasts.parquet", cv_frame)
-
-            if bucketed:
-                from distributed_forecasting_tpu.serving import (
-                    BucketedForecaster,
-                )
-
-                forecaster = BucketedForecaster.from_bucketed_fit(
-                    buckets, model, config
-                )
-            else:
-                forecaster = BatchForecaster.from_fit(
-                    batch, params, model, config,
-                    interval_scale=interval_scale,
-                )
-            forecaster.save(run.artifact_path("forecaster"))
-
-            if per_series_runs:
-                self._log_per_series_runs(eid, series_table, run.run_id)
-
-            run_id = run.run_id
-
-        table_df = forecast_frame(batch, result)
-        version = self.catalog.save_table(output_table, table_df)
-        self.logger.info(
-            "wrote %s (version %s): %d rows; fit %.2fs (%.1f series/s); "
-            "%d/%d series ok",
-            output_table, version, len(table_df), fit_seconds,
-            agg["series_per_second"], batch.n_series - n_failed, batch.n_series,
-        )
-        if n_failed:
-            self.logger.warning("partial model: %d series fell back", n_failed)
-        return {
-            "experiment_id": eid,
-            "run_id": run_id,
-            "table_version": version,
-            "n_series": batch.n_series,
-            "n_failed": n_failed,
-            "fit_seconds": fit_seconds,
-            "metrics": {k: v for k, v in agg.items()},
-        }
+        return self._run_stages(experiment, prep, dispatch, complete,
+                                _executor)
 
     # ------------------------------------------------------------- tuned fit
     def _fine_grained_tuned(
@@ -573,6 +675,7 @@ class TrainingPipeline:
         horizon: int,
         key_cols,
         regressors: Optional[Dict[str, Any]] = None,
+        _executor=None,
     ) -> Dict[str, Any]:
         """Per-series hyperparameter-tuned curve-model training (AutoML-path
         parity, ``notebooks/automl/22-09-26...py:107-178``): vectorized
@@ -589,144 +692,167 @@ class TrainingPipeline:
         )
         from distributed_forecasting_tpu.models import prophet_glm
 
-        df = self.catalog.read_table(source_table)
-        batch = tensorize(df, key_cols=key_cols)
-        base = _config_from_conf(
-            "prophet", _resolve_holidays_conf(model_conf, batch, horizon)
-        )
-        xreg = None
-        if regressors:
-            xreg, base = _load_regressors(
-                self.catalog, regressors, batch, horizon, base
+        def prep() -> Dict[str, Any]:
+            df = self.catalog.read_table(source_table)
+            batch = tensorize(df, key_cols=key_cols)
+            base = _config_from_conf(
+                "prophet", _resolve_holidays_conf(model_conf, batch, horizon)
             )
-        search = HyperSearchConfig(
-            n_trials=int(tuning.get("n_trials", 8)),
-            metric=tuning.get("metric", "smape"),
-            seed=int(tuning.get("seed", 0)),
-            # TPE-parity adaptive zoom: rounds > 1 resample per series
-            # around incumbents with shrinking width (engine/hyper.py)
-            adaptive_rounds=int(tuning.get("adaptive_rounds", 1)),
-            zoom_sigma=float(tuning.get("zoom_sigma", 0.8)),
-            zoom_factor=float(tuning.get("zoom_factor", 0.5)),
-        )
-        cv = CVConfig(**(cv_conf or {}))
-
-        t_start = time.time()
-        # tune sees the (trimmed) history xreg; the refit params carry the
-        # regressor coefficients so the serving artifact works with the
-        # same covariate table (inference.regressors conf)
-        tuned = tune_curve_model(batch, base_config=base, search=search,
-                                 cv=cv, xreg=xreg)
-
-        # per-mode forecasts over history+horizon, combined by winning mode
-        # (day grid built on device — no scalar pulls)
-        from distributed_forecasting_tpu.engine.fit import day_grid
-
-        day_all = day_grid(batch.day, horizon)
-        t_end = batch.day[-1].astype(_jnp.float32)
-        import dataclasses as _dc
-
-        outs = {}
-        for mode, params in tuned.mode_params.items():
-            cfg_m = _dc.replace(base, seasonality_mode=mode)
-            outs[mode] = prophet_glm.forecast(
-                params, day_all, t_end, cfg_m, _jax.random.PRNGKey(0),
-                xreg=xreg,
+            xreg = None
+            if regressors:
+                xreg, base = _load_regressors(
+                    self.catalog, regressors, batch, horizon, base
+                )
+            search = HyperSearchConfig(
+                n_trials=int(tuning.get("n_trials", 8)),
+                metric=tuning.get("metric", "smape"),
+                seed=int(tuning.get("seed", 0)),
+                # TPE-parity adaptive zoom: rounds > 1 resample per series
+                # around incumbents with shrinking width (engine/hyper.py)
+                adaptive_rounds=int(tuning.get("adaptive_rounds", 1)),
+                zoom_sigma=float(tuning.get("zoom_sigma", 0.8)),
+                zoom_factor=float(tuning.get("zoom_factor", 0.5)),
             )
-        # per-series winning-mode gather stays ON DEVICE: stack per-mode
-        # outputs (M, S, T) and index with the (S,) mode-pick vector — only
-        # the pick indices (strings, inherently host data) cross the boundary
-        modes = list(tuned.mode_params)
-        sel = np.asarray(tuned.best_mode)
-        pick = _jnp.asarray([modes.index(m) for m in sel])  # (S,)
-        arange_s = _jnp.arange(pick.shape[0])
-        yhat = _jnp.stack([outs[m][0] for m in modes])[pick, arange_s]
-        lo = _jnp.stack([outs[m][1] for m in modes])[pick, arange_s]
-        hi = _jnp.stack([outs[m][2] for m in modes])[pick, arange_s]
-        # same fail-safe contract as the plain path (engine/fit.py
-        # health_fallback): min_points gating + seasonal-naive splice with
-        # lead-time-widening bands — a degenerate series gets the fallback,
-        # not NaN-free garbage from a tuned refit on two points
-        from distributed_forecasting_tpu.engine.fit import (
-            DEFAULT_MIN_POINTS,
-            health_fallback,
-        )
+            cv = CVConfig(**(cv_conf or {}))
+            return {"batch": batch, "base": base, "xreg": xreg,
+                    "search": search, "cv": cv}
 
-        yhat, lo, hi, ok = health_fallback(
-            batch.y, batch.mask, yhat, lo, hi, horizon,
-            min_points=DEFAULT_MIN_POINTS,
-        )
-        fit_seconds = time.time() - t_start
+        def dispatch(state: Dict[str, Any]) -> Dict[str, Any]:
+            batch, base = state["batch"], state["base"]
+            xreg, search, cv = state["xreg"], state["search"], state["cv"]
+            t_start = time.time()
+            # tune sees the (trimmed) history xreg; the refit params carry
+            # the regressor coefficients so the serving artifact works with
+            # the same covariate table (inference.regressors conf).  The
+            # trial loop inside is the deepest pipeline: many independent
+            # dispatches per experiment.
+            tuned = tune_curve_model(batch, base_config=base, search=search,
+                                     cv=cv, xreg=xreg)
 
-        result = ForecastResult(yhat=yhat, lo=lo, hi=hi, ok=ok, day_all=day_all)
-        n_failed = int((~np.asarray(ok)).sum())
-        if n_failed == batch.n_series:
-            raise RuntimeError("no series trained successfully")
-        if n_failed:
-            self.logger.warning(
-                "tuned partial model: %d series fell back", n_failed
+            # per-mode forecasts over history+horizon, combined by winning
+            # mode (day grid built on device — no scalar pulls)
+            from distributed_forecasting_tpu.engine.fit import day_grid
+
+            day_all = day_grid(batch.day, horizon)
+            t_end = batch.day[-1].astype(_jnp.float32)
+            import dataclasses as _dc
+
+            outs = {}
+            for mode, params in tuned.mode_params.items():
+                cfg_m = _dc.replace(base, seasonality_mode=mode)
+                outs[mode] = prophet_glm.forecast(
+                    params, day_all, t_end, cfg_m, _jax.random.PRNGKey(0),
+                    xreg=xreg,
+                )
+            # per-series winning-mode gather stays ON DEVICE: stack per-mode
+            # outputs (M, S, T) and index with the (S,) mode-pick vector —
+            # only the pick indices (strings, inherently host data) cross
+            # the boundary
+            modes = list(tuned.mode_params)
+            sel = np.asarray(tuned.best_mode)
+            pick = _jnp.asarray([modes.index(m) for m in sel])  # (S,)
+            arange_s = _jnp.arange(pick.shape[0])
+            yhat = _jnp.stack([outs[m][0] for m in modes])[pick, arange_s]
+            lo = _jnp.stack([outs[m][1] for m in modes])[pick, arange_s]
+            hi = _jnp.stack([outs[m][2] for m in modes])[pick, arange_s]
+            # same fail-safe contract as the plain path (engine/fit.py
+            # health_fallback): min_points gating + seasonal-naive splice
+            # with lead-time-widening bands — a degenerate series gets the
+            # fallback, not NaN-free garbage from a tuned refit on two points
+            from distributed_forecasting_tpu.engine.fit import (
+                DEFAULT_MIN_POINTS,
+                health_fallback,
             )
 
-        eid = self.tracker.create_experiment(experiment)
-        with self.tracker.start_run(
-            eid, run_name="tuned_curve_fit",
-            tags={"model": "prophet", "tuned": "true",
-                  "partial_model": str(n_failed > 0)},
-        ) as run:
-            run.log_params(
-                {
-                    "n_trials": search.n_trials,
-                    "selection_metric": search.metric,
-                    "n_series": batch.n_series,
-                    "horizon": horizon,
-                    **_comparability_params(batch, cv),
-                }
+            yhat, lo, hi, ok = health_fallback(
+                batch.y, batch.mask, yhat, lo, hi, horizon,
+                min_points=DEFAULT_MIN_POINTS,
             )
-            # mean over healthy series with a finite CV score — a fallback
-            # series' score is +inf (engine/hyper.py), and a series can be
-            # ok (enough history for a forecast) yet have no observed points
-            # in any CV eval window, which is also +inf
-            scores = np.asarray(tuned.best_score)[np.asarray(ok)]
-            scores = scores[np.isfinite(scores)]
-            val_score = float(np.mean(scores)) if scores.size else float("nan")
-            run.log_metrics(
-                {
-                    f"val_{search.metric}": val_score,
-                    "fit_seconds": fit_seconds,
-                    "n_failed_series": float(n_failed),
-                }
-            )
-            run.log_table("trials.parquet", tuned.trials)
-            series_table = batch.key_frame()
-            series_table["best_mode"] = sel
-            series_table["best_changepoint_prior_scale"] = tuned.best_cp_scale
-            series_table["best_seasonality_prior_scale"] = tuned.best_seas_scale
-            series_table["best_holidays_prior_scale"] = tuned.best_hol_scale
-            series_table[f"best_{search.metric}"] = tuned.best_score
-            run.log_table("series_metrics.parquet", series_table)
-            forecaster = BatchForecaster.from_fit(
-                batch, tuned.params, "prophet", tuned.config
-            )
-            forecaster.save(run.artifact_path("forecaster"))
-            run_id = run.run_id
+            result = ForecastResult(
+                yhat=yhat, lo=lo, hi=hi, ok=ok, day_all=day_all)
+            state.update(t_start=t_start, tuned=tuned, modes=modes, sel=sel,
+                         result=result)
+            return state
 
-        table_df = forecast_frame(batch, result)
-        version = self.catalog.save_table(output_table, table_df)
-        self.logger.info(
-            "tuned fit: %d series, %d trials x %d modes x %d rounds in "
-            "%.2fs -> %s v%s",
-            batch.n_series, search.n_trials, len(modes),
-            search.adaptive_rounds, fit_seconds, output_table, version,
-        )
-        return {
-            "experiment_id": eid,
-            "run_id": run_id,
-            "table_version": version,
-            "n_series": batch.n_series,
-            "n_failed": n_failed,
-            "fit_seconds": fit_seconds,
-            "metrics": {f"val_{search.metric}": val_score},
-        }
+        def complete(state: Dict[str, Any]) -> Dict[str, Any]:
+            batch, search, cv = state["batch"], state["search"], state["cv"]
+            tuned, modes, sel = state["tuned"], state["modes"], state["sel"]
+            result = state["result"]
+            fit_seconds = time.time() - state["t_start"]
+            ok = result.ok
+            n_failed = int((~np.asarray(ok)).sum())
+            if n_failed == batch.n_series:
+                raise RuntimeError("no series trained successfully")
+            if n_failed:
+                self.logger.warning(
+                    "tuned partial model: %d series fell back", n_failed
+                )
+
+            eid = self.tracker.create_experiment(experiment)
+            with self.tracker.start_run(
+                eid, run_name="tuned_curve_fit",
+                tags={"model": "prophet", "tuned": "true",
+                      "partial_model": str(n_failed > 0)},
+            ) as run:
+                run.log_params(
+                    {
+                        "n_trials": search.n_trials,
+                        "selection_metric": search.metric,
+                        "n_series": batch.n_series,
+                        "horizon": horizon,
+                        **_comparability_params(batch, cv),
+                    }
+                )
+                # mean over healthy series with a finite CV score — a
+                # fallback series' score is +inf (engine/hyper.py), and a
+                # series can be ok (enough history for a forecast) yet have
+                # no observed points in any CV eval window, which is also
+                # +inf
+                scores = np.asarray(tuned.best_score)[np.asarray(ok)]
+                scores = scores[np.isfinite(scores)]
+                val_score = (
+                    float(np.mean(scores)) if scores.size else float("nan"))
+                run.log_metrics(
+                    {
+                        f"val_{search.metric}": val_score,
+                        "fit_seconds": fit_seconds,
+                        "n_failed_series": float(n_failed),
+                    }
+                )
+                run.log_table("trials.parquet", tuned.trials)
+                series_table = batch.key_frame()
+                series_table["best_mode"] = sel
+                series_table["best_changepoint_prior_scale"] = tuned.best_cp_scale
+                series_table["best_seasonality_prior_scale"] = tuned.best_seas_scale
+                series_table["best_holidays_prior_scale"] = tuned.best_hol_scale
+                series_table[f"best_{search.metric}"] = tuned.best_score
+                run.log_table("series_metrics.parquet", series_table)
+                forecaster = BatchForecaster.from_fit(
+                    batch, tuned.params, "prophet", tuned.config
+                )
+                forecaster.save(run.artifact_path("forecaster"))
+                run_id = run.run_id
+
+            table_df = forecast_frame(batch, result)
+            version = self.catalog.save_table(output_table, table_df)
+            self.logger.info(
+                "tuned fit: %d series, %d trials x %d modes x %d rounds in "
+                "%.2fs -> %s v%s",
+                batch.n_series, search.n_trials, len(modes),
+                search.adaptive_rounds, fit_seconds, output_table, version,
+            )
+            return {
+                "experiment_id": eid,
+                "run_id": run_id,
+                "table_version": version,
+                "n_series": batch.n_series,
+                "n_failed": n_failed,
+                "fit_seconds": fit_seconds,
+                "metrics": {f"val_{search.metric}": val_score},
+            }
+
+        return self._run_stages(experiment, prep, dispatch, complete,
+                                _executor)
 
     # ---------------------------------------------------------- auto select
     def _fine_grained_auto(
@@ -740,6 +866,7 @@ class TrainingPipeline:
         key_cols,
         seed: int,
         freq: str = "D",
+        _executor=None,
     ) -> Dict[str, Any]:
         """Per-series best-of across model families (``engine/select.py``) —
         the cross-family analogue of the AutoML path's per-series tuning.
@@ -754,25 +881,52 @@ class TrainingPipeline:
         mc = model_conf or {}
         families = tuple(mc.get("families", DEFAULT_FAMILIES))
         metric = mc.get("metric", "smape")
-        cv = CVConfig(**(cv_conf or {}))
 
-        df = self.catalog.read_table(source_table)
-        batch = tensorize(df, key_cols=key_cols, freq=freq)
-        configs = {
-            name: _config_from_conf(
-                name, _resolve_model_conf(name, c, batch, horizon, cv_conf)
+        def prep() -> Dict[str, Any]:
+            cv = CVConfig(**(cv_conf or {}))
+            df = self.catalog.read_table(source_table)
+            batch = tensorize(df, key_cols=key_cols, freq=freq)
+            configs = {
+                name: _config_from_conf(
+                    name, _resolve_model_conf(name, c, batch, horizon,
+                                              cv_conf)
+                )
+                for name, c in (mc.get("configs") or {}).items()
+            }
+            return {"cv": cv, "batch": batch, "configs": configs}
+
+        def dispatch(state: Dict[str, Any]) -> Dict[str, Any]:
+            t_start = time.time()
+            params_by_family, selection, result = fit_forecast_auto(
+                state["batch"], models=families, configs=state["configs"],
+                metric=metric, cv=state["cv"], horizon=horizon,
+                key=jax.random.PRNGKey(seed),
             )
-            for name, c in (mc.get("configs") or {}).items()
-        }
-        t_start = time.time()
-        params_by_family, selection, result = fit_forecast_auto(
-            batch, models=families, configs=configs, metric=metric, cv=cv,
-            horizon=horizon, key=jax.random.PRNGKey(seed),
-        )
-        jax.block_until_ready(result.yhat)
-        fit_seconds = time.time() - t_start
+            state.update(t_start=t_start, params_by_family=params_by_family,
+                         selection=selection, result=result)
+            return state
 
-        eid = self.tracker.create_experiment(experiment)
+        def complete(state: Dict[str, Any]) -> Dict[str, Any]:
+            batch, cv, configs = (
+                state["batch"], state["cv"], state["configs"])
+            params_by_family = state["params_by_family"]
+            selection, result = state["selection"], state["result"]
+            fit_seconds = time.time() - state["t_start"]
+
+            eid = self.tracker.create_experiment(experiment)
+            return self._complete_auto(
+                eid, batch, cv, configs, params_by_family, selection, result,
+                fit_seconds, families, metric, horizon, output_table,
+            )
+
+        return self._run_stages(experiment, prep, dispatch, complete,
+                                _executor)
+
+    def _complete_auto(self, eid, batch, cv, configs, params_by_family,
+                       selection, result, fit_seconds, families, metric,
+                       horizon, output_table) -> Dict[str, Any]:
+        from distributed_forecasting_tpu.serving.ensemble import MultiModelForecaster
+
         with self.tracker.start_run(
             eid, run_name="auto_select_fit",
             tags={"model": "auto", "families": ",".join(families)},
@@ -846,6 +1000,7 @@ class TrainingPipeline:
         seed: int,
         freq: str = "D",
         calibrate_intervals: bool = False,
+        _executor=None,
     ) -> Dict[str, Any]:
         """Per-series weighted cross-family pool (``engine/blend``) — where
         the auto path picks each series' single winner, this combines all
@@ -861,26 +1016,50 @@ class TrainingPipeline:
         families = tuple(mc.get("families", DEFAULT_FAMILIES))
         metric = mc.get("metric", "smape")
         temperature = float(mc.get("temperature", 1.0))
-        cv = CVConfig(**(cv_conf or {}))
 
-        df = self.catalog.read_table(source_table)
-        batch = tensorize(df, key_cols=key_cols, freq=freq)
-        configs = {
-            name: _config_from_conf(
-                name, _resolve_model_conf(name, c, batch, horizon, cv_conf)
+        def prep() -> Dict[str, Any]:
+            cv = CVConfig(**(cv_conf or {}))
+            df = self.catalog.read_table(source_table)
+            batch = tensorize(df, key_cols=key_cols, freq=freq)
+            configs = {
+                name: _config_from_conf(
+                    name, _resolve_model_conf(name, c, batch, horizon,
+                                              cv_conf)
+                )
+                for name, c in (mc.get("configs") or {}).items()
+            }
+            return {"cv": cv, "batch": batch, "configs": configs}
+
+        def dispatch(state: Dict[str, Any]) -> Dict[str, Any]:
+            t_start = time.time()
+            params_by_family, blend, result = fit_forecast_blend(
+                state["batch"], models=families, configs=state["configs"],
+                metric=metric, cv=state["cv"], horizon=horizon,
+                key=jax.random.PRNGKey(seed), temperature=temperature,
+                calibrate=calibrate_intervals,
             )
-            for name, c in (mc.get("configs") or {}).items()
-        }
-        t_start = time.time()
-        params_by_family, blend, result = fit_forecast_blend(
-            batch, models=families, configs=configs, metric=metric, cv=cv,
-            horizon=horizon, key=jax.random.PRNGKey(seed),
-            temperature=temperature, calibrate=calibrate_intervals,
-        )
-        jax.block_until_ready(result.yhat)
-        fit_seconds = time.time() - t_start
+            state.update(t_start=t_start, params_by_family=params_by_family,
+                         blend=blend, result=result)
+            return state
 
-        eid = self.tracker.create_experiment(experiment)
+        def complete(state: Dict[str, Any]) -> Dict[str, Any]:
+            fit_seconds = time.time() - state["t_start"]
+            eid = self.tracker.create_experiment(experiment)
+            return self._complete_blend(
+                eid, state["batch"], state["cv"], state["configs"],
+                state["params_by_family"], state["blend"], state["result"],
+                fit_seconds, families, metric, temperature, horizon,
+                output_table,
+            )
+
+        return self._run_stages(experiment, prep, dispatch, complete,
+                                _executor)
+
+    def _complete_blend(self, eid, batch, cv, configs, params_by_family,
+                        blend, result, fit_seconds, families, metric,
+                        temperature, horizon, output_table) -> Dict[str, Any]:
+        from distributed_forecasting_tpu.serving.ensemble import BlendedForecaster
+
         with self.tracker.start_run(
             eid, run_name="blended_fit",
             tags={"model": "blend", "families": ",".join(families)},
@@ -992,23 +1171,24 @@ class TrainingPipeline:
                 "O(S) host loop) — prefer the batched run's "
                 "series_metrics.parquet at this scale", n,
             )
+        # one buffered batch append + one directory fsync for the whole
+        # experiment (tracking/filestore.py log_runs_batch), instead of
+        # ~8 file ops per series in the hot loop
+        rows = []
         for i, row in enumerate(series_table.itertuples(index=False)):
             d = row._asdict()
-            name = f"run_item_{d.get('item')}_store_{d.get('store')}"
-            with self.tracker.start_run(
-                eid,
-                run_name=name,
-                tags={
+            rows.append({
+                "run_name": f"run_item_{d.get('item')}_store_{d.get('store')}",
+                "tags": {
                     "parent_run_id": parent,
                     "artifact_run_id": parent,
                     "artifact_path": "forecaster",
                     "series_index": str(i),
                 },
-            ) as r:
-                r.log_metrics(
-                    {k: float(v) for k, v in d.items()
-                     if k in _METRICS and np.isfinite(v)}
-                )
+                "metrics": {k: float(v) for k, v in d.items()
+                            if k in _METRICS and np.isfinite(v)},
+            })
+        self.tracker.log_runs_batch(eid, rows)
 
     # ------------------------------------------------------------- allocated
     def allocated(

@@ -106,14 +106,19 @@ class ServingMetrics:
             "requests coalesced into each dispatch")
 
     def render(self) -> str:
-        # The compile-cache registry rides along on /metrics so operators
-        # can watch warmup hit/miss behaviour without a second endpoint.
+        # The compile-cache and training-pipeline registries ride along on
+        # /metrics so operators can watch warmup hit/miss behaviour and
+        # executor occupancy without a second endpoint.
         from distributed_forecasting_tpu.engine.compile_cache import (
             metrics_registry,
         )
+        from distributed_forecasting_tpu.monitoring.monitor import (
+            pipeline_metrics,
+        )
 
         return (self.registry.render_prometheus()
-                + metrics_registry().render_prometheus())
+                + metrics_registry().render_prometheus()
+                + pipeline_metrics().registry.render_prometheus())
 
     def snapshot(self) -> dict:
         return self.registry.snapshot()

@@ -101,6 +101,22 @@ class Task(ABC):
             configure_compile_cache(
                 CompileCacheConfig.from_conf(cc, default_root=root)
             )
+        # Pipelined training executor (engine/executor.py): overlap host
+        # prep, device compute, and tracking I/O across experiments:
+        #
+        #     pipeline:
+        #       enabled: true
+        #       max_in_flight: 2         # dispatched-but-uncompleted bound
+        #       prefetch_depth: 1        # device_put lookahead (span buckets)
+        #       async_tracking: true     # false -> serial reference path
+        pl = self.conf.get("pipeline") if isinstance(self.conf, dict) else None
+        if pl is not None:
+            from distributed_forecasting_tpu.engine.executor import (
+                PipelineConfig,
+                configure_pipeline,
+            )
+
+            configure_pipeline(PipelineConfig.from_conf(pl))
 
     # lazy infra handles ----------------------------------------------------
     @property

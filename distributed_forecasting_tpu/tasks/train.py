@@ -65,6 +65,11 @@ Librarized equivalent of the reference's training notebook entry point
       eviction_policy: lru          # (parsed by the Task base class —
       aot_store: true               # see tasks/common.py and
       min_compile_time_s: 0.0       # engine/compile_cache.py)
+    pipeline:                       # optional pipelined executor: host prep
+      enabled: true                 # and tracking I/O overlap device compute
+      max_in_flight: 2              # (parsed by the Task base class — see
+      prefetch_depth: 1             # engine/executor.py and
+      async_tracking: true          # docs/pipeline.md; byte-identical)
 """
 
 from __future__ import annotations

@@ -203,6 +203,62 @@ class FileTracker:
         )
         return Run(self, experiment_id, rid)
 
+    def log_runs_batch(self, experiment_id: str, rows: List[Dict]) -> List[str]:
+        """Write many small finished runs in one buffered pass.
+
+        ``rows``: dicts with ``run_name`` and optional ``tags`` / ``params``
+        / ``metrics``.  Where :meth:`start_run` + ``log_metrics`` + ``end``
+        costs ~5 file operations and 3 ``os.replace`` fsync-ish barriers per
+        run (pathological for the per-series drill-down loop, which creates
+        one run per SERIES), this writes each run's ``meta.json`` /
+        ``params.json`` / ``metrics.json`` exactly once with plain buffered
+        I/O and issues a single directory fsync at the end of the batch —
+        one durability point per experiment batch, not per row.
+
+        Runs are born ``FINISHED`` (their data is complete by construction),
+        so the layout stays exactly what ``search_runs`` and the MLflow
+        adapter already read.  Returns the new run ids in row order.
+        """
+        base = os.path.join(self.root, "experiments", experiment_id, "runs")
+        os.makedirs(base, exist_ok=True)
+        t = _now()
+        rids: List[str] = []
+        for row in rows:
+            rid = uuid.uuid4().hex[:16]
+            d = os.path.join(base, rid)
+            os.makedirs(os.path.join(d, "artifacts"), exist_ok=True)
+            meta = {
+                "run_id": rid,
+                "run_name": row.get("run_name") or rid,
+                "status": "FINISHED",
+                "start_time": t,
+                "end_time": t,
+                "tags": {k: str(v)
+                         for k, v in (row.get("tags") or {}).items()},
+            }
+            with open(os.path.join(d, "meta.json"), "w") as f:
+                json.dump(meta, f, indent=2, default=_jsonable)
+            params = row.get("params")
+            if params:
+                with open(os.path.join(d, "params.json"), "w") as f:
+                    json.dump({k: _jsonable(v) for k, v in params.items()},
+                              f, indent=2, default=_jsonable)
+            metrics = row.get("metrics")
+            if metrics:
+                hist = {k: [[0, float(v)]] for k, v in metrics.items()}
+                with open(os.path.join(d, "metrics.json"), "w") as f:
+                    json.dump(hist, f, indent=2)
+            rids.append(rid)
+        # one durability barrier for the whole batch: flush the runs
+        # directory so the new entries survive a crash (the per-file
+        # contents went through buffered writes above)
+        fd = os.open(base, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+        return rids
+
     def get_run(self, experiment_id: str, run_id: str) -> Run:
         if not os.path.isdir(self._run_dir(experiment_id, run_id)):
             raise KeyError(f"run {run_id} not found in experiment {experiment_id}")

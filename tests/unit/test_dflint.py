@@ -103,6 +103,70 @@ def test_host_sync_reaches_callees_of_jit_entries(tmp_path):
     assert len(found) == 1 and "inner" in found[0].message
 
 
+def test_host_sync_flags_bare_block_until_ready(tmp_path):
+    # explicit syncs in the hot layers de-pipeline the executor even
+    # OUTSIDE traced code; both spellings (module fn + array method)
+    _write(tmp_path, "engine/sync.py", """
+        import jax
+
+        def pull_everything(result):
+            jax.block_until_ready(result.yhat)
+            return result
+
+        def method_spelling(arr):
+            arr.block_until_ready()
+            return arr
+    """)
+    found = _lint(tmp_path, "engine/sync.py")
+    assert [f.rule for f in found] == ["host-sync-in-hot-path"] * 2
+    assert all("sanctioned_pull" in f.message for f in found)
+
+
+def test_host_sync_covers_pipelines_dir(tmp_path):
+    _write(tmp_path, "pipelines/train.py", """
+        import jax
+
+        def run(result):
+            jax.block_until_ready(result)
+    """)
+    assert len(_lint(tmp_path, "pipelines/train.py")) == 1
+
+
+def test_host_sync_sanctioned_pull_exempts(tmp_path):
+    # the structural escape hatch: the ONE function that is supposed to
+    # block is decorated @sanctioned_pull — any decorator spelling
+    _write(tmp_path, "engine/ok_sync.py", """
+        import jax
+        from distributed_forecasting_tpu.engine.executor import (
+            sanctioned_pull,
+        )
+        from distributed_forecasting_tpu.engine import executor
+
+        @sanctioned_pull
+        def device_pull(tree):
+            return jax.block_until_ready(tree)
+
+        @executor.sanctioned_pull
+        def other_pull(tree):
+            return jax.block_until_ready(tree)
+
+        def caller(tree):
+            return device_pull(tree)     # routing through it stays clean
+    """)
+    assert _lint(tmp_path, "engine/ok_sync.py") == []
+
+
+def test_host_sync_block_until_ready_outside_hot_dirs_ok(tmp_path):
+    # bench/workflow/host layers may sync freely — the rule is scoped
+    _write(tmp_path, "workflows/bench_helper.py", """
+        import jax
+
+        def timed(result):
+            jax.block_until_ready(result)
+    """)
+    assert _lint(tmp_path, "workflows/bench_helper.py") == []
+
+
 # ---------------------------------------------------------------------------
 # tracer-leak
 # ---------------------------------------------------------------------------

@@ -1,0 +1,433 @@
+"""Pipelined training executor (engine/executor.py).
+
+Three concern groups:
+
+* executor mechanics — config parsing, FIFO completion order, bounded
+  in-flight, error propagation (a failing stage C must fail the experiment,
+  not vanish into the writer thread), serial degradation, prefetch;
+* the determinism contract — pipelined and serial paths produce
+  byte-identical forecast tables, per-series CV metrics, and serving
+  artifacts for every model family (incl. the bucketed path);
+* injected tracking failure — a tracker write that raises fails the
+  experiment and marks the run FAILED.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from distributed_forecasting_tpu.engine.executor import (
+    PipelineConfig,
+    TrainingExecutor,
+    device_pull,
+    prefetch_to_device,
+    sanctioned_pull,
+)
+
+# ---------------------------------------------------------------------- conf
+
+
+def test_pipeline_config_defaults():
+    c = PipelineConfig.from_conf(None)
+    assert c.enabled and c.async_tracking
+    assert c.max_in_flight == 2
+    assert c.prefetch_depth == 1
+
+
+def test_pipeline_config_from_conf():
+    c = PipelineConfig.from_conf(
+        {"enabled": False, "max_in_flight": 4, "prefetch_depth": 0,
+         "async_tracking": False})
+    assert not c.enabled and not c.async_tracking
+    assert c.max_in_flight == 4 and c.prefetch_depth == 0
+
+
+def test_pipeline_config_rejects_unknown_keys():
+    with pytest.raises(ValueError, match="unknown pipeline conf keys"):
+        PipelineConfig.from_conf({"max_inflight": 3})
+
+
+def test_pipeline_config_validates_bounds():
+    with pytest.raises(ValueError, match="max_in_flight"):
+        PipelineConfig(max_in_flight=0)
+    with pytest.raises(ValueError, match="prefetch_depth"):
+        PipelineConfig(prefetch_depth=-1)
+
+
+def test_sanctioned_pull_marker():
+    assert getattr(device_pull, "__dftpu_sanctioned_pull__", False)
+
+    @sanctioned_pull
+    def my_pull(x):
+        return x
+
+    assert my_pull.__dftpu_sanctioned_pull__
+
+
+# ----------------------------------------------------------------- mechanics
+
+
+def _noop_prep():
+    return {}
+
+
+def test_executor_completes_in_submission_order():
+    completed = []
+
+    def make(i, delay):
+        def dispatch(state):
+            state["i"] = i
+            state["delay"] = delay
+            return state
+
+        def complete(state):
+            # earlier experiments sleeping longer must still complete first
+            time.sleep(state["delay"])
+            completed.append(state["i"])
+            return state["i"]
+
+        return dispatch, complete
+
+    ex = TrainingExecutor(PipelineConfig(max_in_flight=3))
+    with ex:
+        handles = []
+        for i, delay in enumerate([0.05, 0.0, 0.02, 0.0]):
+            d, c = make(i, delay)
+            handles.append(ex.submit(f"e{i}", _noop_prep, d, c))
+        ex.flush()
+    assert completed == [0, 1, 2, 3]
+    assert [h.result() for h in handles] == [0, 1, 2, 3]
+
+
+def test_executor_bounds_in_flight():
+    peak = {"now": 0, "max": 0}
+    lock = threading.Lock()
+
+    def dispatch(state):
+        with lock:
+            peak["now"] += 1
+            peak["max"] = max(peak["max"], peak["now"])
+        return state
+
+    def complete(state):
+        time.sleep(0.02)
+        with lock:
+            peak["now"] -= 1
+        return None
+
+    ex = TrainingExecutor(PipelineConfig(max_in_flight=2))
+    with ex:
+        for i in range(6):
+            ex.submit(f"e{i}", _noop_prep, dispatch, complete)
+    # submit blocks once 2 experiments are dispatched-but-uncompleted
+    assert peak["max"] <= 2
+
+
+def test_executor_error_propagates_from_flush_and_handle():
+    boom = RuntimeError("tracking write failed")
+
+    def complete(state):
+        raise boom
+
+    ex = TrainingExecutor(PipelineConfig())
+    h = ex.submit("bad", _noop_prep, lambda s: s, complete)
+    with pytest.raises(RuntimeError, match="tracking write failed") as ei:
+        ex.flush()
+    assert ei.value is boom  # the original exception object, not a copy
+    with pytest.raises(RuntimeError, match="tracking write failed"):
+        h.result(timeout=5)
+    # close after a raised flush must not raise a second time into
+    # an unwinding caller when used as a context manager
+    with pytest.raises(RuntimeError):
+        ex.close()
+
+
+def test_executor_error_does_not_skip_later_experiments():
+    done = []
+
+    def bad_complete(state):
+        raise ValueError("first fails")
+
+    def good_complete(state):
+        done.append(True)
+        return "ok"
+
+    ex = TrainingExecutor(PipelineConfig(max_in_flight=2))
+    h1 = ex.submit("bad", _noop_prep, lambda s: s, bad_complete)
+    h2 = ex.submit("good", _noop_prep, lambda s: s, good_complete)
+    with pytest.raises(ValueError):
+        ex.flush()
+    assert h2.result(timeout=5) == "ok"
+    assert done == [True]
+    with pytest.raises(ValueError):
+        h1.result(timeout=5)
+    with pytest.raises(ValueError):
+        ex.close()
+
+
+def test_executor_prep_error_raises_on_caller_thread():
+    def prep():
+        raise KeyError("bad prep")
+
+    ex = TrainingExecutor(PipelineConfig())
+    with pytest.raises(KeyError):
+        ex.submit("bad", prep, lambda s: s, lambda s: None)
+    # the slot was released: later submits still work
+    h = ex.submit("good", _noop_prep, lambda s: s, lambda s: "ok")
+    ex.flush()
+    assert h.result(timeout=5) == "ok"
+    ex.close()
+
+
+def test_executor_serial_mode_runs_inline():
+    thread_ids = []
+
+    def complete(state):
+        thread_ids.append(threading.get_ident())
+        return "done"
+
+    ex = TrainingExecutor(PipelineConfig(async_tracking=False))
+    h = ex.submit("s", _noop_prep, lambda s: s, complete)
+    assert h.done() and h.result() == "done"
+    assert thread_ids == [threading.get_ident()]  # caller thread, no writer
+    ex.close()
+
+
+def test_executor_close_idempotent_and_submit_after_close():
+    ex = TrainingExecutor(PipelineConfig())
+    ex.submit("a", _noop_prep, lambda s: s, lambda s: None)
+    ex.close()
+    ex.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        ex.submit("b", _noop_prep, lambda s: s, lambda s: None)
+
+
+def test_executor_stage_metrics_shape():
+    ex = TrainingExecutor(PipelineConfig())
+    with ex:
+        ex.submit("a", _noop_prep, lambda s: s, lambda s: None)
+        ex.flush()
+    m = ex.stage_metrics()
+    for stage in ("prep", "dispatch", "pull", "complete"):
+        assert f"pipeline_{stage}_seconds" in m
+    assert m["pipeline_n_experiments"] == 1.0
+    assert m["pipeline_n_completed"] == 1.0
+    assert 0.0 <= m["pipeline_device_idle_fraction"] <= 1.0
+
+
+def test_prefetch_to_device_preserves_order_and_values():
+    import jax.numpy as jnp
+
+    items = [np.full((3,), i, dtype=np.float32) for i in range(7)]
+    for depth in (0, 1, 3, 10):
+        out = list(prefetch_to_device(items, depth=depth))
+        assert len(out) == 7
+        for i, arr in enumerate(out):
+            assert isinstance(arr, jnp.ndarray)
+            np.testing.assert_array_equal(np.asarray(arr), items[i])
+
+
+def test_prefetch_depth_limits_lookahead():
+    placed = []
+
+    def place(x):
+        placed.append(x)
+        return x
+
+    gen = prefetch_to_device(range(10), depth=2, place=place)
+    next(gen)
+    # after one yield, at most 1 (yielded) + 2 (in flight) are placed
+    assert len(placed) <= 3
+
+
+def test_pipeline_metrics_on_serving_metrics_endpoint():
+    from distributed_forecasting_tpu.monitoring.monitor import (
+        pipeline_metrics,
+    )
+    from distributed_forecasting_tpu.serving.batcher import ServingMetrics
+
+    ex = TrainingExecutor(PipelineConfig(), metrics=pipeline_metrics())
+    with ex:
+        ex.submit("m", _noop_prep, lambda s: s, lambda s: None)
+        ex.flush()
+    text = ServingMetrics().render()
+    assert "pipeline_stage_complete_seconds_bucket" in text
+    assert "pipeline_device_idle_fraction" in text
+    assert "pipeline_experiments_total" in text
+
+
+# ------------------------------------------------------------- determinism
+
+FAMILIES = ("prophet", "prophet_ar", "holt_winters", "arima", "theta",
+            "croston")
+
+
+@pytest.fixture(scope="module")
+def tiny_sales():
+    from distributed_forecasting_tpu.data import synthetic_store_item_sales
+
+    return synthetic_store_item_sales(
+        n_stores=2, n_items=2, n_days=150, seed=11)
+
+
+def _run_mode(tmp_path, df, tag, model, enabled, bucketed=False):
+    from distributed_forecasting_tpu.data import DatasetCatalog
+    from distributed_forecasting_tpu.engine.executor import (
+        configure_pipeline,
+    )
+    from distributed_forecasting_tpu.pipelines.training import (
+        TrainingPipeline,
+    )
+    from distributed_forecasting_tpu.tracking import FileTracker
+
+    root = tmp_path / tag
+    cat = DatasetCatalog(str(root / "warehouse"))
+    trk = FileTracker(str(root / "mlruns"))
+    cat.save_table("t.raw.sales", df)
+    configure_pipeline(PipelineConfig(enabled=enabled))
+    try:
+        pipe = TrainingPipeline(cat, trk)
+        res = pipe.fine_grained(
+            "t.raw.sales", "t.fc.out", model=model, horizon=7,
+            cv_conf={"initial": 90, "period": 30, "horizon": 7},
+            bucketed=bucketed, seed=3,
+        )
+    finally:
+        configure_pipeline(PipelineConfig())
+    out = cat.read_table("t.fc.out")
+    run = trk.get_run(res["experiment_id"], res["run_id"])
+    series = pd.read_parquet(
+        run.artifact_path("series_metrics.parquet"))
+    return out, series, run.artifact_path("forecaster")
+
+
+def _assert_frames_identical(a: pd.DataFrame, b: pd.DataFrame):
+    assert list(a.columns) == list(b.columns)
+    for col in a.columns:
+        x, y = a[col].to_numpy(), b[col].to_numpy()
+        if x.dtype.kind in "fc":
+            assert np.array_equal(x, y, equal_nan=True), col
+        else:
+            assert np.array_equal(x, y), col
+
+
+def _assert_artifacts_identical(dir_a: str, dir_b: str):
+    names_a = sorted(os.listdir(dir_a))
+    assert names_a == sorted(os.listdir(dir_b))
+    for name in names_a:
+        pa, pb = os.path.join(dir_a, name), os.path.join(dir_b, name)
+        if name.endswith(".npz"):
+            za, zb = np.load(pa), np.load(pb)
+            assert sorted(za.files) == sorted(zb.files), name
+            for k in za.files:
+                assert np.array_equal(za[k], zb[k], equal_nan=True), (
+                    f"{name}:{k}")
+        elif name.endswith(".npy"):
+            assert np.array_equal(np.load(pa), np.load(pb), equal_nan=True)
+        elif name.endswith(".json"):
+            with open(pa) as fa, open(pb) as fb:
+                assert json.load(fa) == json.load(fb), name
+        elif os.path.isdir(pa):
+            _assert_artifacts_identical(pa, pb)
+        else:
+            with open(pa, "rb") as fa, open(pb, "rb") as fb:
+                assert fa.read() == fb.read(), name
+
+
+@pytest.mark.parametrize("model", FAMILIES)
+def test_pipelined_matches_serial_byte_identical(tmp_path, tiny_sales,
+                                                 model):
+    out_s, series_s, art_s = _run_mode(
+        tmp_path, tiny_sales, f"serial_{model}", model, enabled=False)
+    out_p, series_p, art_p = _run_mode(
+        tmp_path, tiny_sales, f"piped_{model}", model, enabled=True)
+    _assert_frames_identical(out_s, out_p)
+    # timing columns don't exist in series_metrics; full-frame identity
+    _assert_frames_identical(series_s, series_p)
+    _assert_artifacts_identical(art_s, art_p)
+
+
+def test_pipelined_matches_serial_bucketed(tmp_path, tiny_sales):
+    # ragged spans so bucketing actually buckets (prefetch_to_device path)
+    df = tiny_sales.copy()
+    cut = df["date"].min() + pd.Timedelta(days=60)
+    late = (df["store"] == df["store"].max())
+    df = df[~late | (df["date"] >= cut)]
+    out_s, series_s, art_s = _run_mode(
+        tmp_path, df, "serial_bkt", "theta", enabled=False, bucketed=True)
+    out_p, series_p, art_p = _run_mode(
+        tmp_path, df, "piped_bkt", "theta", enabled=True, bucketed=True)
+    _assert_frames_identical(out_s, out_p)
+    _assert_frames_identical(series_s, series_p)
+    _assert_artifacts_identical(art_s, art_p)
+
+
+# ------------------------------------------------- injected tracking failure
+
+
+def test_tracking_failure_fails_experiment(tmp_path, tiny_sales,
+                                           monkeypatch):
+    from distributed_forecasting_tpu.data import DatasetCatalog
+    from distributed_forecasting_tpu.pipelines.training import (
+        TrainingPipeline,
+    )
+    from distributed_forecasting_tpu.tracking import FileTracker
+    from distributed_forecasting_tpu.tracking import filestore
+
+    cat = DatasetCatalog(str(tmp_path / "warehouse"))
+    trk = FileTracker(str(tmp_path / "mlruns"))
+    cat.save_table("t.raw.sales", tiny_sales)
+
+    def boom(self, name, df):
+        raise OSError("disk full")
+
+    monkeypatch.setattr(filestore.Run, "log_table", boom)
+    pipe = TrainingPipeline(cat, trk)
+    with pytest.raises(OSError, match="disk full"):
+        pipe.fine_grained(
+            "t.raw.sales", "t.fc.out", model="theta", horizon=7,
+            cv_conf={"initial": 90, "period": 30, "horizon": 7},
+        )
+    # the run the failure happened inside is marked FAILED, not left RUNNING
+    eid = trk.get_experiment_by_name("finegrain_forecasting")
+    runs = trk.search_runs(eid)
+    assert runs and all(r.meta()["status"] == "FAILED" for r in runs)
+    # and no forecast table was published
+    with pytest.raises(Exception):
+        cat.read_table("t.fc.out")
+
+
+# --------------------------------------------------------------- run_many
+
+
+def test_run_many_pipelines_multiple_experiments(tmp_path, tiny_sales):
+    from distributed_forecasting_tpu.data import DatasetCatalog
+    from distributed_forecasting_tpu.pipelines.training import (
+        TrainingPipeline,
+    )
+    from distributed_forecasting_tpu.tracking import FileTracker
+
+    cat = DatasetCatalog(str(tmp_path / "warehouse"))
+    trk = FileTracker(str(tmp_path / "mlruns"))
+    cat.save_table("t.raw.sales", tiny_sales)
+    pipe = TrainingPipeline(cat, trk)
+    specs = [
+        {"source_table": "t.raw.sales", "output_table": f"t.fc.out{i}",
+         "model": "theta", "horizon": 7, "experiment": f"exp_{i}",
+         "cv_conf": {"initial": 90, "period": 30, "horizon": 7}}
+        for i in range(3)
+    ]
+    got = pipe.run_many(specs, pipeline=PipelineConfig(max_in_flight=2))
+    assert len(got["results"]) == 3
+    for i, res in enumerate(got["results"]):
+        assert res["n_series"] == 4
+        assert cat.read_table(f"t.fc.out{i}") is not None
+    pm = got["pipeline"]
+    assert pm["pipeline_n_experiments"] == 3.0
+    assert pm["pipeline_n_completed"] == 3.0
+    assert 0.0 <= pm["pipeline_device_idle_fraction"] <= 1.0

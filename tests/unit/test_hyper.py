@@ -193,7 +193,7 @@ def test_per_series_runs_scale_guard(monkeypatch):
     from distributed_forecasting_tpu.pipelines import training as tr
 
     class _Tracker:
-        def start_run(self, *a, **k):
+        def log_runs_batch(self, *a, **k):
             raise AssertionError("must refuse before creating runs")
 
     pipe = tr.TrainingPipeline.__new__(tr.TrainingPipeline)

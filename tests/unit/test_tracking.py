@@ -181,3 +181,70 @@ def test_frozen_map_config_roundtrip():
     assert json.loads(s) == {"a": [1, 2], "b": {"c": 3, "d": [4, 5]}}
     # tracker param path (lossy-tolerant _jsonable) keeps structure, not str()
     assert _jsonable(fz) == {"a": [1, 2], "b": {"c": 3, "d": [4, 5]}}
+
+
+def test_log_runs_batch_layout_and_search(tracker):
+    """Batched per-series rows land in the exact start_run layout (meta/
+    params/metrics JSON, artifacts dir) with one buffered write per file —
+    search_runs and the read API must not notice the difference."""
+    eid = tracker.create_experiment("exp")
+    rows = [
+        {"run_name": f"run_item_{i}_store_0",
+         "tags": {"parent_run_id": "abc", "series_index": str(i)},
+         "params": {"growth": "linear"},
+         "metrics": {"mape": 0.05 + i, "rmse": 1.0 + i}}
+        for i in range(3)
+    ]
+    rids = tracker.log_runs_batch(eid, rows)
+    assert len(rids) == len(set(rids)) == 3
+    for i, rid in enumerate(rids):
+        r = tracker.get_run(eid, rid)
+        meta = r.meta()
+        assert meta["status"] == "FINISHED"
+        assert meta["run_name"] == f"run_item_{i}_store_0"
+        assert meta["tags"]["series_index"] == str(i)
+        assert meta["end_time"] >= meta["start_time"]
+        assert r.metrics() == {"mape": 0.05 + i, "rmse": 1.0 + i}
+        assert r.params() == {"growth": "linear"}
+        assert os.path.isdir(os.path.join(r._dir, "artifacts"))
+    assert len(tracker.search_runs(eid)) == 3
+    assert len(tracker.search_runs(eid, run_name="run_item_1_store_0")) == 1
+    assert len(tracker.search_runs(eid, tags={"parent_run_id": "abc"})) == 3
+
+
+def test_log_runs_batch_minimal_rows(tracker):
+    eid = tracker.create_experiment("exp2")
+    (rid,) = tracker.log_runs_batch(eid, [{"run_name": "bare"}])
+    r = tracker.get_run(eid, rid)
+    assert r.meta()["status"] == "FINISHED"
+    assert r.params() == {} and r.metrics() == {}
+
+
+def test_per_series_runs_use_batch_api(catalog, tracker):
+    """The training pipeline's drill-down loop routes through
+    log_runs_batch — same run names/tags/metrics as the per-run loop."""
+    import numpy as np
+
+    from distributed_forecasting_tpu.data import synthetic_store_item_sales
+    from distributed_forecasting_tpu.pipelines.training import (
+        TrainingPipeline,
+    )
+
+    df = synthetic_store_item_sales(n_stores=1, n_items=2, n_days=130,
+                                   seed=5)
+    catalog.save_table("t.raw.sales", df)
+    pipe = TrainingPipeline(catalog, tracker)
+    res = pipe.fine_grained(
+        "t.raw.sales", "t.fc.out", model="theta", horizon=7,
+        cv_conf={"initial": 90, "period": 30, "horizon": 7},
+        per_series_runs=True,
+    )
+    eid = res["experiment_id"]
+    drill = tracker.search_runs(eid, tags={"parent_run_id": res["run_id"]})
+    assert len(drill) == 2
+    for r in drill:
+        meta = r.meta()
+        assert meta["status"] == "FINISHED"
+        assert meta["run_name"].startswith("run_item_")
+        assert meta["tags"]["artifact_path"] == "forecaster"
+        assert np.isfinite(r.metrics()["mape"])
