@@ -19,8 +19,10 @@ from distributed_forecasting_tpu.analysis.core import (  # noqa: F401
 
 # importing the rule modules populates REGISTRY
 from distributed_forecasting_tpu.analysis import (  # noqa: F401
+    absint,
     rules_config,
     rules_jax,
+    rules_lockorder,
     rules_purity,
     rules_threads,
 )

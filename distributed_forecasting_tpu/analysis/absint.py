@@ -1,0 +1,462 @@
+"""Abstract interpretation of traced bodies: recompile-churn detection.
+
+Every distinct *abstract signature* (shapes, dtypes, weak-type bits,
+static-arg values) a jit entry is called with costs one full XLA
+compilation — tens of seconds on TPU for the fused CV graphs
+(docs/compile-cache.md measures 3.2 s cold even on CPU), and a new cache
+entry on disk.  Churn is silent: the program stays correct, it just
+recompiles forever.  The classic triggers are all visible statically:
+
+* a Python scalar literal at one call site where another site passes an
+  array — the literal arrives *weakly typed*, producing a second cache
+  entry for the same shapes (``f(x, 2.0)`` vs ``f(x, scale)``);
+* ``float()/int()/bool()`` on a traced value — concretization forces a
+  device sync or a ``TracerConversionError``;
+* a data-dependent Python branch on a value *derived* from traced inputs
+  (``m = jnp.mean(x); if m > 0:``) — fails on a tracer bool, or retraces
+  per value if the input was accidentally concrete;
+* an unhashable (list/dict/set) or array-valued static argument — jit
+  either raises ``TypeError: unhashable`` or retraces per object identity.
+
+The interpreter is a single forward pass over each traced function body
+propagating a three-point lattice per name — STATIC (concrete at trace
+time), TRACED (device value; ``weak`` when it came from a bare Python
+scalar), UNKNOWN — no fixpoint, no joins across branches beyond
+last-writer-wins: a linter wants cheap and predictable over precise.
+Traced bodies come from the project call graph, so a helper in
+``models/`` reached from an ``engine/`` jit entry is interpreted too.
+
+Precision stance: parameters seed as UNKNOWN (declared/inherited statics
+as STATIC) and TRACED arises only from array-producing calls (``jnp.*``,
+``jax.*``) and arithmetic on their results.  The body triggers therefore
+fire only on values that *provably* flowed through device computation —
+quiet on the trace-time config plumbing (model names, widths, orders)
+that dominates this codebase's helper signatures.
+
+Overlap guards: ``float()/int()/bool()``-on-traced is host-sync's job in
+the hot-path dirs (ops/engine/parallel/pipelines), so this rule skips
+those there; branch checks fire only on *derived locals*, raw parameters
+in tests stay static-argnum-drift's territory.
+
+Pure AST + stdlib.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from distributed_forecasting_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    Project,
+    Rule,
+    register,
+)
+from distributed_forecasting_tpu.analysis.callgraph import get_callgraph
+from distributed_forecasting_tpu.analysis.jaxast import (
+    FunctionNode,
+    ImportMap,
+    JitEntry,
+    _param_names,
+)
+
+STATIC = "static"
+TRACED = "traced"
+UNKNOWN = "unknown"
+
+#: dirs where host-sync-in-hot-path already flags float()/int()/bool()
+_HOT_DIRS = frozenset({"ops", "engine", "parallel", "pipelines"})
+
+#: array-producing namespaces: calls rooted here yield traced values
+#: inside a jit (and device/array values outside).  Deliberately NOT the
+#: whole ``jax.`` tree: jax.jit / jax.default_backend / jax.devices and
+#: friends return functions, strings and host objects, and treating those
+#: as traced yields false churn findings (e.g. a branch on
+#: ``jax.default_backend()`` is plain host control flow).
+_ARRAY_ROOTS = ("jax.numpy.", "jax.lax.", "jax.scipy.", "jax.random.",
+                "jax.nn.", "numpy.")
+_ARRAY_EXACT = frozenset({"jax.device_put", "jax.device_get"})
+
+#: attribute reads concrete at trace time even on tracers
+_STATIC_ATTRS = frozenset({"shape", "ndim", "dtype", "size"})
+
+_PY_CASTS = ("float", "int", "bool")
+
+
+@dataclasses.dataclass(frozen=True)
+class Val:
+    kind: str = UNKNOWN
+    weak: bool = False
+    #: True when the value was computed from traced inputs (vs being a raw
+    #: parameter) — the branch trigger fires only on derived values
+    derived: bool = False
+
+    def join(self, other: "Val") -> "Val":
+        if TRACED in (self.kind, other.kind):
+            return Val(TRACED, self.weak or other.weak,
+                       self.derived or other.derived)
+        if UNKNOWN in (self.kind, other.kind):
+            return Val(UNKNOWN)
+        return Val(STATIC)
+
+
+_STATIC_VAL = Val(STATIC)
+_UNKNOWN_VAL = Val(UNKNOWN)
+
+
+def _is_array_call(dotted: Optional[str]) -> bool:
+    return dotted is not None and (
+        dotted.startswith(_ARRAY_ROOTS) or dotted in ("jax", "numpy"))
+
+
+class Interpreter:
+    """Forward pass over one traced function body."""
+
+    def __init__(self, imap: ImportMap):
+        self.imap = imap
+        self.env: Dict[str, Val] = {}
+        #: (node, trigger, detail) accumulated during the pass
+        self.hits: List[Tuple[ast.AST, str, str]] = []
+
+    def seed_params(self, fn: ast.AST, statics: frozenset) -> None:
+        for name in _param_names(fn):
+            if name == "self" or name in statics:
+                self.env[name] = _STATIC_VAL
+            else:
+                # NOT TRACED: a helper's params are often trace-time config
+                # (see module docstring); only device computation taints
+                self.env[name] = _UNKNOWN_VAL
+
+    # -- expressions -------------------------------------------------------
+
+    def eval(self, node: ast.AST) -> Val:
+        if isinstance(node, ast.Constant):
+            return _STATIC_VAL
+        if isinstance(node, ast.Name):
+            return self.env.get(node.id, _UNKNOWN_VAL)
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return _STATIC_VAL
+            base = self.eval(node.value)
+            return Val(base.kind, base.weak, base.derived)
+        if isinstance(node, ast.Call):
+            return self._eval_call(node)
+        if isinstance(node, ast.BinOp):
+            left, right = self.eval(node.left), self.eval(node.right)
+            out = left.join(right)
+            if out.kind == TRACED:
+                return Val(TRACED, out.weak, derived=True)
+            return out
+        if isinstance(node, ast.UnaryOp):
+            v = self.eval(node.operand)
+            return Val(v.kind, v.weak, derived=v.kind == TRACED)
+        if isinstance(node, (ast.Compare, ast.BoolOp)):
+            if (isinstance(node, ast.Compare)
+                    and all(isinstance(op, (ast.Is, ast.IsNot))
+                            for op in node.ops)):
+                return _STATIC_VAL  # pytree-structure dispatch, jit-legal
+            vals = ([self.eval(node.left)] + [self.eval(c) for c in node.comparators]
+                    if isinstance(node, ast.Compare)
+                    else [self.eval(v) for v in node.values])
+            out = _STATIC_VAL
+            for v in vals:
+                out = out.join(v)
+            if out.kind == TRACED:
+                return Val(TRACED, derived=True)
+            return out
+        if isinstance(node, ast.Subscript):
+            base = self.eval(node.value)
+            return Val(base.kind, base.weak, derived=base.kind == TRACED)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            out = _STATIC_VAL
+            for e in node.elts:
+                out = out.join(self.eval(e))
+            return out
+        if isinstance(node, ast.IfExp):
+            return self.eval(node.body).join(self.eval(node.orelse))
+        return _UNKNOWN_VAL
+
+    def _eval_call(self, node: ast.Call) -> Val:
+        for a in node.args:
+            self.eval_for_effect(a)
+        for kw in node.keywords:
+            self.eval_for_effect(kw.value)
+        if isinstance(node.func, ast.Name):
+            if node.func.id == "len":
+                return _STATIC_VAL
+            if node.func.id in _PY_CASTS and node.args:
+                v = self.eval(node.args[0])
+                if v.kind == TRACED:
+                    self.hits.append((node, "concretize", node.func.id))
+                return _STATIC_VAL
+        dotted = self.imap.dotted(node.func)
+        if _is_array_call(dotted):
+            # weak when built from bare Python scalars with no dtype pin
+            has_dtype = any(kw.arg == "dtype" for kw in node.keywords)
+            all_scalar = bool(node.args) and all(
+                isinstance(a, ast.Constant) and isinstance(a.value, (int, float))
+                for a in node.args)
+            return Val(TRACED, weak=all_scalar and not has_dtype, derived=True)
+        return _UNKNOWN_VAL
+
+    def eval_for_effect(self, node: ast.AST) -> None:
+        """Evaluate for the concretization side-channel only."""
+        self.eval(node)
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.Call):
+                pass  # already reached through eval
+        # nested calls not on the eval spine (e.g. inside comprehensions)
+        for sub in ast.walk(node):
+            if (isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name)
+                    and sub.func.id in _PY_CASTS and sub.args
+                    and sub is not node):
+                v = self.eval(sub.args[0])
+                if v.kind == TRACED:
+                    if not any(h[0] is sub for h in self.hits):
+                        self.hits.append((sub, "concretize", sub.func.id))
+
+    # -- statements --------------------------------------------------------
+
+    def run(self, body: List[ast.stmt]) -> None:
+        for stmt in body:
+            self._stmt(stmt)
+
+    def _stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, FunctionNode) or isinstance(stmt, ast.ClassDef):
+            return  # nested defs are interpreted under their own entry
+        if isinstance(stmt, ast.Assign):
+            val = self.eval(stmt.value)
+            for t in stmt.targets:
+                self._bind(t, val)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            self._bind(stmt.target, self.eval(stmt.value))
+        elif isinstance(stmt, ast.AugAssign):
+            val = self.eval(stmt.value).join(self.eval(stmt.target))
+            if val.kind == TRACED:
+                val = Val(TRACED, val.weak, derived=True)
+            self._bind(stmt.target, val)
+        elif isinstance(stmt, (ast.If, ast.While)):
+            test = self.eval(stmt.test)
+            if test.kind == TRACED and test.derived:
+                self.hits.append((stmt, "traced-branch", ""))
+            self.run(stmt.body)
+            self.run(stmt.orelse)
+        elif isinstance(stmt, ast.For):
+            it = self.eval(stmt.iter)
+            self._bind(stmt.target,
+                       Val(TRACED, derived=True) if it.kind == TRACED else it)
+            self.run(stmt.body)
+            self.run(stmt.orelse)
+        elif isinstance(stmt, ast.Return) and stmt.value is not None:
+            self.eval_for_effect(stmt.value)
+        elif isinstance(stmt, ast.Expr):
+            self.eval_for_effect(stmt.value)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            self.run(stmt.body)
+        elif isinstance(stmt, ast.Try):
+            self.run(stmt.body)
+            for h in stmt.handlers:
+                self.run(h.body)
+            self.run(stmt.orelse)
+            self.run(stmt.finalbody)
+
+    def _bind(self, target: ast.AST, val: Val) -> None:
+        if isinstance(target, ast.Name):
+            self.env[target.id] = val
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._bind(e, Val(val.kind, val.weak, val.derived))
+
+
+def _is_bare_scalar(node: ast.AST) -> bool:
+    """A Python numeric literal (or its negation) — arrives weakly typed."""
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, (ast.USub, ast.UAdd)):
+        node = node.operand
+    return (isinstance(node, ast.Constant)
+            and type(node.value) in (int, float))
+
+
+def _is_unhashable_static(node: ast.AST, imap: ImportMap) -> Optional[str]:
+    if isinstance(node, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                         ast.DictComp, ast.SetComp)):
+        return "an unhashable " + type(node).__name__.lower().replace(
+            "comp", " comprehension")
+    if isinstance(node, ast.Call):
+        dotted = imap.dotted(node.func)
+        if _is_array_call(dotted):
+            return f"an array value ({dotted}(...))"
+    return None
+
+
+def _map_args(call: ast.Call, fn: ast.AST) -> Dict[str, ast.AST]:
+    """Call-site argument expression per parameter name (best effort)."""
+    params = [p for p in _param_names(fn) if p != "self"]
+    out: Dict[str, ast.AST] = {}
+    for i, a in enumerate(call.args):
+        if isinstance(a, ast.Starred):
+            break
+        if i < len(params):
+            out[params[i]] = a
+    for kw in call.keywords:
+        if kw.arg is not None:
+            out[kw.arg] = kw.value
+    return out
+
+
+@register
+class RecompileChurn(Rule):
+    name = "recompile-churn"
+    dir_names = frozenset()
+    default_severity = "warning"
+
+    def check_project(self, project: Project) -> List[Finding]:
+        graph = get_callgraph(project)
+        targets = {m.relpath for m in project.modules}
+        out: List[Finding] = []
+
+        # entry index for the call-site scans: fn node -> JitEntry
+        entry_meta: Dict[ast.AST, Tuple[ModuleInfo, JitEntry]] = {}
+        for m in project.all_modules:
+            if m.tree is None:
+                continue
+            _, entries = graph.for_module(m)
+            for fn, e in entries.items():
+                entry_meta[fn] = (m, e)
+
+        # 1+2: interpret every traced body in the *target* modules
+        for m in project.modules:
+            if m.tree is None:
+                continue
+            reach, entries = graph.for_module(m)
+            imap = graph.import_map(m)
+            hot = bool(_HOT_DIRS.intersection(m.segments[:-1]))
+            for fn, how in reach.items():
+                interp = Interpreter(imap)
+                interp.seed_params(fn, graph.statics_of(fn))
+                interp.run(fn.body)
+                for node, trigger, detail in interp.hits:
+                    if trigger == "concretize":
+                        if hot:
+                            continue  # host-sync-in-hot-path owns these dirs
+                        out.append(self.finding(
+                            m, node,
+                            f"{detail}() on a traced value in '{fn.name}' "
+                            f"({how}) concretizes it — device sync or "
+                            f"TracerConversionError; keep the computation "
+                            f"in jnp"))
+                    elif trigger == "traced-branch":
+                        out.append(self.finding(
+                            m, node,
+                            f"Python branch on a value derived from traced "
+                            f"inputs in '{fn.name}' ({how}) — fails on a "
+                            f"tracer bool (or silently retraces per value); "
+                            f"use jnp.where / lax.cond"))
+
+        # 3+4: scan call sites of jit entries across the whole tree
+        sites = self._collect_sites(project, graph, entry_meta)
+        out.extend(self._weak_type_findings(sites, targets, entry_meta))
+        out.extend(self._static_arg_findings(project, graph, sites,
+                                             targets, entry_meta))
+        return out
+
+    # -- call-site collection ---------------------------------------------
+
+    def _collect_sites(self, project: Project, graph, entry_meta,
+                       ) -> List[Tuple[ModuleInfo, ast.Call, ast.AST]]:
+        """(module, call, entry fn) for every resolvable call to a jit
+        entry — by the entry's own name (decorator form) or through a
+        local ``fast = jax.jit(f)`` alias."""
+        sites: List[Tuple[ModuleInfo, ast.Call, ast.AST]] = []
+        for m in project.all_modules:
+            if m.tree is None:
+                continue
+            # name -> entry fn for local jit-wrapper aliases
+            aliases: Dict[str, ast.AST] = {}
+            for node in ast.walk(m.tree):
+                if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)
+                        and isinstance(node.value, ast.Call)
+                        and node.value.args):
+                    inner = node.value.args[0]
+                    for _, fn in graph.resolve_call(
+                            m, inner) if isinstance(
+                                inner, (ast.Name, ast.Attribute)) else ():
+                        if fn in entry_meta:
+                            aliases[node.targets[0].id] = fn
+            for node in ast.walk(m.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn: Optional[ast.AST] = None
+                if (isinstance(node.func, ast.Name)
+                        and node.func.id in aliases):
+                    fn = aliases[node.func.id]
+                else:
+                    for _, cand in graph.resolve_call(m, node.func):
+                        if cand in entry_meta:
+                            fn = cand
+                            break
+                if fn is not None:
+                    sites.append((m, node, fn))
+        return sites
+
+    # -- trigger 3: weak-type churn across call sites ----------------------
+
+    def _weak_type_findings(self, sites, targets, entry_meta,
+                            ) -> List[Finding]:
+        by_param: Dict[Tuple[int, str], List[Tuple[ModuleInfo, ast.Call,
+                                                   ast.AST, bool]]] = {}
+        for m, call, fn in sites:
+            _, entry = entry_meta[fn]
+            for param, arg in _map_args(call, fn).items():
+                if param in entry.static_names:
+                    continue
+                by_param.setdefault((id(fn), param), []).append(
+                    (m, call, arg, _is_bare_scalar(arg)))
+        out: List[Finding] = []
+        for (fn_id, param), entries in by_param.items():
+            if len(entries) < 2:
+                continue
+            literal = [e for e in entries if e[3]]
+            typed = [e for e in entries if not e[3]]
+            if not literal or not typed:
+                continue
+            fn_name = next(fn.name for _, _, fn in sites if id(fn) == fn_id)
+            for m, call, arg, _ in literal:
+                if m.relpath not in targets:
+                    continue
+                out.append(self.finding(
+                    m, arg,
+                    f"bare Python scalar for parameter '{param}' of jitted "
+                    f"'{fn_name}' — it traces weakly typed while other call "
+                    f"sites pass arrays, splitting the compile cache; wrap "
+                    f"in jnp.asarray(..., dtype=...) or hoist a shared "
+                    f"constant"))
+        return out
+
+    # -- trigger 4: unhashable / array statics -----------------------------
+
+    def _static_arg_findings(self, project, graph, sites, targets,
+                             entry_meta) -> List[Finding]:
+        out: List[Finding] = []
+        for m, call, fn in sites:
+            if m.relpath not in targets:
+                continue
+            _, entry = entry_meta[fn]
+            if not entry.static_names:
+                continue
+            imap = graph.import_map(m)
+            for param, arg in _map_args(call, fn).items():
+                if param not in entry.static_names:
+                    continue
+                why = _is_unhashable_static(arg, imap)
+                if why is not None:
+                    out.append(self.finding(
+                        m, arg,
+                        f"static parameter '{param}' of jitted '{fn.name}' "
+                        f"receives {why} — static args are hashed into the "
+                        f"compile key, so this raises TypeError or retraces "
+                        f"per object; pass a tuple/scalar or make the "
+                        f"parameter dynamic"))
+        return out

@@ -1,0 +1,414 @@
+"""Project-wide call graph: cross-module jit-reachability for dflint.
+
+:func:`jaxast.traced_functions` answers "what runs under tracing" for one
+module at a time; this module lifts that closure over the whole tree.  A
+jit entry in ``engine/fit.py`` that calls ``ops/filters.py`` helpers via
+``from distributed_forecasting_tpu.ops import filters`` now pulls those
+helpers into traced scope, so host-sync / tracer-leak / static-argnum
+findings land where the offending code lives, not only where the jit is.
+
+Resolution rules (documented in docs/static-analysis.md):
+
+* a module's dotted name is its posix relpath with ``/`` -> ``.`` and the
+  ``.py`` / ``/__init__.py`` suffix dropped;
+* ``import a.b.c``, ``import a.b.c as x``, ``from a.b import c`` and
+  relative forms (``from .cv import f``, ``from ..ops import filters``)
+  all resolve through :class:`jaxast.ImportMap` with the module's package;
+* a dotted reference resolves by longest known-module prefix, then the
+  remainder is looked up among that module's top-level defs; a name bound
+  by an ImportFrom re-export (``__init__.py`` chains) is followed
+  transitively with a depth guard;
+* ``jax.jit(f)`` call-forms claim imported ``f`` in its *defining* module,
+  carrying ``static_argnames`` from the wrapping call;
+* staticness is interprocedural: when every traced call site of a helper
+  passes a parameter a trace-time-static expression (a literal, a declared
+  static of the caller, an attribute/getattr/len/tuple thereof — or omits
+  it, taking the Python default), the helper inherits that parameter as
+  static, so ``float(interval_width)`` on config plumbing does not read as
+  a host sync.
+
+Known limits (deliberate, a linter must stay quiet when it cannot know):
+dynamic dispatch (``get_model(name).fit``), dict-of-functions registries,
+``getattr``, and method calls on objects are not followed; star imports
+are ignored.  Those edges fail loudly at first trace if they break trace
+discipline — the silent cross-module cases are the direct-call chains this
+graph does resolve.
+
+Pure AST + stdlib, same as the rest of the analysis package.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from distributed_forecasting_tpu.analysis.core import ModuleInfo, Project
+from distributed_forecasting_tpu.analysis.jaxast import (
+    FunctionNode,
+    ImportMap,
+    JitEntry,
+    _defs_by_name,
+    _param_names,
+    _static_names_from_call,
+    _wrapper_of,
+    jit_entries,
+)
+
+_MAX_REEXPORT_DEPTH = 8
+
+
+def _static_expr(node: ast.AST, statics: frozenset) -> bool:
+    """Conservatively true when the expression is concrete at trace time in
+    a scope where the names in ``statics`` are declared static."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.Name):
+        return node.id in statics
+    if isinstance(node, ast.Attribute):
+        return _static_expr(node.value, statics)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return all(_static_expr(e, statics) for e in node.elts)
+    if isinstance(node, ast.BinOp):
+        return (_static_expr(node.left, statics)
+                and _static_expr(node.right, statics))
+    if isinstance(node, ast.UnaryOp):
+        return _static_expr(node.operand, statics)
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+        if node.func.id == "len":
+            return True
+        if node.func.id == "getattr" and node.args:
+            return all(_static_expr(a, statics) for a in node.args)
+    return False
+
+
+def _defaulted_params(fn) -> frozenset:
+    a = fn.args
+    pos = a.posonlyargs + a.args
+    out = {p.arg for p in pos[len(pos) - len(a.defaults):]} if a.defaults else set()
+    for p, d in zip(a.kwonlyargs, a.kw_defaults):
+        if d is not None:
+            out.add(p.arg)
+    return frozenset(out)
+
+
+def module_name(relpath: str) -> str:
+    """``distributed_forecasting_tpu/engine/cv.py`` ->
+    ``distributed_forecasting_tpu.engine.cv``; a package ``__init__.py``
+    maps to the package itself."""
+    name = relpath[:-3] if relpath.endswith(".py") else relpath
+    if name.endswith("/__init__"):
+        name = name[: -len("/__init__")]
+    return name.replace("/", ".")
+
+
+def _package_of(relpath: str) -> Optional[str]:
+    """The package relative imports resolve against: the containing package
+    for a module, the package itself for its ``__init__.py``."""
+    name = module_name(relpath)
+    if relpath.endswith("/__init__.py"):
+        return name
+    return name.rsplit(".", 1)[0] if "." in name else None
+
+
+def _top_level_defs(tree: ast.Module) -> Dict[str, ast.AST]:
+    """Module-level function defs — the only ones an import can bind.
+    Descends into top-level If/Try bodies (version-gated defs) but not into
+    classes or other functions."""
+    out: Dict[str, ast.AST] = {}
+    todo: List[ast.AST] = list(tree.body)
+    while todo:
+        node = todo.pop()
+        if isinstance(node, FunctionNode):
+            out.setdefault(node.name, node)
+        elif isinstance(node, (ast.If, ast.Try)):
+            for body in ast.iter_child_nodes(node):
+                todo.append(body)
+    return out
+
+
+def _is_test_module(relpath: str) -> bool:
+    parts = relpath.split("/")
+    return ("tests" in parts[:-1]
+            or parts[-1].startswith("test_")
+            or parts[-1].endswith("_test.py"))
+
+
+class CallGraph:
+    """Built once per :class:`Project` over ``all_modules`` (the whole tree,
+    not just the lint targets, so a target module's helpers are seen as
+    traced even when the jit entry lives outside the target set).  Test
+    modules are indexed for import resolution but never claim jit entries
+    (see :meth:`_collect_entries`)."""
+
+    def __init__(self, project: Project):
+        self._modules: Dict[str, ModuleInfo] = {}
+        self._imaps: Dict[str, ImportMap] = {}
+        self._defs: Dict[str, Dict[str, List[ast.AST]]] = {}
+        self._top_defs: Dict[str, Dict[str, ast.AST]] = {}
+        #: per module: traced function -> human-readable provenance
+        self._reach: Dict[str, Dict[ast.AST, str]] = {}
+        #: per module: jit entry function -> its JitEntry metadata
+        self._entries: Dict[str, Dict[ast.AST, JitEntry]] = {}
+        #: traced function -> parameters static at EVERY traced call site
+        #: (declared static_argnames for jit entries)
+        self._statics: Dict[ast.AST, frozenset] = {}
+
+        for m in project.all_modules:
+            if m.tree is None:
+                continue
+            name = module_name(m.relpath)
+            self._modules[name] = m
+            self._imaps[name] = ImportMap(m.tree, package=_package_of(m.relpath))
+            self._defs[name] = _defs_by_name(m.tree)
+            self._top_defs[name] = _top_level_defs(m.tree)
+
+        self._collect_entries()
+        self._propagate()
+
+    # -- public API --------------------------------------------------------
+
+    def import_map(self, module: ModuleInfo) -> ImportMap:
+        name = module_name(module.relpath)
+        imap = self._imaps.get(name)
+        if imap is None:  # unparsed or outside the indexed tree
+            imap = ImportMap(module.tree, package=_package_of(module.relpath))
+        return imap
+
+    def for_module(self, module: ModuleInfo,
+                   ) -> Tuple[Dict[ast.AST, str], Dict[ast.AST, JitEntry]]:
+        """(traced functions defined in ``module`` -> provenance, jit-entry
+        metadata for entries defined in ``module``) — drop-in for the
+        module-local :func:`jaxast.traced_functions` pair."""
+        name = module_name(module.relpath)
+        return self._reach.get(name, {}), self._entries.get(name, {})
+
+    def statics_of(self, fn: ast.AST) -> frozenset:
+        """Parameters of a traced function known static: declared
+        ``static_argnames`` for a jit entry, or the intersection of
+        statically-valued arguments over every traced call site for a
+        reached helper."""
+        return self._statics.get(fn, frozenset())
+
+    def resolve_dotted(self, dotted: str,
+                       ) -> Optional[Tuple[ModuleInfo, ast.AST]]:
+        """A canonical dotted name -> (defining module, function def), or
+        None when it does not land on a project function."""
+        hit = self._resolve(dotted, 0)
+        if hit is None:
+            return None
+        mod, fn = hit
+        return self._modules[mod], fn
+
+    def resolve_call(self, module: ModuleInfo, func_expr: ast.AST,
+                     ) -> List[Tuple[ModuleInfo, ast.AST]]:
+        """Project functions a call head may land on: a bare Name resolves
+        to the module's own defs or through its imports; a dotted Attribute
+        resolves through the import map.  Method calls on objects resolve
+        to nothing here (see module docstring on dynamic-dispatch limits)."""
+        name = module_name(module.relpath)
+        out: List[Tuple[ModuleInfo, ast.AST]] = []
+        if isinstance(func_expr, ast.Name):
+            for fn in self._defs.get(name, {}).get(func_expr.id, ()):
+                out.append((module, fn))
+            if not out:
+                hit = self._resolve_name(name, func_expr.id)
+                if hit is not None:
+                    out.append((self._modules[hit[0]], hit[1]))
+            return out
+        imap = self.import_map(module)
+        dotted = imap.dotted(func_expr)
+        if dotted is not None:
+            hit = self.resolve_dotted(dotted)
+            if hit is not None:
+                out.append(hit)
+        return out
+
+    # -- construction ------------------------------------------------------
+
+    def _resolve(self, dotted: str, depth: int,
+                 ) -> Optional[Tuple[str, ast.AST]]:
+        if depth > _MAX_REEXPORT_DEPTH:
+            return None
+        parts = dotted.split(".")
+        for i in range(len(parts) - 1, 0, -1):
+            mod = ".".join(parts[:i])
+            if mod not in self._modules:
+                continue
+            rest = parts[i:]
+            if len(rest) == 1:
+                return self._resolve_in(mod, rest[0], depth)
+            # pkg.sub.f where pkg/__init__.py re-exports sub: follow the
+            # first remaining segment through the module's imports
+            target = self._imaps[mod].aliases.get(rest[0])
+            if target is not None:
+                return self._resolve(".".join([target] + rest[1:]), depth + 1)
+            return None
+        return None
+
+    def _resolve_in(self, mod: str, name: str, depth: int,
+                    ) -> Optional[Tuple[str, ast.AST]]:
+        fn = self._top_defs[mod].get(name)
+        if fn is not None:
+            return mod, fn
+        target = self._imaps[mod].aliases.get(name)
+        if target is not None and target != name:
+            return self._resolve(target, depth + 1)
+        return None
+
+    def _resolve_name(self, mod: str, name: str,
+                      ) -> Optional[Tuple[str, ast.AST]]:
+        """A bare Name in ``mod`` that is not a local def: follow the
+        import binding."""
+        target = self._imaps[mod].aliases.get(name)
+        if target is None or target == name:
+            return None
+        return self._resolve(target, 0)
+
+    def _collect_entries(self) -> None:
+        for mod, info in self._modules.items():
+            if _is_test_module(info.relpath):
+                # tests jit wrappers around host code on purpose (e.g. to
+                # exercise tracer-fallback paths); letting them claim entries
+                # would mark library host paths as traced
+                self._entries[mod] = {}
+                continue
+            imap = self._imaps[mod]
+            self._entries[mod] = dict(jit_entries(info.tree, imap))
+        # second pass: jax.jit(imported_fn) claims the def in the module
+        # that OWNS it — the per-module pass only sees local defs
+        for mod, info in self._modules.items():
+            if _is_test_module(info.relpath):
+                continue
+            imap = self._imaps[mod]
+            local = self._defs[mod]
+            for node in ast.walk(info.tree):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                wrapped = _wrapper_of(node.func, imap)
+                if wrapped is None:
+                    continue
+                arg = node.args[0]
+                if isinstance(arg, ast.Name) and arg.id in local:
+                    continue  # claimed by the per-module pass
+                if isinstance(arg, ast.Name):
+                    hit = self._resolve_name(mod, arg.id)
+                else:
+                    dotted = imap.dotted(arg)
+                    hit = self._resolve(dotted, 0) if dotted else None
+                if hit is None:
+                    continue
+                owner, fn = hit
+                self._entries[owner].setdefault(fn, JitEntry(
+                    func=fn,
+                    wrapper=wrapped[0],
+                    static_names=_static_names_from_call(node, fn),
+                    explicit_statics=wrapped[0] == "jax.jit",
+                ))
+
+    def _propagate(self) -> None:
+        work: List[Tuple[str, ast.AST]] = []
+        for mod, entries in self._entries.items():
+            reach = self._reach.setdefault(mod, {})
+            for fn, e in entries.items():
+                self._statics[fn] = e.static_names
+                if fn not in reach:
+                    reach[fn] = f"traced via {e.wrapper}"
+                    work.append((mod, fn))
+        while work:
+            mod, fn = work.pop()
+            info = self._modules[mod]
+            caller_statics = self._statics.get(fn, frozenset())
+            for target_mod, cand, call in self._references(mod, fn):
+                if cand is fn:
+                    continue
+                is_entry = cand in self._entries.get(target_mod, {})
+                site = (self._site_statics(call, cand, caller_statics)
+                        if call is not None else frozenset())
+                reach = self._reach.setdefault(target_mod, {})
+                if cand not in reach:
+                    if target_mod == mod:
+                        how = f"reached from jitted '{fn.name}'"
+                    else:
+                        how = (f"reached from jitted '{fn.name}' "
+                               f"({info.relpath})")
+                    reach[cand] = how
+                    if not is_entry:
+                        self._statics[cand] = site
+                    work.append((target_mod, cand))
+                elif not is_entry:
+                    # a jit boundary re-declares statics; everything else
+                    # narrows to what EVERY traced call site guarantees
+                    old = self._statics.get(cand, frozenset())
+                    new = old & site
+                    if new != old:
+                        self._statics[cand] = new
+                        work.append((target_mod, cand))
+
+    def _site_statics(self, call: ast.Call, callee: ast.AST,
+                      caller_statics: frozenset) -> frozenset:
+        """Parameters of ``callee`` that are static at this call site: they
+        receive a trace-time-static expression, or are left to their Python
+        default.  ``**kwargs`` / ``*args`` at the site make the mapping
+        unknowable -> nothing is static."""
+        if any(kw.arg is None for kw in call.keywords) or any(
+                isinstance(a, ast.Starred) for a in call.args):
+            return frozenset()
+        params = [p for p in _param_names(callee) if p != "self"]
+        mapped: Dict[str, ast.AST] = {}
+        for i, a in enumerate(call.args):
+            if i < len(params):
+                mapped[params[i]] = a
+        for kw in call.keywords:
+            mapped[kw.arg] = kw.value
+        defaulted = _defaulted_params(callee)
+        out = set()
+        for p in params:
+            arg = mapped.get(p)
+            if arg is None:
+                if p in defaulted:
+                    out.add(p)
+            elif _static_expr(arg, caller_statics):
+                out.add(p)
+        return frozenset(out)
+
+    def _references(self, mod: str, fn: ast.AST,
+                    ) -> Iterable[Tuple[str, ast.AST, Optional[ast.Call]]]:
+        """(owning module, function, call site or None) for every project
+        function ``fn`` references: same-module defs by bare name (the
+        historical over-approximation — referencing counts even without a
+        call), imported names, and dotted attribute chains.  The call is
+        carried when the reference IS the head of a Call, for
+        static-argument inheritance."""
+        imap = self._imaps[mod]
+        defs = self._defs[mod]
+        call_heads: Dict[int, ast.Call] = {}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                call_heads[id(node.func)] = node
+        for node in ast.walk(fn):
+            call = call_heads.get(id(node))
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                local = defs.get(node.id)
+                if local:
+                    for cand in local:
+                        yield mod, cand, call
+                else:
+                    hit = self._resolve_name(mod, node.id)
+                    if hit is not None:
+                        yield hit[0], hit[1], call
+            elif isinstance(node, ast.Attribute):
+                dotted = imap.dotted(node)
+                if dotted is not None:
+                    hit = self._resolve(dotted, 0)
+                    if hit is not None:
+                        yield hit[0], hit[1], call
+
+
+def get_callgraph(project: Project) -> CallGraph:
+    """One graph per Project instance — every rule in an :func:`analyze`
+    run shares the build."""
+    graph = getattr(project, "_dflint_callgraph", None)
+    if graph is None:
+        graph = CallGraph(project)
+        project._dflint_callgraph = graph
+    return graph

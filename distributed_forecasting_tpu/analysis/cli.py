@@ -1,4 +1,5 @@
-"""dflint command line — text/JSON output, baseline management, CI codes.
+"""dflint command line — text/JSON/SARIF output, git-scoped linting,
+baseline management, CI codes.
 
 Exit codes: 0 clean (warnings allowed), 1 at least one error-severity
 finding survived suppressions + baseline, 2 bad invocation or bad
@@ -41,7 +42,18 @@ def _parser() -> argparse.ArgumentParser:
     p.add_argument("--conf-dir", default=None,
                    help="YAML conf tree for config-drift (default: "
                         "<root>/conf)")
-    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--format", choices=("text", "json", "sarif"),
+                   default="text",
+                   help="sarif emits a SARIF 2.1.0 log on stdout "
+                        "(redirect into a file for code-scanning upload)")
+    p.add_argument("--changed-only", action="store_true",
+                   help="lint only Python files changed vs --diff-base "
+                        "(plus untracked ones); clean exit when nothing "
+                        "under the targets changed")
+    p.add_argument("--diff-base", default="HEAD",
+                   help="git rev the --changed-only diff is taken against "
+                        "(default: HEAD, i.e. uncommitted work; CI passes "
+                        "the PR base)")
     p.add_argument("--write-baseline", action="store_true",
                    help="grandfather every current finding into the "
                         "baseline file and exit 0")
@@ -49,6 +61,39 @@ def _parser() -> argparse.ArgumentParser:
                    help="report baselined findings too")
     p.add_argument("--list-rules", action="store_true")
     return p
+
+
+def _changed_files(root: str, base: str) -> Optional[List[str]]:
+    """Root-relative posix paths of .py files changed vs ``base`` plus
+    untracked ones, or None when git cannot answer (not a checkout, bad
+    rev).  Deleted files are excluded — there is nothing left to lint."""
+    import subprocess
+
+    out: List[str] = []
+    for cmd in (
+        ["git", "diff", "--name-only", "--diff-filter=d", base, "--", "*.py"],
+        ["git", "ls-files", "--others", "--exclude-standard", "--", "*.py"],
+    ):
+        try:
+            proc = subprocess.run(cmd, cwd=root, capture_output=True,
+                                  text=True, timeout=30)
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        if proc.returncode != 0:
+            return None
+        out.extend(line.strip() for line in proc.stdout.splitlines()
+                   if line.strip())
+    return sorted(set(out))
+
+
+def _under_targets(relpath: str, root: str, targets: List[str]) -> bool:
+    for t in targets:
+        trel = os.path.relpath(os.path.abspath(t), root).replace(os.sep, "/")
+        if trel == ".":
+            return True
+        if relpath == trel or relpath.startswith(trel + "/"):
+            return True
+    return False
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -75,6 +120,33 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("dflint: no lint targets exist", file=sys.stderr)
         return 2
 
+    if args.changed_only:
+        changed = _changed_files(root, args.diff_base)
+        if changed is None:
+            print(f"dflint: --changed-only: git diff against "
+                  f"{args.diff_base!r} failed (not a checkout, or bad rev)",
+                  file=sys.stderr)
+            return 2
+        narrowed = [os.path.join(root, c) for c in changed
+                    if _under_targets(c, root, targets)
+                    and os.path.exists(os.path.join(root, c))]
+        if not narrowed:
+            if args.format == "text":
+                print("dflint: no changed Python files under the lint "
+                      "targets — nothing to do")
+            targets = []
+        else:
+            targets = narrowed
+        if not targets:
+            if args.format == "sarif":
+                from distributed_forecasting_tpu.analysis.sarif import to_sarif
+                print(json.dumps(to_sarif([]), indent=2))
+            elif args.format == "json":
+                print(json.dumps({"findings": [], "counts": {
+                    "error": 0, "warning": 0}, "suppressed": 0,
+                    "baselined": 0}, indent=2))
+            return 0
+
     project = build_project(root, targets, config=config,
                             conf_dir=args.conf_dir)
     findings, suppressed = analyze(project)
@@ -93,7 +165,10 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     errors = [f for f in findings if f.severity == "error"]
     warnings = [f for f in findings if f.severity != "error"]
-    if args.format == "json":
+    if args.format == "sarif":
+        from distributed_forecasting_tpu.analysis.sarif import to_sarif
+        print(json.dumps(to_sarif(findings), indent=2))
+    elif args.format == "json":
         print(json.dumps({
             "findings": [f.to_dict() for f in findings],
             "counts": {"error": len(errors), "warning": len(warnings)},

@@ -16,11 +16,12 @@ importing jax:
    (``_cv_impl -> _cv_paths -> cv_windows``, engine/cv.py).
    :func:`traced_functions`.
 
-Scope is deliberately per-module: cross-module calls (``get_model(model).
-fit``) are dynamic dispatch the AST cannot resolve, and every hot numeric
-module in this repo keeps its jit roots and helpers together, so the
-module-local closure is the right coverage/noise trade-off (documented in
-docs/static-analysis.md).
+:func:`traced_functions` is the module-local building block; project-wide
+reachability — ``engine/fit.py`` jit entries pulling ``ops/`` and
+``models/`` helpers into traced scope across import boundaries — lives in
+:mod:`analysis.callgraph`, which resolves imports/aliases/re-export chains
+over the whole tree (resolution rules and the dynamic-dispatch limits are
+documented in docs/static-analysis.md).
 """
 
 from __future__ import annotations
@@ -43,12 +44,31 @@ _PARTIAL = "functools.partial"
 FunctionNode = (ast.FunctionDef, ast.AsyncFunctionDef)
 
 
+def _relative_base(package: Optional[str], level: int) -> Optional[str]:
+    """The package a ``from ...x import y`` resolves in: level 1 is the
+    module's own package, each extra level walks one package up.  None when
+    the import reaches above the project root (or no package is known)."""
+    if package is None:
+        return None
+    parts = package.split(".")
+    up = level - 1
+    if up >= len(parts):
+        return None
+    return ".".join(parts[: len(parts) - up]) if up else package
+
+
 class ImportMap:
     """Local name -> canonical dotted path, from every import in the module
     (function-local imports included: ``engine/cv.py`` imports numpy inside
-    host-side helpers)."""
+    host-side helpers).
 
-    def __init__(self, tree: ast.AST):
+    ``package`` is the dotted package the module lives in; when given,
+    relative imports (``from .cv import cross_validate``, level >= 1)
+    resolve against it so the call graph can follow them.  Without it they
+    are skipped, which is safe for the absolute-only rules (jax/numpy are
+    never imported relatively)."""
+
+    def __init__(self, tree: ast.AST, package: Optional[str] = None):
         self.aliases: Dict[str, str] = {}
         for node in ast.walk(tree):
             if isinstance(node, ast.Import):
@@ -58,9 +78,18 @@ class ImportMap:
                     else:
                         top = a.name.split(".")[0]
                         self.aliases[top] = top
-            elif isinstance(node, ast.ImportFrom) and node.module and not node.level:
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    base = _relative_base(package, node.level)
+                    if base is None:
+                        continue
+                    mod = f"{base}.{node.module}" if node.module else base
+                elif node.module:
+                    mod = node.module
+                else:
+                    continue
                 for a in node.names:
-                    self.aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+                    self.aliases[a.asname or a.name] = f"{mod}.{a.name}"
 
     def dotted(self, node: ast.AST) -> Optional[str]:
         """Canonical dotted name of a Name/Attribute chain rooted at an

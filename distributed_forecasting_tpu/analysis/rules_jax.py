@@ -27,12 +27,13 @@ from distributed_forecasting_tpu.analysis.core import (
     Rule,
     register,
 )
+from distributed_forecasting_tpu.analysis.callgraph import get_callgraph
 from distributed_forecasting_tpu.analysis.jaxast import (
+    FunctionNode,
     ImportMap,
     base_name,
     local_bindings,
     traced_body_nodes,
-    traced_functions,
 )
 
 #: host-transfer spellings: canonical dotted call -> why it stalls
@@ -72,24 +73,84 @@ def _decorator_names(fn) -> frozenset:
     return frozenset(names)
 
 
-def _is_static_expr(node: ast.AST, statics: frozenset) -> bool:
+#: calls that return host-side strings/None at trace time — never tracers
+_HOST_STR_SOURCES = frozenset({"os.environ.get", "os.getenv"})
+
+
+def _is_static_expr(node: ast.AST, statics: frozenset, imap=None) -> bool:
     """Conservatively true when the expression is concrete at trace time:
-    literals, declared-static params (and their attributes), ``len`` of
-    anything (shapes are static), and arithmetic thereof."""
+    literals, declared-static params (and their attributes / ``getattr``
+    reads), ``len`` of anything (shapes are static), tuples of statics,
+    arithmetic thereof, and (when ``imap`` is given) host string sources
+    like ``os.environ.get``."""
     if isinstance(node, ast.Constant):
         return True
     if isinstance(node, ast.Name):
         return node.id in statics
     if isinstance(node, ast.Attribute):
-        return _is_static_expr(node.value, statics)
+        return _is_static_expr(node.value, statics, imap)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return all(_is_static_expr(e, statics, imap) for e in node.elts)
     if isinstance(node, ast.Call):
-        return isinstance(node.func, ast.Name) and node.func.id == "len"
+        if imap is not None and imap.dotted(node.func) in _HOST_STR_SOURCES:
+            return True
+        if not isinstance(node.func, ast.Name):
+            return False
+        if node.func.id == "len":
+            return True
+        if node.func.id == "getattr" and node.args:
+            return all(_is_static_expr(a, statics, imap) for a in node.args)
+        return False
     if isinstance(node, ast.BinOp):
-        return (_is_static_expr(node.left, statics)
-                and _is_static_expr(node.right, statics))
+        return (_is_static_expr(node.left, statics, imap)
+                and _is_static_expr(node.right, statics, imap))
     if isinstance(node, ast.UnaryOp):
-        return _is_static_expr(node.operand, statics)
+        return _is_static_expr(node.operand, statics, imap)
     return False
+
+
+def _add_target(t: ast.AST, out: set) -> None:
+    if isinstance(t, ast.Name):
+        out.add(t.id)
+    elif isinstance(t, (ast.Tuple, ast.List)):
+        for e in t.elts:
+            _add_target(e, out)
+
+
+def _augmented_statics(fn, statics: frozenset, imap=None) -> frozenset:
+    """``statics`` plus locals provably static inside ``fn``: names
+    assigned from a static expression, and loop targets iterating one
+    (``for name, period, order in extra_seasonalities:`` — the config
+    tuple unpack idiom, ops/features.py)."""
+    out = set(statics)
+
+    def visit(stmts):
+        for stmt in stmts:
+            if isinstance(stmt, FunctionNode):
+                continue
+            if isinstance(stmt, ast.Assign):
+                if _is_static_expr(stmt.value, frozenset(out), imap):
+                    for t in stmt.targets:
+                        _add_target(t, out)
+            elif isinstance(stmt, ast.For):
+                if _is_static_expr(stmt.iter, frozenset(out), imap):
+                    _add_target(stmt.target, out)
+                visit(stmt.body)
+                visit(stmt.orelse)
+            elif isinstance(stmt, (ast.If, ast.While)):
+                visit(stmt.body)
+                visit(stmt.orelse)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                visit(stmt.body)
+            elif isinstance(stmt, ast.Try):
+                visit(stmt.body)
+                for h in stmt.handlers:
+                    visit(h.body)
+                visit(stmt.orelse)
+                visit(stmt.finalbody)
+
+    visit(fn.body)
+    return frozenset(out)
 
 
 @register
@@ -109,12 +170,12 @@ class HostSyncInHotPath(Rule):
     dir_names = frozenset({"ops", "engine", "parallel", "pipelines"})
 
     def check_module(self, module: ModuleInfo, project) -> List[Finding]:
-        imap = ImportMap(module.tree)
-        reach, entries = traced_functions(module.tree, imap)
+        graph = get_callgraph(project)
+        imap = graph.import_map(module)
+        reach, _ = graph.for_module(module)
         out: List[Finding] = []
         for fn, how in reach.items():
-            entry = entries.get(fn)
-            statics = entry.static_names if entry else frozenset()
+            statics = _augmented_statics(fn, graph.statics_of(fn), imap)
             for node in traced_body_nodes(fn):
                 if not isinstance(node, ast.Call):
                     continue
@@ -136,7 +197,7 @@ class HostSyncInHotPath(Rule):
                 elif (isinstance(node.func, ast.Name)
                         and node.func.id in _PY_CASTS
                         and node.args
-                        and not _is_static_expr(node.args[0], statics)):
+                        and not _is_static_expr(node.args[0], statics, imap)):
                     out.append(self.finding(
                         module, node,
                         f"{node.func.id}() on a potentially traced value in "
@@ -190,8 +251,9 @@ class TracerLeak(Rule):
     dir_names = frozenset()  # every module: a jit anywhere can leak
 
     def check_module(self, module: ModuleInfo, project) -> List[Finding]:
-        imap = ImportMap(module.tree)
-        reach, _ = traced_functions(module.tree, imap)
+        graph = get_callgraph(project)
+        imap = graph.import_map(module)
+        reach, _ = graph.for_module(module)
         out: List[Finding] = []
         for fn, how in reach.items():
             local = local_bindings(fn)
@@ -291,8 +353,8 @@ class StaticArgnumDrift(Rule):
     dir_names = frozenset()
 
     def check_module(self, module: ModuleInfo, project) -> List[Finding]:
-        imap = ImportMap(module.tree)
-        _, entries = traced_functions(module.tree, imap)
+        graph = get_callgraph(project)
+        _, entries = graph.for_module(module)
         out: List[Finding] = []
         for fn, entry in entries.items():
             if not entry.explicit_statics:

@@ -1,0 +1,82 @@
+"""SARIF 2.1.0 serialization of dflint findings.
+
+One run, one tool ("dflint"), every registered rule described in
+``tool.driver.rules`` so GitHub code scanning renders help text even for
+rules with zero results.  Pure stdlib — this module must stay importable
+without jax/numpy/pandas like the rest of the analysis package.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List
+
+from distributed_forecasting_tpu.analysis.core import REGISTRY, Finding
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json")
+
+#: dflint severity -> SARIF result level
+_LEVEL = {"error": "error", "warning": "warning", "info": "note"}
+
+
+def _rule_descriptor(name: str) -> Dict:
+    rule_cls = type(REGISTRY[name]())
+    doc = (rule_cls.__doc__ or "").strip()
+    short = doc.splitlines()[0].strip() if doc else name
+    return {
+        "id": name,
+        "name": rule_cls.__name__,
+        "shortDescription": {"text": short},
+        "defaultConfiguration": {
+            "level": _LEVEL.get(REGISTRY[name]().default_severity, "warning"),
+        },
+        # the docs catalogue is the canonical help text
+        "helpUri": f"docs/static-analysis.md#{name}",
+    }
+
+
+def _result(finding: Finding, rule_index: Dict[str, int]) -> Dict:
+    out: Dict = {
+        "ruleId": finding.rule,
+        "level": _LEVEL.get(finding.severity, "warning"),
+        "message": {"text": finding.message},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {
+                    "uri": finding.path,
+                    "uriBaseId": "%SRCROOT%",
+                },
+                "region": {"startLine": finding.line},
+            },
+        }],
+        # line-insensitive identity, same key the baseline uses — keeps
+        # alerts stable across unrelated edits to the file
+        "partialFingerprints": {
+            "dflint/v1": "|".join(finding.fingerprint()),
+        },
+    }
+    idx = rule_index.get(finding.rule)
+    if idx is not None:
+        out["ruleIndex"] = idx
+    return out
+
+
+def to_sarif(findings: Iterable[Finding]) -> Dict:
+    """A complete SARIF log dict for ``json.dumps``."""
+    rules: List[Dict] = [_rule_descriptor(name) for name in sorted(REGISTRY)]
+    rule_index = {r["id"]: i for i, r in enumerate(rules)}
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "dflint",
+                    "informationUri": "docs/static-analysis.md",
+                    "rules": rules,
+                },
+            },
+            "results": [_result(f, rule_index) for f in findings],
+        }],
+    }

@@ -105,6 +105,10 @@ def _lib() -> Optional[ctypes.CDLL]:
     global _LIB, _TRIED
     with _LOCK:
         if not _TRIED:
+            # the one-time native build IS the critical section: every
+            # concurrent first caller must block until the single compile
+            # finishes, otherwise they would race the .so on disk
+            # dflint: disable=blocking-under-lock (intentional build barrier)
             _LIB = _build_and_load()
             _TRIED = True
         return _LIB

@@ -106,8 +106,12 @@ def conformal_scale_from_paths(y, yhat, hi, eval_masks,
     """Per-series interval scale factors from already-computed CV paths
     (the ``cross_validate(..., calibrate=True)`` route — one CV pass feeds
     metrics, the diagnostics frame, AND calibration)."""
-    return _conformal_scale_impl(y, yhat, hi, eval_masks,
-                                 float(interval_width), int(min_points))
+    return _conformal_scale_impl(
+        y, yhat, hi, eval_masks,
+        # both are declared static on the impl, so the casts run at trace
+        # time — they canonicalize the jit cache key (0.95 vs np.float64)
+        # dflint: disable=host-sync-in-hot-path (trace-time static canonicalization)
+        float(interval_width), int(min_points))
 
 
 def conformal_interval_scale(

@@ -437,13 +437,26 @@ def configure_compile_cache(
     ``enabled=False`` tears both layers down (tests rely on this).
     """
     global _active_config, _active_store
-    with _state_lock:
-        if not config.enabled:
+    if not config.enabled:
+        with _state_lock:
             jax.config.update("jax_compilation_cache_dir", None)
             _active_config, _active_store = None, None
-            return None
-        xla_dir = os.path.join(config.directory, "xla")
-        os.makedirs(xla_dir, exist_ok=True)
+        return None
+    # filesystem work stays OUTSIDE _state_lock: on a shared filesystem a
+    # cold mkdir (or the eviction sweep walking the store) can take
+    # seconds, and the critical section should only cover the config flips
+    # and the global swap, not disk I/O
+    xla_dir = os.path.join(config.directory, "xla")
+    os.makedirs(xla_dir, exist_ok=True)
+    store = (
+        AOTStore(
+            os.path.join(config.directory, "aot"),
+            max_size_mb=config.max_size_mb,
+            eviction_policy=config.eviction_policy,
+        )
+        if config.aot_store else None
+    )
+    with _state_lock:
         jax.config.update("jax_compilation_cache_dir", xla_dir)
         # CPU programs compile in under the default 1 s threshold and
         # above the default min size — without these every CPU entry is
@@ -457,17 +470,12 @@ def configure_compile_cache(
             if config.eviction_policy == "lru" else -1,
         )
         _active_config = config
-        _active_store = (
-            AOTStore(
-                os.path.join(config.directory, "aot"),
-                max_size_mb=config.max_size_mb,
-                eviction_policy=config.eviction_policy,
-            )
-            if config.aot_store else None
-        )
-        if _active_store is not None:
-            _active_store.evict()
-        return _active_store
+        _active_store = store
+    if store is not None:
+        # best-effort disk sweep; touches only the store's own files and
+        # per-instance lock, so no global state to protect
+        store.evict()
+    return store
 
 
 def enable_from_env() -> Optional[AOTStore]:

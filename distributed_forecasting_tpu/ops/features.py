@@ -195,8 +195,13 @@ def curve_design_matrix(
         cols.append(yr)
     extra_slices = {}
     pos = n_fixed + k + n_wk + n_yr
+    # extra_seasonalities is static model config at every traced entry
+    # point (the call sites rebuild it from the static config), so these
+    # casts normalize conf-file values at trace time, not on device data
     for name, period, order in extra_seasonalities:
+        # dflint: disable=host-sync-in-hot-path (static config tuple)
         order = int(order)
+        # dflint: disable=host-sync-in-hot-path (static config tuple)
         cols.append(fourier_features(day, float(period), order))
         extra_slices[f"seas_{name}"] = slice(pos, pos + 2 * order)
         pos += 2 * order

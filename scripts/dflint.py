@@ -1,7 +1,8 @@
 #!/usr/bin/env python
 """dflint CLI — repo-native JAX/TPU static analysis.
 
-Usage: python scripts/dflint.py [paths...] [--format json] [--write-baseline]
+Usage: python scripts/dflint.py [paths...] [--format json|sarif]
+       [--changed-only [--diff-base REV]] [--write-baseline]
 See docs/static-analysis.md for the rule catalogue and suppression syntax.
 """
 

@@ -1,0 +1,588 @@
+"""dflint v2 tests: the project call graph (cross-module jit
+reachability, alias/re-export/relative-import resolution, interprocedural
+static-argument inheritance), the lock-order/blocking-under-lock rules,
+recompile-churn detection, and the new CLI surface (SARIF output,
+--changed-only) — plus the `make lint` wall-time guard.
+
+Same fixture idiom as test_dflint.py: source STRINGS in tmp trees,
+nothing imports jax/numpy.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from distributed_forecasting_tpu.analysis import lint_paths
+from distributed_forecasting_tpu.analysis import cli
+
+from test_dflint import _write, _lint  # shared fixture helpers
+
+REPO = Path(__file__).resolve().parents[2]
+
+
+def _rules(found):
+    return sorted(f.rule for f in found)
+
+
+# ---------------------------------------------------------------------------
+# cross-module jit reachability (the per-module blind spot, closed)
+# ---------------------------------------------------------------------------
+
+def test_host_sync_reaches_across_modules(tmp_path):
+    # the jit entry lives in engine/, the sync in ops/ — invisible to a
+    # module-local closure, the core case the call graph exists for
+    _write(tmp_path, "ops/helper.py", """
+        def pull(x):
+            return x.item()
+    """)
+    _write(tmp_path, "engine/entry.py", """
+        import jax
+        from ops.helper import pull
+
+        @jax.jit
+        def run(x):
+            return pull(x)
+    """)
+    found = _lint(tmp_path, "ops/helper.py")
+    assert _rules(found) == ["host-sync-in-hot-path"]
+    assert "engine/entry.py" in found[0].message
+
+
+def test_reach_through_import_alias_and_reexport(tmp_path):
+    _write(tmp_path, "ops/impl.py", """
+        def pull(x):
+            return x.item()
+    """)
+    _write(tmp_path, "ops/__init__.py", """
+        from ops.impl import pull
+    """)
+    _write(tmp_path, "engine/entry.py", """
+        import jax
+        from ops import pull as grab
+
+        @jax.jit
+        def run(x):
+            return grab(x)
+    """)
+    found = _lint(tmp_path, "ops/impl.py")
+    assert _rules(found) == ["host-sync-in-hot-path"]
+
+
+def test_reach_through_relative_import(tmp_path):
+    _write(tmp_path, "pkg/__init__.py", "")
+    _write(tmp_path, "pkg/ops/__init__.py", "")
+    _write(tmp_path, "pkg/ops/helper.py", """
+        def pull(x):
+            return x.item()
+    """)
+    _write(tmp_path, "pkg/engine/__init__.py", "")
+    _write(tmp_path, "pkg/engine/entry.py", """
+        import jax
+        from ..ops.helper import pull
+
+        @jax.jit
+        def run(x):
+            return pull(x)
+    """)
+    found = _lint(tmp_path, "pkg/ops/helper.py")
+    assert _rules(found) == ["host-sync-in-hot-path"]
+
+
+def test_jit_call_form_claims_imported_function(tmp_path):
+    # jax.jit(imported_fn) marks the def in its DEFINING module as traced
+    _write(tmp_path, "ops/helper.py", """
+        def pull(x):
+            return x.item()
+    """)
+    _write(tmp_path, "engine/entry.py", """
+        import jax
+        from ops.helper import pull
+
+        fast_pull = jax.jit(pull)
+    """)
+    found = _lint(tmp_path, "ops/helper.py")
+    assert _rules(found) == ["host-sync-in-hot-path"]
+
+
+def test_test_modules_never_claim_jit_entries(tmp_path):
+    # tests jit host wrappers on purpose (tracer-fallback coverage); that
+    # must not mark library host paths as traced
+    _write(tmp_path, "ops/helper.py", """
+        def pull(x):
+            return x.item()
+    """)
+    _write(tmp_path, "tests/test_wrap.py", """
+        import jax
+        from ops.helper import pull
+
+        @jax.jit
+        def outer(x):
+            return pull(x)
+    """)
+    assert _lint(tmp_path, "ops/helper.py") == []
+
+
+# ---------------------------------------------------------------------------
+# interprocedural static-argument inheritance
+# ---------------------------------------------------------------------------
+
+def test_statics_inherited_across_modules(tmp_path):
+    # n is static at the only traced call site -> float(n) is trace-time
+    _write(tmp_path, "ops/helper.py", """
+        def scale(x, n):
+            return x * float(n)
+    """)
+    _write(tmp_path, "engine/entry.py", """
+        import jax
+        from functools import partial
+        from ops.helper import scale
+
+        @partial(jax.jit, static_argnames=("n",))
+        def run(x, n):
+            return scale(x, n)
+    """)
+    assert _lint(tmp_path, "ops/helper.py") == []
+
+
+def test_statics_intersect_over_call_sites(tmp_path):
+    # a second traced call site passes a TRACED value for n -> the
+    # intersection drops it and float(n) is flagged again
+    _write(tmp_path, "ops/helper.py", """
+        def scale(x, n):
+            return x * float(n)
+    """)
+    _write(tmp_path, "engine/entry.py", """
+        import jax
+        from functools import partial
+        from ops.helper import scale
+
+        @partial(jax.jit, static_argnames=("n",))
+        def run(x, n):
+            return scale(x, n)
+
+        @jax.jit
+        def run_dynamic(x):
+            return scale(x, x)
+    """)
+    found = _lint(tmp_path, "ops/helper.py")
+    assert _rules(found) == ["host-sync-in-hot-path"]
+
+
+def test_env_var_reads_are_static(tmp_path):
+    # os.environ strings exist at trace time; int() on them is host math
+    _write(tmp_path, "ops/helper.py", """
+        import os
+        import jax
+
+        @jax.jit
+        def f(x):
+            chunk = os.environ.get("CHUNK")
+            if chunk is not None:
+                n = int(chunk)
+            else:
+                n = 4
+            return x * n
+    """)
+    assert _lint(tmp_path, "ops/helper.py") == []
+
+
+# ---------------------------------------------------------------------------
+# lock-order-cycle
+# ---------------------------------------------------------------------------
+
+def test_lock_order_cycle_positive(tmp_path):
+    _write(tmp_path, "serving/locks.py", """
+        import threading
+
+        A = threading.Lock()
+        B = threading.Lock()
+
+        def forward():
+            with A:
+                with B:
+                    pass
+
+        def backward():
+            with B:
+                with A:
+                    pass
+    """)
+    found = _lint(tmp_path, "serving/locks.py")
+    assert "lock-order-cycle" in _rules(found)
+
+
+def test_lock_order_consistent_negative(tmp_path):
+    _write(tmp_path, "serving/locks.py", """
+        import threading
+
+        A = threading.Lock()
+        B = threading.Lock()
+
+        def first():
+            with A:
+                with B:
+                    pass
+
+        def second():
+            with A:
+                with B:
+                    pass
+    """)
+    assert _lint(tmp_path, "serving/locks.py") == []
+
+
+def test_lock_order_cycle_through_callee(tmp_path):
+    # the second acquisition is a call away — needs function summaries
+    _write(tmp_path, "serving/locks.py", """
+        import threading
+
+        A = threading.Lock()
+        B = threading.Lock()
+
+        def _inner_b():
+            with B:
+                pass
+
+        def _inner_a():
+            with A:
+                pass
+
+        def forward():
+            with A:
+                _inner_b()
+
+        def backward():
+            with B:
+                _inner_a()
+    """)
+    found = _lint(tmp_path, "serving/locks.py")
+    assert "lock-order-cycle" in _rules(found)
+
+
+def test_rlock_reacquire_is_not_a_cycle(tmp_path):
+    _write(tmp_path, "serving/locks.py", """
+        import threading
+
+        L = threading.RLock()
+
+        def outer():
+            with L:
+                inner()
+
+        def inner():
+            with L:
+                pass
+    """)
+    assert _lint(tmp_path, "serving/locks.py") == []
+
+
+# ---------------------------------------------------------------------------
+# blocking-under-lock
+# ---------------------------------------------------------------------------
+
+def test_blocking_put_under_lock_positive(tmp_path):
+    _write(tmp_path, "serving/q.py", """
+        import queue
+        import threading
+
+        L = threading.Lock()
+        Q = queue.Queue(maxsize=8)
+
+        def submit(item):
+            with L:
+                Q.put(item)
+    """)
+    found = _lint(tmp_path, "serving/q.py")
+    assert "blocking-under-lock" in _rules(found)
+
+
+def test_timeout_put_under_lock_negative(tmp_path):
+    _write(tmp_path, "serving/q.py", """
+        import queue
+        import threading
+
+        L = threading.Lock()
+        Q = queue.Queue(maxsize=8)
+
+        def submit(item):
+            with L:
+                Q.put(item, timeout=0.5)
+    """)
+    assert _lint(tmp_path, "serving/q.py") == []
+
+
+def test_unbounded_queue_put_never_blocks(tmp_path):
+    _write(tmp_path, "serving/q.py", """
+        import queue
+        import threading
+
+        L = threading.Lock()
+        Q = queue.Queue()
+
+        def submit(item):
+            with L:
+                Q.put(item)
+    """)
+    assert _lint(tmp_path, "serving/q.py") == []
+
+
+def test_file_io_under_lock_through_callee(tmp_path):
+    _write(tmp_path, "serving/state.py", """
+        import threading
+
+        L = threading.Lock()
+
+        def _persist(path, payload):
+            with open(path, "w") as fh:
+                fh.write(payload)
+
+        def save(path, payload):
+            with L:
+                _persist(path, payload)
+    """)
+    found = _lint(tmp_path, "serving/state.py")
+    assert "blocking-under-lock" in _rules(found)
+    assert "_persist" in found[0].message
+
+
+def test_io_outside_lock_negative(tmp_path):
+    _write(tmp_path, "serving/state.py", """
+        import threading
+
+        L = threading.Lock()
+        _cache = {}
+
+        def save(path, payload):
+            with L:
+                _cache[path] = payload
+            with open(path, "w") as fh:
+                fh.write(payload)
+    """)
+    assert _lint(tmp_path, "serving/state.py") == []
+
+
+# ---------------------------------------------------------------------------
+# recompile-churn
+# ---------------------------------------------------------------------------
+
+def test_weak_type_churn_across_call_sites(tmp_path):
+    _write(tmp_path, "models/m.py", """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x, scale):
+            return x * scale
+
+        def site_literal(x):
+            return f(x, 0.5)
+
+        def site_typed(x):
+            return f(x, jnp.asarray(0.5, dtype=jnp.float32))
+    """)
+    found = _lint(tmp_path, "models/m.py")
+    assert "recompile-churn" in _rules(found)
+    assert any("weakly typed" in f.message for f in found)
+
+
+def test_consistent_call_sites_negative(tmp_path):
+    _write(tmp_path, "models/m.py", """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x, scale):
+            return x * scale
+
+        def site_a(x):
+            return f(x, jnp.asarray(0.5, dtype=jnp.float32))
+
+        def site_b(x):
+            return f(x, jnp.asarray(2.0, dtype=jnp.float32))
+    """)
+    assert _lint(tmp_path, "models/m.py") == []
+
+
+def test_traced_branch_flagged(tmp_path):
+    _write(tmp_path, "models/m.py", """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            total = jnp.sum(x)
+            if total > 0:
+                return x
+            return -x
+    """)
+    found = _lint(tmp_path, "models/m.py")
+    assert "recompile-churn" in _rules(found)
+    assert any("branch" in f.message for f in found)
+
+
+def test_static_branch_negative(tmp_path):
+    _write(tmp_path, "models/m.py", """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("mode",))
+        def f(x, mode):
+            if mode == "fast":
+                return x
+            return x * 2
+    """)
+    assert _lint(tmp_path, "models/m.py") == []
+
+
+def test_unhashable_static_arg_flagged(tmp_path):
+    _write(tmp_path, "models/m.py", """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("cfg",))
+        def f(x, cfg):
+            return x * len(cfg)
+
+        def call(x):
+            return f(x, cfg=[1, 2, 3])
+    """)
+    found = _lint(tmp_path, "models/m.py")
+    assert "recompile-churn" in _rules(found)
+    assert any("unhashable" in f.message for f in found)
+
+
+def test_backend_string_branch_not_flagged(tmp_path):
+    # jax.default_backend() returns a host string — branching on it is
+    # plain control flow, not churn (the FP that shaped _ARRAY_ROOTS)
+    _write(tmp_path, "models/m.py", """
+        import jax
+
+        @jax.jit
+        def f(x):
+            if jax.default_backend() != "cpu":
+                return x * 2
+            return x
+    """)
+    assert _lint(tmp_path, "models/m.py") == []
+
+
+# ---------------------------------------------------------------------------
+# CLI: SARIF + --changed-only
+# ---------------------------------------------------------------------------
+
+def _cli(tmp_path, capsys, *argv):
+    code = cli.main(["--root", str(tmp_path), *argv])
+    return code, capsys.readouterr().out
+
+
+def test_sarif_output_shape(tmp_path, capsys):
+    _write(tmp_path, "ops/hot.py", """
+        import jax
+
+        @jax.jit
+        def f(x):
+            return x.item()
+    """)
+    code, out = _cli(tmp_path, capsys, str(tmp_path / "ops"),
+                     "--format", "sarif", "--no-baseline")
+    assert code == 1
+    log = json.loads(out)
+    assert log["version"] == "2.1.0"
+    run = log["runs"][0]
+    assert run["tool"]["driver"]["name"] == "dflint"
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {"host-sync-in-hot-path", "lock-order-cycle",
+            "blocking-under-lock", "recompile-churn"} <= rule_ids
+    (result,) = run["results"]
+    assert result["ruleId"] == "host-sync-in-hot-path"
+    assert result["level"] == "error"
+    loc = result["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "ops/hot.py"
+    assert loc["region"]["startLine"] > 1
+    assert "dflint/v1" in result["partialFingerprints"]
+
+
+def _git(tmp_path, *args):
+    subprocess.run(["git", *args], cwd=tmp_path, check=True,
+                   capture_output=True,
+                   env={**os.environ,
+                        "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+                        "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t"})
+
+
+def test_changed_only_lints_only_changed_files(tmp_path, capsys):
+    _git(tmp_path, "init", "-q")
+    _write(tmp_path, "ops/clean_but_bad.py", """
+        import jax
+
+        @jax.jit
+        def f(x):
+            return x.item()
+    """)
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-qm", "seed")
+    # committed file is dirty by dflint standards but unchanged vs HEAD:
+    # --changed-only must skip it and report clean
+    code, out = _cli(tmp_path, capsys, str(tmp_path / "ops"),
+                     "--changed-only", "--no-baseline")
+    assert code == 0
+    assert "nothing to do" in out
+    # an untracked file with a violation is in scope
+    _write(tmp_path, "ops/fresh.py", """
+        import jax
+
+        @jax.jit
+        def g(x):
+            return float(x)
+    """)
+    code, out = _cli(tmp_path, capsys, str(tmp_path / "ops"),
+                     "--changed-only", "--no-baseline")
+    assert code == 1
+    assert "ops/fresh.py" in out and "clean_but_bad" not in out
+
+
+def test_changed_only_bad_rev_is_usage_error(tmp_path, capsys):
+    _git(tmp_path, "init", "-q")
+    _write(tmp_path, "ops/a.py", "x = 1\n")
+    code, _ = _cli(tmp_path, capsys, str(tmp_path / "ops"),
+                   "--changed-only", "--diff-base", "no-such-rev")
+    assert code == 2
+
+
+# ---------------------------------------------------------------------------
+# guards: wall time, import purity
+# ---------------------------------------------------------------------------
+
+def test_make_lint_wall_time_under_10s():
+    start = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "dflint.py"),
+         str(REPO / "distributed_forecasting_tpu")],
+        capture_output=True, text=True, cwd=REPO, timeout=60)
+    elapsed = time.monotonic() - start
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert elapsed < 10.0, f"make lint took {elapsed:.1f}s (budget 10s)"
+
+
+def test_v2_modules_never_import_jax():
+    # same contract as the package-level test, for the new surface: the
+    # CLI (with SARIF serialization) must stay importable with no
+    # jax/numpy/pandas anywhere in sys.modules
+    code = (
+        "import sys\n"
+        "from distributed_forecasting_tpu.analysis import cli, sarif\n"
+        "from distributed_forecasting_tpu.analysis import callgraph\n"
+        "from distributed_forecasting_tpu.analysis import rules_lockorder\n"
+        "from distributed_forecasting_tpu.analysis import absint\n"
+        "bad = [m for m in ('jax', 'numpy', 'pandas')\n"
+        "       if m in sys.modules]\n"
+        "assert not bad, bad\n"
+    )
+    proc = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                          capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr
