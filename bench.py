@@ -429,8 +429,16 @@ import numpy as np
 from distributed_forecasting_tpu.data import synthetic_store_item_sales
 from distributed_forecasting_tpu.data.catalog import DatasetCatalog
 from distributed_forecasting_tpu.engine.executor import PipelineConfig
+from distributed_forecasting_tpu.monitoring.trace import (
+    enable_from_env, get_tracer, write_chrome_trace)
 from distributed_forecasting_tpu.pipelines.training import TrainingPipeline
 from distributed_forecasting_tpu.tracking.filestore import FileTracker
+
+# DFTPU_TRACE_DIR=<dir> (set by CI or a human debugging the probe) streams
+# pipeline.* spans to <dir>/trace.jsonl and dumps a Perfetto-loadable
+# snapshot at the end; unset, tracing stays on the default in-memory ring
+trace_dir = os.environ.get("DFTPU_TRACE_DIR")
+enable_from_env()
 
 # smoke-sized so the serial leg stays ~2-3 s on one CPU: 200 series x
 # 1000 days keeps the host chain (tensorize + artifact/tracking writes)
@@ -519,6 +527,15 @@ try:
         "serial_stage_seconds": {k: sm[k] for k in stages},
         "pipelined_stage_seconds": {k: pm[k] for k in stages},
     }
+    if trace_dir:
+        tracer = get_tracer()
+        write_chrome_trace(
+            os.path.join(trace_dir, "overlap.trace.json"),
+            tracer.recorder.snapshot(),
+            metadata={"probe": "pipeline_overlap", "n_experiments": N_EXP},
+        )
+        tracer.close()
+        out["trace_dir"] = trace_dir
     print("OVERLAPPROBE=" + json.dumps(out))
 finally:
     shutil.rmtree(root, ignore_errors=True)

@@ -3,10 +3,19 @@
 NeuralProphet's reproducibility guidance (PAPERS.md) pins forecast drift on
 hidden nondeterminism; this repo's equivalents are a bare ``np.random.*`` /
 ``random.*`` draw or a wall-clock read inside the numeric layers (``ops/``,
-``engine/``, ``models/``).  Randomness there must flow through an explicit
-``jax.random`` key or a seeded ``np.random.default_rng(seed)``, and timing
-belongs to the orchestration layers (``pipelines/``, ``workflows/``,
-``utils/profiling``), which this rule deliberately does not cover.
+``engine/``, ``models/``) and the telemetry layer (``monitoring/``, whose
+span/metric values feed dashboards that must not silently mix clock
+domains).  Randomness there must flow through an explicit ``jax.random``
+key or a seeded ``np.random.default_rng(seed)``, and timing belongs to the
+orchestration layers (``pipelines/``, ``workflows/``, ``utils/profiling``),
+which this rule deliberately does not cover.
+
+Structural exemption: the MONOTONIC clocks (``time.monotonic``,
+``time.perf_counter`` and their ``_ns`` variants) are never flagged — they
+measure durations, carry no wall-clock information, and are exactly what
+the tracing layer (``monitoring/trace.py``) is built on.  Only wall clocks
+(``time.time``/``time.time_ns``) make numeric or telemetry output depend on
+*when* it ran.
 """
 
 from __future__ import annotations
@@ -25,13 +34,26 @@ from distributed_forecasting_tpu.analysis.jaxast import ImportMap
 #: numpy.random constructors that ARE deterministic once given a seed
 _SEEDABLE = frozenset({"default_rng", "RandomState", "SeedSequence", "Generator"})
 
-_CLOCKS = frozenset({"time.time", "time.time_ns"})
+#: every clock read the rule recognizes...
+_ALL_CLOCKS = frozenset({
+    "time.time", "time.time_ns",
+    "time.monotonic", "time.monotonic_ns",
+    "time.perf_counter", "time.perf_counter_ns",
+})
+#: ...minus the structurally exempt monotonic ones: duration measurement is
+#: deterministic IN KIND (no wall-clock leak), so the tracing layer's span
+#: timestamps never need inline suppressions
+_MONOTONIC = frozenset({
+    "time.monotonic", "time.monotonic_ns",
+    "time.perf_counter", "time.perf_counter_ns",
+})
+_CLOCKS = _ALL_CLOCKS - _MONOTONIC
 
 
 @register
 class Nondeterminism(Rule):
     name = "nondeterminism"
-    dir_names = frozenset({"ops", "engine", "models"})
+    dir_names = frozenset({"ops", "engine", "models", "monitoring"})
 
     def check_module(self, module: ModuleInfo, project) -> List[Finding]:
         imap = ImportMap(module.tree)

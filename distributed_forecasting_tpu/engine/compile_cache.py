@@ -62,6 +62,7 @@ from typing import Any, Callable, Dict, Optional
 import jax
 
 from distributed_forecasting_tpu.monitoring.monitor import MetricsRegistry
+from distributed_forecasting_tpu.monitoring.trace import get_tracer
 from distributed_forecasting_tpu.utils import get_logger
 
 _logger = get_logger("compile_cache")
@@ -94,6 +95,12 @@ _load_seconds = _registry.histogram(
 _compile_seconds = _registry.histogram(
     "compile_cache_compile_seconds", _COMPILE_BUCKETS,
     "lower+compile time per store miss")
+# per-entry breakdown: entry names are arbitrary strings ("serving_predict:
+# prophet"), so this doubles as the live consumer of the registry's
+# label-value escaping
+_entry_requests = _registry.labeled_counter(
+    "compile_cache_entry_requests_total", ("entry", "outcome"),
+    "AOT store lookups per entry point, by outcome (memo | hit | miss)")
 
 
 def metrics_registry() -> MetricsRegistry:
@@ -395,26 +402,38 @@ class AOTStore:
         in the in-process memo but out of the on-disk store (programs whose
         executables do not survive serialization — see :func:`aot_call`).
         """
-        with self._lock:
-            compiled = self._memo.get(key)
-        if compiled is not None:
+        tracer = get_tracer()
+        with tracer.span("aot.call", entry=entry) as span:
+            with self._lock:
+                compiled = self._memo.get(key)
+            if compiled is not None:
+                # steady-state fast path; the span records it so a trace
+                # distinguishes "cache did its job" from "cache bypassed"
+                span.set_attribute("outcome", "memo")
+                _entry_requests.inc(entry=entry, outcome="memo")
+                return compiled
+            with tracer.span("aot.load", entry=entry):
+                compiled = self.load(key)
+            if compiled is not None:
+                _hits.inc()
+                span.set_attribute("outcome", "hit")
+                _entry_requests.inc(entry=entry, outcome="hit")
+            else:
+                _misses.inc()
+                span.set_attribute("outcome", "miss")
+                _entry_requests.inc(entry=entry, outcome="miss")
+                t0 = time.perf_counter()
+                with tracer.span("aot.compile", entry=entry):
+                    result = compile_fn()
+                compiled, storable = (
+                    result if isinstance(result, tuple) else (result, True)
+                )
+                _compile_seconds.observe(time.perf_counter() - t0)
+                if storable:
+                    self.store(key, compiled, entry=entry)
+            with self._lock:
+                self._memo[key] = compiled
             return compiled
-        compiled = self.load(key)
-        if compiled is not None:
-            _hits.inc()
-        else:
-            _misses.inc()
-            t0 = time.perf_counter()
-            result = compile_fn()
-            compiled, storable = (
-                result if isinstance(result, tuple) else (result, True)
-            )
-            _compile_seconds.observe(time.perf_counter() - t0)
-            if storable:
-                self.store(key, compiled, entry=entry)
-        with self._lock:
-            self._memo[key] = compiled
-        return compiled
 
 
 # -- process-global configuration -------------------------------------------

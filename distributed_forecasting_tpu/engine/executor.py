@@ -60,6 +60,13 @@ from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional
 
 import jax
 
+from distributed_forecasting_tpu.monitoring.trace import (
+    TraceContext,
+    device_annotation,
+    get_tracer,
+    new_trace_id,
+)
+
 logger = logging.getLogger(__name__)
 
 
@@ -265,46 +272,73 @@ class TrainingExecutor:
         self._raise_if_failed()
         self.metrics.inc_experiments()
         handle = ExperimentHandle(name)
+        ctx = self._experiment_ctx()
         if not self._async:
-            return self._run_serial(handle, prep, dispatch, complete)
+            return self._run_serial(handle, prep, dispatch, complete, ctx)
 
         self._ensure_worker()
         self._slots.acquire()
+        tracer = get_tracer()
         try:
             t0 = time.perf_counter()
-            prepared = prep()
+            with tracer.span("pipeline.prep", ctx=ctx, experiment=name):
+                prepared = prep()
             t1 = time.perf_counter()
             self._observe("prep", t1 - t0)
             self._record_dispatch(t1)
-            state = dispatch(prepared)
+            with tracer.span("pipeline.dispatch", ctx=ctx, experiment=name):
+                with device_annotation(f"pipeline_dispatch:{name}"):
+                    state = dispatch(prepared)
             t2 = time.perf_counter()
             self._observe("dispatch", t2 - t1)
         except BaseException:
             self._slots.release()
             raise
         self._set_in_flight(+1)
-        self._queue.put((handle, state, complete))
+        # ctx rides along so the writer thread's pull/complete spans land in
+        # the same trace as this thread's prep/dispatch spans
+        self._queue.put((handle, state, complete, ctx))
         return handle
 
+    def _experiment_ctx(self) -> Optional[TraceContext]:
+        """One trace per experiment: the caller's current context when a
+        span is open (run_many under an outer span), a fresh trace id
+        otherwise — so an experiment's four stage spans always share one
+        trace id even though they run on two threads."""
+        tracer = get_tracer()
+        if not tracer.enabled:
+            return None
+        ctx = tracer.current()
+        if ctx is None:
+            ctx = TraceContext(new_trace_id(), None)
+        return ctx
+
     def _run_serial(self, handle: ExperimentHandle, prep, dispatch,
-                    complete) -> ExperimentHandle:
+                    complete, ctx=None) -> ExperimentHandle:
         # Inline reference path: identical stage structure and accounting,
         # no thread — what the determinism suite compares against.
+        tracer = get_tracer()
+        name = handle.name
         t0 = time.perf_counter()
-        prepared = prep()
+        with tracer.span("pipeline.prep", ctx=ctx, experiment=name):
+            prepared = prep()
         t1 = time.perf_counter()
         self._observe("prep", t1 - t0)
         self._record_dispatch(t1)
-        state = dispatch(prepared)
+        with tracer.span("pipeline.dispatch", ctx=ctx, experiment=name):
+            with device_annotation(f"pipeline_dispatch:{name}"):
+                state = dispatch(prepared)
         t2 = time.perf_counter()
         self._observe("dispatch", t2 - t1)
         try:
-            state = device_pull(state)
+            with tracer.span("pipeline.pull", ctx=ctx, experiment=name):
+                state = device_pull(state)
             t3 = time.perf_counter()
             self._record_pull_end(t3)
             self._observe("pull", t3 - t2)
             self._inject_stage_seconds(state, t1 - t0, t2 - t1, t3 - t2)
-            result = complete(state)
+            with tracer.span("pipeline.complete", ctx=ctx, experiment=name):
+                result = complete(state)
             t4 = time.perf_counter()
             self._observe("complete", t4 - t3)
             with self._lock:
@@ -339,20 +373,28 @@ class TrainingExecutor:
                 self._worker.start()
 
     def _drain(self) -> None:
+        tracer = get_tracer()
         while True:
             task = self._queue.get()
             if task is _STOP:
                 self._queue.task_done()
                 return
-            handle, state, complete = task
+            handle, state, complete, ctx = task
             try:
                 t0 = time.perf_counter()
-                state = device_pull(state)
+                # pull duration IS the queue-wait + device-wait for this
+                # experiment's stage C: it starts when the writer picks the
+                # task up and ends when the device has drained
+                with tracer.span("pipeline.pull", ctx=ctx,
+                                 experiment=handle.name):
+                    state = device_pull(state)
                 t1 = time.perf_counter()
                 self._record_pull_end(t1)
                 self._observe("pull", t1 - t0)
                 self._inject_stage_seconds(state, 0.0, 0.0, t1 - t0)
-                result = complete(state)
+                with tracer.span("pipeline.complete", ctx=ctx,
+                                 experiment=handle.name):
+                    result = complete(state)
                 t2 = time.perf_counter()
                 self._observe("complete", t2 - t1)
                 with self._lock:
@@ -457,10 +499,14 @@ def prefetch_to_device(items: Iterable[Any], depth: Optional[int] = None,
     """
     if depth is None:
         depth = pipeline_config().prefetch_depth
+    tracer = get_tracer()
     it = iter(items)
     buf: "collections.deque" = collections.deque()
     for item in it:
-        buf.append(place(item))
+        # the span times the host-side issue of the copy (device_put
+        # returns immediately) — visible lookahead in the trace lanes
+        with tracer.span("pipeline.prefetch", depth=depth):
+            buf.append(place(item))
         if len(buf) > depth:
             yield buf.popleft()
     while buf:

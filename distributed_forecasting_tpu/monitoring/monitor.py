@@ -74,7 +74,10 @@ class MonitorRegistry:
         if os.path.exists(path) and not exist_ok:
             raise FileExistsError(f"monitor {config.name!r} exists")
         with open(path, "w") as f:
-            json.dump({**config.to_dict(), "created_at": time.time()}, f, indent=2)
+            # human-readable provenance only, never numerics
+            json.dump({**config.to_dict(),
+                       "created_at": time.time()},  # dflint: disable=nondeterminism
+                      f, indent=2)
 
     def get_monitor(self, name: str) -> MonitorConfig:
         path = self._path(name)
@@ -510,6 +513,36 @@ def _fmt_value(v: float) -> str:
     return str(int(f)) if f.is_integer() and abs(f) < 1e15 else repr(f)
 
 
+def escape_label_value(value) -> str:
+    """Escape a label VALUE per the text exposition format 0.0.4: backslash,
+    double-quote and newline must be escaped inside the quoted value, in
+    this order (escaping the escape character first).  Label values are the
+    one place arbitrary strings (model families, AOT entry names, span
+    kinds) reach the exposition, so un-escaped quotes or newlines would let
+    one hostile or merely unlucky name corrupt the whole scrape."""
+    return (str(value)
+            .replace("\\", "\\\\")
+            .replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _escape_help(text: str) -> str:
+    """HELP text escaping (format 0.0.4): backslash and newline only —
+    a newline in help text would otherwise terminate the comment line and
+    inject whatever follows as a sample line."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def render_labels(labels: Dict[str, str]) -> str:
+    """``{a="x",b="y"}`` with escaped values; empty dict renders nothing."""
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{k}="{escape_label_value(v)}"' for k, v in labels.items()
+    )
+    return "{" + inner + "}"
+
+
 class Counter:
     """Monotonically increasing counter (thread-safe)."""
 
@@ -563,6 +596,58 @@ class Gauge:
 
     def snapshot(self) -> float:
         return self.value
+
+
+class LabeledCounter:
+    """Counter family keyed by label values (thread-safe).
+
+    The plain :class:`Counter` covers fixed-name telemetry; this is the
+    labeled variant for low-cardinality breakdowns (AOT entry × outcome,
+    span kinds).  Values render with :func:`escape_label_value`, so family
+    members named with quotes/backslashes/newlines cannot corrupt the
+    exposition.  Keep label cardinality bounded by construction — every
+    distinct label combination is a live time series.
+    """
+
+    def __init__(self, label_names: Tuple[str, ...]) -> None:
+        if not label_names:
+            raise ValueError("labeled counter needs at least one label")
+        self._label_names = tuple(label_names)
+        self._values: Dict[Tuple[str, ...], float] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        if amount < 0:
+            raise ValueError("counters only go up; use a Gauge")
+        if set(labels) != set(self._label_names):
+            raise ValueError(
+                f"expected labels {self._label_names}, got {sorted(labels)}")
+        key = tuple(str(labels[k]) for k in self._label_names)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        key = tuple(str(labels[k]) for k in self._label_names)
+        with self._lock:
+            return self._values.get(key, 0.0)
+
+    def render(self, name: str) -> List[str]:
+        with self._lock:
+            items = sorted(self._values.items())
+        return [
+            name
+            + render_labels(dict(zip(self._label_names, key)))
+            + f" {_fmt_value(v)}"
+            for key, v in items
+        ]
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            items = sorted(self._values.items())
+        return {
+            ",".join(f"{k}={v}" for k, v in zip(self._label_names, key)): val
+            for key, val in items
+        }
 
 
 class Histogram:
@@ -670,13 +755,19 @@ class MetricsRegistry:
     ) -> Histogram:
         return self._register(name, "histogram", help_text, Histogram(buckets))
 
+    def labeled_counter(
+        self, name: str, label_names: Tuple[str, ...], help_text: str = ""
+    ) -> LabeledCounter:
+        return self._register(
+            name, "counter", help_text, LabeledCounter(label_names))
+
     def render_prometheus(self) -> str:
         with self._lock:
             items = list(self._metrics.items())
         lines: List[str] = []
         for name, (kind, help_text, metric) in items:
             if help_text:
-                lines.append(f"# HELP {name} {help_text}")
+                lines.append(f"# HELP {name} {_escape_help(help_text)}")
             lines.append(f"# TYPE {name} {kind}")
             lines.extend(metric.render(name))
         return "\n".join(lines) + "\n"

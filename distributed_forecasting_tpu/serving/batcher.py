@@ -53,6 +53,8 @@ from typing import Optional
 import pandas as pd
 
 from distributed_forecasting_tpu.monitoring.monitor import MetricsRegistry
+from distributed_forecasting_tpu.monitoring.trace import clock as trace_clock
+from distributed_forecasting_tpu.monitoring.trace import get_tracer
 from distributed_forecasting_tpu.serving.predictor import result_block_index
 from distributed_forecasting_tpu.utils import get_logger
 
@@ -176,6 +178,10 @@ class _Pending:
     future: Future
     enqueued_at: float
     deadline: float
+    # the submitting request's TraceContext (None when tracing is off or the
+    # caller had no open span): the scheduler thread adopts it so the
+    # merged dispatch lands in the submitter's trace
+    trace_ctx: object = None
 
     def signature(self, coalesce_safe: bool):
         """Requests merge iff their compiled program and merge semantics
@@ -215,6 +221,8 @@ class RequestBatcher:
     ) -> Future:
         """Enqueue a parsed request; the returned future resolves to the
         result frame (or the exception a solo call would have raised)."""
+        # time.monotonic IS the trace clock (monitoring.trace.clock), so
+        # enqueued_at doubles as the queue-wait span's start timestamp
         now = time.monotonic()
         item = _Pending(
             frame=frame,
@@ -226,6 +234,7 @@ class RequestBatcher:
             future=Future(),
             enqueued_at=now,
             deadline=now + self.config.request_timeout_s,
+            trace_ctx=get_tracer().current(),
         )
         with self._cond:
             if self._closed:
@@ -251,6 +260,12 @@ class RequestBatcher:
         if self._thread.is_alive():  # pragma: no cover - stuck device call
             self.logger.warning("batcher thread did not drain within %.1fs",
                                 timeout)
+
+    @property
+    def accepting(self) -> bool:
+        """False once close() has started — the server's /readyz input."""
+        with self._cond:
+            return not self._closed
 
     # -- scheduler side ------------------------------------------------------
     def _run(self) -> None:
@@ -279,11 +294,15 @@ class RequestBatcher:
 
     def _process(self, batch: list) -> None:
         now = time.monotonic()
+        tracer = get_tracer()
         live: dict = {}
         for item in batch:
             if now > item.deadline:
                 # expired while queued: fail fast instead of spending a
                 # dispatch on a response nobody is waiting for
+                tracer.record_span(
+                    "batcher.queue_wait", item.enqueued_at, now,
+                    ctx=item.trace_ctx, expired=True)
                 item.future.set_exception(TimeoutError(
                     f"request timed out after "
                     f"{self.config.request_timeout_s:g}s in queue"))
@@ -314,11 +333,33 @@ class RequestBatcher:
 
     def _dispatch(self, chunk: list) -> None:
         self.metrics.batch_size.observe(len(chunk))
+        tracer = get_tracer()
+        now = trace_clock()
+        for item in chunk:
+            # queue wait is explicit in every trace: enqueued_at was read
+            # from the same monotonic clock, so this is exact, not inferred
+            tracer.record_span("batcher.queue_wait", item.enqueued_at, now,
+                               ctx=item.trace_ctx)
+        # the scheduler thread adopts the FIRST request's trace; coalesced
+        # neighbors are correlated through the trace_ids attribute (one
+        # dispatch span cannot parent into N traces)
+        with tracer.context(chunk[0].trace_ctx):
+            with tracer.span(
+                "batcher.dispatch",
+                batch_size=len(chunk),
+                merged=len(chunk) > 1,
+                trace_ids=[item.trace_ctx.trace_id for item in chunk
+                           if item.trace_ctx is not None],
+            ) as span:
+                self._dispatch_inner(chunk, span)
+
+    def _dispatch_inner(self, chunk: list, span) -> None:
         if len(chunk) == 1:
             item = chunk[0]
             try:
                 item.future.set_result(self._call(item, item.frame))
             except Exception as e:  # noqa: BLE001 - scatter to the waiter
+                span.set_attribute("outcome", f"error:{type(e).__name__}")
                 item.future.set_exception(e)
             return
         try:
@@ -330,6 +371,7 @@ class RequestBatcher:
             self.logger.exception(
                 "merged dispatch of %d requests failed; retrying solo",
                 len(chunk))
+            span.set_attribute("outcome", "solo-retry")
             for item in chunk:
                 try:
                     item.future.set_result(self._call(item, item.frame))

@@ -30,6 +30,10 @@ import numpy as np
 import pandas as pd
 
 from distributed_forecasting_tpu.models.base import get_model
+from distributed_forecasting_tpu.monitoring.trace import (
+    device_annotation,
+    get_tracer,
+)
 # JSON round-trips tuples as lists; configs are static jit args and must
 # stay hashable — shared freeze() restores tuples recursively
 from distributed_forecasting_tpu.utils.config import freeze as _freeze
@@ -490,27 +494,37 @@ class BatchForecaster:
         # aot_call and still get the persistent XLA cache.
         from distributed_forecasting_tpu.engine.compile_cache import aot_call
 
-        yhat, lo, hi = aot_call(
-            f"serving_predict:{self.model}", fns.forecast,
-            args=(params, day_all, jnp.float32(self.day1)),
-            static_kwargs={"config": self.config},
-            dynamic_kwargs={"key": key, **fc_kwargs},
-        )
-        if scale is not None:
-            from distributed_forecasting_tpu.engine.calibrate import (
-                apply_interval_scale,
-            )
+        with get_tracer().span(
+            "serving.predict", model=self.model, k=k,
+            bucket=self._bucket(k), horizon=int(horizon),
+        ):
+            # the annotation stamps this dispatch onto the device timeline
+            # of a profiler capture, keyed like the AOT entry
+            with device_annotation(f"serving_predict:{self.model}"):
+                yhat, lo, hi = aot_call(
+                    f"serving_predict:{self.model}", fns.forecast,
+                    args=(params, day_all, jnp.float32(self.day1)),
+                    static_kwargs={"config": self.config},
+                    dynamic_kwargs={"key": key, **fc_kwargs},
+                )
+            if scale is not None:
+                from distributed_forecasting_tpu.engine.calibrate import (
+                    apply_interval_scale,
+                )
 
-            yhat, lo, hi = apply_interval_scale(yhat, lo, hi, scale,
-                                                floor=fns.band_floor)
-        if not include_history:
-            day_all = day_all[-horizon:]
-            yhat, lo, hi = yhat[:, -horizon:], lo[:, -horizon:], hi[:, -horizon:]
-        frame = self._frame_skeleton(sidx, day_all)
-        frame["yhat"] = np.asarray(yhat)[:k].reshape(-1)
-        frame["yhat_upper"] = np.asarray(hi)[:k].reshape(-1)
-        frame["yhat_lower"] = np.asarray(lo)[:k].reshape(-1)
-        return pd.DataFrame(frame)
+                yhat, lo, hi = apply_interval_scale(yhat, lo, hi, scale,
+                                                    floor=fns.band_floor)
+            if not include_history:
+                day_all = day_all[-horizon:]
+                yhat, lo, hi = (yhat[:, -horizon:], lo[:, -horizon:],
+                                hi[:, -horizon:])
+            frame = self._frame_skeleton(sidx, day_all)
+            # the np.asarray pulls are the host sync: they sit inside the
+            # span so device wait shows up as serving.predict time
+            frame["yhat"] = np.asarray(yhat)[:k].reshape(-1)
+            frame["yhat_upper"] = np.asarray(hi)[:k].reshape(-1)
+            frame["yhat_lower"] = np.asarray(lo)[:k].reshape(-1)
+            return pd.DataFrame(frame)
 
     def predict_quantiles(
         self,
@@ -543,31 +557,39 @@ class BatchForecaster:
         if sidx.size == 0:
             return pd.DataFrame(columns=["ds", *self.key_names, *qcols])
         k = int(sidx.size)
-        # conformal scaling spreads every level around the median, so the
-        # median is priced alongside when calibration is on (one extra
-        # column in the same compiled program) and dropped if not requested
-        priced = quantiles
-        if scale is not None and 0.5 not in priced:
-            priced = tuple(sorted((*priced, 0.5)))
-        yq = fns.forecast_quantiles(
-            params, day_all, jnp.float32(self.day1), self.config,
-            priced, key, **fc_kwargs,
-        )  # (bucket, Q, T_all)
-        if scale is not None:
-            med = yq[:, priced.index(0.5), :][:, None, :]
-            yq = med + scale[:, None, None] * (yq - med)
-            if fns.band_floor is not None:
-                # re-apply the family's hard clamp (gaussian_quantiles
-                # floors the raw levels; widening must not undo it)
-                yq = jnp.maximum(yq, fns.band_floor)
-        if priced != quantiles:
-            keep = jnp.asarray([priced.index(q) for q in quantiles])
-            yq = yq[:, keep, :]
-        if not include_history:
-            day_all = day_all[-horizon:]
-            yq = yq[:, :, -horizon:]
-        yq = np.asarray(yq)[:k]
-        frame = self._frame_skeleton(sidx, day_all)
-        for qi, col in enumerate(qcols):
-            frame[col] = yq[:, qi, :].reshape(-1)
-        return pd.DataFrame(frame)
+        with get_tracer().span(
+            "serving.predict_quantiles", model=self.model, k=k,
+            bucket=self._bucket(k), horizon=int(horizon),
+            n_quantiles=len(quantiles),
+        ):
+            # conformal scaling spreads every level around the median, so
+            # the median is priced alongside when calibration is on (one
+            # extra column in the same compiled program) and dropped if
+            # not requested
+            priced = quantiles
+            if scale is not None and 0.5 not in priced:
+                priced = tuple(sorted((*priced, 0.5)))
+            with device_annotation(
+                    f"serving_predict_quantiles:{self.model}"):
+                yq = fns.forecast_quantiles(
+                    params, day_all, jnp.float32(self.day1), self.config,
+                    priced, key, **fc_kwargs,
+                )  # (bucket, Q, T_all)
+            if scale is not None:
+                med = yq[:, priced.index(0.5), :][:, None, :]
+                yq = med + scale[:, None, None] * (yq - med)
+                if fns.band_floor is not None:
+                    # re-apply the family's hard clamp (gaussian_quantiles
+                    # floors the raw levels; widening must not undo it)
+                    yq = jnp.maximum(yq, fns.band_floor)
+            if priced != quantiles:
+                keep = jnp.asarray([priced.index(q) for q in quantiles])
+                yq = yq[:, keep, :]
+            if not include_history:
+                day_all = day_all[-horizon:]
+                yq = yq[:, :, -horizon:]
+            yq = np.asarray(yq)[:k]
+            frame = self._frame_skeleton(sidx, day_all)
+            for qi, col in enumerate(qcols):
+                frame[col] = yq[:, qi, :].reshape(-1)
+            return pd.DataFrame(frame)

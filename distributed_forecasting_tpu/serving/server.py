@@ -13,6 +13,11 @@ Endpoints (JSON over HTTP, stdlib http.server — no web framework in the
 image, and none needed for a single-model scorer):
 
   GET  /health            -> {"status": "ok", "model": ..., "n_series": N}
+  GET  /healthz           -> {"status": "ok"} (pure liveness: the process
+                             answers; no model state consulted)
+  GET  /readyz            -> 200 once warmup is complete AND the batcher is
+                             accepting, 503 otherwise (fleet supervisors
+                             route traffic on this, not /health)
   GET  /schema            -> serving schema + key names (the tag the
                              reference stores on the model version,
                              03_deploy.py:44-58)
@@ -41,6 +46,7 @@ import json
 import os
 import threading
 import time
+import urllib.parse
 from concurrent.futures import TimeoutError as _FutureTimeoutError
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
@@ -48,6 +54,12 @@ from typing import Optional
 import numpy as np
 import pandas as pd
 
+from distributed_forecasting_tpu.monitoring.trace import (
+    ProfilerBusyError,
+    dump_flight_recorder,
+    get_tracer,
+    to_chrome_trace,
+)
 from distributed_forecasting_tpu.serving.batcher import (
     BatchingConfig,
     QueueFullError,
@@ -97,15 +109,36 @@ def resolve_from_registry(registry, model_name: str, stage: Optional[str] = None
     return load_forecaster(sub if os.path.isdir(sub) else version.artifact_dir), version
 
 
+def _safe_trace_id(raw: Optional[str]) -> Optional[str]:
+    """Accept a client-supplied X-Trace-Id only when it is a sane token —
+    a hostile header must not ride into log files or dump names."""
+    if not raw:
+        return None
+    raw = raw.strip()
+    if 1 <= len(raw) <= 64 and all(c.isalnum() or c in "-_" for c in raw):
+        return raw
+    return None
+
+
 class _Handler(BaseHTTPRequestHandler):
     server_version = "dftpu-serve/1.0"
+
+    # per-request trace state (assigned before _invoke; BaseHTTPRequestHandler
+    # instances are per-connection, so these are not shared across requests)
+    _trace_id: Optional[str] = None
+    _status: int = 0
 
     # the forecaster and metadata ride on the server object
     def _send(self, code: int, payload: dict, extra_headers=()) -> None:
         body = json.dumps(payload).encode()
+        self._status = code
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        if self._trace_id:
+            # echo the correlation id so clients can quote it in bug reports
+            # and operators can grep it out of trace exports
+            self.send_header("X-Trace-Id", self._trace_id)
         for name, value in extra_headers:
             self.send_header(name, value)
         self.end_headers()
@@ -116,6 +149,19 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         fc = self.server.forecaster
+        parsed = urllib.parse.urlsplit(self.path)
+        if parsed.path == "/healthz":
+            # liveness only: answering at all is the signal
+            self._send(200, {"status": "ok"})
+            return
+        if parsed.path == "/readyz":
+            ready, reason = self.server.readiness()
+            self._send(200 if ready else 503,
+                       {"ready": ready, "reason": reason})
+            return
+        if parsed.path.startswith("/debug/"):
+            self._debug(parsed)
+            return
         if self.path == "/health":
             self._send(
                 200,
@@ -151,17 +197,69 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._send(404, {"error": f"no route {self.path}"})
 
+    def _debug(self, parsed) -> None:
+        """Introspection surface, OFF unless tracing.debug_endpoints is set
+        (these expose internals and can hold a handler thread for seconds)."""
+        tracer = get_tracer()
+        if not tracer.config.debug_endpoints:
+            self._send(404, {"error": f"no route {parsed.path}"})
+            return
+        if parsed.path == "/debug/trace":
+            # the flight recorder's recent spans as a Perfetto-loadable
+            # Chrome trace — save the body, open it in ui.perfetto.dev
+            spans = tracer.recorder.snapshot()
+            self._send(200, to_chrome_trace(
+                spans, metadata={"n_spans": len(spans)}))
+        elif parsed.path == "/debug/profile":
+            query = urllib.parse.parse_qs(parsed.query)
+            try:
+                seconds = float(query.get("seconds", ["3"])[0])
+            except ValueError:
+                self._send(400, {"error": "seconds must be a number"})
+                return
+            if not tracer.profiler.available:
+                self._send(503, {"error": "profiler capture not configured "
+                                          "(tracing.profile_dir is unset)"})
+                return
+            try:
+                # blocks THIS handler thread for the capture window; other
+                # handler threads keep serving (ThreadingHTTPServer), which
+                # is the point — the capture sees live traffic
+                out = tracer.profiler.capture(seconds)
+            except ProfilerBusyError as e:
+                self._send(409, {"error": str(e)})
+                return
+            self._send(200, {"capture_dir": out, "seconds": seconds})
+        else:
+            self._send(404, {"error": f"no route {parsed.path}"})
+
     def do_POST(self):
         if self.path not in ("/invocations", "/predict"):
             self._send(404, {"error": f"no route {self.path}"})
             return
         metrics = self.server.metrics
         metrics.requests.inc()
+        tracer = get_tracer()
+        self._trace_id = _safe_trace_id(self.headers.get("X-Trace-Id"))
         t0 = time.monotonic()
         try:
-            self._invoke()
+            with tracer.root_span(
+                "http.request", trace_id=self._trace_id,
+                method="POST", path=self.path,
+            ) as root:
+                self._trace_id = root.trace_id or self._trace_id
+                self._invoke()
+                root.set_attribute("status", self._status)
         finally:
             metrics.latency.observe(time.monotonic() - t0)
+            if self._status >= 500:
+                # slow (503 deadline) and failed (5xx) requests leave the
+                # last seconds of span history on disk for post-mortems
+                path = dump_flight_recorder(f"http-{self._status}")
+                if path:
+                    self.server.logger.warning(
+                        "status %d: flight recorder dumped to %s",
+                        self._status, path)
 
     def _invoke(self):
         metrics = self.server.metrics
@@ -295,6 +393,9 @@ class ForecastServer(ThreadingHTTPServer):
         self.logger = get_logger("ForecastServer")
         self.metrics = ServingMetrics()
         self.batching = batching
+        # readiness is an Event, not a guarded flag: it is set exactly once
+        # after warmup and cleared at shutdown, and /readyz polls it
+        self._ready = threading.Event()
         self.batcher: Optional[RequestBatcher] = None
         if batching is not None and batching.enabled:
             self.batcher = RequestBatcher(forecaster, batching, self.metrics)
@@ -349,9 +450,24 @@ class ForecastServer(ThreadingHTTPServer):
             xreg=xreg,
         )
 
+    def mark_ready(self) -> None:
+        """Flip /readyz to 200 — called by the launcher AFTER warmup, so a
+        supervisor never routes traffic at a replica still compiling."""
+        self._ready.set()
+
+    def readiness(self):
+        """(ready, reason) for /readyz: warmup done and batcher accepting."""
+        if not self._ready.is_set():
+            return False, "warming up"
+        if self.batcher is not None and not self.batcher.accepting:
+            return False, "draining"
+        return True, "ok"
+
     def shutdown(self):
-        """Graceful: drain the batching queue (every queued request gets its
-        response) BEFORE stopping the accept loop and closing the socket."""
+        """Graceful: flip /readyz to 503 and drain the batching queue (every
+        queued request gets its response) BEFORE stopping the accept loop
+        and closing the socket."""
+        self._ready.clear()
         if self.batcher is not None:
             self.batcher.close()
         super().shutdown()
@@ -363,10 +479,15 @@ def start_server(
     port: int = 0,
     model_version: Optional[str] = None,
     batching: Optional[BatchingConfig] = None,
+    ready: bool = True,
 ) -> ForecastServer:
     """Start serving on a background thread; returns the server (its
-    ``server_address[1]`` is the bound port — port=0 picks a free one)."""
+    ``server_address[1]`` is the bound port — port=0 picks a free one).
+    ``ready=False`` starts with /readyz at 503 until ``mark_ready()`` —
+    for launchers that warm the compile ladder against the live server."""
     srv = ForecastServer((host, port), forecaster, model_version, batching)
+    if ready:
+        srv.mark_ready()
     t = threading.Thread(target=srv.serve_forever, daemon=True)
     t.start()
     return srv
@@ -380,5 +501,6 @@ def serve(
     batching: Optional[BatchingConfig] = None,
 ) -> None:
     srv = ForecastServer((host, port), forecaster, model_version, batching)
+    srv.mark_ready()
     srv.logger.info("serving on %s:%d", host, port)
     srv.serve_forever()

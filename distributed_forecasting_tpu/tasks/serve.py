@@ -24,6 +24,14 @@ Conf::
         max_wait_ms: 5        # coalescing window after the first arrival
         max_queue_depth: 256  # admission control: 429 past this
         request_timeout_s: 30 # 503 for requests that outlive this
+      tracing:                # optional span tracing (monitoring/trace.py)
+        enabled: true         # flight recorder always on when enabled
+        ring_size: 4096       # recent completed spans kept in memory
+        jsonl_path: null      # streaming JSONL export (off by default)
+        dump_dir: null        # auto flight-recorder dumps on 5xx/timeout
+        debug_endpoints: false  # /debug/trace + /debug/profile?seconds=N
+        profile_dir: null     # jax.profiler capture root for /debug/profile
+        max_profile_seconds: 60
     compile_cache:            # optional persistent compile cache + AOT
       enabled: true           # store (engine/compile_cache): warmup loads
       directory: null         # serialized bucket programs from disk
@@ -35,6 +43,10 @@ Conf::
 
 from __future__ import annotations
 
+from distributed_forecasting_tpu.monitoring.trace import (
+    TraceConfig,
+    configure_tracing,
+)
 from distributed_forecasting_tpu.serving.batcher import BatchingConfig
 from distributed_forecasting_tpu.serving.server import resolve_from_registry, serve
 from distributed_forecasting_tpu.tasks.common import Task
@@ -45,9 +57,12 @@ class ServeTask(Task):
         conf = self.conf.get("serving", {})
         name = conf.get("model_name", "ForecastingBatchModel")
         stage = conf.get("stage")
-        # parse the batching block BEFORE the expensive registry load so a
-        # conf typo fails in milliseconds, not after artifact resolution
+        # parse the batching + tracing blocks BEFORE the expensive registry
+        # load so a conf typo fails in milliseconds, not after artifact
+        # resolution
         batching = BatchingConfig.from_conf(conf.get("batching"))
+        tracing = TraceConfig.from_conf(conf.get("tracing"))
+        configure_tracing(tracing)
         forecaster, version = resolve_from_registry(self.registry, name, stage=stage)
         sizes = conf.get("warmup_sizes")
         if sizes:

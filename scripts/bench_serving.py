@@ -117,6 +117,12 @@ def main() -> None:
     ap.add_argument("--model", default="theta",
                     help="fast-fitting family; the dispatch story is the same")
     ap.add_argument("--max-wait-ms", type=float, default=4.0)
+    ap.add_argument("--trace-dir", default=os.environ.get("DFTPU_TRACE_DIR"),
+                    help="emit trace artifacts (JSONL + Perfetto JSON) here; "
+                         "defaults to $DFTPU_TRACE_DIR")
+    ap.add_argument("--measure-trace-overhead", action="store_true",
+                    help="re-run the unbatched leg with tracing disabled and "
+                         "report the p50 delta the tracer costs")
     args = ap.parse_args()
 
     sys.path.insert(
@@ -133,6 +139,20 @@ def main() -> None:
     )
 
     from distributed_forecasting_tpu.models.base import get_model
+    from distributed_forecasting_tpu.monitoring.trace import (
+        TraceConfig,
+        configure_tracing,
+        get_tracer,
+        write_chrome_trace,
+    )
+
+    if args.trace_dir:
+        os.makedirs(args.trace_dir, exist_ok=True)
+        configure_tracing(TraceConfig(
+            enabled=True,
+            jsonl_path=os.path.join(args.trace_dir, "trace.jsonl"),
+            dump_dir=args.trace_dir,
+        ))
 
     n_items = max(1, (args.series + 3) // 4)
     df = synthetic_store_item_sales(
@@ -194,6 +214,29 @@ def main() -> None:
             batched["throughput_rps"] / unbatched["throughput_rps"], 2),
         "exact_match": bool(exact),
     }
+
+    if args.trace_dir:
+        # snapshot BEFORE any tracer reconfiguration below discards the ring
+        tracer = get_tracer()
+        out["trace_artifact"] = write_chrome_trace(
+            os.path.join(args.trace_dir, "serving.trace.json"),
+            tracer.recorder.snapshot(),
+            metadata={"bench": "serving_microbatch", "clients": K},
+        )
+        # swapping configs closes the old tracer, flushing the JSONL stream
+        configure_tracing(TraceConfig(enabled=True))
+
+    if args.measure_trace_overhead:
+        # same leg, tracing fully off: the p50 gap is what span recording
+        # costs per request (ISSUE #6 acceptance: < 2%)
+        configure_tracing(TraceConfig(enabled=False))
+        untraced = run_mode(fc, payloads, args.requests, batching=None)
+        untraced.pop("_bodies")
+        configure_tracing(TraceConfig(enabled=True))
+        p50_off = untraced["p50_ms"]
+        out["untraced"] = untraced
+        out["trace_overhead_p50_pct"] = round(
+            100.0 * (unbatched["p50_ms"] - p50_off) / max(p50_off, 1e-9), 2)
     print(json.dumps(out))
     if not exact:
         sys.exit("coalesced responses diverged from per-request responses")

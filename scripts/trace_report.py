@@ -1,0 +1,158 @@
+"""Summarize a span-trace export: per-kind latency percentiles + critical path.
+
+The tracer (``monitoring/trace.py``) emits two artifact shapes — streaming
+JSONL (one ``SpanRecord.to_json()`` dict per line, via ``jsonl_path``) and
+Chrome/Perfetto trace JSON (flight-recorder dumps and ``/debug/trace``).
+This script reads either, groups spans by name ("kind"), and prints one
+JSON line with count / p50 / p95 / p99 / total milliseconds per kind —
+the numbers a latency investigation starts from before anyone opens the
+Perfetto UI.
+
+With ``--trace <id>`` it additionally prints the critical-path breakdown of
+a single request: every span in that trace ordered by start time, with
+queue-wait vs dispatch vs device time visible at a glance.
+
+Run::
+
+    python scripts/trace_report.py /tmp/trace/trace.jsonl
+    python scripts/trace_report.py dump.trace.json --trace feedbeefcafe0001
+    python scripts/trace_report.py trace.jsonl --top 5 --sort total_ms
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return float("nan")
+    i = min(int(q * len(sorted_vals)), len(sorted_vals) - 1)
+    return sorted_vals[i]
+
+
+def load_spans(path: str) -> List[Dict]:
+    """Parse JSONL or Chrome-trace JSON into a list of span dicts.
+
+    Both shapes normalize to ``{name, trace_id, span_id, parent_id, start,
+    duration_ms, thread, status, attrs}`` with ``start`` in seconds on the
+    trace clock (Chrome events carry microseconds relative to the dump).
+    """
+    with open(path) as f:
+        text = f.read()
+    # both shapes start with "{": a Chrome trace is ONE document with a
+    # traceEvents list; JSONL is one document per line and only parses
+    # whole when it has a single line
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        doc = None
+    if isinstance(doc, dict) and "traceEvents" in doc:
+        spans = []
+        for ev in doc["traceEvents"]:
+            if ev.get("ph") != "X":
+                continue
+            args = ev.get("args", {})
+            spans.append({
+                "name": ev["name"],
+                "trace_id": args.get("trace_id", ""),
+                "span_id": args.get("span_id", ""),
+                "parent_id": args.get("parent_id"),
+                "start": ev["ts"] / 1e6,
+                "duration_ms": ev["dur"] / 1e3,
+                "thread": str(ev.get("tid", "")),
+                "status": args.get("status", "ok"),
+                "attrs": {k: v for k, v in args.items()
+                          if k not in ("trace_id", "span_id",
+                                       "parent_id", "status")},
+            })
+        return spans
+    if isinstance(doc, dict):
+        return [doc]  # single-line JSONL
+    return [json.loads(line) for line in text.splitlines() if line.strip()]
+
+
+def by_kind(spans: List[Dict]) -> List[Dict]:
+    kinds: Dict[str, List[float]] = {}
+    errors: Dict[str, int] = {}
+    for s in spans:
+        kinds.setdefault(s["name"], []).append(float(s["duration_ms"]))
+        if s.get("status", "ok") != "ok":
+            errors[s["name"]] = errors.get(s["name"], 0) + 1
+    out = []
+    for name, durs in kinds.items():
+        durs.sort()
+        out.append({
+            "kind": name,
+            "count": len(durs),
+            "errors": errors.get(name, 0),
+            "p50_ms": round(_percentile(durs, 0.50), 3),
+            "p95_ms": round(_percentile(durs, 0.95), 3),
+            "p99_ms": round(_percentile(durs, 0.99), 3),
+            "max_ms": round(durs[-1], 3),
+            "total_ms": round(sum(durs), 3),
+        })
+    return out
+
+
+def critical_path(spans: List[Dict], trace_id: str) -> List[Dict]:
+    """All spans of one trace, start-ordered, with offsets from the root."""
+    mine = sorted(
+        (s for s in spans if s.get("trace_id") == trace_id),
+        key=lambda s: float(s["start"]),
+    )
+    if not mine:
+        return []
+    t0 = float(mine[0]["start"])
+    return [{
+        "kind": s["name"],
+        "offset_ms": round(1e3 * (float(s["start"]) - t0), 3),
+        "duration_ms": round(float(s["duration_ms"]), 3),
+        "thread": s.get("thread", ""),
+        "status": s.get("status", "ok"),
+        "attrs": s.get("attrs", {}),
+    } for s in mine]
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="trace JSONL or Chrome-trace JSON file")
+    ap.add_argument("--trace", default=None,
+                    help="trace id: also print that request's span timeline")
+    ap.add_argument("--sort", default="p99_ms",
+                    choices=["p50_ms", "p95_ms", "p99_ms", "max_ms",
+                             "total_ms", "count", "kind"])
+    ap.add_argument("--top", type=int, default=0,
+                    help="keep only the N worst kinds (0 = all)")
+    args = ap.parse_args()
+
+    spans = load_spans(args.path)
+    if not spans:
+        sys.exit(f"no spans in {args.path}")
+    kinds = sorted(
+        by_kind(spans),
+        key=lambda r: r[args.sort],
+        reverse=args.sort != "kind",
+    )
+    if args.top:
+        kinds = kinds[:args.top]
+    report = {
+        "report": "trace_summary",
+        "path": args.path,
+        "spans": len(spans),
+        "traces": len({s.get("trace_id") for s in spans}),
+        "kinds": kinds,
+    }
+    if args.trace:
+        path_spans = critical_path(spans, args.trace)
+        if not path_spans:
+            sys.exit(f"trace id {args.trace!r} not found in {args.path}")
+        report["trace"] = {"trace_id": args.trace, "spans": path_spans}
+    print(json.dumps(report))
+
+
+if __name__ == "__main__":
+    main()

@@ -336,6 +336,58 @@ def test_nondeterminism_negative_seeded(tmp_path):
     assert _lint(tmp_path, "models/seeded.py") == []
 
 
+def test_nondeterminism_covers_monitoring(tmp_path):
+    # the telemetry layer is in scope: a wall-clock read in monitoring/
+    # stamps metric values with when-it-ran
+    _write(tmp_path, "monitoring/stamp.py", """
+        import time
+
+        def stamp():
+            return time.time()
+    """)
+    found = _lint(tmp_path, "monitoring/stamp.py")
+    assert [f.rule for f in found] == ["nondeterminism"]
+
+
+def test_nondeterminism_monotonic_clocks_exempt(tmp_path):
+    # monotonic/perf_counter measure durations, carry no wall-clock
+    # information, and are what the span tracer is built on — structurally
+    # exempt, no inline suppressions needed
+    _write(tmp_path, "monitoring/spans.py", """
+        import time
+
+        def wait():
+            return time.monotonic()
+
+        def wait_ns():
+            return time.monotonic_ns()
+
+        def tick():
+            return time.perf_counter()
+
+        def tick_ns():
+            return time.perf_counter_ns()
+    """)
+    assert _lint(tmp_path, "monitoring/spans.py") == []
+
+
+def test_nondeterminism_wall_clock_still_flagged_next_to_monotonic(tmp_path):
+    # the exemption is per-call, not per-file: a time.time() in the same
+    # module as monotonic reads is still an error
+    _write(tmp_path, "engine/mixed.py", """
+        import time
+
+        def span():
+            return time.monotonic()
+
+        def stamp():
+            return time.time_ns()
+    """)
+    found = _lint(tmp_path, "engine/mixed.py")
+    assert [f.rule for f in found] == ["nondeterminism"]
+    assert "time.time_ns" in found[0].message
+
+
 # ---------------------------------------------------------------------------
 # config-drift
 # ---------------------------------------------------------------------------

@@ -479,3 +479,79 @@ def test_degradation_coverage_requires_interval_columns(catalog):
     with pytest.raises(ValueError, match="coverage"):
         degradation_report(catalog, cfg, metric="coverage",
                            granularity="1 week")
+
+
+# --- Prometheus exposition escaping (format 0.0.4) -------------------------
+
+
+def test_escape_label_value_and_render_labels():
+    from distributed_forecasting_tpu.monitoring import (
+        escape_label_value,
+        render_labels,
+    )
+
+    # backslash must escape FIRST or the other escapes double up
+    assert escape_label_value('a"b') == 'a\\"b'
+    assert escape_label_value("a\\b") == "a\\\\b"
+    assert escape_label_value("a\nb") == "a\\nb"
+    assert escape_label_value('\\"\n') == '\\\\\\"\\n'
+    assert render_labels({}) == ""
+    assert render_labels({"entry": 'serving:"x"'}) == \
+        '{entry="serving:\\"x\\""}'
+
+
+def test_labeled_counter_render_and_guards():
+    from distributed_forecasting_tpu.monitoring import (
+        LabeledCounter,
+        MetricsRegistry,
+    )
+
+    reg = MetricsRegistry()
+    c = reg.labeled_counter(
+        "aot_requests_total", ("entry", "outcome"), 'help with "quotes"\nx')
+    c.inc(entry="serving_predict:prophet", outcome="memo")
+    c.inc(2, entry="serving_predict:prophet", outcome="memo")
+    c.inc(entry='we"ird\\name', outcome="miss")
+    assert c.value(entry="serving_predict:prophet", outcome="memo") == 3
+    text = reg.render_prometheus()
+    # help text escaped onto ONE line; body lines one per label combo
+    assert '# HELP aot_requests_total help with "quotes"\\nx' in text
+    assert ('aot_requests_total{entry="serving_predict:prophet",'
+            'outcome="memo"} 3') in text
+    assert ('aot_requests_total{entry="we\\"ird\\\\name",outcome="miss"} 1'
+            ) in text
+    # every exposition line must actually be one line (no raw newlines leak)
+    for line in text.splitlines():
+        assert "\n" not in line
+    with pytest.raises(ValueError):
+        c.inc(entry="only-one-label")
+    with pytest.raises(ValueError):
+        c.inc(-1, entry="e", outcome="o")
+    with pytest.raises(ValueError):
+        LabeledCounter(())
+
+
+def test_help_text_escaped_for_plain_metrics():
+    from distributed_forecasting_tpu.monitoring import MetricsRegistry
+
+    reg = MetricsRegistry()
+    reg.counter("c_total", "line one\nline two \\ backslash")
+    text = reg.render_prometheus()
+    assert "# HELP c_total line one\\nline two \\\\ backslash" in text
+    assert "# TYPE c_total counter" in text
+    assert len([l for l in text.splitlines() if l.startswith("# HELP")]) == 1
+
+
+def test_compile_cache_entry_counter_labels():
+    """The live consumer: per-entry AOT outcome counts render with escaped
+    arbitrary entry strings on the cache's /metrics registry."""
+    from distributed_forecasting_tpu.engine import compile_cache as cc
+
+    before = cc._entry_requests.value(entry="test:entry", outcome="memo")
+    cc._entry_requests.inc(entry="test:entry", outcome="memo")
+    text = cc.metrics_registry().render_prometheus()
+    assert "# TYPE compile_cache_entry_requests_total counter" in text
+    assert ('compile_cache_entry_requests_total{entry="test:entry",'
+            'outcome="memo"}') in text
+    assert cc._entry_requests.value(
+        entry="test:entry", outcome="memo") == before + 1

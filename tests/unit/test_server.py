@@ -550,3 +550,43 @@ def test_batched_server_shutdown_drains_queue():
     t_b.join(30)
     assert not stopper.is_alive()
     assert results == {1: 200, 2: 200}
+
+
+# --- liveness vs readiness (ISSUE #6) --------------------------------------
+
+
+def test_healthz_and_readyz(server):
+    """/healthz is liveness (always 200 once the socket is up); /readyz is
+    readiness (200 only after mark_ready)."""
+    code, out = _call(server, "/healthz")
+    assert code == 200 and out == {"status": "ok"}
+    code, out = _call(server, "/readyz")
+    assert code == 200
+    assert out == {"ready": True, "reason": "ok"}
+
+
+def test_readyz_503_until_marked_ready():
+    """ready=False starts the server warming: /healthz 200 but /readyz 503,
+    flipping to 200 only at mark_ready() — the launcher's warmup window."""
+    from test_batcher import FakeForecaster
+
+    srv = start_server(FakeForecaster(), ready=False)
+    try:
+        code, out = _call(srv, "/healthz")
+        assert code == 200
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _call(srv, "/readyz")
+        assert e.value.code == 503
+        assert json.loads(e.value.read()) == {
+            "ready": False, "reason": "warming up"}
+        # a warming replica still serves traffic that does arrive
+        code, _ = _call(srv, "/invocations",
+                        {"inputs": [{"store": 1, "item": 1}], "horizon": 3})
+        assert code == 200
+        srv.mark_ready()
+        code, out = _call(srv, "/readyz")
+        assert code == 200 and out["ready"] is True
+    finally:
+        srv.shutdown()
+    # after shutdown the readiness answer is draining/warming, never ok
+    assert srv.readiness()[0] is False

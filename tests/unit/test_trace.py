@@ -1,0 +1,432 @@
+"""End-to-end tracing + flight recorder (monitoring/trace.py, ISSUE #6).
+
+The acceptance shape: one ``/invocations`` request through a *batched*
+server with a compile cache configured yields one Perfetto-loadable trace
+whose spans — HTTP handling, batcher queue wait, merged dispatch, AOT
+cache lookup, device compute — all share the request's trace id across
+the handler and scheduler threads.
+"""
+
+import importlib.util
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from distributed_forecasting_tpu.monitoring.trace import (
+    FlightRecorder,
+    ProfilerBusyError,
+    ProfilerSession,
+    SpanRecord,
+    TraceConfig,
+    TraceContext,
+    Tracer,
+    clock,
+    configure_tracing,
+    dump_flight_recorder,
+    get_tracer,
+    new_trace_id,
+    to_chrome_trace,
+    write_chrome_trace,
+)
+
+REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+
+@pytest.fixture()
+def tracer():
+    """A private tracer; the process-global one is restored afterwards."""
+    tr = Tracer(TraceConfig(enabled=True, ring_size=64))
+    yield tr
+    tr.close()
+
+
+@pytest.fixture()
+def global_tracing():
+    """Swap the process-global tracer for the test, restore defaults after."""
+    def apply(config):
+        configure_tracing(config)
+        return get_tracer()
+    yield apply
+    configure_tracing(TraceConfig())
+
+
+# --- span model -------------------------------------------------------------
+
+
+def test_span_nesting_and_parenthood(tracer):
+    with tracer.root_span("outer", trace_id="t" * 16) as outer:
+        with tracer.span("inner", k=3):
+            pass
+    spans = {s.name: s for s in tracer.recorder.snapshot()}
+    assert set(spans) == {"outer", "inner"}
+    assert spans["inner"].trace_id == spans["outer"].trace_id == "t" * 16
+    assert spans["inner"].parent_id == spans["outer"].span_id
+    assert spans["outer"].parent_id is None
+    assert spans["inner"].attrs["k"] == 3
+    assert spans["inner"].start <= spans["inner"].end
+    # inner closed first: recorder is completion-ordered
+    assert [s.name for s in tracer.recorder.snapshot()] == ["inner", "outer"]
+
+
+def test_span_error_status(tracer):
+    with pytest.raises(ValueError):
+        with tracer.span("boom"):
+            raise ValueError("nope")
+    (span,) = tracer.recorder.snapshot()
+    assert span.status == "error:ValueError"
+
+
+def test_context_crosses_threads(tracer):
+    """The batcher/executor hand-off: capture current() on the producer
+    thread, adopt it on the consumer — one trace id, correct parent."""
+    captured = {}
+
+    def consumer(ctx):
+        with tracer.context(ctx):
+            with tracer.span("consumer.work"):
+                pass
+
+    with tracer.root_span("producer", trace_id="feedbeefcafe0001"):
+        ctx = tracer.current()
+        captured["ctx"] = ctx
+        t = threading.Thread(target=consumer, args=(ctx,))
+        t.start()
+        t.join(10)
+
+    assert isinstance(captured["ctx"], TraceContext)
+    spans = {s.name: s for s in tracer.recorder.snapshot()}
+    assert spans["consumer.work"].trace_id == "feedbeefcafe0001"
+    assert spans["consumer.work"].parent_id == captured["ctx"].span_id
+    assert spans["consumer.work"].thread_name != spans["producer"].thread_name
+
+
+def test_record_span_explicit_times(tracer):
+    """Exact queue-wait spans: both endpoints on the trace clock, recorded
+    after the fact."""
+    t0 = clock()
+    t1 = t0 + 0.5
+    tracer.record_span("batcher.queue_wait", t0, t1, expired=False)
+    (span,) = tracer.recorder.snapshot()
+    assert span.start == t0 and span.end == t1
+    assert span.attrs == {"expired": False}
+
+
+def test_disabled_tracer_is_noop():
+    tr = Tracer(TraceConfig(enabled=False))
+    with tr.span("a") as s1:
+        with tr.root_span("b") as s2:
+            pass
+    assert s1 is s2  # the shared no-op span: zero allocation on the hot path
+    assert len(tr.recorder) == 0
+    assert tr.current() is None
+    tr.close()
+
+
+def test_flight_recorder_ring_bound(tracer):
+    for i in range(200):
+        with tracer.span(f"s{i}"):
+            pass
+    assert len(tracer.recorder) == 64  # ring_size, oldest evicted
+    names = [s.name for s in tracer.recorder.snapshot()]
+    assert names[0] == "s136" and names[-1] == "s199"
+
+
+def test_trace_config_from_conf_strict():
+    cfg = TraceConfig.from_conf(None)
+    assert cfg.enabled and cfg.ring_size == 4096
+    cfg = TraceConfig.from_conf(
+        {"enabled": False, "ring_size": 8, "debug_endpoints": True})
+    assert not cfg.enabled and cfg.ring_size == 8 and cfg.debug_endpoints
+    with pytest.raises(ValueError, match="unknown"):
+        TraceConfig.from_conf({"ringsize": 8})
+    with pytest.raises(ValueError):
+        TraceConfig(ring_size=0)
+    with pytest.raises(ValueError):
+        TraceConfig(max_profile_seconds=-1.0)
+
+
+# --- exporters --------------------------------------------------------------
+
+
+def test_jsonl_exporter(tmp_path):
+    path = str(tmp_path / "t" / "trace.jsonl")
+    tr = Tracer(TraceConfig(jsonl_path=path))
+    with tr.root_span("http.request", trace_id="a" * 16, method="POST"):
+        with tr.span("serving.predict"):
+            pass
+    tr.close()  # flush + join the writer thread
+    rows = [json.loads(l) for l in open(path)]
+    assert [r["name"] for r in rows] == ["serving.predict", "http.request"]
+    assert all(r["trace_id"] == "a" * 16 for r in rows)
+    assert rows[1]["attrs"]["method"] == "POST"
+    assert rows[0]["duration_ms"] >= 0
+
+
+def test_chrome_trace_format(tracer, tmp_path):
+    with tracer.root_span("outer", trace_id="c" * 16):
+        with tracer.span("inner"):
+            time.sleep(0.002)
+    doc = to_chrome_trace(tracer.recorder.snapshot(), metadata={"run": "x"})
+    assert doc["displayTimeUnit"] == "ms"
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    metas = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    assert len(xs) == 2 and metas, doc
+    assert min(e["ts"] for e in xs) == 0  # relative to the earliest span
+    inner = next(e for e in xs if e["name"] == "inner")
+    assert inner["dur"] >= 2000  # microseconds
+    assert inner["args"]["trace_id"] == "c" * 16
+    assert doc["otherData"]["run"] == "x"
+    # round-trips through the file writer
+    p = write_chrome_trace(str(tmp_path / "d" / "t.trace.json"),
+                           tracer.recorder.snapshot())
+    assert json.load(open(p))["traceEvents"]
+
+
+def test_dump_flight_recorder(tmp_path, global_tracing):
+    # no dump_dir configured -> no dump
+    global_tracing(TraceConfig(enabled=True))
+    assert dump_flight_recorder("x") is None
+    # dump_dir + empty ring -> no dump either
+    tr = global_tracing(TraceConfig(enabled=True,
+                                    dump_dir=str(tmp_path / "dumps")))
+    assert dump_flight_recorder("empty") is None
+    with tr.span("s"):
+        pass
+    p1 = dump_flight_recorder("http-503")
+    p2 = dump_flight_recorder("http-503")
+    assert p1 and p2 and p1 != p2  # unique filenames per incident
+    assert os.path.basename(p1).startswith("flight-")
+    assert "http-503" in os.path.basename(p1)
+    assert json.load(open(p1))["traceEvents"]
+
+
+# --- profiler session -------------------------------------------------------
+
+
+def test_profiler_session_single_flight(tmp_path):
+    sess = ProfilerSession(None, max_seconds=10.0)
+    assert not sess.available
+    with pytest.raises(RuntimeError):
+        sess.capture(1.0)
+
+    sess = ProfilerSession(str(tmp_path / "prof"), max_seconds=10.0)
+    assert sess.available
+    with sess._flag_lock:
+        sess._active = True  # a capture is in flight
+    with pytest.raises(ProfilerBusyError):
+        sess.capture(0.1)
+    with sess._flag_lock:
+        sess._active = False
+
+
+# --- the acceptance path: one request, one correlated trace ----------------
+
+
+def _post(port, path, payload, headers=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    with urllib.request.urlopen(req, timeout=60) as r:
+        return r.status, json.loads(r.read()), dict(r.headers)
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30) as r:
+        return r.status, json.loads(r.read())
+
+
+@pytest.mark.filterwarnings("ignore::DeprecationWarning")
+def test_request_trace_end_to_end(tmp_path, global_tracing):
+    """ISSUE #6 acceptance: a request under the batched server produces a
+    Perfetto-loadable trace where queue wait, dispatch, AOT cache outcome,
+    and device compute share the request's trace id."""
+    from distributed_forecasting_tpu.data import (
+        synthetic_store_item_sales,
+        tensorize,
+    )
+    from distributed_forecasting_tpu.engine import fit_forecast
+    from distributed_forecasting_tpu.engine.compile_cache import (
+        CompileCacheConfig,
+        configure_compile_cache,
+    )
+    from distributed_forecasting_tpu.models.base import get_model
+    from distributed_forecasting_tpu.serving import (
+        BatchForecaster,
+        BatchingConfig,
+        start_server,
+    )
+
+    global_tracing(TraceConfig(enabled=True, debug_endpoints=True,
+                               dump_dir=str(tmp_path / "dumps")))
+    # aot.* spans only exist when the AOT store is live (aot_call bypasses
+    # it otherwise), so the acceptance run configures a throwaway cache
+    configure_compile_cache(CompileCacheConfig(
+        enabled=True, directory=str(tmp_path / "cc"), aot_store=True))
+    try:
+        df = synthetic_store_item_sales(
+            n_stores=2, n_items=2, n_days=200, seed=9)
+        batch = tensorize(df)
+        cfg = get_model("theta").config_cls()
+        params, _ = fit_forecast(
+            batch, model="theta", config=cfg, horizon=30)
+        fc = BatchForecaster.from_fit(batch, params, "theta", cfg)
+        srv = start_server(fc, batching=BatchingConfig(
+            enabled=True, max_batch_size=8, max_wait_ms=1.0,
+            max_queue_depth=16, request_timeout_s=60.0))
+        port = srv.server_address[1]
+        try:
+            trace_id = "feedbeefcafe0001"
+            k0 = {n: int(v) for n, v in zip(fc.key_names, fc.keys[0])}
+            code, _, headers = _post(
+                port, "/invocations", {"inputs": [k0], "horizon": 14},
+                headers={"X-Trace-Id": trace_id})
+            assert code == 200
+            assert headers["X-Trace-Id"] == trace_id  # echoed for log join
+            # the root span closes after the response is sent; give it a beat
+            time.sleep(0.3)
+            code, doc = _get(port, "/debug/trace")
+            assert code == 200
+        finally:
+            srv.shutdown()
+    finally:
+        configure_compile_cache(CompileCacheConfig(enabled=False))
+
+    mine = [e for e in doc["traceEvents"]
+            if e.get("ph") == "X"
+            and e.get("args", {}).get("trace_id") == trace_id]
+    kinds = {e["name"] for e in mine}
+    # the full correlated path: HTTP -> queue -> dispatch -> predict -> AOT
+    assert {"http.request", "batcher.queue_wait", "batcher.dispatch",
+            "serving.predict", "aot.call"} <= kinds, kinds
+    threads = {e["tid"] for e in mine}
+    assert len(threads) >= 2  # handler thread + scheduler thread
+    root = next(e for e in mine if e["name"] == "http.request")
+    dispatch = next(e for e in mine if e["name"] == "batcher.dispatch")
+    assert dispatch["args"]["parent_id"] == root["args"]["span_id"]
+    assert root["args"]["status"] == 200
+
+
+def test_debug_endpoints_gated(global_tracing):
+    """/debug/* is 404 when debug_endpoints is off (the default), and
+    /debug/profile without a profile_dir is 503, bad seconds is 400."""
+    from test_batcher import FakeForecaster
+
+    from distributed_forecasting_tpu.serving import start_server
+
+    global_tracing(TraceConfig(enabled=True, debug_endpoints=False))
+    srv = start_server(FakeForecaster())
+    port = srv.server_address[1]
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(port, "/debug/trace")
+        assert e.value.code == 404
+    finally:
+        srv.shutdown()
+
+    global_tracing(TraceConfig(enabled=True, debug_endpoints=True))
+    srv = start_server(FakeForecaster())
+    port = srv.server_address[1]
+    try:
+        code, doc = _get(port, "/debug/trace")
+        assert code == 200 and "traceEvents" in doc
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(port, "/debug/profile?seconds=2")
+        assert e.value.code == 503  # no profile_dir configured
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(port, "/debug/profile?seconds=banana")
+        assert e.value.code == 400
+    finally:
+        srv.shutdown()
+
+
+def test_flight_recorder_dumped_on_5xx(tmp_path, global_tracing):
+    """A 503 (deadline exceeded) auto-dumps the ring: the post-mortem
+    exists without anyone having asked for it."""
+    from test_batcher import FakeForecaster
+
+    from distributed_forecasting_tpu.serving import (
+        BatchingConfig,
+        start_server,
+    )
+
+    dump_dir = tmp_path / "dumps"
+    global_tracing(TraceConfig(enabled=True, dump_dir=str(dump_dir)))
+    release = threading.Event()
+    fc = FakeForecaster(block_event=release)
+    srv = start_server(fc, batching=BatchingConfig(
+        enabled=True, max_batch_size=4, max_wait_ms=0.0,
+        max_queue_depth=8, request_timeout_s=0.1))
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(srv.server_address[1], "/invocations",
+                  {"inputs": [{"store": 1, "item": 1}], "horizon": 3})
+        assert e.value.code == 503
+        deadline = time.time() + 5
+        while time.time() < deadline and not list(dump_dir.glob("*")):
+            time.sleep(0.05)
+        dumps = list(dump_dir.glob("flight-*-http-503.trace.json"))
+        assert dumps, list(dump_dir.glob("*"))
+        assert json.load(open(dumps[0]))["traceEvents"]
+    finally:
+        release.set()
+        srv.shutdown()
+
+
+# --- trace_report.py --------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def trace_report():
+    spec = importlib.util.spec_from_file_location(
+        "trace_report_under_test",
+        os.path.join(REPO, "scripts", "trace_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _mk_span(name, trace_id, start, dur, **attrs):
+    return SpanRecord(
+        name=name, trace_id=trace_id, span_id=new_trace_id(),
+        parent_id=None, start=start, end=start + dur,
+        thread_id=1, thread_name="main", attrs=attrs)
+
+
+def test_trace_report_reads_both_shapes(tmp_path, trace_report):
+    spans = [
+        _mk_span("serving.predict", "t1", 1.0, 0.010),
+        _mk_span("serving.predict", "t1", 2.0, 0.030),
+        _mk_span("batcher.queue_wait", "t1", 0.5, 0.002),
+        _mk_span("serving.predict", "t2", 3.0, 0.020),
+    ]
+    jsonl = tmp_path / "trace.jsonl"
+    jsonl.write_text(
+        "".join(json.dumps(s.to_json()) + "\n" for s in spans))
+    chrome = str(tmp_path / "dump.trace.json")
+    write_chrome_trace(chrome, spans)
+
+    for path in (str(jsonl), chrome):
+        loaded = trace_report.load_spans(path)
+        assert len(loaded) == 4
+        kinds = {r["kind"]: r for r in trace_report.by_kind(loaded)}
+        assert kinds["serving.predict"]["count"] == 3
+        assert kinds["serving.predict"]["max_ms"] == pytest.approx(30, rel=0.01)
+        assert kinds["batcher.queue_wait"]["count"] == 1
+
+    # critical path: one trace's spans, start-ordered, offsets from first
+    loaded = trace_report.load_spans(str(jsonl))
+    path_spans = trace_report.critical_path(loaded, "t1")
+    assert [s["kind"] for s in path_spans] == [
+        "batcher.queue_wait", "serving.predict", "serving.predict"]
+    assert path_spans[0]["offset_ms"] == 0
+    assert path_spans[1]["offset_ms"] == pytest.approx(500, rel=0.01)
+    assert trace_report.critical_path(loaded, "missing") == []
