@@ -318,7 +318,18 @@ class AOTStore:
 
     def store(self, key: str, compiled, entry: str = "",
               meta: Optional[dict] = None) -> bool:
-        """Serialize ``compiled`` under ``key`` (atomic write)."""
+        """Serialize ``compiled`` under ``key``.
+
+        Multi-process safe: the record is written to a private temp file in
+        the store directory, flushed + fsync'd, then published with the
+        atomic ``os.replace`` — a reader (another fleet replica warming the
+        same bucket concurrently) sees either no entry or a complete one,
+        never a torn file, and the last concurrent writer wins with an
+        identical payload.  The fsync matters on crash: without it the
+        rename can land before the data blocks, and the next boot would
+        read a truncated entry (the checksum would catch it, but at the
+        cost of a discarded entry and a recompile).
+        """
         from jax.experimental import serialize_executable
 
         try:
@@ -343,6 +354,8 @@ class AOTStore:
             try:
                 with os.fdopen(fd, "wb") as f:
                     pickle.dump(record, f, protocol=pickle.HIGHEST_PROTOCOL)
+                    f.flush()
+                    os.fsync(f.fileno())
                 os.replace(tmp, self._path(key, entry))
             finally:
                 if os.path.exists(tmp):

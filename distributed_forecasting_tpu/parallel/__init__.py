@@ -4,6 +4,7 @@ from distributed_forecasting_tpu.parallel.mesh import (
 )
 from distributed_forecasting_tpu.parallel.sharded import (
     shard_batch,
+    shard_forecast_inputs,
     sharded_fit_forecast,
     sharded_cv_metrics,
     global_metric_means,
@@ -13,6 +14,7 @@ __all__ = [
     "make_mesh",
     "initialize_distributed",
     "shard_batch",
+    "shard_forecast_inputs",
     "sharded_fit_forecast",
     "sharded_cv_metrics",
     "global_metric_means",

@@ -74,6 +74,53 @@ def _shard_xreg(xreg, orig_S: int, padded_S: int, mesh: Mesh):
     return jax.device_put(xreg, NamedSharding(mesh, P(None, None)))
 
 
+def shard_forecast_inputs(params, day_all, scale, fc_kwargs, mesh: Mesh,
+                          bucket: int):
+    """Place a bucket-ladder predict's gathered inputs on the mesh.
+
+    The serving analogue of :func:`shard_batch`'s layout: every pytree leaf
+    whose leading axis is the request bucket (already padded to a mesh
+    multiple by ``BatchForecaster._bucket``) shards on the series axis; the
+    day grid, shared covariates, and scalar/global leaves replicate.  The
+    SAME jitted forecast the single-device path uses then runs
+    SPMD-partitioned with zero cross-chip traffic — forecasts are
+    per-series independent — which is why mesh-sharded predict stays
+    byte-identical to single-device predict (the ``coalesce_safe``
+    contract, now across mesh shapes too).
+    """
+    n = mesh.devices.size
+    if bucket % n:
+        raise ValueError(
+            f"request bucket {bucket} is not a multiple of the mesh size "
+            f"{n}; buckets must be padded to mesh multiples before sharding"
+        )
+    row = NamedSharding(mesh, P(SERIES_AXIS))  # trailing dims replicate
+    rep = NamedSharding(mesh, P())
+
+    def place(leaf):
+        leaf = jnp.asarray(leaf)
+        if leaf.ndim >= 1 and leaf.shape[0] == bucket:
+            return jax.device_put(leaf, row)
+        return jax.device_put(leaf, rep)
+
+    params = jax.tree_util.tree_map(place, params)
+    day_all = jax.device_put(day_all, rep)
+    if scale is not None:
+        scale = jax.device_put(jnp.asarray(scale), row)
+    if fc_kwargs:
+        placed = {}
+        for name, v in fc_kwargs.items():
+            v = jnp.asarray(v)
+            if name == "xreg":
+                # explicit, not heuristic: a (T_all, R) shared calendar with
+                # T_all == bucket must still replicate
+                placed[name] = jax.device_put(v, row if v.ndim == 3 else rep)
+            else:
+                placed[name] = place(v)
+        fc_kwargs = placed
+    return params, day_all, scale, fc_kwargs
+
+
 def sharded_fit_forecast(
     batch: SeriesBatch,
     model: str = "prophet",

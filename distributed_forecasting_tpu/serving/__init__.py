@@ -11,6 +11,13 @@ from distributed_forecasting_tpu.serving.ensemble import (
     BlendedForecaster,
     MultiModelForecaster,
 )
+from distributed_forecasting_tpu.serving.fleet import (
+    FleetConfig,
+    FleetSupervisor,
+    FrontDoorServer,
+    aggregate_prometheus,
+    start_fleet,
+)
 from distributed_forecasting_tpu.serving.server import (
     ForecastServer,
     load_forecaster,
@@ -25,13 +32,18 @@ __all__ = [
     "BucketedForecaster",
     "MultiModelForecaster",
     "BlendedForecaster",
+    "FleetConfig",
+    "FleetSupervisor",
     "ForecastServer",
+    "FrontDoorServer",
     "QueueFullError",
     "RequestBatcher",
     "ServingMetrics",
     "ShuttingDownError",
+    "aggregate_prometheus",
     "load_forecaster",
     "resolve_from_registry",
     "serve",
+    "start_fleet",
     "start_server",
 ]

@@ -1,0 +1,642 @@
+"""Serving fleet: N replica processes behind one front door.
+
+The reference scales serving by fanning its PyFunc model out across a Spark
+cluster — each executor re-resolves and re-loads per-series models
+(``notebooks/prophet/04_inference.py:4-16``).  Here the scale-out unit is a
+whole server process running the batched artifact (``serving/server.py``):
+
+  * :class:`FleetSupervisor` spawns N replicas (``serving/replica.py``
+    subprocesses by default; tests inject in-process fakes), polls their
+    ``/readyz``, restarts crashed ones with capped exponential backoff, and
+    terminates the fleet gracefully on drain;
+  * :class:`FrontDoorServer` is the single client-facing HTTP endpoint: it
+    round-robins ``POST /invocations`` (and pass-through GETs) across READY
+    replicas, retries connection-level failures on the next replica
+    (predict is idempotent, so a replica dying mid-request is retriable,
+    not an error the client sees), and serves ``GET /metrics`` as the SUM
+    of every replica's exposition plus the fleet's own gauges/counters.
+
+Replicas share one on-disk AOT executable store (``engine/compile_cache``,
+multi-process-safe writes), so the fleet's Nth cold boot deserializes the
+bucket ladder the 1st one compiled — the ARIMA_PLUS-style "many workers
+over shared fingerprinted state" posture (PAPERS.md, arXiv:2510.24452).
+
+Lock discipline (dflint's blocking-under-lock + unlocked-shared-state rules
+gate this file): the supervisor takes its lock only to snapshot or update
+replica state; every blocking action — health probes, process spawn/wait,
+sleeps — happens OUTSIDE the critical section on the snapshot.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import http.client
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, List, Optional
+
+from distributed_forecasting_tpu.monitoring.monitor import MetricsRegistry
+from distributed_forecasting_tpu.utils import get_logger
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetConfig:
+    """The ``serving.fleet`` conf block (see conf/tasks/serve_config.yml)."""
+
+    enabled: bool = False
+    replicas: int = 2
+    replica_host: str = "127.0.0.1"   # replicas are local children
+    base_port: int = 0                # 0: pick free ports; else base_port+i
+    health_poll_interval_s: float = 0.5
+    probe_timeout_s: float = 2.0
+    ready_timeout_s: float = 300.0    # cold warmup can compile for minutes
+    restart_backoff_s: float = 0.5    # first restart delay after a crash
+    restart_backoff_max_s: float = 30.0
+    drain_timeout_s: float = 10.0     # SIGTERM -> SIGKILL grace per drain
+    proxy_timeout_s: float = 120.0    # per-attempt forward timeout
+    retry_window_s: float = 10.0      # front-door budget to find a replica
+    mesh_devices: int = 0             # >1: each replica shards predict over
+                                      # a device mesh of this size (layer 1)
+
+    def __post_init__(self):
+        if self.replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got {self.replicas}")
+        if self.restart_backoff_s <= 0:
+            raise ValueError("restart_backoff_s must be > 0")
+        if self.restart_backoff_max_s < self.restart_backoff_s:
+            raise ValueError(
+                "restart_backoff_max_s must be >= restart_backoff_s")
+        if self.health_poll_interval_s <= 0:
+            raise ValueError("health_poll_interval_s must be > 0")
+        if self.mesh_devices < 0:
+            raise ValueError(
+                f"mesh_devices must be >= 0, got {self.mesh_devices}")
+
+    @classmethod
+    def from_conf(cls, conf: Optional[dict]) -> "FleetConfig":
+        conf = conf or {}
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(conf) - known
+        if unknown:
+            # a typo like restart_backof_s must not silently lose its value
+            raise ValueError(
+                f"unknown serving.fleet conf key(s) {sorted(unknown)}; "
+                f"valid: {sorted(known)}")
+        # YAML already types the values; the cast normalizes "8080" -> 8080
+        # in hand-built dicts and keeps every field its declared scalar type
+        kwargs = {
+            f.name: type(f.default)(conf[f.name])
+            for f in dataclasses.fields(cls)
+            if f.name in conf
+        }
+        return cls(**kwargs)
+
+
+def _free_port(host: str) -> int:
+    with socket.socket() as s:
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
+def _probe_ready(host: str, port: int, timeout: float) -> bool:
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request("GET", "/readyz")
+        return conn.getresponse().status == 200
+    except (OSError, http.client.HTTPException):
+        return False
+    finally:
+        conn.close()
+
+
+def _fetch(host: str, port: int, path: str, timeout: float) -> Optional[bytes]:
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        if resp.status != 200:
+            return None
+        return resp.read()
+    except (OSError, http.client.HTTPException):
+        return None
+    finally:
+        conn.close()
+
+
+# -- Prometheus aggregation --------------------------------------------------
+
+def _fmt_value(v: float) -> str:
+    if float(v).is_integer() and abs(v) < 2**53:
+        return str(int(v))
+    return repr(float(v))
+
+
+def aggregate_prometheus(texts: List[str]) -> str:
+    """Merge replica ``/metrics`` expositions by summing samples.
+
+    Samples with the same name+labels sum across replicas; ``# HELP`` /
+    ``# TYPE`` lines keep the first replica's wording.  Sum is the right
+    fold for everything the serving stack exposes: counters, histogram
+    bucket/sum/count series, and additive gauges (queue depth in flight
+    across the fleet).  Replicas run identical code, so their expositions
+    share line structure and the merged output keeps family grouping.
+    """
+    lines: List[str] = []          # meta lines and sample keys, in order
+    values: dict = {}              # sample key -> summed value
+    seen_meta: set = set()
+    for text in texts:
+        for raw in text.splitlines():
+            if not raw.strip():
+                continue
+            if raw.startswith("#"):
+                parts = raw.split(None, 3)
+                if len(parts) >= 3 and parts[1] in ("HELP", "TYPE"):
+                    meta_key = (parts[1], parts[2])
+                    if meta_key not in seen_meta:
+                        seen_meta.add(meta_key)
+                        lines.append(raw)
+                continue
+            key, _, val = raw.rpartition(" ")
+            if not key:
+                continue
+            try:
+                v = float(val)
+            except ValueError:
+                continue
+            if key in values:
+                values[key] += v
+            else:
+                values[key] = v
+                lines.append(("sample", key))
+    out = []
+    for entry in lines:
+        if isinstance(entry, tuple):
+            key = entry[1]
+            out.append(f"{key} {_fmt_value(values[key])}")
+        else:
+            out.append(entry)
+    return "\n".join(out) + ("\n" if out else "")
+
+
+# -- replica bookkeeping -----------------------------------------------------
+
+class Replica:
+    """Per-replica state.  Deliberately lock-free: every field except the
+    immutable identity is read and written ONLY while the supervisor holds
+    its lock (the supervisor snapshots under the lock and acts outside)."""
+
+    def __init__(self, index: int, port: int):
+        self.index = index
+        self.port = port
+        self.proc = None            # Popen-compatible handle (poll/terminate/
+        self.ready = False          # kill/wait) or an injected fake
+        self.restarts = 0
+        self.backoff_s = 0.0        # current restart delay (0 = next crash
+        self.next_restart_at = 0.0  # restarts immediately); monotonic clock
+
+    def describe(self) -> dict:
+        alive = self.proc is not None and self.proc.poll() is None
+        return {
+            "index": self.index,
+            "port": self.port,
+            "alive": alive,
+            "ready": self.ready,
+            "restarts": self.restarts,
+        }
+
+
+SpawnFn = Callable[[int, int], object]
+
+
+def default_spawn_fn(
+    config: FleetConfig,
+    artifact_dir: str,
+    serving_conf: Optional[dict] = None,
+    env_extra: Optional[dict] = None,
+) -> SpawnFn:
+    """A spawn_fn launching ``serving/replica.py`` subprocesses.
+
+    Each child loads the artifact itself (no pickled state crosses the
+    process boundary), binds its assigned port with ``/readyz`` at 503,
+    warms the bucket ladder, then flips ready.  ``env_extra`` typically
+    carries ``DFTPU_COMPILE_CACHE`` so every replica shares one AOT store.
+    """
+    serving_conf = dict(serving_conf or {})
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+    def spawn(index: int, port: int):
+        replica_conf = {
+            "artifact_dir": artifact_dir,
+            "host": config.replica_host,
+            "port": port,
+            "warmup_sizes": serving_conf.get("warmup_sizes"),
+            "warmup_horizon": serving_conf.get("warmup_horizon", 90),
+            "batching": serving_conf.get("batching"),
+            "tracing": serving_conf.get("tracing"),
+            "model_version": serving_conf.get("model_version"),
+            "mesh_devices": config.mesh_devices,
+        }
+        env = dict(os.environ)
+        existing = env.get("PYTHONPATH", "")
+        env["PYTHONPATH"] = (
+            pkg_root + (os.pathsep + existing if existing else ""))
+        if env_extra:
+            env.update(env_extra)
+        return subprocess.Popen(
+            [sys.executable, "-m",
+             "distributed_forecasting_tpu.serving.replica",
+             "--conf", json.dumps(replica_conf)],
+            env=env,
+        )
+
+    return spawn
+
+
+# -- the supervisor ----------------------------------------------------------
+
+class FleetSupervisor:
+    """Spawns, health-polls, and restarts the replica set.
+
+    Thread-safety: ``_lock`` guards every Replica field and the round-robin
+    cursor.  The poll loop snapshots under the lock, probes/spawns/waits
+    OUTSIDE it, then applies observations under the lock again — no
+    blocking call ever runs inside the critical section.
+    """
+
+    def __init__(self, config: FleetConfig, spawn_fn: SpawnFn):
+        self._config = config
+        self._spawn = spawn_fn
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._poll_thread: Optional[threading.Thread] = None
+        self._rr = 0
+        ports = [
+            _free_port(config.replica_host) if config.base_port == 0
+            else config.base_port + i
+            for i in range(config.replicas)
+        ]
+        self._replicas = [Replica(i, p) for i, p in enumerate(ports)]
+        self.logger = get_logger("FleetSupervisor")
+        self.registry = MetricsRegistry()
+        self._g_total = self.registry.gauge(
+            "fleet_replicas_total", "replicas the supervisor manages")
+        self._g_ready = self.registry.gauge(
+            "fleet_replicas_ready", "replicas currently passing /readyz")
+        self._c_restarts = self.registry.counter(
+            "fleet_restarts_total", "replica processes (re)spawned after "
+            "the initial launch")
+        self._c_conn_failures = self.registry.counter(
+            "fleet_connection_failures_total",
+            "front-door forwards that failed at the connection level")
+        self._c_retries = self.registry.counter(
+            "fleet_retries_total",
+            "requests the front door retried on another replica")
+        self._c_unrouted = self.registry.counter(
+            "fleet_unrouted_total",
+            "requests that exhausted the retry window with no ready replica")
+        self._g_total.set(config.replicas)
+
+    # -- introspection (snapshot under lock, return plain data) -------------
+    @property
+    def config(self) -> FleetConfig:
+        return self._config
+
+    @property
+    def host(self) -> str:
+        return self._config.replica_host
+
+    @property
+    def size(self) -> int:
+        return len(self._replicas)
+
+    def describe(self) -> List[dict]:
+        with self._lock:
+            return [r.describe() for r in self._replicas]
+
+    def ready_count(self) -> int:
+        with self._lock:
+            return sum(1 for r in self._replicas if r.ready)
+
+    def all_ports(self) -> List[int]:
+        with self._lock:
+            return [r.port for r in self._replicas]
+
+    def rotation(self) -> List[int]:
+        """Ready ports, rotated round-robin per call: the first entry is
+        this request's primary, the rest its retry order."""
+        with self._lock:
+            ports = [r.port for r in self._replicas if r.ready]
+            if not ports:
+                return []
+            start = self._rr % len(ports)
+            self._rr += 1
+        return ports[start:] + ports[:start]
+
+    # -- front-door feedback ------------------------------------------------
+    def report_failure(self, port: int) -> None:
+        """A connection-level forward failure: stop routing to this replica
+        until the next successful health probe flips it back."""
+        self._c_conn_failures.inc()
+        with self._lock:
+            for r in self._replicas:
+                if r.port == port:
+                    r.ready = False
+
+    def note_retry(self) -> None:
+        self._c_retries.inc()
+
+    def note_unrouted(self) -> None:
+        self._c_unrouted.inc()
+
+    def render_metrics(self) -> str:
+        return self.registry.render_prometheus()
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> None:
+        """Spawn every replica and start the health-poll loop."""
+        spawned = [(rep.index, rep.port, self._spawn(rep.index, rep.port))
+                   for rep in self._replicas]
+        thread = threading.Thread(
+            target=self._poll_loop, name="fleet-health-poll", daemon=True)
+        with self._lock:
+            for (_, _, proc), rep in zip(spawned, self._replicas):
+                rep.proc = proc
+            self._poll_thread = thread
+        self.logger.info(
+            "spawned %d replica(s) on ports %s", len(spawned),
+            [p for _, p, _ in spawned])
+        thread.start()
+
+    def wait_ready(self, min_ready: int = 1,
+                   timeout: Optional[float] = None) -> bool:
+        """Block until ``min_ready`` replicas pass /readyz (True) or the
+        timeout/stop arrives (False)."""
+        budget = self._config.ready_timeout_s if timeout is None else timeout
+        deadline = time.monotonic() + budget
+        while time.monotonic() < deadline:
+            if self.ready_count() >= min_ready:
+                return True
+            if self._stop.wait(timeout=0.05):
+                return False
+        return self.ready_count() >= min_ready
+
+    def poll_once(self) -> None:
+        """One health sweep: probe outside the lock, update under it,
+        restart crashed replicas (capped exponential backoff) outside it."""
+        with self._lock:
+            snapshot = [(r, r.proc, r.port) for r in self._replicas]
+        cfg = self._config
+        observed = []
+        for rep, proc, port in snapshot:
+            alive = proc is not None and proc.poll() is None
+            ready = alive and _probe_ready(cfg.replica_host, port,
+                                           cfg.probe_timeout_s)
+            observed.append((rep, alive, ready))
+        now = time.monotonic()
+        to_restart = []
+        with self._lock:
+            for rep, alive, ready in observed:
+                if alive:
+                    rep.ready = ready
+                    if ready:
+                        rep.backoff_s = 0.0  # healthy: reset the backoff
+                else:
+                    rep.ready = False
+                    if now >= rep.next_restart_at:
+                        rep.backoff_s = min(
+                            cfg.restart_backoff_s if rep.backoff_s == 0.0
+                            else rep.backoff_s * 2.0,
+                            cfg.restart_backoff_max_s,
+                        )
+                        rep.next_restart_at = now + rep.backoff_s
+                        rep.restarts += 1
+                        to_restart.append(rep)
+            n_ready = sum(1 for r in self._replicas if r.ready)
+        self._g_ready.set(n_ready)
+        for rep in to_restart:
+            self._c_restarts.inc()
+            self.logger.warning(
+                "replica %d (port %d) is down; restarting "
+                "(attempt %d, next backoff %.1fs)",
+                rep.index, rep.port, rep.restarts, rep.backoff_s)
+            try:
+                proc = self._spawn(rep.index, rep.port)
+            except Exception:
+                self.logger.exception(
+                    "respawn of replica %d failed; will retry after backoff",
+                    rep.index)
+                continue
+            with self._lock:
+                rep.proc = proc
+
+    def _poll_loop(self) -> None:
+        while not self._stop.wait(
+                timeout=self._config.health_poll_interval_s):
+            self.poll_once()
+
+    def stop(self) -> None:
+        """Graceful drain: stop polling, SIGTERM every replica (each drains
+        its own batcher — server.shutdown), escalate to SIGKILL after
+        ``drain_timeout_s``."""
+        self._stop.set()
+        with self._lock:
+            thread = self._poll_thread
+            procs = [r.proc for r in self._replicas]
+            for r in self._replicas:
+                r.ready = False
+        if thread is not None:
+            thread.join(timeout=5.0)
+        self._g_ready.set(0)
+        for proc in procs:
+            if proc is not None and proc.poll() is None:
+                try:
+                    proc.terminate()
+                except OSError:
+                    pass
+        deadline = time.monotonic() + self._config.drain_timeout_s
+        for proc in procs:
+            if proc is None:
+                continue
+            try:
+                proc.wait(timeout=max(deadline - time.monotonic(), 0.1))
+            except Exception:
+                try:
+                    proc.kill()
+                except OSError:
+                    pass
+        self.logger.info("fleet stopped")
+
+
+# -- the front door ----------------------------------------------------------
+
+class _FrontDoorHandler(BaseHTTPRequestHandler):
+    server_version = "dftpu-fleet/1.0"
+
+    def log_message(self, fmt, *args):
+        self.server.logger.info("%s " + fmt, self.address_string(), *args)
+
+    def _send_json(self, code: int, payload: dict, extra_headers=()) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for name, value in extra_headers:
+            self.send_header(name, value)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        sup = self.server.supervisor
+        if self.path == "/healthz":
+            # the front door's own liveness, independent of the fleet
+            self._send_json(200, {"status": "ok"})
+        elif self.path == "/readyz":
+            n = sup.ready_count()
+            self._send_json(
+                200 if n > 0 else 503,
+                {"ready": n > 0, "ready_replicas": n, "replicas": sup.size})
+        elif self.path == "/fleet":
+            self._send_json(200, {"replicas": sup.describe()})
+        elif self.path == "/metrics":
+            self._metrics()
+        else:
+            # /health, /schema, ... answer the same on any replica
+            self._proxy("GET", None)
+
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length", "0"))
+        self._proxy("POST", self.rfile.read(length))
+
+    def _metrics(self) -> None:
+        sup = self.server.supervisor
+        cfg = sup.config
+        texts = []
+        for port in sup.all_ports():
+            # every live replica contributes, ready or not (a draining
+            # replica's counters still belong in the fleet totals)
+            payload = _fetch(cfg.replica_host, port, "/metrics",
+                             cfg.probe_timeout_s)
+            if payload is not None:
+                texts.append(payload.decode())
+        body = (aggregate_prometheus(texts) + sup.render_metrics()).encode()
+        self.send_response(200)
+        self.send_header(
+            "Content-Type", "text/plain; version=0.0.4; charset=utf-8")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _forward(self, host: int, port: int, method: str, body):
+        conn = http.client.HTTPConnection(
+            host, port, timeout=self.server.supervisor.config.proxy_timeout_s)
+        try:
+            headers = {"Content-Type": self.headers.get(
+                "Content-Type", "application/json")} if body is not None else {}
+            conn.request(method, self.path, body=body, headers=headers)
+            resp = conn.getresponse()
+            return resp.status, resp.getheader(
+                "Content-Type", "application/json"), resp.read()
+        finally:
+            conn.close()
+
+    def _proxy(self, method: str, body) -> None:
+        """Round-robin with retry-on-next-replica.
+
+        Connection-level failures (refused/reset/timeout before a response
+        arrives) mean the replica died or is mid-restart; predict is
+        idempotent, so the request replays on the next ready replica and
+        the client never sees the crash.  Application-level responses —
+        including a replica's own 4xx/5xx — pass through untouched.
+        """
+        sup = self.server.supervisor
+        cfg = sup.config
+        deadline = time.monotonic() + cfg.retry_window_s
+        attempts = 0
+        last_err: Optional[str] = None
+        while True:
+            for port in sup.rotation():
+                attempts += 1
+                if attempts > 1:
+                    sup.note_retry()
+                try:
+                    status, ctype, payload = self._forward(
+                        cfg.replica_host, port, method, body)
+                except (OSError, http.client.HTTPException) as e:
+                    sup.report_failure(port)
+                    last_err = f"{type(e).__name__}: {e}"
+                    continue
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(payload)))
+                self.send_header("X-Fleet-Replica", str(port))
+                self.end_headers()
+                self.wfile.write(payload)
+                return
+            if time.monotonic() >= deadline:
+                break
+            # no ready replica right now (all crashed or mid-restart):
+            # wait for the supervisor's poll loop to bring one back
+            time.sleep(0.05)
+        sup.note_unrouted()
+        self._send_json(
+            503,
+            {"error": "no ready replica",
+             "detail": last_err or "fleet has no ready replicas",
+             "attempts": attempts},
+            extra_headers=(("Retry-After", "1"),),
+        )
+
+
+class FrontDoorServer(ThreadingHTTPServer):
+    daemon_threads = True
+    request_queue_size = 128  # match ForecastServer's burst posture
+
+    def __init__(self, addr, supervisor: FleetSupervisor):
+        super().__init__(addr, _FrontDoorHandler)
+        self.supervisor = supervisor
+        self.logger = get_logger("FrontDoor")
+
+
+def start_fleet(
+    config: FleetConfig,
+    artifact_dir: Optional[str] = None,
+    serving_conf: Optional[dict] = None,
+    front_host: str = "127.0.0.1",
+    front_port: int = 0,
+    env_extra: Optional[dict] = None,
+    spawn_fn: Optional[SpawnFn] = None,
+    wait: bool = True,
+):
+    """Boot the whole subsystem: supervisor + replicas + front door.
+
+    Returns ``(supervisor, front_door_server)``; the front door runs on a
+    daemon thread (its bound port is ``front.server_address[1]``).  Callers
+    stop with ``front.shutdown(); supervisor.stop()``.
+    """
+    if spawn_fn is None:
+        if artifact_dir is None:
+            raise ValueError(
+                "pass artifact_dir (for the default subprocess spawner) or "
+                "an explicit spawn_fn")
+        spawn_fn = default_spawn_fn(
+            config, artifact_dir, serving_conf, env_extra=env_extra)
+    supervisor = FleetSupervisor(config, spawn_fn)
+    supervisor.start()
+    if wait and not supervisor.wait_ready(min_ready=1):
+        supervisor.stop()
+        raise RuntimeError(
+            f"no replica became ready within {config.ready_timeout_s}s")
+    front = FrontDoorServer((front_host, front_port), supervisor)
+    t = threading.Thread(target=front.serve_forever, daemon=True)
+    t.start()
+    supervisor.logger.info(
+        "front door on %s:%d over %d replica(s)",
+        front_host, front.server_address[1], supervisor.size)
+    return supervisor, front

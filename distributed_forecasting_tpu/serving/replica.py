@@ -1,0 +1,135 @@
+"""Fleet replica entrypoint: one serving process under the supervisor.
+
+Launched by ``serving/fleet.py``'s default spawner as
+
+    python -m distributed_forecasting_tpu.serving.replica --conf '<json>'
+
+The conf object carries: ``artifact_dir`` (the saved forecaster to load),
+``host``/``port`` (the supervisor-assigned, restart-stable address),
+``warmup_sizes``/``warmup_horizon``, optional ``batching``/``tracing``
+blocks (same shapes as the ``serving:`` conf), ``model_version``, and
+``mesh_devices`` (>1 shards every predict's series axis over a device mesh
+— ``BatchForecaster.enable_mesh``).
+
+Boot order is the contract the supervisor routes on: bind the port with
+``/readyz`` at 503 first, warm the bucket ladder, THEN flip ready — a
+replica never receives traffic while it is still compiling.  The shared
+AOT store (``DFTPU_COMPILE_CACHE`` in the spawn env) makes every warmup
+after the fleet's first a deserialize, not a compile.  SIGTERM drains
+gracefully: /readyz flips to 503, queued requests finish, then the socket
+closes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import threading
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--conf", required=True,
+                    help="JSON replica config (see module docstring)")
+    args = ap.parse_args(argv)
+    conf = json.loads(args.conf)
+
+    mesh_devices = int(conf.get("mesh_devices") or 0)
+    if mesh_devices > 1:
+        # must land before the first jax device use; the flag only affects
+        # the host (CPU) platform, so it is harmless on real accelerators
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags +
+                f" --xla_force_host_platform_device_count={mesh_devices}"
+            ).strip()
+
+    # jax-touching imports stay below the XLA_FLAGS staging above
+    from distributed_forecasting_tpu.engine.compile_cache import (
+        cache_stats,
+        enable_from_env,
+    )
+    from distributed_forecasting_tpu.monitoring.trace import (
+        TraceConfig,
+        configure_tracing,
+    )
+    from distributed_forecasting_tpu.serving.batcher import BatchingConfig
+    from distributed_forecasting_tpu.serving.server import (
+        load_forecaster,
+        start_server,
+    )
+    from distributed_forecasting_tpu.utils import get_logger
+
+    logger = get_logger("fleet-replica")
+    enable_from_env()  # DFTPU_COMPILE_CACHE: the store all replicas share
+
+    tracing_conf = conf.get("tracing")
+    trace_dir = os.environ.get("DFTPU_TRACE_DIR")
+    if tracing_conf is None and trace_dir:
+        # conf-less trace activation, same hook the bench uses: per-replica
+        # JSONL streams + flight-recorder dumps land in one artifact dir
+        tracing_conf = {
+            "enabled": True,
+            "jsonl_path": os.path.join(
+                trace_dir, f"replica-{int(conf['port'])}.trace.jsonl"),
+            "dump_dir": trace_dir,
+        }
+    configure_tracing(TraceConfig.from_conf(tracing_conf))
+
+    forecaster = load_forecaster(conf["artifact_dir"])
+    if mesh_devices > 1:
+        enable_mesh = getattr(forecaster, "enable_mesh", None)
+        if enable_mesh is None:
+            # composite artifacts (ensemble/bucketed) don't shard yet;
+            # serve them single-device rather than refuse to boot
+            logger.warning(
+                "%s has no mesh-parallel predict; serving single-device",
+                type(forecaster).__name__)
+        else:
+            from distributed_forecasting_tpu.parallel import make_mesh
+
+            enable_mesh(make_mesh(mesh_devices))
+            logger.info("mesh-parallel predict over %d device(s)",
+                        mesh_devices)
+
+    batching = BatchingConfig.from_conf(conf.get("batching"))
+    srv = start_server(
+        forecaster,
+        host=conf.get("host", "127.0.0.1"),
+        port=int(conf["port"]),
+        model_version=conf.get("model_version"),
+        batching=batching,
+        ready=False,  # warm first; the supervisor routes on /readyz
+    )
+    sizes = conf.get("warmup_sizes")
+    if sizes:
+        n = forecaster.warmup(
+            horizon=int(conf.get("warmup_horizon", 90)),
+            sizes=[int(s) for s in sizes],
+        )
+        stats = cache_stats()
+        logger.info(
+            "warmed %d bucket(s) (%d AOT store hit(s), %d miss(es))",
+            n, stats["hits"], stats["misses"])
+    srv.mark_ready()
+    logger.info("replica ready on %s:%d", conf.get("host", "127.0.0.1"),
+                int(conf["port"]))
+
+    stop = threading.Event()
+
+    def _drain(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _drain)
+    signal.signal(signal.SIGINT, _drain)
+    stop.wait()
+    logger.info("draining replica on port %d", int(conf["port"]))
+    srv.shutdown()  # /readyz -> 503, batcher drains, accept loop stops
+    srv.server_close()
+
+
+if __name__ == "__main__":
+    main()

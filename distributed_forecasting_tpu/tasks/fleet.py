@@ -1,0 +1,109 @@
+"""Fleet task: registered model -> N serving replicas behind one front door.
+
+The horizontal-scale counterpart of ``tasks/serve.py``: where the reference
+scales serving by handing its PyFunc to a Spark cluster (one model re-load
+per executor per batch, ``notebooks/prophet/04_inference.py:4-16``), this
+task resolves the artifact ONCE, then supervises N replica processes that
+each load it once and share the on-disk AOT executable store — so the
+fleet's cold boot compiles each bucket program exactly once, fleet-wide.
+
+Conf: the same ``serving:`` block ``dftpu-serve`` reads, plus::
+
+    serving:
+      fleet:
+        enabled: true
+        replicas: 2              # server processes behind the front door
+        replica_host: 127.0.0.1  # replicas are local children
+        base_port: 0             # 0: free ports; else base_port + i
+        health_poll_interval_s: 0.5
+        probe_timeout_s: 2
+        ready_timeout_s: 300     # cold warmup may compile for minutes
+        restart_backoff_s: 0.5   # capped exponential crash-restart backoff
+        restart_backoff_max_s: 30
+        drain_timeout_s: 10      # SIGTERM -> SIGKILL grace on drain
+        proxy_timeout_s: 120     # per-attempt forward timeout
+        retry_window_s: 10       # front-door budget to find a ready replica
+        mesh_devices: 0          # >1: each replica shards predict over a
+                                 # device mesh of this size
+
+``serving.host``/``serving.port`` bind the FRONT DOOR (the one address
+clients see); replicas bind supervisor-assigned ports on ``replica_host``.
+SIGTERM drains the whole fleet gracefully: front door stops accepting,
+every replica flips /readyz to 503 and finishes its queued requests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+import threading
+
+from distributed_forecasting_tpu.serving.batcher import BatchingConfig
+from distributed_forecasting_tpu.serving.fleet import (
+    FleetConfig,
+    start_fleet,
+)
+from distributed_forecasting_tpu.tasks.common import Task
+
+
+class FleetTask(Task):
+    def launch(self) -> None:
+        conf = self.conf.get("serving", {})
+        fleet = FleetConfig.from_conf(conf.get("fleet"))
+        if not fleet.enabled:
+            # running dftpu-fleet IS the opt-in; honor the block's sizing
+            # but don't require a redundant enabled: true
+            fleet = dataclasses.replace(fleet, enabled=True)
+        # fail on a batching typo in milliseconds, before artifact resolution
+        BatchingConfig.from_conf(conf.get("batching"))
+        name = conf.get("model_name", "ForecastingBatchModel")
+        stage = conf.get("stage")
+        version = self.registry.latest_version(name, stage=stage)
+        sub = os.path.join(version.artifact_dir, "forecaster")
+        artifact_dir = sub if os.path.isdir(sub) else version.artifact_dir
+        serving_conf = {**conf, "model_version": str(version.version)}
+
+        env_extra = {}
+        from distributed_forecasting_tpu.engine.compile_cache import (
+            get_config,
+        )
+
+        cc = get_config()
+        if cc is not None and cc.enabled:
+            # every replica shares the task's AOT store: the first warmup
+            # compiles, the other N-1 (and every restart) deserialize
+            env_extra["DFTPU_COMPILE_CACHE"] = cc.directory
+
+        supervisor, front = start_fleet(
+            fleet,
+            artifact_dir=artifact_dir,
+            serving_conf=serving_conf,
+            front_host=conf.get("host", "0.0.0.0"),
+            front_port=int(conf.get("port", 8080)),
+            env_extra=env_extra,
+        )
+        self.logger.info(
+            "fleet of %d replica(s) serving %s v%s behind %s:%d",
+            supervisor.size, name, version.version,
+            conf.get("host", "0.0.0.0"), front.server_address[1])
+
+        stop = threading.Event()
+
+        def _drain(signum, frame):
+            stop.set()
+
+        signal.signal(signal.SIGTERM, _drain)
+        signal.signal(signal.SIGINT, _drain)
+        stop.wait()
+        self.logger.info("draining fleet")
+        front.shutdown()
+        supervisor.stop()
+
+
+def entrypoint():
+    FleetTask().launch()
+
+
+if __name__ == "__main__":
+    entrypoint()

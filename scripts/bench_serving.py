@@ -1,35 +1,53 @@
-"""Serving-path load benchmark: sequential dispatch vs micro-batching.
+"""Serving-path load benchmark: micro-batching win + fleet scaling curve.
 
-ISSUE #1 acceptance: the win from the request coalescer
-(``serving/batcher.py``) must be measured, not asserted.  This script fits
-a small artifact, starts the SAME forecaster behind two live HTTP servers —
-micro-batching disabled, then enabled — fires K concurrent clients at each
-(every client scores its own series, the worst case for coalescing dedup),
-and prints one JSON line with both modes' throughput and latency
-percentiles plus an exact-equality check of the coalesced responses against
-per-request responses.
+Two benches in one harness, sharing the latency accounting
+(:class:`LatencyStats`: p50/p95/p99 percentile summaries used by both the
+closed-loop and open-loop drivers):
 
-Both modes share one process and one compile cache, and every request-size
-bucket the coalescer can produce is warmed before timing, so the comparison
-isolates dispatch behavior: N threads -> N solo device dispatches vs N
-threads -> ~N/K merged dispatches.
+**Default mode** (ISSUE #1 acceptance): sequential dispatch vs
+micro-batching.  Fits a small artifact, starts the SAME forecaster behind
+two live HTTP servers — coalescing disabled, then enabled — fires K
+concurrent closed-loop clients at each, and reports both modes' throughput
+and latency percentiles plus an exact-equality check of coalesced
+responses against per-request responses.
 
-Run (CPU backend is fine — the dispatch overhead being amortized exists on
+**Fleet mode** (ISSUE #7 acceptance, ``--fleet 1,2,...``): boots a replica
+fleet (``serving/fleet.py``) per listed replica count — real subprocess
+replicas sharing one AOT store behind the front door — waits on
+``/readyz``, then drives BOTH load shapes through the front door:
+
+  * closed loop: K clients, each firing its next request when the last
+    returns (throughput-seeking, hides queueing delay);
+  * open loop: fixed arrival rate, latency measured FROM THE SCHEDULED
+    SEND TIME so queueing under saturation counts (no coordinated
+    omission).
+
+The result is a machine-readable scaling curve: p50/p95/p99, series/s and
+sustained QPS per replica for 1 vs N replicas, plus failed-request counts
+and an aggregated-/metrics presence check — the JSON the CI fleet smoke
+step and BENCH trajectory tracking consume (``--json-out``).
+
+Run (CPU backend is fine — dispatch overhead and fleet mechanics exist on
 every backend):
 
     JAX_PLATFORMS=cpu python scripts/bench_serving.py --clients 16
+    JAX_PLATFORMS=cpu python scripts/bench_serving.py --fleet 1,2 \\
+        --json-out fleet-scaling.json
 
-Output: one JSON line on stdout, e.g. speedup = batched throughput /
-unbatched throughput; docs/serving.md carries a measured row.
+Trace artifacts: with ``--trace-dir`` (or ``$DFTPU_TRACE_DIR``) the
+default mode writes a Perfetto trace of the bench process, and fleet-mode
+replicas stream per-replica JSONL spans into the same directory.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
 import re
 import sys
+import tempfile
 import threading
 import time
 import urllib.request
@@ -52,20 +70,47 @@ def _metrics(port: int) -> str:
         return r.read().decode()
 
 
-def _percentile(sorted_vals, q: float) -> float:
-    if not sorted_vals:
-        return float("nan")
-    i = min(int(q * len(sorted_vals)), len(sorted_vals) - 1)
-    return sorted_vals[i]
+class LatencyStats:
+    """Thread-safe latency accumulator with percentile summaries — the ONE
+    accounting path for every load shape in this harness, so closed- and
+    open-loop numbers are always comparable."""
+
+    def __init__(self) -> None:
+        self._vals = []
+        self._lock = threading.Lock()
+
+    def observe(self, seconds: float) -> None:
+        with self._lock:
+            self._vals.append(seconds)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._vals)
+
+    def percentile(self, q: float) -> float:
+        with self._lock:
+            vals = sorted(self._vals)
+        if not vals:
+            return float("nan")
+        i = min(int(q * len(vals)), len(vals) - 1)
+        return vals[i]
+
+    def summary(self) -> dict:
+        return {
+            "count": len(self),
+            "p50_ms": round(1e3 * self.percentile(0.50), 2),
+            "p95_ms": round(1e3 * self.percentile(0.95), 2),
+            "p99_ms": round(1e3 * self.percentile(0.99), 2),
+        }
 
 
-def run_mode(fc, payloads, n_requests: int, batching) -> dict:
-    from distributed_forecasting_tpu.serving import start_server
-
-    srv = start_server(fc, batching=batching)
-    port = srv.server_address[1]
+def closed_loop(call, payloads, n_requests: int) -> dict:
+    """K clients, each firing its next request as soon as the last returns.
+    Returns throughput + percentile summary + first response bodies."""
     K = len(payloads)
-    latencies = [[] for _ in range(K)]
+    stats = LatencyStats()
+    failures = [0]
+    flock = threading.Lock()
     bodies = [None] * K
     spans = [None] * K
     barrier = threading.Barrier(K)
@@ -75,8 +120,13 @@ def run_mode(fc, payloads, n_requests: int, batching) -> dict:
         t_start = time.perf_counter()
         for _ in range(n_requests):
             t0 = time.perf_counter()
-            body = _call(port, payloads[i])
-            latencies[i].append(time.perf_counter() - t0)
+            try:
+                body = call(payloads[i])
+            except Exception:
+                with flock:
+                    failures[0] += 1
+                continue
+            stats.observe(time.perf_counter() - t0)
             if bodies[i] is None:
                 bodies[i] = body
         spans[i] = (t_start, time.perf_counter())
@@ -87,22 +137,205 @@ def run_mode(fc, payloads, n_requests: int, batching) -> dict:
     for t in threads:
         t.join()
     wall = max(t1 for _, t1 in spans) - min(t0 for t0, _ in spans)
+    ok = K * n_requests - failures[0]
+    return {
+        "throughput_rps": round(ok / wall, 2) if wall > 0 else float("nan"),
+        "wall_s": round(wall, 3),
+        **stats.summary(),
+        "failed_requests": failures[0],
+        "_bodies": bodies,
+    }
+
+
+def open_loop(call, payloads, rate_qps: float, n_requests: int) -> dict:
+    """Fixed arrival rate: request i is scheduled at ``t0 + i/rate`` and its
+    latency runs FROM THE SCHEDULED TIME — a server that falls behind pays
+    the queueing delay in these percentiles (closed loop cannot see it)."""
+    stats = LatencyStats()
+    failures = [0]
+    flock = threading.Lock()
+    t0 = time.perf_counter() + 0.05
+
+    def fire(i: int, scheduled: float) -> None:
+        delay = scheduled - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        try:
+            call(payloads[i % len(payloads)])
+        except Exception:
+            with flock:
+                failures[0] += 1
+            return
+        stats.observe(time.perf_counter() - scheduled)
+
+    threads = []
+    for i in range(n_requests):
+        th = threading.Thread(target=fire, args=(i, t0 + i / rate_qps))
+        th.start()
+        threads.append(th)
+    for th in threads:
+        th.join()
+    wall = time.perf_counter() - t0
+    ok = n_requests - failures[0]
+    return {
+        "offered_qps": round(rate_qps, 2),
+        "achieved_rps": round(ok / wall, 2) if wall > 0 else float("nan"),
+        "wall_s": round(wall, 3),
+        **stats.summary(),
+        "failed_requests": failures[0],
+    }
+
+
+def run_mode(fc, payloads, n_requests: int, batching) -> dict:
+    from distributed_forecasting_tpu.serving import start_server
+
+    srv = start_server(fc, batching=batching)
+    port = srv.server_address[1]
+    out = closed_loop(lambda p: _call(port, p), payloads, n_requests)
     text = _metrics(port)
     dispatches = int(re.search(r"serving_dispatches_total (\d+)", text).group(1))
     requests = int(re.search(r"serving_requests_total (\d+)", text).group(1))
     srv.shutdown()
-    lat = sorted(x for per_client in latencies for x in per_client)
-    return {
-        "throughput_rps": round(K * n_requests / wall, 2),
-        "wall_s": round(wall, 3),
-        "p50_ms": round(1e3 * _percentile(lat, 0.50), 2),
-        "p95_ms": round(1e3 * _percentile(lat, 0.95), 2),
-        "p99_ms": round(1e3 * _percentile(lat, 0.99), 2),
-        "requests": requests,
-        "dispatches": dispatches,
-        "mean_batch": round(requests / max(dispatches, 1), 2),
-        "_bodies": bodies,
+    out.update(
+        requests=requests,
+        dispatches=dispatches,
+        mean_batch=round(requests / max(dispatches, 1), 2),
+    )
+    return out
+
+
+def _fit_forecaster(args):
+    from distributed_forecasting_tpu.data import (
+        synthetic_store_item_sales,
+        tensorize,
+    )
+    from distributed_forecasting_tpu.engine import fit_forecast
+    from distributed_forecasting_tpu.models.base import get_model
+    from distributed_forecasting_tpu.serving import BatchForecaster
+
+    n_items = max(1, (args.series + 3) // 4)
+    df = synthetic_store_item_sales(
+        n_stores=4, n_items=n_items, n_days=args.days, seed=7)
+    batch = tensorize(df)
+    cfg = get_model(args.model).config_cls()
+    params, _ = fit_forecast(
+        batch, model=args.model, config=cfg, horizon=args.horizon)
+    return BatchForecaster.from_fit(batch, params, args.model, cfg)
+
+
+def _payloads(fc, horizon: int, K: int):
+    S = fc.n_series
+    keys = fc.keys
+    return [
+        {
+            "inputs": [
+                {name: int(v) for name, v in zip(fc.key_names, keys[i % S])}
+            ],
+            "horizon": horizon,
+        }
+        for i in range(K)
+    ]
+
+
+def run_fleet_scaling(args, counts) -> dict:
+    """Boot a fleet per replica count and drive closed + open loop through
+    the front door; emits the 1-vs-N scaling curve as JSON."""
+    from distributed_forecasting_tpu.serving.fleet import (
+        FleetConfig,
+        start_fleet,
+    )
+
+    fc = _fit_forecaster(args)
+    K = min(args.clients, fc.n_series)
+    payloads = _payloads(fc, args.horizon, K)
+    # one response row per ds per requested series: series/s = rps * k_req
+    series_per_request = 1
+
+    workdir = tempfile.mkdtemp(prefix="dftpu-fleet-bench-")
+    artifact_dir = os.path.join(workdir, "forecaster")
+    fc.save(artifact_dir)
+    cache_dir = os.environ.get(
+        "DFTPU_COMPILE_CACHE", os.path.join(workdir, "compile_cache"))
+    env_extra = {"DFTPU_COMPILE_CACHE": cache_dir}
+    if args.trace_dir:
+        os.makedirs(args.trace_dir, exist_ok=True)
+        env_extra["DFTPU_TRACE_DIR"] = args.trace_dir
+
+    warm = sorted({1, K})
+    serving_conf = {
+        "warmup_sizes": warm,
+        "warmup_horizon": args.horizon,
     }
+    scaling = []
+    for count in counts:
+        cfg = FleetConfig(
+            enabled=True,
+            replicas=count,
+            health_poll_interval_s=0.2,
+            ready_timeout_s=args.fleet_ready_timeout,
+            mesh_devices=args.fleet_mesh_devices,
+        )
+        sup, front = start_fleet(
+            cfg,
+            artifact_dir=artifact_dir,
+            serving_conf=serving_conf,
+            front_host="127.0.0.1",
+            front_port=0,
+            env_extra=env_extra,
+            wait=False,
+        )
+        try:
+            if not sup.wait_ready(min_ready=count,
+                                  timeout=args.fleet_ready_timeout):
+                raise RuntimeError(
+                    f"only {sup.ready_count()}/{count} replicas became "
+                    f"ready within {args.fleet_ready_timeout}s")
+            port = front.server_address[1]
+            closed = closed_loop(
+                lambda p: _call(port, p), payloads, args.requests)
+            closed.pop("_bodies")
+            rate = args.open_loop_qps or max(
+                1.0, 0.7 * closed["throughput_rps"])
+            n_open = max(10, int(math.ceil(rate * args.open_loop_duration)))
+            opened = open_loop(
+                lambda p: _call(port, p), payloads, rate, n_open)
+            text = _metrics(port)
+            # aggregation sanity: the fleet's own gauges AND the summed
+            # replica counters must both be present in one exposition
+            aggregated = (
+                "fleet_replicas_ready" in text
+                and "serving_requests_total" in text
+            )
+            scaling.append({
+                "replicas": count,
+                "closed_loop": closed,
+                "open_loop": opened,
+                "series_per_s": round(
+                    closed["throughput_rps"] * series_per_request, 2),
+                "qps_per_replica": round(
+                    closed["throughput_rps"] / count, 2),
+                "failed_requests": (
+                    closed["failed_requests"] + opened["failed_requests"]),
+                "metrics_aggregated": bool(aggregated),
+            })
+        finally:
+            front.shutdown()
+            sup.stop()
+    out = {
+        "bench": "serving_fleet",
+        "model": args.model,
+        "clients": K,
+        "requests_per_client": args.requests,
+        "series": fc.n_series,
+        "horizon": args.horizon,
+        "mesh_devices_per_replica": args.fleet_mesh_devices,
+        "scaling": scaling,
+    }
+    if len(scaling) > 1:
+        base = scaling[0]["closed_loop"]["throughput_rps"]
+        out["scaling_speedup"] = round(
+            scaling[-1]["closed_loop"]["throughput_rps"] / base, 2)
+    return out
 
 
 def main() -> None:
@@ -117,6 +350,21 @@ def main() -> None:
     ap.add_argument("--model", default="theta",
                     help="fast-fitting family; the dispatch story is the same")
     ap.add_argument("--max-wait-ms", type=float, default=4.0)
+    ap.add_argument("--fleet", default=None,
+                    help="comma list of replica counts (e.g. 1,2): run the "
+                         "fleet scaling bench through the front door "
+                         "instead of the micro-batching comparison")
+    ap.add_argument("--fleet-mesh-devices", type=int, default=0,
+                    help="shard each replica's predict over a mesh of this "
+                         "size (>1; replicas force host devices to match)")
+    ap.add_argument("--fleet-ready-timeout", type=float, default=300.0)
+    ap.add_argument("--open-loop-qps", type=float, default=0.0,
+                    help="fixed arrival rate; 0 = 70%% of measured "
+                         "closed-loop throughput")
+    ap.add_argument("--open-loop-duration", type=float, default=5.0,
+                    help="seconds of offered open-loop load per point")
+    ap.add_argument("--json-out", default=None,
+                    help="also write the result JSON to this path")
     ap.add_argument("--trace-dir", default=os.environ.get("DFTPU_TRACE_DIR"),
                     help="emit trace artifacts (JSONL + Perfetto JSON) here; "
                          "defaults to $DFTPU_TRACE_DIR")
@@ -128,17 +376,21 @@ def main() -> None:
     sys.path.insert(
         0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     import distributed_forecasting_tpu  # noqa: F401  (platform override first)
-    from distributed_forecasting_tpu.data import (
-        synthetic_store_item_sales,
-        tensorize,
-    )
-    from distributed_forecasting_tpu.engine import fit_forecast
-    from distributed_forecasting_tpu.serving import (
-        BatchForecaster,
-        BatchingConfig,
-    )
 
-    from distributed_forecasting_tpu.models.base import get_model
+    if args.fleet:
+        counts = [int(x) for x in args.fleet.split(",") if x.strip()]
+        out = run_fleet_scaling(args, counts)
+        line = json.dumps(out)
+        print(line)
+        if args.json_out:
+            with open(args.json_out, "w") as f:
+                f.write(line + "\n")
+        failed = sum(p["failed_requests"] for p in out["scaling"])
+        if failed:
+            sys.exit(f"{failed} request(s) failed through the front door")
+        return
+
+    from distributed_forecasting_tpu.serving import BatchingConfig
     from distributed_forecasting_tpu.monitoring.trace import (
         TraceConfig,
         configure_tracing,
@@ -154,27 +406,9 @@ def main() -> None:
             dump_dir=args.trace_dir,
         ))
 
-    n_items = max(1, (args.series + 3) // 4)
-    df = synthetic_store_item_sales(
-        n_stores=4, n_items=n_items, n_days=args.days, seed=7)
-    batch = tensorize(df)
-    cfg = get_model(args.model).config_cls()
-    params, _ = fit_forecast(
-        batch, model=args.model, config=cfg, horizon=args.horizon)
-    fc = BatchForecaster.from_fit(batch, params, args.model, cfg)
-
-    S = fc.n_series
-    K = min(args.clients, S)
-    keys = fc.keys
-    payloads = [
-        {
-            "inputs": [
-                {name: int(v) for name, v in zip(fc.key_names, keys[i % S])}
-            ],
-            "horizon": args.horizon,
-        }
-        for i in range(K)
-    ]
+    fc = _fit_forecaster(args)
+    K = min(args.clients, fc.n_series)
+    payloads = _payloads(fc, args.horizon, K)
 
     # warm every bucket the coalescer can produce (1..K) plus the solo path
     sizes = [1]
@@ -206,7 +440,7 @@ def main() -> None:
         "model": args.model,
         "clients": K,
         "requests_per_client": args.requests,
-        "series": S,
+        "series": fc.n_series,
         "horizon": args.horizon,
         "unbatched": unbatched,
         "batched": batched,
@@ -237,7 +471,11 @@ def main() -> None:
         out["untraced"] = untraced
         out["trace_overhead_p50_pct"] = round(
             100.0 * (unbatched["p50_ms"] - p50_off) / max(p50_off, 1e-9), 2)
-    print(json.dumps(out))
+    line = json.dumps(out)
+    print(line)
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            f.write(line + "\n")
     if not exact:
         sys.exit("coalesced responses diverged from per-request responses")
 

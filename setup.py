@@ -48,6 +48,7 @@ setup(
             "dftpu-deploy=distributed_forecasting_tpu.tasks.deploy:entrypoint",
             "dftpu-infer=distributed_forecasting_tpu.tasks.inference:entrypoint",
             "dftpu-serve=distributed_forecasting_tpu.tasks.serve:entrypoint",
+            "dftpu-fleet=distributed_forecasting_tpu.tasks.fleet:entrypoint",
             "dftpu-ml=distributed_forecasting_tpu.tasks.sample_ml:entrypoint",
             "dftpu-monitor=distributed_forecasting_tpu.tasks.monitor:entrypoint",
             "dftpu-promote=distributed_forecasting_tpu.tasks.promote:entrypoint",

@@ -11,6 +11,7 @@ global cache state back down so the rest of the suite sees jit untouched.
 import glob
 import os
 import pickle
+import time
 from functools import partial
 
 import jax
@@ -260,3 +261,55 @@ def test_lru_eviction_order(tmp_path):
         f.write(b"x" * 512)
     store2.max_size_bytes = 1
     assert store2.evict() == 0
+
+
+def test_concurrent_writers_never_tear_the_store(cache_dir):
+    """ISSUE-7 satellite: fleet replicas share ONE on-disk AOT store, so N
+    processes warming the same bucket race store() on the same key.  The
+    temp-file + fsync + atomic-rename publish must guarantee a reader sees
+    either no entry or a complete one — never a torn pickle (which load()
+    would count as an error and discard, costing a recompile)."""
+    import threading
+
+    store_dir = os.path.join(cache_dir, "aot")
+    writer_store = cc.AOTStore(store_dir)
+    x = jnp.linspace(0.0, 1.0, 32, dtype=jnp.float32)
+    compiled = _toy.lower(x, y=None, scale=2.0).compile()
+    reference = np.asarray(compiled(x, y=None)).tobytes()
+    key = "cafe" * 16
+    s0 = cc.cache_stats()
+
+    stop = threading.Event()
+    failures = []
+
+    def writer():
+        while not stop.is_set():
+            if not writer_store.store(key, compiled, entry="toy"):
+                failures.append("store() returned False")
+
+    def reader():
+        while not stop.is_set():
+            # a fresh store per load bypasses the in-process memo: every
+            # load really deserializes whatever is on disk right now
+            out = cc.AOTStore(store_dir).load(key)
+            if out is not None:
+                got = np.asarray(out(x, y=None)).tobytes()
+                if got != reference:
+                    failures.append("loaded executable diverged")
+
+    threads = [threading.Thread(target=writer) for _ in range(3)]
+    threads += [threading.Thread(target=reader) for _ in range(3)]
+    for t in threads:
+        t.start()
+    time.sleep(2.0)
+    stop.set()
+    for t in threads:
+        t.join()
+
+    assert not failures, failures[:3]
+    # no reader ever hit a torn/corrupt entry (load() would have counted
+    # an error and deleted it)
+    assert cc.cache_stats()["errors"] == s0["errors"]
+    files = os.listdir(store_dir)
+    assert [f for f in files if f.endswith(".aot")], files
+    assert not [f for f in files if f.endswith(".tmp")], "temp files leaked"

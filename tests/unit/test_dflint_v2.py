@@ -586,3 +586,122 @@ def test_v2_modules_never_import_jax():
     proc = subprocess.run([sys.executable, "-c", code], cwd=REPO,
                           capture_output=True, text=True)
     assert proc.returncode == 0, proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# ISSUE-7 fixtures: the serving.fleet conf block + the supervisor poll loop
+# ---------------------------------------------------------------------------
+
+def test_fleet_conf_block_drift_positive_and_negative(tmp_path):
+    # mirrors conf/tasks/serve_config.yml's serving.fleet block: a typo'd
+    # backoff key is spellable from YAML but no FleetConfig field or string
+    # lookup consumes it -> drift; every real key lands on a field
+    _write(tmp_path, "conf/serve.yml", """
+        serving:
+          fleet:
+            enabled: false
+            replicas: 2
+            restart_backoff_s: 0.5
+            restart_backof_max_s: 30
+    """)
+    _write(tmp_path, "src/fleet_cfg.py", """
+        import dataclasses
+
+        @dataclasses.dataclass(frozen=True)
+        class FleetConfig:
+            enabled: bool = False
+            replicas: int = 2
+            restart_backoff_s: float = 0.5
+            restart_backoff_max_s: float = 30.0
+
+            @classmethod
+            def from_conf(cls, conf):
+                fleet = conf.get("serving", {}).get("fleet", {})
+                known = {f.name for f in dataclasses.fields(cls)}
+                return cls(**{k: v for k, v in fleet.items() if k in known})
+    """)
+    found = _lint(tmp_path, "src/fleet_cfg.py")
+    assert [f.rule for f in found] == ["config-drift"]
+    assert "restart_backof_max_s" in found[0].message
+    assert found[0].path == "conf/serve.yml"
+
+    # fixing the typo makes the block clean
+    _write(tmp_path, "conf/serve.yml", """
+        serving:
+          fleet:
+            enabled: false
+            replicas: 2
+            restart_backoff_s: 0.5
+            restart_backoff_max_s: 30
+    """)
+    assert _lint(tmp_path, "src/fleet_cfg.py") == []
+
+
+def test_health_poll_probe_under_lock_positive(tmp_path):
+    # the anti-pattern the fleet supervisor must avoid: holding the state
+    # lock across the readiness probe, the restart spawn, and the backoff
+    # sleep — every replica introspection call would stall behind the sweep
+    _write(tmp_path, "serving/sup.py", """
+        import socket
+        import subprocess
+        import threading
+        import time
+
+        class Supervisor:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._ready = [False]
+
+            def poll_once(self):
+                with self._lock:
+                    s = socket.socket()
+                    s.connect(("127.0.0.1", 8080))
+                    self._ready[0] = True
+
+            def restart(self, cmd, backoff_s):
+                with self._lock:
+                    time.sleep(backoff_s)
+                    subprocess.Popen(cmd)
+    """)
+    found = _lint(tmp_path, "serving/sup.py")
+    assert _rules(found).count("blocking-under-lock") >= 3
+
+
+def test_health_poll_snapshot_pattern_negative(tmp_path):
+    # the shape serving/fleet.py actually uses: snapshot under the lock,
+    # probe and spawn OUTSIDE it, re-take the lock to apply observations
+    _write(tmp_path, "serving/sup.py", """
+        import socket
+        import subprocess
+        import threading
+
+        class Supervisor:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._ports = [8080]
+                self._ready = {}
+
+            def poll_once(self):
+                with self._lock:
+                    snapshot = list(self._ports)
+                observed = []
+                for port in snapshot:
+                    s = socket.socket()
+                    try:
+                        s.connect(("127.0.0.1", port))
+                        observed.append((port, True))
+                    except OSError:
+                        observed.append((port, False))
+                    finally:
+                        s.close()
+                with self._lock:
+                    for port, ok in observed:
+                        self._ready[port] = ok
+
+            def restart(self, cmd):
+                proc = subprocess.Popen(cmd)
+                with self._lock:
+                    self._ready[id(proc)] = False
+    """)
+    found = _lint(tmp_path, "serving/sup.py")
+    assert "blocking-under-lock" not in _rules(found)

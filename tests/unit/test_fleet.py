@@ -1,0 +1,480 @@
+"""Serving fleet (serving/fleet.py): supervisor + front door mechanics.
+
+The fast tests inject an in-process spawn_fn — each "replica" is a tiny
+stdlib HTTP server behind a Popen-compatible fake handle — so round-robin,
+retry-on-next-replica, restart-with-backoff, metrics aggregation, and
+drain are all exercised without fitting a model or booting a subprocess.
+The one real-subprocess lifecycle test (kill -9 a replica under load, zero
+client-visible 5xx, restart observable in aggregated /metrics) is marked
+slow: tier-1 skips it, CI's unit step runs it.
+"""
+
+import http.client
+import json
+import signal
+import threading
+import time
+
+import pytest
+
+from distributed_forecasting_tpu.serving.fleet import (
+    FleetConfig,
+    FleetSupervisor,
+    aggregate_prometheus,
+    start_fleet,
+)
+
+
+# -- config -------------------------------------------------------------------
+
+def test_fleet_config_defaults_and_from_conf():
+    cfg = FleetConfig.from_conf(None)
+    assert cfg.replicas == 2 and not cfg.enabled
+    cfg = FleetConfig.from_conf(
+        {"enabled": True, "replicas": 3, "base_port": "9000"})
+    assert cfg.enabled and cfg.replicas == 3
+    assert cfg.base_port == 9000  # string port normalizes to int
+
+
+def test_fleet_config_rejects_unknown_keys():
+    with pytest.raises(ValueError, match="restart_backof_s"):
+        FleetConfig.from_conf({"restart_backof_s": 1.0})
+
+
+@pytest.mark.parametrize("bad", [
+    {"replicas": 0},
+    {"restart_backoff_s": 0.0},
+    {"restart_backoff_s": 5.0, "restart_backoff_max_s": 1.0},
+    {"health_poll_interval_s": 0.0},
+    {"mesh_devices": -1},
+])
+def test_fleet_config_validates(bad):
+    with pytest.raises(ValueError):
+        FleetConfig(**bad)
+
+
+# -- prometheus aggregation ---------------------------------------------------
+
+def test_aggregate_prometheus_sums_samples():
+    a = ("# HELP serving_requests_total requests\n"
+         "# TYPE serving_requests_total counter\n"
+         "serving_requests_total 3\n"
+         'serving_errors_total{code="429"} 1\n')
+    b = ("# HELP serving_requests_total requests\n"
+         "# TYPE serving_requests_total counter\n"
+         "serving_requests_total 4\n"
+         'serving_errors_total{code="429"} 2\n')
+    merged = aggregate_prometheus([a, b])
+    assert "serving_requests_total 7" in merged
+    assert 'serving_errors_total{code="429"} 3' in merged
+    # HELP/TYPE kept once, before the summed sample
+    assert merged.count("# HELP serving_requests_total") == 1
+    assert merged.count("# TYPE serving_requests_total") == 1
+    assert merged.index("# TYPE serving_requests_total") < merged.index(
+        "serving_requests_total 7")
+
+
+def test_aggregate_prometheus_distinct_labels_stay_separate():
+    a = 'serving_latency_bucket{le="0.1"} 2\nserving_latency_bucket{le="1"} 5\n'
+    b = 'serving_latency_bucket{le="0.1"} 1\n'
+    merged = aggregate_prometheus([a, b])
+    assert 'serving_latency_bucket{le="0.1"} 3' in merged
+    assert 'serving_latency_bucket{le="1"} 5' in merged
+
+
+def test_aggregate_prometheus_float_rendering():
+    merged = aggregate_prometheus(["m 0.25\n", "m 0.5\n"])
+    assert "m 0.75" in merged
+    assert aggregate_prometheus([]) == ""
+
+
+# -- in-process fake replicas -------------------------------------------------
+
+def _make_fake_replica(port):
+    """A minimal in-process 'replica': /readyz, /metrics, POST /invocations."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def _send(self, code, body, ctype="application/json"):
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/readyz":
+                code = 200 if self.server.ready else 503
+                self._send(code, b'{"ready": true}')
+            elif self.path == "/metrics":
+                text = ("# HELP serving_requests_total requests\n"
+                        "# TYPE serving_requests_total counter\n"
+                        f"serving_requests_total {self.server.hits}\n")
+                self._send(200, text.encode(), "text/plain")
+            else:
+                self._send(404, b"{}")
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", "0"))
+            self.rfile.read(n)
+            self.server.hits += 1
+            self._send(
+                200, json.dumps({"port": self.server.server_address[1]})
+                .encode())
+
+    srv = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    srv.daemon_threads = True
+    srv.ready = True
+    srv.hits = 0
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv
+
+
+class _FakeProc:
+    """Popen-compatible handle over an in-process fake replica."""
+
+    def __init__(self, server):
+        self.server = server
+        self._returncode = None
+        self._closed = False
+
+    def _close(self):
+        if not self._closed:
+            self._closed = True
+            self.server.shutdown()
+            self.server.server_close()
+
+    def poll(self):
+        return self._returncode
+
+    def crash(self):
+        """Simulate the process dying: port closes, poll() reports exit."""
+        self._close()
+        self._returncode = -9
+
+    def hang_up(self):
+        """Simulate a wedged process: port closes but poll() stays alive."""
+        self._close()
+
+    def terminate(self):
+        self._close()
+        if self._returncode is None:
+            self._returncode = -15
+
+    def kill(self):
+        self.terminate()
+
+    def wait(self, timeout=None):
+        return self._returncode
+
+
+@pytest.fixture
+def fake_fleet():
+    """(supervisor, front, procs) over 2 in-process fake replicas."""
+    cfg = FleetConfig(
+        enabled=True, replicas=2, health_poll_interval_s=0.05,
+        probe_timeout_s=1.0, restart_backoff_s=0.05,
+        restart_backoff_max_s=0.4, drain_timeout_s=2.0, retry_window_s=3.0)
+    procs = {}
+
+    def spawn(index, port):
+        proc = _FakeProc(_make_fake_replica(port))
+        procs[index] = proc
+        return proc
+
+    sup, front = start_fleet(cfg, spawn_fn=spawn, wait=False)
+    assert sup.wait_ready(min_ready=2, timeout=10.0)
+    try:
+        yield sup, front, procs
+    finally:
+        front.shutdown()
+        sup.stop()
+
+
+def _front_call(front, method="POST", path="/invocations", body=b"{}"):
+    host, port = front.server_address
+    conn = http.client.HTTPConnection(host, port, timeout=10)
+    try:
+        headers = {"Content-Type": "application/json"} if body else {}
+        conn.request(method, path, body=body, headers=headers)
+        resp = conn.getresponse()
+        return resp.status, dict(resp.getheaders()), resp.read()
+    finally:
+        conn.close()
+
+
+def test_front_door_round_robins_ready_replicas(fake_fleet):
+    sup, front, _ = fake_fleet
+    hit_ports = set()
+    for _ in range(6):
+        status, headers, _ = _front_call(front)
+        assert status == 200
+        hit_ports.add(int(headers["X-Fleet-Replica"]))
+    assert hit_ports == set(sup.all_ports())
+
+
+def test_front_door_health_endpoints(fake_fleet):
+    sup, front, _ = fake_fleet
+    status, _, body = _front_call(front, "GET", "/healthz", None)
+    assert status == 200
+    status, _, body = _front_call(front, "GET", "/readyz", None)
+    assert status == 200
+    ready = json.loads(body)
+    assert ready["ready"] and ready["ready_replicas"] == 2
+    status, _, body = _front_call(front, "GET", "/fleet", None)
+    replicas = json.loads(body)["replicas"]
+    assert [r["ready"] for r in replicas] == [True, True]
+
+
+def test_retry_on_dead_replica_is_invisible_to_clients():
+    # health sweeps are 60s apart (first one included), so the supervisor
+    # believes the hung replica is ready for the whole test: every route
+    # through it MUST fail over to the live one, never surface a 5xx
+    cfg = FleetConfig(
+        enabled=True, replicas=2, health_poll_interval_s=60.0,
+        restart_backoff_s=0.05, restart_backoff_max_s=0.4,
+        drain_timeout_s=1.0, retry_window_s=3.0)
+    procs = {}
+
+    def spawn(index, port):
+        proc = _FakeProc(_make_fake_replica(port))
+        procs[index] = proc
+        return proc
+
+    sup, front = start_fleet(cfg, spawn_fn=spawn, wait=False)
+    try:
+        sup.poll_once()  # the loop's first sweep is 60s out: mark ready now
+        assert sup.ready_count() == 2
+        procs[0].hang_up()
+        dead, live = sup.all_ports()
+        for _ in range(4):
+            status, headers, _ = _front_call(front)
+            assert status == 200
+            assert int(headers["X-Fleet-Replica"]) == live
+        metrics = sup.render_metrics()
+        # the first request to start on the dead port fails over: exactly
+        # one connection failure, one retry, and report_failure() pulls
+        # the dead port from every later rotation
+        assert "fleet_connection_failures_total 1" in metrics
+        assert "fleet_retries_total 1" in metrics
+        assert "fleet_unrouted_total 0" in metrics
+    finally:
+        front.shutdown()
+        sup.stop()
+
+
+def test_replica_kill_under_load_zero_client_5xx(fake_fleet):
+    sup, front, procs = fake_fleet
+    statuses = []
+    lock = threading.Lock()
+
+    def client():
+        for _ in range(10):
+            status, _, _ = _front_call(front)
+            with lock:
+                statuses.append(status)
+
+    threads = [threading.Thread(target=client) for _ in range(4)]
+    for t in threads:
+        t.start()
+    procs[1].crash()  # mid-load
+    for t in threads:
+        t.join()
+    assert statuses and all(s == 200 for s in statuses)
+
+
+def test_supervisor_restarts_crashed_replica(fake_fleet):
+    sup, front, procs = fake_fleet
+    procs[0].crash()
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        if sup.ready_count() == 2 and procs[0].poll() is None:
+            break
+        time.sleep(0.05)
+    assert sup.ready_count() == 2, "crashed replica never came back"
+    state = sup.describe()
+    assert state[0]["restarts"] >= 1
+    assert "fleet_restarts_total" in sup.render_metrics()
+    # the restart reused the replica's assigned port
+    status, headers, _ = _front_call(front)
+    assert status == 200
+
+
+def test_restart_backoff_caps_and_resets():
+    # no start(): drive the health sweeps by hand so the ladder is exact
+    cfg = FleetConfig(
+        enabled=True, replicas=1, health_poll_interval_s=0.05,
+        restart_backoff_s=0.05, restart_backoff_max_s=0.4,
+        drain_timeout_s=1.0)
+    procs = []
+
+    def spawn(index, port):
+        proc = _FakeProc(_make_fake_replica(port))
+        procs.append(proc)
+        return proc
+
+    sup = FleetSupervisor(cfg, spawn)
+    try:
+        expected = [0.05, 0.1, 0.2, 0.4, 0.4]  # doubles, then caps
+        observed = []
+        for _ in expected:
+            if procs:
+                procs[-1].crash()
+            with sup._lock:
+                sup._replicas[0].next_restart_at = 0.0
+            sup.poll_once()  # sees the dead replica, schedules + respawns
+            with sup._lock:
+                observed.append(sup._replicas[0].backoff_s)
+        assert observed == pytest.approx(expected)
+        sup.poll_once()  # the last respawn is alive and ready again
+        with sup._lock:
+            assert sup._replicas[0].ready
+            assert sup._replicas[0].backoff_s == 0.0  # ladder reset
+    finally:
+        sup.stop()
+
+
+def test_front_door_aggregates_metrics(fake_fleet):
+    sup, front, _ = fake_fleet
+    for _ in range(5):
+        assert _front_call(front)[0] == 200
+    status, headers, body = _front_call(front, "GET", "/metrics", None)
+    assert status == 200
+    assert headers["Content-Type"].startswith("text/plain")
+    text = body.decode()
+    # replica counters summed across the fleet...
+    assert "serving_requests_total 5" in text
+    # ...plus the supervisor's own gauges in the same exposition
+    assert "fleet_replicas_total 2" in text
+    assert "fleet_replicas_ready 2" in text
+
+
+def test_unrouted_when_whole_fleet_is_down():
+    cfg = FleetConfig(
+        enabled=True, replicas=2, health_poll_interval_s=0.05,
+        restart_backoff_s=30.0, restart_backoff_max_s=30.0,
+        retry_window_s=0.3, drain_timeout_s=1.0)
+    procs = []
+
+    def spawn(index, port):
+        proc = _FakeProc(_make_fake_replica(port))
+        procs.append(proc)
+        return proc
+
+    sup, front = start_fleet(cfg, spawn_fn=spawn, wait=False)
+    try:
+        assert sup.wait_ready(min_ready=2, timeout=10.0)
+        for p in procs:
+            p.hang_up()
+        status, headers, body = _front_call(front)
+        assert status == 503
+        assert headers.get("Retry-After") == "1"
+        payload = json.loads(body)
+        assert payload["error"] == "no ready replica"
+        assert "fleet_unrouted_total 1" in sup.render_metrics()
+    finally:
+        front.shutdown()
+        sup.stop()
+
+
+def test_drain_terminates_replicas(fake_fleet):
+    sup, front, procs = fake_fleet
+    sup.stop()
+    assert all(p.poll() is not None for p in procs.values())
+    assert sup.ready_count() == 0
+
+
+# -- real-subprocess lifecycle (CI unit step; excluded from tier-1) -----------
+
+@pytest.mark.slow
+def test_subprocess_fleet_kill_under_load_e2e(tmp_path):
+    """The ISSUE-7 acceptance path with REAL replicas: boot 2 subprocess
+    replicas sharing one AOT store, kill -9 one under load, assert zero
+    client-visible 5xx, the restart lands, and the restart is observable in
+    the front door's aggregated /metrics."""
+    import numpy as np  # noqa: F401  (jax import below forces CPU devices)
+
+    from distributed_forecasting_tpu.data import (
+        synthetic_store_item_sales,
+        tensorize,
+    )
+    from distributed_forecasting_tpu.engine import fit_forecast
+    from distributed_forecasting_tpu.models.base import get_model
+    from distributed_forecasting_tpu.serving import BatchForecaster
+
+    df = synthetic_store_item_sales(
+        n_stores=2, n_items=2, n_days=120, seed=13)
+    batch = tensorize(df)
+    cfg_m = get_model("theta").config_cls()
+    params, _ = fit_forecast(batch, model="theta", config=cfg_m, horizon=5)
+    fc = BatchForecaster.from_fit(batch, params, "theta", cfg_m)
+    artifact_dir = str(tmp_path / "forecaster")
+    fc.save(artifact_dir)
+
+    payload = json.dumps({
+        "inputs": [
+            {name: int(v) for name, v in zip(fc.key_names, fc.keys[0])}
+        ],
+        "horizon": 5,
+    }).encode()
+
+    cfg = FleetConfig(
+        enabled=True, replicas=2, health_poll_interval_s=0.2,
+        restart_backoff_s=0.2, restart_backoff_max_s=2.0,
+        ready_timeout_s=300.0, drain_timeout_s=10.0, retry_window_s=20.0)
+    sup, front = start_fleet(
+        cfg,
+        artifact_dir=artifact_dir,
+        serving_conf={"warmup_sizes": [1], "warmup_horizon": 5},
+        env_extra={"DFTPU_COMPILE_CACHE": str(tmp_path / "cc")},
+        wait=False,
+    )
+    try:
+        assert sup.wait_ready(min_ready=2, timeout=300.0), \
+            f"replicas never ready: {sup.describe()}"
+
+        statuses = []
+        lock = threading.Lock()
+
+        def client():
+            for _ in range(15):
+                status, _, _ = _front_call(
+                    front, "POST", "/invocations", payload)
+                with lock:
+                    statuses.append(status)
+
+        threads = [threading.Thread(target=client) for _ in range(3)]
+        for t in threads:
+            t.start()
+        time.sleep(0.2)
+        victim = None
+        with sup._lock:
+            victim = sup._replicas[0].proc
+        victim.send_signal(signal.SIGKILL)
+        for t in threads:
+            t.join()
+        assert statuses and all(s == 200 for s in statuses), \
+            f"client saw non-200s: {sorted(set(statuses))}"
+
+        # the supervisor restarts the victim and it becomes ready again
+        deadline = time.monotonic() + 300.0
+        while time.monotonic() < deadline and sup.ready_count() < 2:
+            time.sleep(0.2)
+        assert sup.ready_count() == 2, f"no recovery: {sup.describe()}"
+        assert sup.describe()[0]["restarts"] >= 1
+
+        # restart is visible in the front door's aggregated exposition
+        status, _, body = _front_call(front, "GET", "/metrics", None)
+        assert status == 200
+        text = body.decode()
+        assert "fleet_restarts_total 1" in text
+        assert "serving_requests_total" in text
+        assert "fleet_replicas_ready 2" in text
+    finally:
+        front.shutdown()
+        sup.stop()
+    assert all(r["alive"] is False for r in sup.describe())

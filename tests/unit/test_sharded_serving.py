@@ -1,0 +1,152 @@
+"""Mesh-parallel serving predict: byte-identity against single-device.
+
+ISSUE #7 acceptance: sharding the series axis of the bucket-ladder predict
+over a device mesh (``BatchForecaster.enable_mesh``) must be a placement
+change, not a math change — the output frame is byte-identical to the
+single-device path for EVERY model family and for request sizes that do not
+divide the mesh (remainder-chunk padding).  The conftest forces 8 virtual
+CPU devices (``--xla_force_host_platform_device_count=8``), so meshes of
+size 8 and a non-divisor size 3 are both constructible here.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from distributed_forecasting_tpu.data import (
+    synthetic_store_item_sales,
+    tensorize,
+)
+from distributed_forecasting_tpu.engine import fit_forecast
+from distributed_forecasting_tpu.models.base import MODEL_REGISTRY, get_model
+from distributed_forecasting_tpu.parallel import make_mesh
+from distributed_forecasting_tpu.serving import BatchForecaster
+
+HORIZON = 5
+# S = 6 trained series: not a multiple of 3 or 4, so the remainder path
+# (bucket rounded up past S, padding rows repeating sidx[0]) is exercised
+# by the full-request case as well as the k=5 case
+N_STORES, N_ITEMS, N_DAYS = 2, 3, 120
+
+FAMILIES = sorted(MODEL_REGISTRY)
+
+
+@pytest.fixture(scope="module")
+def forecasters():
+    """One small fitted BatchForecaster per registered family."""
+    df = synthetic_store_item_sales(
+        n_stores=N_STORES, n_items=N_ITEMS, n_days=N_DAYS, seed=11)
+    batch = tensorize(df)
+    out = {}
+    for model in FAMILIES:
+        cfg = get_model(model).config_cls()
+        params, _ = fit_forecast(
+            batch, model=model, config=cfg, horizon=HORIZON)
+        out[model] = BatchForecaster.from_fit(batch, params, model, cfg)
+    return out
+
+
+def _request(fc, k):
+    return pd.DataFrame(fc.keys[:k], columns=fc.key_names)
+
+
+def _assert_frames_byte_identical(base, sharded, model, ctx):
+    assert list(base.columns) == list(sharded.columns)
+    assert len(base) == len(sharded), (model, ctx)
+    for col in ("yhat", "yhat_upper", "yhat_lower"):
+        b = base[col].to_numpy()
+        s = sharded[col].to_numpy()
+        assert np.array_equal(b, s), (
+            f"{model} {ctx}: column {col} diverged; max abs delta "
+            f"{np.max(np.abs(b - s))}"
+        )
+
+
+@pytest.mark.parametrize("model", FAMILIES)
+def test_mesh_predict_byte_identical_every_family(forecasters, model):
+    """mesh=8 (devices > series) and mesh=3 (S % 3 != 0): both exact."""
+    fc = forecasters[model]
+    S = fc.n_series
+    base = {k: fc.predict(_request(fc, k), horizon=HORIZON)
+            for k in (1, S - 1, S)}
+    for n in (3, 8):
+        fc.enable_mesh(make_mesh(n))
+        try:
+            for k, expected in base.items():
+                got = fc.predict(_request(fc, k), horizon=HORIZON)
+                _assert_frames_byte_identical(
+                    expected, got, model, f"mesh={n} k={k}")
+        finally:
+            fc.disable_mesh()
+
+
+def test_mesh_bucket_rounds_to_mesh_multiple(forecasters):
+    fc = forecasters["theta"]
+    assert fc._bucket(1) == 1 and fc._bucket(5) == 6
+    fc.enable_mesh(make_mesh(4))
+    try:
+        # pow2 bucket first, then rounded up to a mesh multiple
+        assert fc._bucket(1) == 4
+        assert fc._bucket(3) == 4
+        assert fc._bucket(5) == 8  # capped at S=6, then rounded to 8
+    finally:
+        fc.disable_mesh()
+    assert fc._bucket(5) == 6  # disable restores single-device buckets
+
+
+def test_mesh_predict_quantiles_byte_identical(forecasters):
+    fc = forecasters["theta"]
+    req = _request(fc, fc.n_series - 1)
+    base = fc.predict_quantiles(req, quantiles=(0.1, 0.5, 0.9),
+                                horizon=HORIZON)
+    fc.enable_mesh(make_mesh(3))
+    try:
+        got = fc.predict_quantiles(req, quantiles=(0.1, 0.5, 0.9),
+                                   horizon=HORIZON)
+    finally:
+        fc.disable_mesh()
+    for col in base.columns:
+        if col.startswith("q"):
+            assert np.array_equal(base[col].to_numpy(),
+                                  got[col].to_numpy()), col
+
+
+def test_aot_entry_names_fingerprint_topology(forecasters):
+    """Mesh shape rides the AOT entry name, so a shared store holds
+    single-device and per-mesh executables side by side (warm starts
+    survive mesh-shape changes instead of colliding on one key)."""
+    fc = forecasters["theta"]
+    assert fc._aot_entry("serving_predict") == "serving_predict:theta"
+    fc.enable_mesh(make_mesh(4))
+    try:
+        assert fc._aot_entry("serving_predict") == "serving_predict:theta@mesh4"
+    finally:
+        fc.disable_mesh()
+    assert fc._aot_entry("serving_predict") == "serving_predict:theta"
+
+
+def test_mesh_predict_through_aot_store(forecasters, tmp_path):
+    """With the AOT store live, the sharded predict round-trips the store
+    (or falls through safely) and stays byte-identical; switching mesh
+    shapes against the same warm store keeps working."""
+    from distributed_forecasting_tpu.engine.compile_cache import (
+        CompileCacheConfig,
+        configure_compile_cache,
+    )
+
+    fc = forecasters["theta"]
+    req = _request(fc, fc.n_series)
+    base = fc.predict(req, horizon=HORIZON)
+    cfg = CompileCacheConfig(enabled=True, directory=str(tmp_path / "cc"))
+    configure_compile_cache(cfg)
+    try:
+        for n in (2, 4):
+            fc.enable_mesh(make_mesh(n))
+            try:
+                got = fc.predict(req, horizon=HORIZON)
+                _assert_frames_byte_identical(
+                    base, got, "theta", f"aot mesh={n}")
+            finally:
+                fc.disable_mesh()
+    finally:
+        configure_compile_cache(CompileCacheConfig(enabled=False))
