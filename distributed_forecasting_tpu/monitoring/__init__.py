@@ -3,6 +3,7 @@ from distributed_forecasting_tpu.monitoring.monitor import (
     Gauge,
     Histogram,
     LabeledCounter,
+    LabeledGauge,
     MetricsRegistry,
     MonitorConfig,
     MonitorRegistry,
@@ -12,6 +13,24 @@ from distributed_forecasting_tpu.monitoring.monitor import (
     escape_label_value,
     render_labels,
     run_monitor,
+)
+from distributed_forecasting_tpu.monitoring.quality import (
+    QualityConfig,
+    QualityMonitor,
+    QualityRuntime,
+    build_quality_runtime,
+)
+from distributed_forecasting_tpu.monitoring.slo import (
+    SLOConfig,
+    SLOEvaluator,
+    SLORule,
+    latest_run_timestamp,
+)
+from distributed_forecasting_tpu.monitoring.store import (
+    QualityStoreConfig,
+    ScrapeLoop,
+    TimeSeriesStore,
+    flatten_registry_snapshot,
 )
 from distributed_forecasting_tpu.monitoring.trace import (
     FlightRecorder,
@@ -30,8 +49,13 @@ from distributed_forecasting_tpu.monitoring.trace import (
 
 __all__ = ["MonitorConfig", "MonitorRegistry", "detect_anomalies",
            "drift_report", "degradation_report", "run_monitor",
-           "Counter", "Gauge", "Histogram", "LabeledCounter",
+           "Counter", "Gauge", "Histogram", "LabeledCounter", "LabeledGauge",
            "MetricsRegistry", "escape_label_value", "render_labels",
+           "QualityConfig", "QualityMonitor", "QualityRuntime",
+           "build_quality_runtime",
+           "SLOConfig", "SLOEvaluator", "SLORule", "latest_run_timestamp",
+           "QualityStoreConfig", "ScrapeLoop", "TimeSeriesStore",
+           "flatten_registry_snapshot",
            "FlightRecorder", "ProfilerSession", "SpanRecord", "TraceConfig",
            "TraceContext", "Tracer", "configure_tracing",
            "device_annotation", "dump_flight_recorder", "get_tracer",

@@ -724,6 +724,88 @@ class Histogram:
             "buckets": dict(self._cumulative(counts)),
         }
 
+    def snapshot_quantiles(
+        self, qs: Tuple[float, ...] = (0.5, 0.95, 0.99)
+    ) -> Dict[float, float]:
+        """Quantile estimates from ONE locked (counts, sum) snapshot — the
+        shared derivation the SLO evaluator and report scripts use instead
+        of re-deriving quantiles from bucket text ad hoc.
+
+        Prometheus ``histogram_quantile`` convention: each quantile reports
+        the upper bound of the bucket its rank falls in (no intra-bucket
+        interpolation — fixed buckets cannot support it honestly), clamped
+        to the highest FINITE bound when the rank lands in +Inf.  An empty
+        histogram reports NaN for every level, which no threshold compares
+        true against — an SLO on an idle endpoint stays quiet.
+        """
+        counts, _ = self._state()
+        total = sum(counts)
+        out: Dict[float, float] = {}
+        for q in qs:
+            if not 0.0 <= q <= 1.0:
+                raise ValueError(f"quantile {q} outside [0, 1]")
+            if total == 0:
+                out[q] = float("nan")
+                continue
+            rank = q * total
+            running = 0
+            value = self._uppers[-1]  # +Inf rank clamps to top finite bound
+            for ub, c in zip(self._uppers, counts):
+                running += c
+                if running >= rank and c:
+                    value = ub
+                    break
+            out[q] = float(value)
+        return out
+
+
+class LabeledGauge:
+    """Gauge family keyed by label values (thread-safe) — the settable
+    counterpart of :class:`LabeledCounter`, for per-rule/per-family live
+    values (SLO burn rates, rolling quality per model family).  Same
+    escaping and cardinality caveats as the labeled counter."""
+
+    def __init__(self, label_names: Tuple[str, ...]) -> None:
+        if not label_names:
+            raise ValueError("labeled gauge needs at least one label")
+        self._label_names = tuple(label_names)
+        self._values: Dict[Tuple[str, ...], float] = {}
+        self._lock = threading.Lock()
+
+    def _key(self, labels: Dict) -> Tuple[str, ...]:
+        if set(labels) != set(self._label_names):
+            raise ValueError(
+                f"expected labels {self._label_names}, got {sorted(labels)}")
+        return tuple(str(labels[k]) for k in self._label_names)
+
+    def set(self, value: float, **labels) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = float(value)
+
+    def value(self, **labels) -> float:
+        key = self._key(labels)
+        with self._lock:
+            return self._values.get(key, 0.0)
+
+    def render(self, name: str) -> List[str]:
+        with self._lock:
+            items = sorted(self._values.items())
+        return [
+            name
+            + render_labels(dict(zip(self._label_names, key)))
+            + f" {_fmt_value(v)}"
+            for key, v in items
+        ]
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            items = sorted(self._values.items())
+        return {
+            ",".join(f"{k}={v}" for k, v in zip(self._label_names, key)): val
+            for key, val in items
+        }
+
 
 class MetricsRegistry:
     """Named metrics + Prometheus text exposition (format 0.0.4).
@@ -760,6 +842,19 @@ class MetricsRegistry:
     ) -> LabeledCounter:
         return self._register(
             name, "counter", help_text, LabeledCounter(label_names))
+
+    def labeled_gauge(
+        self, name: str, label_names: Tuple[str, ...], help_text: str = ""
+    ) -> LabeledGauge:
+        return self._register(
+            name, "gauge", help_text, LabeledGauge(label_names))
+
+    def items(self) -> List[Tuple[str, str, object]]:
+        """(name, kind, metric) triples from one locked registry snapshot —
+        the public walk the scrape loop uses (the metric objects are
+        themselves thread-safe, only the registry dict needs the lock)."""
+        with self._lock:
+            return [(n, k, m) for n, (k, _, m) in self._metrics.items()]
 
     def render_prometheus(self) -> str:
         with self._lock:

@@ -123,6 +123,79 @@ def coverage(y, lo, hi, mask):
     return _mean(inside, mask)
 
 
+def wape(y, yhat, mask):
+    """Weighted absolute percentage error: sum|err| / sum|y| under the mask.
+
+    The retail-forecasting headline metric (volume-weighted, so it neither
+    explodes on near-zero days like MAPE nor hides big-series misses like a
+    flat mean): errors on high-volume series dominate exactly in proportion
+    to their volume.  An all-zero (or fully masked) actuals window makes the
+    ratio meaningless — NaN, same convention as :func:`mase`.
+    """
+    num = jnp.sum(jnp.abs(y - yhat) * mask, axis=-1)
+    denom = jnp.sum(jnp.abs(y) * mask, axis=-1)
+    return jnp.where(denom > _EPS, num / jnp.maximum(denom, _EPS), jnp.nan)
+
+
+def rmsse(y, yhat, eval_mask, train_mask, m: int = 1):
+    """Root mean squared SCALED error — the M5-accuracy metric: eval-window
+    MSE divided by the m-step naive MSE on the TRAINING window, square
+    root.  Scale-free like :func:`mase` but quadratic, so it weights the
+    large misses the squared-loss fitters optimize for.  A zero naive
+    scale (constant training window) yields NaN, not an eps-ratio blow-up.
+    """
+    dy = y[..., m:] - y[..., :-m]
+    both = train_mask[..., m:] * train_mask[..., :-m]
+    scale = jnp.sum(dy * dy * both, axis=-1) / jnp.maximum(
+        jnp.sum(both, axis=-1), 1.0
+    )
+    mse_eval = _mean((y - yhat) ** 2, eval_mask)
+    return jnp.where(scale > _EPS,
+                     jnp.sqrt(mse_eval / jnp.maximum(scale, _EPS)),
+                     jnp.nan)
+
+
+def quality_terms(y, yhat, lo, hi, step, mask):
+    """Elementwise rolling-quality terms for ``monitoring/quality.py`` —
+    ONE batched dispatch over every observed series at once.
+
+    Returns per-point (masked, NaN-aware) term arrays; the caller reduces
+    them with a vectorized float64 host sum.  The reduction deliberately
+    stays OFF device: rolling accumulators grow without bound, so float32
+    on-device sums would drift, and XLA's reduction order differs from
+    NumPy's — float64 host accumulation keeps the monitor bitwise equal to
+    a NumPy reference (the acceptance bar) AND numerically stable.  All
+    inputs are ``(..., T)``; ``step`` is the integer period ordinal of each
+    observation (consecutive ordinals feed the RMSSE naive scale).
+
+    Terms: ``abs_err``/``abs_y`` (WAPE numerator/denominator), ``sq_err``
+    (RMSSE numerator), ``inside`` (calibration coverage against the served
+    [lo, hi] band — the conformal-scaled interval when the artifact carries
+    ``interval_scale``), ``n`` (observation count), ``naive_sq``/``naive_n``
+    (RMSSE denominator: squared 1-step naive diffs over consecutive
+    observed periods).
+    """
+    m = mask & jnp.isfinite(y) & jnp.isfinite(yhat)
+    mf = m.astype(jnp.float32)
+    y0 = jnp.where(m, y, 0.0)
+    err = (y0 - jnp.where(m, yhat, 0.0)) * mf
+    inside = ((y0 >= lo) & (y0 <= hi)).astype(jnp.float32) * mf
+    adj = (
+        m[..., 1:] & m[..., :-1]
+        & ((step[..., 1:] - step[..., :-1]) == 1)
+    )
+    d = jnp.where(adj, y0[..., 1:] - y0[..., :-1], 0.0)
+    return {
+        "abs_err": jnp.abs(err),
+        "abs_y": jnp.abs(y0) * mf,
+        "sq_err": err * err,
+        "inside": inside,
+        "n": mf,
+        "naive_sq": d * d,
+        "naive_n": adj.astype(jnp.float32),
+    }
+
+
 def pinball(y, yhat_q, mask, q: float):
     """Pinball (quantile) loss at level ``q`` — the M5-uncertainty metric.
 
@@ -142,6 +215,10 @@ METRIC_FNS = {
     "smape": smape,
     "mdape": mdape,
 }
+# wape/rmsse/mase stay OUT of METRIC_FNS: they carry the NaN-on-degenerate
+# convention (zero denominator is meaningless, not perfect), while the
+# METRIC_FNS contract is finite-on-fully-masked (padded rows yield 0 and
+# callers filter on the companion valid count).
 
 
 def compute_all(y, yhat, mask, lo=None, hi=None) -> dict:

@@ -137,30 +137,81 @@ def _fmt_value(v: float) -> str:
     return repr(float(v))
 
 
-def aggregate_prometheus(texts: List[str]) -> str:
-    """Merge replica ``/metrics`` expositions by summing samples.
+def _split_label_pairs(body: str) -> List[str]:
+    """Split the inside of a ``{...}`` label block on commas OUTSIDE quoted
+    values (label values may contain escaped commas/quotes)."""
+    parts: List[str] = []
+    buf: List[str] = []
+    in_quotes = False
+    escaped = False
+    for ch in body:
+        if escaped:
+            buf.append(ch)
+            escaped = False
+            continue
+        if ch == "\\":
+            buf.append(ch)
+            escaped = True
+            continue
+        if ch == '"':
+            in_quotes = not in_quotes
+            buf.append(ch)
+            continue
+        if ch == "," and not in_quotes:
+            parts.append("".join(buf))
+            buf = []
+            continue
+        buf.append(ch)
+    if buf:
+        parts.append("".join(buf))
+    return parts
 
-    Samples with the same name+labels sum across replicas; ``# HELP`` /
-    ``# TYPE`` lines keep the first replica's wording.  Sum is the right
-    fold for everything the serving stack exposes: counters, histogram
-    bucket/sum/count series, and additive gauges (queue depth in flight
-    across the fleet).  Replicas run identical code, so their expositions
-    share line structure and the merged output keeps family grouping.
+
+def _le_value(raw: str) -> float:
+    return float("inf") if raw == "+Inf" else float(raw)
+
+
+def aggregate_prometheus(texts: List[str]) -> str:
+    """Merge replica ``/metrics`` expositions, TYPE-aware.
+
+    ``# HELP`` / ``# TYPE`` lines keep the first replica's wording, and the
+    TYPE map drives the fold per family:
+
+      * **histograms** merge BUCKET-WISE: ``_bucket`` samples group by
+        family + non-``le`` labels, bounds union across replicas, and each
+        replica contributes its cumulative count carried forward from its
+        largest own bound at or below each merged bound — so replicas whose
+        bucket ladders differ (a rolling config change mid-fleet) still
+        produce one monotone cumulative ladder instead of an interleaved
+        corrupt one.  ``_sum``/``_count`` sum as before.
+      * **``dftpu_slo_*`` gauges** merge by MAX: an SLO burning or firing
+        on ANY replica is burning fleet-wide — summing would overstate burn
+        rates by the replica count, and averaging would hide a single
+        burning replica behind healthy peers.
+      * everything else — counters, additive gauges (queue depth in flight
+        across the fleet) — sums by name+labels.
     """
-    lines: List[str] = []          # meta lines and sample keys, in order
-    values: dict = {}              # sample key -> summed value
+    entries: List[tuple] = []      # ("meta", raw) | ("sample", key) |
+    #                                ("hist", group_key), in first-seen order
+    values: dict = {}              # sample key -> folded value
     seen_meta: set = set()
-    for text in texts:
+    types: dict = {}               # family name -> prometheus kind
+    # (family, other-labels str) -> per-replica {le_float: cumulative}
+    hist_groups: dict = {}
+    hist_le_str: dict = {}         # le_float -> original le token
+    for replica_i, text in enumerate(texts):
         for raw in text.splitlines():
             if not raw.strip():
                 continue
             if raw.startswith("#"):
                 parts = raw.split(None, 3)
                 if len(parts) >= 3 and parts[1] in ("HELP", "TYPE"):
+                    if parts[1] == "TYPE" and len(parts) >= 4:
+                        types[parts[2]] = parts[3].strip()
                     meta_key = (parts[1], parts[2])
                     if meta_key not in seen_meta:
                         seen_meta.add(meta_key)
-                        lines.append(raw)
+                        entries.append(("meta", raw))
                 continue
             key, _, val = raw.rpartition(" ")
             if not key:
@@ -169,18 +220,59 @@ def aggregate_prometheus(texts: List[str]) -> str:
                 v = float(val)
             except ValueError:
                 continue
+            name = key.partition("{")[0]
+            if name.endswith("_bucket") and \
+                    types.get(name[: -len("_bucket")]) == "histogram":
+                brace = key.find("{")
+                body = key[brace + 1: key.rfind("}")] if brace >= 0 else ""
+                pairs = _split_label_pairs(body)
+                le_raw = None
+                others = []
+                for p in pairs:
+                    k, _, lv = p.partition("=")
+                    if k.strip() == "le":
+                        le_raw = lv.strip().strip('"')
+                    else:
+                        others.append(p)
+                if le_raw is None:
+                    continue  # malformed bucket line; drop rather than guess
+                le = _le_value(le_raw)
+                hist_le_str[le] = le_raw
+                gkey = (name, ",".join(others))
+                group = hist_groups.setdefault(gkey, {})
+                if not group:
+                    entries.append(("hist", gkey))
+                group.setdefault(replica_i, {})[le] = v
+                continue
             if key in values:
-                values[key] += v
+                if name.startswith("dftpu_slo_") and \
+                        types.get(name) == "gauge":
+                    values[key] = max(values[key], v)
+                else:
+                    values[key] += v
             else:
                 values[key] = v
-                lines.append(("sample", key))
+                entries.append(("sample", key))
     out = []
-    for entry in lines:
-        if isinstance(entry, tuple):
-            key = entry[1]
-            out.append(f"{key} {_fmt_value(values[key])}")
+    for kind, payload in entries:
+        if kind == "meta":
+            out.append(payload)
+        elif kind == "sample":
+            out.append(f"{payload} {_fmt_value(values[payload])}")
         else:
-            out.append(entry)
+            name, others = payload
+            per_replica = hist_groups[payload]
+            bounds = sorted({le for m in per_replica.values() for le in m})
+            for le in bounds:
+                total = 0.0
+                for m in per_replica.values():
+                    own = [b for b in m if b <= le]
+                    if own:  # carry the replica's last cumulative forward
+                        total += m[max(own)]
+                label_body = ",".join(
+                    ([others] if others else []) +
+                    [f'le="{hist_le_str[le]}"'])
+                out.append(f"{name}{{{label_body}}} {_fmt_value(total)}")
     return "\n".join(out) + ("\n" if out else "")
 
 
@@ -242,6 +334,11 @@ def default_spawn_fn(
             "tracing": serving_conf.get("tracing"),
             "model_version": serving_conf.get("model_version"),
             "mesh_devices": config.mesh_devices,
+            # quality/store/slo conf (tasks/fleet.py passes the top-level
+            # monitoring block through); the replica suffixes its store
+            # directory with the port so two processes never share a
+            # segment cursor
+            "monitoring": serving_conf.get("monitoring"),
         }
         env = dict(os.environ)
         existing = env.get("PYTHONPATH", "")

@@ -7,9 +7,11 @@ Launched by ``serving/fleet.py``'s default spawner as
 The conf object carries: ``artifact_dir`` (the saved forecaster to load),
 ``host``/``port`` (the supervisor-assigned, restart-stable address),
 ``warmup_sizes``/``warmup_horizon``, optional ``batching``/``tracing``
-blocks (same shapes as the ``serving:`` conf), ``model_version``, and
+blocks (same shapes as the ``serving:`` conf), ``model_version``,
 ``mesh_devices`` (>1 shards every predict's series axis over a device mesh
-— ``BatchForecaster.enable_mesh``).
+— ``BatchForecaster.enable_mesh``), and an optional ``monitoring`` block
+(quality/store/SLO — ``monitoring/quality.py``; the replica suffixes the
+store directory with its port so replicas never share an append cursor).
 
 Boot order is the contract the supervisor routes on: bind the port with
 ``/readyz`` at 503 first, warm the bucket ladder, THEN flip ready — a
@@ -51,6 +53,9 @@ def main(argv=None) -> None:
     from distributed_forecasting_tpu.engine.compile_cache import (
         cache_stats,
         enable_from_env,
+    )
+    from distributed_forecasting_tpu.monitoring.quality import (
+        build_quality_runtime,
     )
     from distributed_forecasting_tpu.monitoring.trace import (
         TraceConfig,
@@ -96,6 +101,25 @@ def main(argv=None) -> None:
                         mesh_devices)
 
     batching = BatchingConfig.from_conf(conf.get("batching"))
+    mon_conf = conf.get("monitoring")
+    quality = None
+    if mon_conf:
+        # every replica gets its OWN store subdirectory (segment cursors
+        # are per-process state; two appenders in one directory would race
+        # on rotation) — the fleet quality report reads across them
+        mon_conf = dict(mon_conf)
+        qs = dict(mon_conf.get("quality_store") or {})
+        if qs.get("directory"):
+            qs["directory"] = os.path.join(
+                qs["directory"], f"replica-{int(conf['port'])}")
+            mon_conf["quality_store"] = qs
+        quality = build_quality_runtime(
+            mon_conf,
+            forecaster,
+            default_store_dir=os.path.join(
+                conf["artifact_dir"], "quality_store",
+                f"replica-{int(conf['port'])}"),
+        )
     srv = start_server(
         forecaster,
         host=conf.get("host", "127.0.0.1"),
@@ -103,6 +127,7 @@ def main(argv=None) -> None:
         model_version=conf.get("model_version"),
         batching=batching,
         ready=False,  # warm first; the supervisor routes on /readyz
+        quality=quality,
     )
     sizes = conf.get("warmup_sizes")
     if sizes:

@@ -23,7 +23,9 @@ image, and none needed for a single-model scorer):
                              03_deploy.py:44-58)
   GET  /metrics           -> Prometheus text exposition: request/dispatch/
                              rejection/timeout counters, queue-depth gauge,
-                             latency + coalesced-batch-size histograms
+                             latency + coalesced-batch-size histograms; with
+                             a quality runtime attached, also the
+                             ``dftpu_quality_*`` / ``dftpu_slo_*`` families
   POST /invocations       -> {"inputs": [{"store": 1, "item": 2}, ...],
                               "horizon": 90, "include_history": false}
                           -> {"predictions": [...]} (records of the output
@@ -31,6 +33,13 @@ image, and none needed for a single-model scorer):
                              "on_missing": "skip"; with micro-batching
                              enabled, a full queue -> 429 and a request
                              outliving request_timeout_s -> 503)
+  POST /observe           -> {"observations": [{<keys>, "ds": ..., "y": ...},
+                              ...]} — ground-truth actuals scored against
+                             what this model serves for those dates
+                             (``monitoring/quality.py``); 503 when no
+                             quality runtime is configured
+  GET  /debug/quality     -> rolling quality + SLO + store snapshot (behind
+                             tracing.debug_endpoints, like /debug/trace)
 
 ``serve`` blocks; ``start_server`` returns the live server for tests/
 embedding.  Model resolution goes through the registry exactly like the
@@ -187,7 +196,10 @@ class _Handler(BaseHTTPRequestHandler):
                 },
             )
         elif self.path == "/metrics":
-            body = self.server.metrics.render().encode()
+            text = self.server.metrics.render()
+            if self.server.quality is not None:
+                text += self.server.quality.render_metrics()
+            body = text.encode()
             self.send_response(200)
             self.send_header(
                 "Content-Type", "text/plain; version=0.0.4; charset=utf-8")
@@ -230,10 +242,20 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(409, {"error": str(e)})
                 return
             self._send(200, {"capture_dir": out, "seconds": seconds})
+        elif parsed.path == "/debug/quality":
+            quality = self.server.quality
+            if quality is None:
+                self._send(503, {"error": "quality monitoring not enabled "
+                                          "(monitoring.quality conf block)"})
+                return
+            self._send(200, quality.snapshot())
         else:
             self._send(404, {"error": f"no route {parsed.path}"})
 
     def do_POST(self):
+        if self.path == "/observe":
+            self._observe()
+            return
         if self.path not in ("/invocations", "/predict"):
             self._send(404, {"error": f"no route {self.path}"})
             return
@@ -371,6 +393,52 @@ class _Handler(BaseHTTPRequestHandler):
             self.server.logger.exception("invocation failed")
             self._send(500, {"error": f"{type(e).__name__}: {e}"})
 
+    def _observe(self):
+        """POST /observe: ground-truth actuals into the quality monitor.
+
+        Body: ``{"observations": [{<key cols>, "ds": "...", "y": ...}, ...],
+        "on_missing": "skip"|"raise"}``.  Scoring runs the forecaster's own
+        batched predict plus one term-kernel dispatch (the quality module's
+        batching contract), so a large actuals batch is still two device
+        calls, not a per-series loop.
+        """
+        quality = self.server.quality
+        if quality is None or quality.monitor is None:
+            self._send(503, {"error": "quality monitoring not enabled "
+                                      "(monitoring.quality conf block)"})
+            return
+        tracer = get_tracer()
+        self._trace_id = _safe_trace_id(self.headers.get("X-Trace-Id"))
+        try:
+            with tracer.root_span(
+                "http.request", trace_id=self._trace_id,
+                method="POST", path="/observe",
+            ) as root:
+                self._trace_id = root.trace_id or self._trace_id
+                length = int(self.headers.get("Content-Length", "0"))
+                req = json.loads(self.rfile.read(length) or b"{}")
+                if not isinstance(req, dict):
+                    self._send(400, {"error": "body must be a JSON object "
+                                              "with 'observations'"})
+                    return
+                observations = req.get("observations")
+                if not observations:
+                    self._send(400, {"error": "body needs a non-empty "
+                                              "'observations' list"})
+                    return
+                summary = quality.observe(
+                    pd.DataFrame(observations),
+                    on_missing=req.get("on_missing", "skip"))
+                self._send(200, summary)
+                root.set_attribute("status", self._status)
+        except UnknownSeriesError as e:
+            self._send(404, {"error": str(e)})
+        except (ValueError, TypeError, KeyError, json.JSONDecodeError) as e:
+            self._send(400, {"error": f"{type(e).__name__}: {e}"})
+        except Exception as e:  # noqa: BLE001 — scorer must not die mid-request
+            self.server.logger.exception("observe failed")
+            self._send(500, {"error": f"{type(e).__name__}: {e}"})
+
 
 class ForecastServer(ThreadingHTTPServer):
     daemon_threads = True
@@ -386,6 +454,7 @@ class ForecastServer(ThreadingHTTPServer):
         forecaster,
         model_version: Optional[str] = None,
         batching: Optional[BatchingConfig] = None,
+        quality=None,
     ):
         super().__init__(addr, _Handler)
         self.forecaster = forecaster
@@ -393,6 +462,14 @@ class ForecastServer(ThreadingHTTPServer):
         self.logger = get_logger("ForecastServer")
         self.metrics = ServingMetrics()
         self.batching = batching
+        # the wired quality stack (monitoring/quality.QualityRuntime) —
+        # owns the scrape + SLO loops, started here so every construction
+        # path (serve, start_server, tests) gets the same lifecycle; the
+        # latency SLO and the scrape loop bind to THIS server's metrics
+        self.quality = quality
+        if quality is not None:
+            quality.attach_server_metrics(self.metrics)
+            quality.start()
         # readiness is an Event, not a guarded flag: it is set exactly once
         # after warmup and cleared at shutdown, and /readyz polls it
         self._ready = threading.Event()
@@ -470,6 +547,10 @@ class ForecastServer(ThreadingHTTPServer):
         self._ready.clear()
         if self.batcher is not None:
             self.batcher.close()
+        if self.quality is not None:
+            # stop the SLO/scrape threads and flush one final scrape so the
+            # on-disk history covers the full process lifetime
+            self.quality.stop()
         super().shutdown()
 
 
@@ -480,12 +561,14 @@ def start_server(
     model_version: Optional[str] = None,
     batching: Optional[BatchingConfig] = None,
     ready: bool = True,
+    quality=None,
 ) -> ForecastServer:
     """Start serving on a background thread; returns the server (its
     ``server_address[1]`` is the bound port — port=0 picks a free one).
     ``ready=False`` starts with /readyz at 503 until ``mark_ready()`` —
     for launchers that warm the compile ladder against the live server."""
-    srv = ForecastServer((host, port), forecaster, model_version, batching)
+    srv = ForecastServer((host, port), forecaster, model_version, batching,
+                         quality=quality)
     if ready:
         srv.mark_ready()
     t = threading.Thread(target=srv.serve_forever, daemon=True)
@@ -499,8 +582,10 @@ def serve(
     port: int = 8080,
     model_version: Optional[str] = None,
     batching: Optional[BatchingConfig] = None,
+    quality=None,
 ) -> None:
-    srv = ForecastServer((host, port), forecaster, model_version, batching)
+    srv = ForecastServer((host, port), forecaster, model_version, batching,
+                         quality=quality)
     srv.mark_ready()
     srv.logger.info("serving on %s:%d", host, port)
     srv.serve_forever()

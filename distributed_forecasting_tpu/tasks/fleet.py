@@ -26,6 +26,12 @@ Conf: the same ``serving:`` block ``dftpu-serve`` reads, plus::
         mesh_devices: 0          # >1: each replica shards predict over a
                                  # device mesh of this size
 
+A top-level ``monitoring:`` block (see ``tasks/serve.py``) flows through to
+every replica: each builds its own quality monitor + store (port-suffixed
+subdirectory) + SLO evaluator, the front door proxies ``POST /observe``
+round-robin, and the fleet ``/metrics`` max-merges ``dftpu_slo_*`` so an
+SLO firing on any replica is visible at the front door.
+
 ``serving.host``/``serving.port`` bind the FRONT DOOR (the one address
 clients see); replicas bind supervisor-assigned ports on ``replica_host``.
 SIGTERM drains the whole fleet gracefully: front door stops accepting,
@@ -63,6 +69,21 @@ class FleetTask(Task):
         sub = os.path.join(version.artifact_dir, "forecaster")
         artifact_dir = sub if os.path.isdir(sub) else version.artifact_dir
         serving_conf = {**conf, "model_version": str(version.version)}
+        mon_conf = self.conf.get("monitoring")
+        if mon_conf:
+            # replicas build their own quality runtime from this block
+            # (default_spawn_fn passes it through); inject the env's
+            # tracking root + a store default so the staleness SLO and the
+            # store work without per-replica conf
+            env = self.conf.get("env", {})
+            mon_conf = dict(mon_conf)
+            mon_conf.setdefault("tracking_root", self._paths["tracking"])
+            qs = dict(mon_conf.get("quality_store") or {})
+            if qs.get("enabled") and not qs.get("directory"):
+                qs["directory"] = os.path.join(
+                    env.get("root", "./dftpu_store"), "quality_store")
+                mon_conf["quality_store"] = qs
+            serving_conf["monitoring"] = mon_conf
 
         env_extra = {}
         from distributed_forecasting_tpu.engine.compile_cache import (

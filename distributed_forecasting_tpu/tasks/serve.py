@@ -39,10 +39,34 @@ Conf::
       eviction_policy: lru    # Task base class — see tasks/common.py)
       aot_store: true
       min_compile_time_s: 0.0
+    monitoring:               # optional forecast-quality observability
+      quality:                # (monitoring/quality.py — POST /observe
+        enabled: true         # scores actuals against served forecasts)
+        max_horizon: 365
+        nominal_coverage: 0.0 # 0 -> the artifact's interval_width
+      quality_store:          # on-disk metric history (monitoring/store.py)
+        enabled: true
+        directory: null       # default <env.root>/quality_store
+        retention_s: 604800
+        scrape_interval_s: 30
+      slo:                    # burn-rate alerting (monitoring/slo.py)
+        enabled: true
+        error_budget: 0.05
+        windows: [[300, 2.0], [3600, 1.0]]
+        rules:
+          - {name: predict_latency_p95, kind: latency_quantile,
+             quantile: 0.95, objective: 0.5}
+          - {name: calibration_coverage, kind: coverage, tolerance: 0.05}
+          - {name: model_staleness, kind: staleness, objective: 604800}
 """
 
 from __future__ import annotations
 
+import os
+
+from distributed_forecasting_tpu.monitoring.quality import (
+    build_quality_runtime,
+)
 from distributed_forecasting_tpu.monitoring.trace import (
     TraceConfig,
     configure_tracing,
@@ -64,6 +88,19 @@ class ServeTask(Task):
         tracing = TraceConfig.from_conf(conf.get("tracing"))
         configure_tracing(tracing)
         forecaster, version = resolve_from_registry(self.registry, name, stage=stage)
+        env = self.conf.get("env", {})
+        quality = build_quality_runtime(
+            self.conf.get("monitoring"),
+            forecaster,
+            tracking_root=self._paths["tracking"],
+            default_store_dir=os.path.join(
+                env.get("root", "./dftpu_store"), "quality_store"),
+        )
+        if quality is not None:
+            self.logger.info(
+                "quality observability on (monitor=%s store=%s slo=%s)",
+                quality.monitor is not None, quality.store is not None,
+                quality.slo is not None)
         sizes = conf.get("warmup_sizes")
         if sizes:
             import time
@@ -97,6 +134,7 @@ class ServeTask(Task):
             port=int(conf.get("port", 8080)),
             model_version=str(version.version),
             batching=batching,
+            quality=quality,
         )
 
 

@@ -1,0 +1,221 @@
+"""Render forecast-quality degradation tables from the on-disk store.
+
+The quality layer (``monitoring/quality.py``) streams rolling WAPE / RMSSE /
+calibration coverage — per family and for the worst series — into the
+append-only time-series store (``monitoring/store.py``), and the SLO
+evaluator (``monitoring/slo.py``) streams its good/bad ticks alongside.
+This script reads that history back and prints one JSON report:
+
+  * ``families`` — per model family: the latest rolling metrics, the mean
+    over the trailing ``--window``, the mean over everything before it, and
+    the delta — the "did this week get worse than the past" table.
+  * ``worst_series`` — the most-degraded series by latest WAPE, with their
+    RMSSE and coverage (the store carries the top offenders each /observe
+    publishes, so this reads history, not a live server).
+  * ``slo`` — per rule: bad-tick fraction over the window, latest firing
+    state, and the summed ``dftpu_slo_evaluation_errors_total`` — the CI
+    smoke gates on that last number staying zero.
+
+A fleet writes one store subdirectory per replica (``replica-<port>``);
+pass the parent directory and the report merges them.
+
+Run::
+
+    python scripts/quality_report.py ./dftpu_store/quality_store
+    python scripts/quality_report.py ./dftpu_store/quality_store \
+        --window-s 86400 --top 10 --strict   # CI: non-empty + 0 SLO errors
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from distributed_forecasting_tpu.monitoring.store import (  # noqa: E402
+    TimeSeriesStore,
+)
+
+_FAMILY_METRICS = ("wape", "rmsse", "coverage")
+
+
+def find_store_dirs(root: str) -> List[str]:
+    """The store directories under ``root``: itself and/or per-replica
+    subdirectories (any directory holding ``seg-*.jsonl`` files)."""
+    def has_segments(d: str) -> bool:
+        try:
+            names = os.listdir(d)
+        except OSError:
+            return False
+        return any(n.startswith("seg-") and n.endswith(".jsonl")
+                   for n in names)
+
+    if not os.path.isdir(root):
+        return []
+    out = [root] if has_segments(root) else []
+    for name in sorted(os.listdir(root)):
+        d = os.path.join(root, name)
+        if os.path.isdir(d) and has_segments(d):
+            out.append(d)
+    return out
+
+
+def _load(dirs: List[str], name: str) -> List[Dict]:
+    pts: List[Dict] = []
+    for d in dirs:
+        pts.extend(TimeSeriesStore(d).query(name=name))
+    pts.sort(key=lambda p: p["ts"])
+    return pts
+
+
+def _mean(vals: List[float]) -> float:
+    return sum(vals) / len(vals) if vals else float("nan")
+
+
+def _round(v: float, nd: int = 6):
+    return None if v != v else round(v, nd)
+
+
+def family_table(dirs: List[str], now: float, window_s: float) -> List[Dict]:
+    rows: Dict[str, Dict] = {}
+    for metric in _FAMILY_METRICS:
+        for p in _load(dirs, f"dftpu_quality_{metric}"):
+            fam = (p.get("labels") or {}).get("family", "unknown")
+            r = rows.setdefault(fam, {"family": fam})
+            r.setdefault(metric, []).append((p["ts"], p["value"]))
+    obs = _load(dirs, "dftpu_quality_observations")
+    out = []
+    for fam in sorted(rows):
+        r = rows[fam]
+        entry: Dict = {"family": fam}
+        for metric in _FAMILY_METRICS:
+            series = r.get(metric, [])
+            if not series:
+                continue
+            recent = [v for ts, v in series if ts >= now - window_s]
+            before = [v for ts, v in series if ts < now - window_s]
+            cur = _mean(recent) if recent else series[-1][1]
+            entry[metric] = {
+                "latest": _round(series[-1][1]),
+                "window_mean": _round(cur),
+                "baseline_mean": _round(_mean(before)),
+                # positive delta = this window is WORSE than the past for
+                # wape/rmsse; for coverage read it as drift off baseline
+                "delta": (_round(cur - _mean(before))
+                          if before else None),
+            }
+        fam_obs = [p["value"] for p in obs
+                   if (p.get("labels") or {}).get("family") == fam]
+        # a running total republished each observe: the max IS the latest
+        entry["observations"] = int(max(fam_obs)) if fam_obs else 0
+        out.append(entry)
+    return out
+
+
+def worst_series_table(dirs: List[str], top: int) -> List[Dict]:
+    latest: Dict[tuple, Dict] = {}
+    for metric in _FAMILY_METRICS:
+        for p in _load(dirs, f"dftpu_quality_series_{metric}"):
+            labels = dict(p.get("labels") or {})
+            key = tuple(sorted(labels.items()))
+            row = latest.setdefault(key, {"labels": labels})
+            # points arrive ts-sorted, so the last write wins = latest
+            row[metric] = p["value"]
+            row["ts"] = p["ts"]
+    rows = sorted(
+        latest.values(),
+        key=lambda r: -(r.get("wape") if r.get("wape") == r.get("wape")
+                        else float("-inf")))
+    return [{
+        **r["labels"],
+        "wape": _round(r.get("wape", float("nan"))),
+        "rmsse": _round(r.get("rmsse", float("nan"))),
+        "coverage": _round(r.get("coverage", float("nan"))),
+    } for r in rows[:top]]
+
+
+def slo_table(dirs: List[str], now: float, window_s: float) -> Dict:
+    out: Dict = {"rules": [], "evaluation_errors": 0}
+    bad = _load(dirs, "dftpu_slo_bad")
+    by_rule: Dict[str, List[Dict]] = {}
+    for p in bad:
+        rule = (p.get("labels") or {}).get("rule", "unknown")
+        by_rule.setdefault(rule, []).append(p)
+    for rule in sorted(by_rule):
+        pts = by_rule[rule]
+        recent = [p["value"] for p in pts if p["ts"] >= now - window_s]
+        out["rules"].append({
+            "rule": rule,
+            "ticks": len(pts),
+            "bad_fraction_window": _round(_mean(recent), 4)
+            if recent else None,
+        })
+    firing = _load(dirs, "dftpu_slo_firing")
+    latest_firing: Dict[str, float] = {}
+    for p in firing:
+        latest_firing[(p.get("labels") or {}).get("rule", "unknown")] = \
+            p["value"]
+    for r in out["rules"]:
+        if r["rule"] in latest_firing:
+            r["firing"] = bool(latest_firing[r["rule"]])
+    # per-replica counters: take each store's latest sample and sum
+    for d in dirs:
+        errs = TimeSeriesStore(d).query(
+            name="dftpu_slo_evaluation_errors_total")
+        if errs:
+            out["evaluation_errors"] += int(errs[-1]["value"])
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("store_dir",
+                    help="quality store root (a fleet's parent directory "
+                         "with replica-<port> subdirectories also works)")
+    ap.add_argument("--window-s", type=float, default=86400.0,
+                    help="trailing window for current-vs-baseline deltas")
+    ap.add_argument("--top", type=int, default=20,
+                    help="worst-series rows to print")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 unless the report has at least one family "
+                         "row and zero SLO evaluation errors (the CI gate)")
+    args = ap.parse_args()
+
+    dirs = find_store_dirs(args.store_dir)
+    if not dirs:
+        print(f"quality_report: no store segments under {args.store_dir}",
+              file=sys.stderr)
+        sys.exit(1 if args.strict else 0)
+    all_ts = [p["ts"] for d in dirs for p in TimeSeriesStore(d).query()]
+    now = max(all_ts) if all_ts else 0.0
+    families = family_table(dirs, now, args.window_s)
+    report = {
+        "report": "quality_report",
+        "store_dirs": dirs,
+        "points": len(all_ts),
+        "families": families,
+        "worst_series": worst_series_table(dirs, args.top),
+        "slo": slo_table(dirs, now, args.window_s),
+    }
+    print(json.dumps(report))
+    if args.strict:
+        errors = report["slo"]["evaluation_errors"]
+        has_metrics = any(
+            f.get(m) for f in families for m in _FAMILY_METRICS)
+        if not has_metrics:
+            print("quality_report: STRICT: no family metrics in the store",
+                  file=sys.stderr)
+            sys.exit(1)
+        if errors:
+            print(f"quality_report: STRICT: {errors} SLO evaluation "
+                  "error(s)", file=sys.stderr)
+            sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

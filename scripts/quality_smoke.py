@@ -1,0 +1,197 @@
+"""CI smoke: serve -> POST /observe -> SLO ticks -> quality report.
+
+The end-to-end demo of the forecast-quality observability layer
+(``monitoring/quality.py`` / ``store.py`` / ``slo.py``) on the REAL fleet
+path:
+
+  1. fit a small multi-series model, register the artifact, and log one
+     tracking run (the staleness SLO's freshness source);
+  2. boot a 1-replica fleet (``serving/fleet.py``) with the full
+     ``monitoring:`` block — quality monitor, on-disk store with a 1 s
+     scrape loop, and three SLO rules (latency / coverage / staleness);
+  3. POST the last days of actuals to the FRONT DOOR's ``/observe``
+     (proxied round-robin to the replica like any other POST);
+  4. let the replica's SLO evaluator and scrape loop tick, then assert the
+     ``dftpu_quality_*`` / ``dftpu_slo_*`` families are present on BOTH the
+     replica's ``/metrics`` and the front door's aggregated exposition;
+  5. drain the fleet (the final scrape flushes history to disk) and run
+     ``scripts/quality_report.py --strict`` over the store — the CI gate:
+     a non-empty per-family report with ZERO SLO evaluation errors.
+
+Run::
+
+    python scripts/quality_smoke.py --workdir /tmp/quality_smoke
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import os
+import shutil
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _post(host: str, port: int, path: str, payload: dict,
+          timeout: float = 60.0) -> tuple:
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request("POST", path, json.dumps(payload),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read() or b"{}")
+    finally:
+        conn.close()
+
+
+def _get(host: str, port: int, path: str, timeout: float = 10.0) -> tuple:
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, resp.read().decode()
+    finally:
+        conn.close()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--workdir", default="/tmp/quality_smoke")
+    ap.add_argument("--series", type=int, default=6,
+                    help="synthetic series count (stores x items)")
+    ap.add_argument("--days", type=int, default=200)
+    ap.add_argument("--settle-s", type=float, default=3.0,
+                    help="seconds to let the 1s scrape/SLO loops tick")
+    args = ap.parse_args()
+
+    import pandas as pd
+
+    from distributed_forecasting_tpu.data import (
+        synthetic_store_item_sales,
+        tensorize,
+    )
+    from distributed_forecasting_tpu.engine import fit_forecast
+    from distributed_forecasting_tpu.models import CurveModelConfig
+    from distributed_forecasting_tpu.serving import BatchForecaster
+    from distributed_forecasting_tpu.serving.fleet import (
+        FleetConfig,
+        start_fleet,
+    )
+    from distributed_forecasting_tpu.tracking import FileTracker
+
+    if os.path.exists(args.workdir):
+        shutil.rmtree(args.workdir)
+    os.makedirs(args.workdir)
+    store_root = os.path.join(args.workdir, "quality_store")
+
+    # 1. fit + save the artifact; log a finished run for the staleness SLO
+    df = synthetic_store_item_sales(
+        n_stores=2, n_items=max(args.series // 2, 1),
+        n_days=args.days, seed=7)
+    batch = tensorize(df)
+    cfg = CurveModelConfig()
+    params, _ = fit_forecast(batch, model="prophet", config=cfg, horizon=30)
+    fc = BatchForecaster.from_fit(batch, params, "prophet", cfg)
+    artifact_dir = os.path.join(args.workdir, "artifact")
+    fc.save(artifact_dir)
+    tracker = FileTracker(os.path.join(args.workdir, "mlruns"))
+    exp = tracker.create_experiment("quality-smoke")
+    run = tracker.start_run(exp)
+    run.log_metrics({"train_series": float(fc.n_series)})
+    run.end()
+
+    mon_conf = {
+        "tracking_root": os.path.join(args.workdir, "mlruns"),
+        "quality": {"enabled": True, "max_horizon": 60},
+        "quality_store": {
+            "enabled": True, "directory": store_root,
+            "scrape_interval_s": 1.0, "compact_interval_s": 3600.0},
+        "slo": {
+            "enabled": True, "evaluation_interval_s": 1.0,
+            "error_budget": 0.05, "windows": [[60, 2.0], [600, 1.0]],
+            "rules": [
+                # generous latency objective: the gate is zero EVALUATION
+                # errors, not whether a cold CI runner fires the alert
+                {"name": "predict_latency_p95", "kind": "latency_quantile",
+                 "quantile": 0.95, "objective": 30.0},
+                {"name": "calibration_coverage", "kind": "coverage",
+                 "tolerance": 0.2},
+                {"name": "model_staleness", "kind": "staleness",
+                 "objective": 604800.0},
+            ]},
+    }
+
+    # 2. one-replica fleet with the monitoring block flowing through
+    fleet = FleetConfig(enabled=True, replicas=1, ready_timeout_s=600)
+    supervisor, front = start_fleet(
+        fleet,
+        artifact_dir=artifact_dir,
+        serving_conf={"warmup_sizes": [8], "warmup_horizon": 30,
+                      "monitoring": mon_conf},
+        front_host="127.0.0.1",
+        front_port=0,
+    )
+    front_port = front.server_address[1]
+    replica_port = supervisor.all_ports()[0]
+    failures = []
+    try:
+        # 3. actuals through the front door
+        recent = df[df["date"] >= df["date"].max() - pd.Timedelta(days=9)]
+        obs = recent.rename(columns={"sales": "y", "date": "ds"})
+        obs["ds"] = obs["ds"].astype(str)
+        status, summary = _post(
+            "127.0.0.1", front_port, "/observe",
+            {"observations":
+             obs[["store", "item", "ds", "y"]].to_dict(orient="records")})
+        print("observe:", status, json.dumps(summary)[:400])
+        if status != 200 or summary.get("observations", 0) <= 0:
+            failures.append(f"/observe failed: {status} {summary}")
+        for metric in ("wape", "rmsse", "coverage"):
+            if summary.get("metrics", {}).get(metric) is None:
+                failures.append(f"no rolling {metric} after observe")
+
+        # 4. let the replica's 1s SLO + scrape loops tick, then check both
+        # expositions carry the quality/SLO families
+        time.sleep(args.settle_s)
+        _, replica_metrics = _get("127.0.0.1", replica_port, "/metrics")
+        _, fleet_metrics = _get("127.0.0.1", front_port, "/metrics")
+        for needle in ("dftpu_quality_metric", "dftpu_slo_firing",
+                       "dftpu_slo_burn_rate"):
+            if needle not in replica_metrics:
+                failures.append(f"{needle} missing from replica /metrics")
+            if needle not in fleet_metrics:
+                failures.append(f"{needle} missing from fleet /metrics")
+        if "dftpu_slo_evaluation_errors_total 0" not in replica_metrics:
+            failures.append("SLO evaluation errors on the replica: " + " ".join(
+                ln for ln in replica_metrics.splitlines()
+                if ln.startswith("dftpu_slo_evaluation_errors_total")))
+    finally:
+        # 5. drain (the replica's shutdown flushes one final scrape)
+        front.shutdown()
+        supervisor.stop()
+
+    report = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "quality_report.py"),
+         store_root, "--strict"],
+        capture_output=True, text=True)
+    sys.stderr.write(report.stderr)
+    print(report.stdout.strip())
+    if report.returncode != 0:
+        failures.append(f"quality_report --strict exited "
+                        f"{report.returncode}")
+
+    if failures:
+        for f in failures:
+            print("FAIL:", f, file=sys.stderr)
+        sys.exit(1)
+    print("quality smoke ok")
+
+
+if __name__ == "__main__":
+    main()

@@ -17,6 +17,12 @@ Run::
     python scripts/trace_report.py /tmp/trace/trace.jsonl
     python scripts/trace_report.py dump.trace.json --trace feedbeefcafe0001
     python scripts/trace_report.py trace.jsonl --top 5 --sort total_ms
+    python scripts/trace_report.py trace/replica-*.trace.jsonl   # whole fleet
+
+Multiple paths merge into one report (each replica streams its own JSONL).
+Empty, truncated, or partially-written files — a live tracer's stream can
+be cut mid-line at any moment — are skipped line-wise with a warning on
+stderr instead of failing the whole report.
 """
 
 from __future__ import annotations
@@ -40,9 +46,21 @@ def load_spans(path: str) -> List[Dict]:
     Both shapes normalize to ``{name, trace_id, span_id, parent_id, start,
     duration_ms, thread, status, attrs}`` with ``start`` in seconds on the
     trace clock (Chrome events carry microseconds relative to the dump).
+
+    Tolerant by design: an unreadable or empty file yields ``[]`` with a
+    stderr warning, and a truncated JSONL line (a tracer killed mid-write)
+    is skipped, not raised — a report over a live fleet's streams must not
+    die on the one replica that was restarting.
     """
-    with open(path) as f:
-        text = f.read()
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError as e:
+        print(f"trace_report: skipping {path}: {e}", file=sys.stderr)
+        return []
+    if not text.strip():
+        print(f"trace_report: skipping {path}: empty file", file=sys.stderr)
+        return []
     # both shapes start with "{": a Chrome trace is ONE document with a
     # traceEvents list; JSONL is one document per line and only parses
     # whole when it has a single line
@@ -72,7 +90,24 @@ def load_spans(path: str) -> List[Dict]:
         return spans
     if isinstance(doc, dict):
         return [doc]  # single-line JSONL
-    return [json.loads(line) for line in text.splitlines() if line.strip()]
+    spans = []
+    bad = 0
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        try:
+            span = json.loads(line)
+        except json.JSONDecodeError:
+            bad += 1  # truncated tail of a live stream, or a torn write
+            continue
+        if isinstance(span, dict) and "name" in span:
+            spans.append(span)
+        else:
+            bad += 1
+    if bad:
+        print(f"trace_report: {path}: skipped {bad} malformed line(s)",
+              file=sys.stderr)
+    return spans
 
 
 def by_kind(spans: List[Dict]) -> List[Dict]:
@@ -117,9 +152,32 @@ def critical_path(spans: List[Dict], trace_id: str) -> List[Dict]:
     } for s in mine]
 
 
+def quality_rollup(spans: List[Dict]) -> List[Dict]:
+    """Per-trace-id rollup of ``quality.*`` spans (the /observe path): how
+    many observation rows each request scored and how long scoring took —
+    the slice an on-call reads when /observe latency regresses."""
+    rows: Dict[str, Dict] = {}
+    for s in spans:
+        if not str(s["name"]).startswith("quality."):
+            continue
+        tid = s.get("trace_id") or ""
+        r = rows.setdefault(tid, {"trace_id": tid, "spans": 0,
+                                  "rows": 0, "total_ms": 0.0})
+        r["spans"] += 1
+        r["total_ms"] = round(r["total_ms"] + float(s["duration_ms"]), 3)
+        attrs = s.get("attrs") or {}
+        try:
+            r["rows"] += int(attrs.get("rows", 0))
+        except (TypeError, ValueError):
+            pass
+    return sorted(rows.values(), key=lambda r: -r["total_ms"])
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("path", help="trace JSONL or Chrome-trace JSON file")
+    ap.add_argument("paths", nargs="+",
+                    help="trace JSONL / Chrome-trace JSON file(s); a fleet's "
+                         "per-replica streams merge into one report")
     ap.add_argument("--trace", default=None,
                     help="trace id: also print that request's span timeline")
     ap.add_argument("--sort", default="p99_ms",
@@ -129,9 +187,9 @@ def main() -> None:
                     help="keep only the N worst kinds (0 = all)")
     args = ap.parse_args()
 
-    spans = load_spans(args.path)
+    spans = [s for p in args.paths for s in load_spans(p)]
     if not spans:
-        sys.exit(f"no spans in {args.path}")
+        sys.exit(f"no spans in {', '.join(args.paths)}")
     kinds = sorted(
         by_kind(spans),
         key=lambda r: r[args.sort],
@@ -141,15 +199,19 @@ def main() -> None:
         kinds = kinds[:args.top]
     report = {
         "report": "trace_summary",
-        "path": args.path,
+        "paths": args.paths,
         "spans": len(spans),
         "traces": len({s.get("trace_id") for s in spans}),
         "kinds": kinds,
     }
+    quality = quality_rollup(spans)
+    if quality:
+        report["quality"] = quality
     if args.trace:
         path_spans = critical_path(spans, args.trace)
         if not path_spans:
-            sys.exit(f"trace id {args.trace!r} not found in {args.path}")
+            sys.exit(f"trace id {args.trace!r} not found in "
+                     f"{', '.join(args.paths)}")
         report["trace"] = {"trace_id": args.trace, "spans": path_spans}
     print(json.dumps(report))
 

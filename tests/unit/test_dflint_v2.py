@@ -705,3 +705,133 @@ def test_health_poll_snapshot_pattern_negative(tmp_path):
     """)
     found = _lint(tmp_path, "serving/sup.py")
     assert "blocking-under-lock" not in _rules(found)
+
+
+# ---------------------------------------------------------------------------
+# ISSUE-8 fixtures: the monitoring.slo / monitoring.quality_store conf
+# blocks + the quality store's snapshot-then-write append discipline
+# ---------------------------------------------------------------------------
+
+def test_quality_conf_blocks_drift_positive_and_negative(tmp_path):
+    # mirrors conf/tasks/serve_config.yml's monitoring block: a typo'd
+    # scrape key is spellable from YAML but no QualityStoreConfig field
+    # consumes it -> drift; SLO keys all land on SLOConfig fields
+    _write(tmp_path, "conf/serve.yml", """
+        monitoring:
+          quality_store:
+            enabled: true
+            retention_s: 604800
+            scrap_interval_s: 30
+          slo:
+            enabled: true
+            evaluation_interval_s: 30
+            error_budget: 0.05
+    """)
+    _write(tmp_path, "src/quality_cfg.py", """
+        import dataclasses
+
+        @dataclasses.dataclass(frozen=True)
+        class QualityStoreConfig:
+            enabled: bool = False
+            retention_s: float = 604800.0
+            scrape_interval_s: float = 30.0
+
+            @classmethod
+            def from_conf(cls, conf):
+                block = conf.get("monitoring", {}).get("quality_store", {})
+                known = {f.name for f in dataclasses.fields(cls)}
+                return cls(**{k: v for k, v in block.items() if k in known})
+
+        @dataclasses.dataclass(frozen=True)
+        class SLOConfig:
+            enabled: bool = False
+            evaluation_interval_s: float = 30.0
+            error_budget: float = 0.05
+
+            @classmethod
+            def from_conf(cls, conf):
+                block = conf.get("monitoring", {}).get("slo", {})
+                known = {f.name for f in dataclasses.fields(cls)}
+                return cls(**{k: v for k, v in block.items() if k in known})
+    """)
+    found = _lint(tmp_path, "src/quality_cfg.py")
+    assert [f.rule for f in found] == ["config-drift"]
+    assert "scrap_interval_s" in found[0].message
+    assert found[0].path == "conf/serve.yml"
+
+    # fixing the typo makes both blocks clean
+    _write(tmp_path, "conf/serve.yml", """
+        monitoring:
+          quality_store:
+            enabled: true
+            retention_s: 604800
+            scrape_interval_s: 30
+          slo:
+            enabled: true
+            evaluation_interval_s: 30
+            error_budget: 0.05
+    """)
+    assert _lint(tmp_path, "src/quality_cfg.py") == []
+
+
+def test_store_append_under_lock_positive(tmp_path):
+    # the anti-pattern the quality store must avoid: holding the cursor
+    # lock across the segment write — every concurrent scrape/observe
+    # append would serialize behind disk latency
+    _write(tmp_path, "monitoring/qstore.py", """
+        import threading
+
+        class Store:
+            def __init__(self, path):
+                self._lock = threading.Lock()
+                self._path = path
+                self._bytes = 0
+
+            def append(self, payload):
+                with self._lock:
+                    self._bytes += len(payload)
+                    with open(self._path, "a") as fh:
+                        fh.write(payload)
+    """)
+    found = _lint(tmp_path, "monitoring/qstore.py")
+    assert "blocking-under-lock" in _rules(found)
+
+
+def test_store_snapshot_then_write_negative(tmp_path):
+    # the shape monitoring/store.py actually uses: cursor bookkeeping under
+    # the lock, the appending write OUTSIDE it; the scrape loop snapshots
+    # registries (in-memory) and then persists with no lock held at all
+    _write(tmp_path, "monitoring/qstore.py", """
+        import threading
+
+        class Store:
+            def __init__(self, path):
+                self._lock = threading.Lock()
+                self._path = path
+                self._bytes = 0
+
+            def append(self, payload):
+                with self._lock:
+                    self._bytes += len(payload)
+                    path = self._path
+                with open(path, "a") as fh:
+                    fh.write(payload)
+
+        class ScrapeLoop:
+            def __init__(self, store, sources):
+                self._store = store
+                self._sources = sources
+                self._lock = threading.Lock()
+                self._ticks = 0
+
+            def scrape_once(self):
+                points = []
+                for snapshot_fn in self._sources:
+                    points.extend(snapshot_fn())
+                payload = "".join(points)
+                self._store.append(payload)
+                with self._lock:
+                    self._ticks += 1
+    """)
+    found = _lint(tmp_path, "monitoring/qstore.py")
+    assert "blocking-under-lock" not in _rules(found)

@@ -1,4 +1,6 @@
-"""Ingest-time data-quality report (data/quality)."""
+"""Data quality (data/quality) + forecast quality (monitoring/quality)."""
+
+import json
 
 import numpy as np
 import pandas as pd
@@ -105,3 +107,533 @@ def test_single_observation_series_not_constant():
     )], ignore_index=True)
     rep = quality_report(df, min_days=1)
     assert rep.n_constant_series == 0
+
+
+# === forecast-quality observability =========================================
+# monitoring/quality.py (rolling accuracy + calibration), monitoring/store.py
+# (on-disk history), monitoring/slo.py (burn-rate alerting), plus the serving
+# surfaces: POST /observe, /debug/quality, and the fleet's TYPE-aware merge.
+
+from distributed_forecasting_tpu.data import (  # noqa: E402
+    synthetic_store_item_sales,
+    tensorize,
+)
+from distributed_forecasting_tpu.data.tensorize import (  # noqa: E402
+    period_ordinals,
+)
+from distributed_forecasting_tpu.engine import fit_forecast  # noqa: E402
+from distributed_forecasting_tpu.engine.calibrate import (  # noqa: E402
+    config_interval_width,
+)
+from distributed_forecasting_tpu.models import CurveModelConfig  # noqa: E402
+from distributed_forecasting_tpu.monitoring.quality import (  # noqa: E402
+    QualityConfig,
+    QualityMonitor,
+    build_quality_runtime,
+)
+from distributed_forecasting_tpu.monitoring.slo import (  # noqa: E402
+    SLOConfig,
+    SLOEvaluator,
+    SLORule,
+    latest_run_timestamp,
+)
+from distributed_forecasting_tpu.monitoring.store import (  # noqa: E402
+    QualityStoreConfig,
+    ScrapeLoop,
+    TimeSeriesStore,
+    flatten_registry_snapshot,
+)
+from distributed_forecasting_tpu.ops.metrics import quality_terms  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def qfc():
+    """A small calibrated prophet artifact, shared module-wide (fit once)."""
+    from distributed_forecasting_tpu.serving import BatchForecaster
+
+    df = synthetic_store_item_sales(
+        n_stores=2, n_items=2, n_days=150, seed=11)
+    batch = tensorize(df)
+    cfg = CurveModelConfig()
+    params, _ = fit_forecast(batch, model="prophet", config=cfg, horizon=30)
+    fc = BatchForecaster.from_fit(batch, params, "prophet", cfg)
+    return fc, df
+
+
+def _numpy_terms_f64(y, yhat, lo, hi, step, mask):
+    """The NumPy reference for ``ops/metrics.quality_terms``: the same
+    float32 elementwise terms, reduced with the same ``np.sum`` float64
+    host reduction the monitor uses — bitwise is the contract."""
+    f32 = np.float32
+    y, yhat = y.astype(f32), yhat.astype(f32)
+    lo, hi = lo.astype(f32), hi.astype(f32)
+    m = mask & np.isfinite(y) & np.isfinite(yhat)
+    mf = m.astype(f32)
+    y0 = np.where(m, y, f32(0.0))
+    err = (y0 - np.where(m, yhat, f32(0.0))) * mf
+    inside = ((y0 >= lo) & (y0 <= hi)).astype(f32) * mf
+    adj = m[..., 1:] & m[..., :-1] & ((step[..., 1:] - step[..., :-1]) == 1)
+    d = np.where(adj, y0[..., 1:] - y0[..., :-1], f32(0.0))
+    terms = {
+        "abs_err": np.abs(err), "abs_y": np.abs(y0) * mf, "sq_err": err * err,
+        "inside": inside, "n": mf,
+        "naive_sq": d * d, "naive_n": adj.astype(f32),
+    }
+    return {k: np.sum(v.astype(np.float64), axis=-1)
+            for k, v in terms.items()}
+
+
+def test_quality_terms_bitwise_vs_numpy_reference():
+    """One batched dispatch + float64 host sum == the NumPy reference,
+    bitwise, including NaN actuals and masked padding."""
+    import jax
+
+    rng = np.random.default_rng(3)
+    k, T = 8, 16
+    y = rng.normal(50, 10, (k, T)).astype(np.float32)
+    y[0, 3] = np.nan            # a missing actual inside the mask
+    y[2, :] = np.nan            # a fully-NaN series
+    yhat = (y + rng.normal(0, 2, (k, T))).astype(np.float32)
+    yhat[1, 5] = np.nan         # a missing forecast
+    lo, hi = yhat - 5.0, yhat + 5.0
+    step = np.tile(np.arange(T, dtype=np.int32), (k, 1))
+    step[3, 8:] += 4            # a gap: no naive diff across it
+    mask = np.ones((k, T), dtype=bool)
+    mask[:, 12:] = False        # dense-layout padding
+
+    terms = jax.jit(quality_terms)(y, yhat, lo, hi, step, mask)
+    sums = {f: np.sum(np.asarray(t, dtype=np.float64), axis=-1)
+            for f, t in terms.items()}
+    ref = _numpy_terms_f64(y, yhat, lo, hi, step, mask)
+    for field, expect in ref.items():
+        assert np.array_equal(sums[field], expect), field
+    # the gap at series 3 removed exactly one naive pair
+    assert ref["naive_n"][3] == ref["naive_n"][4] - 1
+    # the fully-NaN series contributes nothing anywhere
+    assert all(v[2] == 0.0 for v in ref.values())
+
+
+def test_observe_accumulators_match_numpy_reference(qfc):
+    """QualityMonitor.observe's rolling accumulators are bitwise equal to a
+    pandas+NumPy recomputation of the same alignment and reduction."""
+    fc, df = qfc
+    monitor = QualityMonitor(
+        fc, QualityConfig(enabled=True, max_horizon=60))
+    recent = df[df["date"] >= df["date"].max() - pd.Timedelta(days=9)]
+    obs = recent.rename(columns={"sales": "y", "date": "ds"})
+    obs = obs[["store", "item", "ds", "y"]].reset_index(drop=True)
+
+    summary = monitor.observe(obs)
+    assert summary["observations"] == len(obs)
+    assert monitor.observations_total.value == len(obs)
+
+    # -- the reference: same alignment, same dense layout, same np.sum ----
+    key_names = list(fc.key_names)
+    ref_obs = obs.copy()
+    ref_obs["ds"] = pd.to_datetime(ref_obs["ds"])
+    freq = getattr(fc, "freq", "D")
+    ref_obs["_ord"] = period_ordinals(ref_obs["ds"], freq)
+    horizon = int(np.clip(ref_obs["_ord"].max() - fc.day1, 1, 60))
+    pred = fc.predict(ref_obs[key_names].drop_duplicates(), horizon=horizon,
+                      include_history=True)
+    merged = ref_obs.merge(
+        pred.assign(_ord=period_ordinals(pred["ds"], freq))
+            [key_names + ["_ord", "yhat", "yhat_lower", "yhat_upper"]],
+        on=key_names + ["_ord"], how="inner")
+    merged = merged.sort_values(key_names + ["_ord"], kind="stable")
+    sid, uniq = pd.factorize(
+        pd.MultiIndex.from_frame(merged[key_names]), sort=False)
+    pos = merged.groupby(sid).cumcount().to_numpy()
+    k = len(uniq)
+    kb = 1 << max(k - 1, 0).bit_length()
+    Tb = max(1 << max(int(pos.max()) + 1 - 1, 0).bit_length(), 2)
+
+    def dense(col, fill, dtype):
+        out = np.full((kb, Tb), fill, dtype=dtype)
+        out[sid, pos] = merged[col].to_numpy(dtype=dtype)
+        return out
+
+    mask = np.zeros((kb, Tb), dtype=bool)
+    mask[sid, pos] = True
+    ref = _numpy_terms_f64(
+        dense("y", np.nan, np.float32), dense("yhat", np.nan, np.float32),
+        dense("yhat_lower", 0.0, np.float32),
+        dense("yhat_upper", 0.0, np.float32),
+        dense("_ord", -10, np.int32), mask)
+    slot = {tuple(key): i for i, key in enumerate(fc.keys.tolist())}
+    expect = {f: np.zeros(fc.n_series) for f in ref}
+    for row, key in enumerate(uniq):
+        for f in ref:
+            expect[f][slot[tuple(key)]] += ref[f][row]
+    for f in expect:
+        assert np.array_equal(monitor._acc[f], expect[f]), f
+
+    # a second observe keeps accumulating (rolling, not replace)
+    monitor.observe(obs.iloc[: len(obs) // 2])
+    assert monitor._acc["n"].sum() > expect["n"].sum()
+
+
+def test_coverage_math_against_served_intervals(qfc):
+    """Calibration coverage counts actuals inside the SERVED conformal
+    band exactly, and the nominal target comes from the model config."""
+    fc, _ = qfc
+    monitor = QualityMonitor(fc, QualityConfig(enabled=True, max_horizon=30))
+    assert monitor.nominal_coverage == config_interval_width(fc.config)
+
+    key_names = list(fc.key_names)
+    pred = fc.predict(
+        pd.DataFrame(fc.keys, columns=key_names), horizon=5)
+    obs = pred[key_names + ["ds"]].copy()
+    # first half dead-center in the band, second half far above it
+    mid = (pred["yhat_lower"] + pred["yhat_upper"]) / 2
+    n_in = len(obs) // 2
+    obs["y"] = np.where(np.arange(len(obs)) < n_in,
+                        mid, pred["yhat_upper"] + 1e6)
+    summary = monitor.observe(obs)
+    assert summary["observations"] == len(obs)
+    assert monitor.coverage() == n_in / len(obs)
+    assert summary["metrics"]["coverage"] == n_in / len(obs)
+    # out-of-grid actuals are skipped, not scored
+    far = obs.iloc[:3].copy()
+    far["ds"] = pd.to_datetime(far["ds"]) + pd.Timedelta(days=1000)
+    before = monitor.observations_skipped.value
+    monitor.observe(far)
+    assert monitor.observations_skipped.value == before + 3
+    assert monitor.coverage() == n_in / len(obs)
+
+
+def test_store_retention_compaction_roundtrip(tmp_path):
+    store = TimeSeriesStore(str(tmp_path / "ts"), retention_s=100.0,
+                            max_segment_bytes=1024)
+    old = [{"ts": float(i), "name": "m", "labels": {"k": "a"}, "value": 1.0}
+           for i in range(20)]
+    assert store.append(old) == 20
+    new = [{"ts": 1000.0 + i, "name": "m", "labels": {"k": "a"},
+            "value": 2.0} for i in range(5)]
+    store.append(new)  # first append past max_segment_bytes seals seg 1
+    assert store.stats()["segments"] == 2
+    dropped = store.compact(now=1050.0)  # retention floor at ts=950
+    assert dropped == 20
+    pts = store.query(name="m")
+    assert [p["value"] for p in pts] == [2.0] * 5
+    assert store.query(name="m", since=1002.0, until=1003.0,
+                       labels={"k": "a"})[0]["ts"] == 1002.0
+    assert store.query(name="m", labels={"k": "zzz"}) == []
+    # the live segment was never touched; appends continue after compaction
+    store.append([{"ts": 2000.0, "name": "m2", "labels": {}, "value": 3.0}])
+    assert store.names() == ["m", "m2"]
+
+
+def test_store_skips_torn_lines(tmp_path):
+    store = TimeSeriesStore(str(tmp_path / "ts"))
+    store.append([{"ts": 1.0, "name": "m", "labels": {}, "value": 1.0}])
+    with open(store._seg_path(store._seg), "a") as f:
+        f.write('{"ts": 2.0, "name": "m", "val')  # crash mid-write
+    assert [p["ts"] for p in store.query(name="m")] == [1.0]
+
+
+def test_scrape_loop_flattens_registries(tmp_path):
+    from distributed_forecasting_tpu.monitoring.monitor import (
+        MetricsRegistry,
+    )
+
+    reg = MetricsRegistry()
+    reg.counter("c_total", "c").inc(3)
+    reg.labeled_gauge("g", ("rule",), "g").set(1.5, rule="r1")
+    h = reg.histogram("lat_seconds", (0.05, 0.1, 0.5), "h")
+    for v in (0.01, 0.02, 0.4):
+        h.observe(v)
+    store = TimeSeriesStore(str(tmp_path / "ts"))
+    loop = ScrapeLoop(store, [({"replica": "0"}, lambda: reg)],
+                      scrape_interval_s=30.0)
+    assert loop.scrape_once(now=100.0) > 0
+    names = store.names()
+    assert "c_total" in names and "g" in names
+    assert {"lat_seconds_count", "lat_seconds_sum",
+            "lat_seconds_p95"} <= set(names)
+    g = store.query(name="g")[0]
+    assert g["labels"] == {"replica": "0", "rule": "r1"}
+    assert store.query(name="c_total")[0]["value"] == 3.0
+
+
+def _slo_eval(tmp_path, staleness_holder, windows=((60.0, 1.0),
+                                                   (600.0, 0.5))):
+    store = TimeSeriesStore(str(tmp_path / "slo_store"))
+    conf = SLOConfig(
+        enabled=True, evaluation_interval_s=1.0, error_budget=0.5,
+        windows=windows,
+        rules=(SLORule(name="staleness", kind="staleness",
+                       objective=100.0),))
+    return SLOEvaluator(conf, store,
+                        staleness_fn=lambda: staleness_holder["ts"]), store
+
+
+def test_slo_burn_rate_fires_and_clears(tmp_path):
+    holder = {"ts": 1000.0}
+    ev, _ = _slo_eval(tmp_path, holder)
+    ev.evaluate_once(now=1000.0)  # age 0: good tick
+    holder["ts"] = 0.0            # the model goes stale
+    fired_at = None
+    for now in range(1010, 1070, 10):  # keep burning past the first fire
+        state = ev.evaluate_once(now=float(now))
+        if state["rules"][0]["firing"] and fired_at is None:
+            fired_at = now
+    assert fired_at is not None, "stale model never fired"
+    assert ev.snapshot()["firing"]["staleness"] is True
+    # recovery: fresh runs; hysteresis holds until the SHORT window drains
+    cleared_at = None
+    for now in range(1070, 1260, 10):
+        holder["ts"] = float(now)
+        state = ev.evaluate_once(now=float(now))
+        if not state["rules"][0]["firing"]:
+            cleared_at = now
+            break
+    assert cleared_at is not None, "recovered SLO never cleared"
+    assert cleared_at > 1080  # not instantly: bad ticks must age out
+    assert ev.evaluation_errors.value == 0
+    rendered = ev.registry.render_prometheus()
+    assert 'dftpu_slo_firing{rule="staleness"} 0' in rendered
+    assert "dftpu_slo_burn_rate" in rendered
+
+
+def test_slo_unmeasurable_sli_burns_no_budget(tmp_path):
+    """No traffic / no runs -> no bad samples, no burn, no errors."""
+    holder = {"ts": None}
+    ev, store = _slo_eval(tmp_path, holder)
+    state = ev.evaluate_once(now=1000.0)
+    rule = state["rules"][0]
+    assert rule["sli"] is None and rule["bad"] is None
+    assert not rule["firing"]
+    assert all(b == 0.0 for b in rule["burn_rates"].values())
+    assert store.query(name="dftpu_slo_bad") == []
+    assert ev.evaluation_errors.value == 0
+
+
+def test_slo_rule_errors_are_isolated(tmp_path):
+    store = TimeSeriesStore(str(tmp_path / "slo_store"))
+    conf = SLOConfig(
+        enabled=True, error_budget=0.5, windows=((60.0, 1.0),),
+        rules=(SLORule(name="cov", kind="coverage", tolerance=0.1),
+               SLORule(name="fresh", kind="staleness", objective=100.0)))
+
+    def boom():
+        raise RuntimeError("sli source down")
+
+    ev = SLOEvaluator(conf, store, coverage_fn=boom,
+                      staleness_fn=lambda: 995.0)
+    state = ev.evaluate_once(now=1000.0)
+    assert ev.evaluation_errors.value == 1
+    assert [r["name"] for r in state["rules"]] == ["fresh"]
+    assert state["rules"][0]["bad"] is False
+
+
+def test_slo_conf_validation():
+    with pytest.raises(ValueError, match="burn-rate window"):
+        SLOConfig.from_conf({"enabled": True, "windows": []})
+    with pytest.raises(ValueError, match="kind"):
+        SLORule.from_conf({"name": "x", "kind": "latency"})
+    with pytest.raises(ValueError, match="duplicate"):
+        SLOConfig.from_conf({"rules": [
+            {"name": "a", "kind": "staleness", "objective": 1},
+            {"name": "a", "kind": "staleness", "objective": 2}]})
+    with pytest.raises(ValueError, match="retension_s"):
+        QualityStoreConfig.from_conf({"retension_s": 60})
+    with pytest.raises(ValueError, match="max_horison"):
+        QualityConfig.from_conf({"max_horison": 10})
+    conf = SLOConfig.from_conf({
+        "enabled": True, "windows": [[60, 2.0], [600, 1.0]],
+        "rules": [{"name": "lat", "kind": "latency_quantile",
+                   "quantile": 0.99, "objective": 0.25}]})
+    assert conf.short_window == (60.0, 2.0)
+    assert conf.rules[0].quantile == 0.99
+
+
+def test_latest_run_timestamp_reads_tracker_runs(tmp_path):
+    from distributed_forecasting_tpu.tracking import FileTracker
+
+    root = str(tmp_path / "mlruns")
+    assert latest_run_timestamp(root) is None
+    tracker = FileTracker(root)
+    exp = tracker.create_experiment("q")
+    run = tracker.start_run(exp)
+    run.log_metrics({"m": 1.0})
+    run.end()
+    ts = latest_run_timestamp(root)
+    assert ts is not None and ts > 0
+
+
+def test_build_quality_runtime_wiring(tmp_path, qfc):
+    fc, _ = qfc
+    assert build_quality_runtime(None, fc) is None
+    assert build_quality_runtime({"quality": {"enabled": False}}, fc) is None
+    with pytest.raises(ValueError, match="unknown monitoring conf"):
+        build_quality_runtime({"qualty": {}}, fc)
+    with pytest.raises(ValueError, match="quality_store.enabled"):
+        build_quality_runtime(
+            {"slo": {"enabled": True}}, fc)
+    with pytest.raises(ValueError, match="directory"):
+        build_quality_runtime(
+            {"quality_store": {"enabled": True}}, fc)
+    rt = build_quality_runtime({
+        "quality": {"enabled": True, "max_horizon": 30},
+        "quality_store": {"enabled": True,
+                          "directory": str(tmp_path / "qs")},
+        "slo": {"enabled": True, "windows": [[60, 1.0]],
+                "rules": [{"name": "cov", "kind": "coverage"}]},
+    }, fc)
+    assert rt.monitor is not None and rt.store is not None
+    assert rt.scrape is not None and rt.slo is not None
+    rt.slo.evaluate_once(now=1000.0)
+    text = rt.render_metrics()
+    assert "dftpu_quality_observe_requests_total" in text
+    assert "dftpu_slo_evaluations_total 1" in text
+    snap = rt.snapshot()
+    assert {"quality", "slo", "store"} <= set(snap)
+
+
+def _http(port, method, path, payload=None):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    try:
+        body = None if payload is None else json.dumps(payload)
+        conn.request(method, path, body,
+                     {"Content-Type": "application/json"} if body else {})
+        resp = conn.getresponse()
+        raw = resp.read()
+        try:
+            return resp.status, json.loads(raw)
+        except ValueError:
+            return resp.status, raw.decode()
+    finally:
+        conn.close()
+
+
+def test_observe_and_debug_quality_endpoints(tmp_path, qfc):
+    from distributed_forecasting_tpu.monitoring.trace import (
+        TraceConfig,
+        configure_tracing,
+    )
+    from distributed_forecasting_tpu.serving.server import start_server
+
+    fc, df = qfc
+    rt = build_quality_runtime({
+        "quality": {"enabled": True, "max_horizon": 60},
+        "quality_store": {"enabled": True,
+                          "directory": str(tmp_path / "qs")},
+    }, fc)
+    srv = start_server(fc, quality=rt)
+    port = srv.server_address[1]
+    try:
+        recent = df[df["date"] >= df["date"].max() - pd.Timedelta(days=4)]
+        obs = recent.rename(columns={"sales": "y", "date": "ds"})
+        obs = obs[["store", "item", "ds", "y"]]
+        obs["ds"] = obs["ds"].astype(str)
+        status, summary = _http(
+            port, "POST", "/observe",
+            {"observations": obs.to_dict(orient="records")})
+        assert status == 200
+        assert summary["observations"] == len(obs)
+        assert summary["metrics"]["wape"] is not None
+
+        status, err = _http(port, "POST", "/observe", {})
+        assert status == 400 and "observations" in err["error"]
+        status, err = _http(port, "POST", "/observe", {
+            "observations": [{"store": 999, "item": 999,
+                              "ds": str(df["date"].max().date()),
+                              "y": 1.0}],
+            "on_missing": "raise"})
+        assert status == 404
+
+        status, text = _http(port, "GET", "/metrics")
+        assert status == 200
+        assert "dftpu_quality_metric" in text
+        assert "dftpu_quality_observations_total" in text
+
+        # /debug/* stays dark unless tracing.debug_endpoints opts in
+        status, _ = _http(port, "GET", "/debug/quality")
+        assert status == 404
+        configure_tracing(TraceConfig(enabled=True, debug_endpoints=True))
+        try:
+            status, snap = _http(port, "GET", "/debug/quality")
+            assert status == 200
+            assert {"quality", "store"} <= set(snap)
+            assert snap["quality"]["observations"] == len(obs)
+        finally:
+            configure_tracing(TraceConfig())
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_observe_without_quality_runtime_is_503(qfc):
+    from distributed_forecasting_tpu.serving.server import start_server
+
+    fc, _ = qfc
+    srv = start_server(fc)
+    port = srv.server_address[1]
+    try:
+        status, err = _http(port, "POST", "/observe",
+                            {"observations": [{"store": 1, "item": 1,
+                                               "ds": "2023-01-01", "y": 1}]})
+        assert status == 503 and "not enabled" in err["error"]
+        status, text = _http(port, "GET", "/metrics")
+        assert status == 200 and "dftpu_quality" not in text
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_fleet_merge_slo_gauges_max_not_sum():
+    from distributed_forecasting_tpu.serving.fleet import (
+        aggregate_prometheus,
+    )
+
+    a = ("# TYPE dftpu_slo_firing gauge\n"
+         'dftpu_slo_firing{rule="cov"} 0\n'
+         "# TYPE dftpu_slo_burn_rate gauge\n"
+         'dftpu_slo_burn_rate{rule="cov",window="60s"} 0.5\n'
+         "# TYPE dftpu_slo_evaluations_total counter\n"
+         "dftpu_slo_evaluations_total 7\n")
+    b = ("# TYPE dftpu_slo_firing gauge\n"
+         'dftpu_slo_firing{rule="cov"} 1\n'
+         "# TYPE dftpu_slo_burn_rate gauge\n"
+         'dftpu_slo_burn_rate{rule="cov",window="60s"} 2.5\n'
+         "# TYPE dftpu_slo_evaluations_total counter\n"
+         "dftpu_slo_evaluations_total 5\n")
+    merged = aggregate_prometheus([a, b])
+    # firing anywhere is firing fleet-wide: MAX, never a sum
+    assert 'dftpu_slo_firing{rule="cov"} 1' in merged
+    assert 'dftpu_slo_burn_rate{rule="cov",window="60s"} 2.5' in merged
+    # counters still sum, even in the dftpu_slo_ namespace
+    assert "dftpu_slo_evaluations_total 12" in merged
+
+
+def test_fleet_merge_histogram_buckets_union_ladders():
+    from distributed_forecasting_tpu.serving.fleet import (
+        aggregate_prometheus,
+    )
+
+    a = ("# TYPE lat_seconds histogram\n"
+         'lat_seconds_bucket{le="0.1"} 2\n'
+         'lat_seconds_bucket{le="1"} 5\n'
+         'lat_seconds_bucket{le="+Inf"} 5\n'
+         "lat_seconds_sum 1.5\n"
+         "lat_seconds_count 5\n")
+    b = ("# TYPE lat_seconds histogram\n"
+         'lat_seconds_bucket{le="0.5"} 3\n'   # a DIFFERENT bucket ladder
+         'lat_seconds_bucket{le="+Inf"} 4\n'
+         "lat_seconds_sum 0.9\n"
+         "lat_seconds_count 4\n")
+    merged = aggregate_prometheus([a, b])
+    # union bounds, each replica's cumulative carried forward per bound
+    assert 'lat_seconds_bucket{le="0.1"} 2' in merged      # 2 + 0
+    assert 'lat_seconds_bucket{le="0.5"} 5' in merged      # 2 + 3
+    assert 'lat_seconds_bucket{le="1"} 8' in merged        # 5 + 3
+    assert 'lat_seconds_bucket{le="+Inf"} 9' in merged     # 5 + 4
+    assert "lat_seconds_sum 2.4" in merged
+    assert "lat_seconds_count 9" in merged
+    # the cumulative ladder stays monotone in exposition order
+    counts = [float(ln.rpartition(" ")[2])
+              for ln in merged.splitlines() if "_bucket" in ln]
+    assert counts == sorted(counts)
