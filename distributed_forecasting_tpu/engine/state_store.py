@@ -1,0 +1,344 @@
+"""Per-series filter state behind the streaming ingest path.
+
+ARIMA_PLUS (arXiv:2510.24452) keeps model state IN the database so ingest
+and forecast share one source of truth; this module is that state holder
+for the batched JAX world: one :class:`SeriesStateStore` per served
+forecaster, owning the live param pytree (level/trend/seasonal for
+holt_winters, SES level for theta, demand/interval carries for croston),
+the update-aux moments the fit does not persist, a padded fitted-path
+buffer, and the pending buffer of not-yet-applied points.
+
+Shape discipline — the whole point of routing streaming through here:
+
+- the SERIES axis keeps the forecaster's existing bucket ladder untouched
+  (states are full-(S,) arrays; requests gather);
+- the NEW-DAY axis K is padded to a power of two (``ops/update
+  .column_bucket``) with per-column ``valid`` flags, so the stream of
+  single-day and burst applies reuses a handful of compiled programs;
+- the TIME axis of the fitted/history buffers grows in ``time_bucket``
+  increments, and the forecaster's predict grid pads to the same bucket
+  (``BatchForecaster.time_bucket``), so a day-1 apply does not recompile
+  every predict program.
+
+Concurrency contract (the dflint blocking-under-lock rules apply):
+``_lock`` guards the in-memory pending buffer and the installed-state
+references — snapshot-then-release, never held across a device dispatch
+or file I/O; ``_apply_gate`` is a capacity-1 ``BoundedSemaphore``
+serializing state WRITERS (apply_pending, the refit install) against
+each other so their read-modify-write of the param pytree is atomic — a
+semaphore, not a lock, deliberately: writers legitimately hold the gate
+across the update dispatch (which can reach the AOT store's disk I/O),
+which is exactly the capacity-limiter pattern the lock-order lint
+exempts.  Readers (predict) take neither — they see state through
+``BatchForecaster.swap_state``'s atomic snapshot.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_forecasting_tpu.models.base import get_model
+from distributed_forecasting_tpu.monitoring.trace import get_tracer
+from distributed_forecasting_tpu.ops.update import apply_update, column_bucket
+from distributed_forecasting_tpu.utils import get_logger
+
+
+def time_cap(t: int, bucket: int) -> int:
+    """Smallest multiple of ``bucket`` >= t (minimum one bucket)."""
+    b = max(int(bucket), 1)
+    return max((int(t) + b - 1) // b, 1) * b
+
+
+class SeriesStateStore:
+    """Live filter state + pending points for one streamed forecaster."""
+
+    def __init__(self, forecaster, time_bucket: int = 32,
+                 history_y: Optional[np.ndarray] = None,
+                 history_mask: Optional[np.ndarray] = None,
+                 metrics=None):
+        fns = get_model(forecaster.model)
+        if fns.update_state is None or fns.init_update_aux is None:
+            raise ValueError(
+                f"model {forecaster.model!r} has no streaming update kernel; "
+                f"ingest supports holt_winters, theta, and croston"
+            )
+        self._fc = forecaster
+        self._fns = fns
+        self.model = forecaster.model
+        self.config = forecaster.config
+        self.day0 = int(forecaster.day0)
+        self.time_bucket = max(int(time_bucket), 1)
+        self.metrics = metrics
+        self.logger = get_logger("SeriesStateStore")
+
+        self._lock = threading.Lock()        # pending + installed-state refs
+        self._apply_gate = threading.BoundedSemaphore(1)  # state writers
+        self._day_cur = int(forecaster.day1)
+        self._pending: Dict[int, Dict[int, float]] = {}
+        self._applied_since_refit = 0
+        self._late_points = 0
+        self._last_refit_monotonic = time.monotonic()
+
+        params = forecaster.params
+        S, T0 = params.fitted.shape
+        self.n_series = S
+        t_cap = time_cap(T0, self.time_bucket)
+        fitted = jnp.pad(jnp.asarray(params.fitted),
+                         ((0, 0), (0, t_cap - T0)))
+        self._params = dataclasses.replace(params, fitted=fitted)
+        # history buffers: required for full refits (and for folding late
+        # points in); optional for pure incremental serving
+        if history_y is not None and history_mask is not None:
+            self._y = np.zeros((S, t_cap), np.float32)
+            self._mask = np.zeros((S, t_cap), np.float32)
+            self._y[:, :T0] = np.asarray(history_y, np.float32)
+            self._mask[:, :T0] = np.asarray(history_mask, np.float32)
+            aux_args = {"y": jnp.asarray(self._y[:, :T0]),
+                        "mask": jnp.asarray(self._mask[:, :T0])}
+        else:
+            self._y = None
+            self._mask = None
+            aux_args = {}
+        self._aux = fns.init_update_aux(self._params, **aux_args)
+        # install: predicts now pad their grid on the same time bucket and
+        # serve from the padded fitted buffer (padding rows are never read
+        # — history_splice only gathers days <= t_fit_end)
+        forecaster.time_bucket = self.time_bucket
+        forecaster.swap_state(params=self._params, day1=self._day_cur)
+
+    # -- introspection -------------------------------------------------------
+    @property
+    def day_cur(self) -> int:
+        with self._lock:
+            return self._day_cur
+
+    @property
+    def can_refit(self) -> bool:
+        """Full refits need the training history (serving from a bare
+        artifact has only params — incremental updates still work)."""
+        return self._y is not None
+
+    def stats(self) -> Dict:
+        with self._lock:
+            dirty = set()
+            for points in self._pending.values():
+                dirty.update(points)
+            return {
+                "day_cur": self._day_cur,
+                "pending_days": len(self._pending),
+                "dirty_series": len(dirty),
+                "pending_points": sum(
+                    len(p) for p in self._pending.values()),
+                "applied_since_refit": self._applied_since_refit,
+                "late_points": self._late_points,
+                "seconds_since_refit":
+                    time.monotonic() - self._last_refit_monotonic,
+            }
+
+    # -- ingest --------------------------------------------------------------
+    def ingest(self, points: List[Tuple[int, int, float]]) -> Dict[str, int]:
+        """Buffer ``(series_idx, day, y)`` observations.
+
+        Days past the applied frontier go to the pending buffer (last write
+        wins per (series, day)); days inside the applied window fold into
+        the history buffers only — they are "late" and reach model state at
+        the next full refit, exactly like a warehouse backfill; days before
+        the training grid are rejected.  In-memory only: callers persist to
+        the WAL first (serving/ingest) — this buffer is reconstructible by
+        replay.
+        """
+        accepted = late = rejected = 0
+        with self._lock:
+            day_cur = self._day_cur
+            for sidx, day, y in points:
+                if day > day_cur:
+                    self._pending.setdefault(int(day), {})[int(sidx)] = \
+                        float(y)
+                    accepted += 1
+                elif day >= self.day0:
+                    if self._y is not None:
+                        row = int(day) - self.day0
+                        self._y[int(sidx), row] = float(y)
+                        self._mask[int(sidx), row] = 1.0
+                    late += 1
+                    self._late_points += 1
+                else:
+                    rejected += 1
+        return {"accepted": accepted, "late": late, "rejected": rejected}
+
+    # -- the batched apply ---------------------------------------------------
+    def apply_pending(self) -> Dict[str, int]:
+        """Apply every pending point in ONE batched update dispatch.
+
+        Builds dense (S, K) day-columns from the pending buffer — all
+        series, masked where no point arrived, covering every day up to
+        the pending frontier (gap days are all-masked columns: the same
+        rows a full refit's extended contiguous grid would contain) — and
+        routes them through ``ops/update.apply_update``.  K pads to the
+        column bucket; the state installs atomically into the forecaster.
+        """
+        with self._apply_gate:
+            with self._lock:
+                if not self._pending:
+                    return {"days": 0, "points": 0}
+                day_cur = self._day_cur
+                pending, self._pending = self._pending, {}
+            t0 = time.monotonic()
+            max_day = max(pending)
+            k = max_day - day_cur
+            n_points = sum(len(p) for p in pending.values())
+            k_alloc = column_bucket(k)
+            y_new = np.zeros((self.n_series, k_alloc), np.float32)
+            m_new = np.zeros((self.n_series, k_alloc), np.float32)
+            for day, points in pending.items():
+                col = day - day_cur - 1
+                for sidx, y in points.items():
+                    y_new[sidx, col] = y
+                    m_new[sidx, col] = 1.0
+            valid = np.zeros((k_alloc,), np.float32)
+            valid[:k] = 1.0
+            day_new = np.arange(day_cur + 1, day_cur + 1 + k_alloc,
+                                dtype=np.int32)
+
+            params2, aux2, preds = apply_update(
+                self.model, self.config, self._params, self._aux,
+                jnp.asarray(y_new), jnp.asarray(m_new), jnp.asarray(valid),
+                jnp.asarray(day_new),
+            )
+            t_len = day_cur - self.day0 + 1
+            fitted = self._grown_fitted(params2.fitted, t_len + k)
+            fitted = jax.lax.dynamic_update_slice(
+                fitted, preds[:, :k], (0, t_len))
+            params2 = dataclasses.replace(params2, fitted=fitted)
+            if self._y is not None:
+                self._grow_history(t_len + k)
+                self._y[:, t_len:t_len + k] = y_new[:, :k]
+                self._mask[:, t_len:t_len + k] = m_new[:, :k]
+            with self._lock:
+                self._params = params2
+                self._aux = aux2
+                self._day_cur = max_day
+                self._applied_since_refit += n_points
+            self._fc.swap_state(params=params2, day1=max_day)
+            if self.metrics is not None:
+                self.metrics.update_seconds.observe(time.monotonic() - t0)
+                self.metrics.applied_points_total.inc(n_points)
+            return {"days": k, "points": n_points}
+
+    def _grown_fitted(self, fitted, t_need: int):
+        t_cap = int(fitted.shape[1])
+        if t_need <= t_cap:
+            return fitted
+        new_cap = time_cap(t_need, self.time_bucket)
+        return jnp.pad(fitted, ((0, 0), (0, new_cap - t_cap)))
+
+    def _grow_history(self, t_need: int) -> None:
+        t_cap = self._y.shape[1]
+        if t_need <= t_cap:
+            return
+        new_cap = time_cap(t_need, self.time_bucket)
+        pad = new_cap - t_cap
+        self._y = np.pad(self._y, ((0, 0), (0, pad)))
+        self._mask = np.pad(self._mask, ((0, 0), (0, pad)))
+
+    # -- background full refit ----------------------------------------------
+    def refit_stages(self):
+        """(prep, dispatch, complete) closures for ``TrainingExecutor
+        .submit`` — a full refit as a background pipeline experiment.
+
+        prep snapshots the history under ``_lock``; dispatch launches the
+        family's grid-search fit on the real (unpadded) extended grid;
+        complete — on the executor's ordered writer thread — REPLAYS any
+        columns applied while the fit ran (exact continuation through the
+        same update kernel), rebuilds the fitted buffer, and swaps the
+        fresh state in under a ``refit.swap`` span.  ``interval_scale`` is
+        left as fit originally calibrated it (re-calibration needs a CV
+        pass, out of streaming scope — docs/streaming.md).
+        """
+        if not self.can_refit:
+            raise ValueError(
+                "refit needs the training history; this store was attached "
+                "without (history_y, history_mask)")
+
+        def prep():
+            with self._lock:
+                day_snap = self._day_cur
+                t_len = day_snap - self.day0 + 1
+                y = self._y[:, :t_len].copy()
+                mask = self._mask[:, :t_len].copy()
+            return {"day_snap": day_snap, "y": y, "mask": mask,
+                    "t0": time.monotonic()}
+
+        def dispatch(prepared):
+            day = jnp.arange(self.day0, prepared["day_snap"] + 1,
+                             dtype=jnp.int32)
+            params = self._fns.fit(
+                jnp.asarray(prepared["y"]), jnp.asarray(prepared["mask"]),
+                day, self.config)
+            return {**prepared, "params": params}
+
+        def complete(state):
+            with self._apply_gate:
+                self._install_refit(state)
+            return {"day_snap": state["day_snap"]}
+
+        return prep, dispatch, complete
+
+    def _install_refit(self, state) -> None:
+        """Replay-and-swap under ``_apply_gate`` (caller holds it)."""
+        day_snap = int(state["day_snap"])
+        params = state["params"]
+        t_snap = day_snap - self.day0 + 1
+        aux = self._fns.init_update_aux(
+            params, y=jnp.asarray(self._y[:, :t_snap]),
+            mask=jnp.asarray(self._mask[:, :t_snap]))
+        with self._lock:
+            day_now = self._day_cur
+        delta = day_now - day_snap
+        t_cap = time_cap(day_now - self.day0 + 1, self.time_bucket)
+        fitted = jnp.pad(params.fitted, ((0, 0), (0, t_cap - t_snap)))
+        if delta > 0:
+            # columns applied while the fit ran: replay them through the
+            # same update kernel so the installed state is the exact
+            # continuation of the new fit over everything seen so far
+            k_alloc = column_bucket(delta)
+            y_new = np.zeros((self.n_series, k_alloc), np.float32)
+            m_new = np.zeros((self.n_series, k_alloc), np.float32)
+            y_new[:, :delta] = self._y[:, t_snap:t_snap + delta]
+            m_new[:, :delta] = self._mask[:, t_snap:t_snap + delta]
+            valid = np.zeros((k_alloc,), np.float32)
+            valid[:delta] = 1.0
+            day_new = np.arange(day_snap + 1, day_snap + 1 + k_alloc,
+                                dtype=np.int32)
+            params, aux, preds = apply_update(
+                self.model, self.config,
+                dataclasses.replace(params, fitted=fitted), aux,
+                jnp.asarray(y_new), jnp.asarray(m_new), jnp.asarray(valid),
+                jnp.asarray(day_new),
+            )
+            fitted = jax.lax.dynamic_update_slice(
+                params.fitted, preds[:, :delta], (0, t_snap))
+        params = dataclasses.replace(params, fitted=fitted)
+        with get_tracer().span("refit.swap", model=self.model,
+                               day_snap=day_snap, replayed_days=delta):
+            with self._lock:
+                self._params = params
+                self._aux = aux
+                self._applied_since_refit = 0
+                self._late_points = 0
+                self._last_refit_monotonic = time.monotonic()
+            self._fc.swap_state(params=params, day1=day_now)
+        if self.metrics is not None:
+            self.metrics.refits_total.inc()
+            self.metrics.refit_seconds.observe(
+                time.monotonic() - state["t0"])
+        self.logger.info(
+            "refit installed through day %d (replayed %d day(s))",
+            day_now, delta)

@@ -89,16 +89,36 @@ class ModelFns(NamedTuple):
     # clamps demand at 0); band post-processing (conformal scaling,
     # engine/calibrate) must re-apply it after widening
     band_floor: float = None
+    # optional streaming-update kernel (the serving/ingest path):
+    #   update_state(params, aux, y_new, mask_new, valid, day_new, config)
+    #       -> (params', aux', preds)
+    # continues the family's filter over K appended day-columns in one
+    # jitted dispatch.  y_new/mask_new: (S, K); valid: (K,) 1.0 for real
+    # appended days, 0.0 for shape-bucket padding (padded columns must
+    # leave the carry bit-identical); day_new: (K,) absolute day ordinals;
+    # preds: (S, K) one-step-ahead fitted values for the new columns.
+    # ``params'.fitted`` is left untouched — the state store owns the
+    # fitted buffer and splices ``preds`` in itself.
+    update_state: Callable = None
+    # init_update_aux(params, y=None, mask=None) -> aux pytree seeding the
+    # filter carry pieces that fit() does not persist in params (sse/n for
+    # sigma continuation, croston's gap counter, tsb's probability).  With
+    # the training (y, mask) the seed is exact; without, a documented
+    # approximation (docs/streaming.md).
+    init_update_aux: Callable = None
 
 
 def register_model(name: str, fit: Callable, forecast: Callable, config_cls: type,
                    supports_xreg: bool = False, forecast_quantiles: Callable = None,
-                   band_floor: float = None):
+                   band_floor: float = None, update_state: Callable = None,
+                   init_update_aux: Callable = None):
     MODEL_REGISTRY[name] = ModelFns(fit=fit, forecast=forecast,
                                     config_cls=config_cls,
                                     supports_xreg=supports_xreg,
                                     forecast_quantiles=forecast_quantiles,
-                                    band_floor=band_floor)
+                                    band_floor=band_floor,
+                                    update_state=update_state,
+                                    init_update_aux=init_update_aux)
 
 
 def get_model(name: str) -> ModelFns:

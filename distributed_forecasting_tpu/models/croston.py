@@ -67,6 +67,36 @@ def _rate(z, p, alpha, variant):
     return rate
 
 
+def _croston_step(z, p, q, yt, mt, alpha, variant):
+    """One Croston/SBA step: (z, p, q) -> (z', p', q', pred).  Shared
+    verbatim by fit's scan and the streaming ``update_state`` kernel (one
+    body — the docs/streaming.md exactness contract).  mt == 0 steps are
+    state-preserving: q_new = q + 0 and demand is False."""
+    pred = _rate(z, p, alpha, variant)
+    demand = (yt > _EPS) & (mt > 0)
+    q_new = q + mt  # observed periods since last demand
+    z_upd = alpha * yt + (1 - alpha) * z
+    p_upd = alpha * q_new + (1 - alpha) * p
+    z2 = jnp.where(demand, z_upd, z)
+    p2 = jnp.where(demand, p_upd, p)
+    q2 = jnp.where(demand, 0.0, q_new)
+    return z2, p2, q2, pred
+
+
+def _tsb_step(z, b, yt, mt, alpha, beta):
+    """One TSB step: (z, b) -> (z', b', pred); same sharing discipline as
+    :func:`_croston_step`.  The probability b updates every observed
+    period; the size z only at demand points."""
+    pred = z * b
+    demand = (yt > _EPS) & (mt > 0)
+    ind = jnp.where(demand, 1.0, 0.0)
+    # probability updates EVERY observed period; size only at
+    # demand points — the asymmetry that makes dead tails decay
+    b2 = jnp.where(mt > 0, beta * ind + (1 - beta) * b, b)
+    z2 = jnp.where(demand, alpha * yt + (1 - alpha) * z, z)
+    return z2, b2, pred
+
+
 @partial(jax.jit, static_argnames=("config",))
 def fit(y, mask, day, config: CrostonConfig) -> CrostonParams:
     if config.variant not in ("croston", "sba", "tsb"):
@@ -90,13 +120,7 @@ def fit(y, mask, day, config: CrostonConfig) -> CrostonParams:
             def step(carry, inp):
                 z, b, sse, n = carry
                 yt, mt = inp
-                pred = z * b
-                demand = (yt > _EPS) & (mt > 0)
-                ind = jnp.where(demand, 1.0, 0.0)
-                # probability updates EVERY observed period; size only at
-                # demand points — the asymmetry that makes dead tails decay
-                b2 = jnp.where(mt > 0, bta * ind + (1 - bta) * b, b)
-                z2 = jnp.where(demand, a * yt + (1 - a) * z, z)
+                z2, b2, pred = _tsb_step(z, b, yt, mt, a, bta)
                 err = (yt - pred) * mt
                 return (z2, b2, sse + err**2, n + mt), pred
 
@@ -110,14 +134,8 @@ def fit(y, mask, day, config: CrostonConfig) -> CrostonParams:
             def step(carry, inp):
                 z, p, q, sse, n = carry
                 yt, mt = inp
-                pred = _rate(z, p, a, config.variant)
-                demand = (yt > _EPS) & (mt > 0)
-                q_new = q + mt  # observed periods since last demand
-                z_upd = a * yt + (1 - a) * z
-                p_upd = a * q_new + (1 - a) * p
-                z2 = jnp.where(demand, z_upd, z)
-                p2 = jnp.where(demand, p_upd, p)
-                q2 = jnp.where(demand, 0.0, q_new)
+                z2, p2, q2, pred = _croston_step(z, p, q, yt, mt, a,
+                                                 config.variant)
                 err = (yt - pred) * mt
                 return (z2, p2, q2, sse + err**2, n + mt), pred
 
@@ -153,6 +171,115 @@ def forecast(params: CrostonParams, day_all, t_end, config: CrostonConfig,
     return yhat, lo, hi
 
 
+@partial(jax.jit, static_argnames=("config",))
+def update_state(params: CrostonParams, aux, y_new, mask_new, valid, day_new,
+                 config: CrostonConfig):
+    """Continue the Croston/SBA/TSB filter over K appended day-columns.
+
+    Both variants' masked steps are state-preserving, so shape-bucket
+    padding rides in as ``mask * valid == 0`` (bitwise the original mask
+    where valid == 1).  The carries fit() does not persist live in aux:
+    ``q`` (Croston/SBA observed-periods-since-demand) and ``b`` (TSB
+    demand probability — params stores only 1/b, so aux keeps the exact
+    value across dispatches; only the initial seeding pays the reciprocal
+    round-trip, see ``init_update_aux``).  aux keeps BOTH keys regardless
+    of variant, passing the unused one through, so the aux pytree
+    structure — and with it the AOT cache fingerprint — is identical on
+    every dispatch.
+    """
+    if config.variant not in ("croston", "sba", "tsb"):
+        raise ValueError(
+            f"unknown CrostonConfig.variant {config.variant!r}; "
+            f"'croston', 'sba', or 'tsb'"
+        )
+    a = config.alpha
+    dayf = day_new.astype(jnp.float32)
+    m_eff = mask_new * valid[None, :]
+
+    if config.variant == "tsb":
+        bta = config.beta
+
+        def per_series(z, b, ys, ms, sse, n):
+            def step(carry, inp):
+                z, b, sse, n = carry
+                yt, mt = inp
+                z2, b2, pred = _tsb_step(z, b, yt, mt, a, bta)
+                err = (yt - pred) * mt
+                return (z2, b2, sse + err**2, n + mt), pred
+
+            (z, b, sse, n), preds = jax.lax.scan(
+                step, (z, b, sse, n), (ys, ms)
+            )
+            return z, b, sse, n, preds
+
+        z, b, sse, n, preds = jax.vmap(per_series)(
+            params.z_level, aux["b"], y_new, m_eff, aux["sse"], aux["n_obs"]
+        )
+        p = 1.0 / jnp.maximum(b, _EPS)
+        q2 = aux["q"]
+    else:
+
+        def per_series(z, p, q, ys, ms, sse, n):
+            def step(carry, inp):
+                z, p, q, sse, n = carry
+                yt, mt = inp
+                z2, p2, q2, pred = _croston_step(z, p, q, yt, mt, a,
+                                                 config.variant)
+                err = (yt - pred) * mt
+                return (z2, p2, q2, sse + err**2, n + mt), pred
+
+            (z, p, q, sse, n), preds = jax.lax.scan(
+                step, (z, p, q, sse, n), (ys, ms)
+            )
+            return z, p, q, sse, n, preds
+
+        z, p, q2, sse, n, preds = jax.vmap(per_series)(
+            params.z_level, params.p_level, aux["q"], y_new, m_eff,
+            aux["sse"], aux["n_obs"]
+        )
+        b = aux["b"]
+    sigma = jnp.sqrt(sse / jnp.maximum(n, 1.0))
+    t2 = jnp.maximum(
+        params.t_fit_end,
+        jnp.max(jnp.where(valid > 0, dayf, params.t_fit_end)),
+    )
+    params2 = dataclasses.replace(
+        params, z_level=z, p_level=p, sigma=sigma, t_fit_end=t2
+    )
+    return params2, {"sse": sse, "n_obs": n, "q": q2, "b": b}, preds
+
+
+def init_update_aux(params: CrostonParams, y=None, mask=None):
+    """Seed the non-persisted carries from training history.
+
+    With (y, mask): ``q`` is the exact observed-period count after the last
+    demand (0/1 sums — exact in float32); without, q = 0 (assume a demand
+    closed the training window — documented approximation).  ``b`` is
+    recovered as 1/max(p_level, eps): exact for Croston/SBA (unused) and a
+    ~2-ulp reciprocal round-trip for TSB, after which aux carries b
+    exactly.  (sse, n_obs) as in the other families.
+    """
+    if mask is not None:
+        maskf = jnp.asarray(mask, jnp.float32)
+        n = jnp.sum(maskf, axis=1)
+    else:
+        maskf = None
+        n = jnp.full_like(params.sigma, float(params.fitted.shape[1]))
+    sse = params.sigma**2 * jnp.maximum(n, 1.0)
+    b = 1.0 / jnp.maximum(params.p_level, _EPS)
+    if y is not None and maskf is not None:
+        yf = jnp.asarray(y, jnp.float32)
+        nz = ((yf > _EPS) & (maskf > 0)).astype(jnp.float32)
+        # positions strictly after the last demand contribute their mask;
+        # reversed-cumsum == 0 marks exactly those trailing positions
+        trailing = (jnp.cumsum(nz[:, ::-1], axis=1) == 0).astype(jnp.float32)
+        q = jnp.sum(maskf[:, ::-1] * trailing, axis=1)
+    else:
+        q = jnp.zeros_like(params.sigma)
+    return {"sse": sse, "n_obs": n, "q": q, "b": b}
+
+
 register_model("croston", fit, forecast, CrostonConfig,
                forecast_quantiles=gaussian_quantiles(forecast, floor=0.0),
-               band_floor=0.0)
+               band_floor=0.0,
+               update_state=update_state, init_update_aux=init_update_aux)

@@ -114,6 +114,33 @@ def _init_state(y, mask, m, mode):
     return l0, b0, s0
 
 
+def _hw_step(l, b, s, yt, mt, it, alpha, beta, gamma, phi, mode):
+    """One Holt-Winters recursion step: (l, b, s) -> (l', b', s', pred).
+
+    Shared verbatim by the fit-time filter (``_filter``) and the streaming
+    ``update_state`` kernel so the incremental path is the *same float
+    expression sequence* as a refit — the exactness contract of
+    docs/streaming.md rests on this function having exactly one body.
+    Masked steps (mt == 0) take the predict-only branch, which still
+    advances the level by phi*b (HW's masked step is NOT state-preserving).
+    """
+    si = s[it]
+    pb = phi * b
+    if mode == "multiplicative":
+        pred = (l + pb) * si
+        l_obs = alpha * yt / jnp.maximum(si, _EPS) + (1 - alpha) * (l + pb)
+        s_obs = gamma * yt / jnp.maximum(l_obs, _EPS) + (1 - gamma) * si
+    else:
+        pred = l + pb + si
+        l_obs = alpha * (yt - si) + (1 - alpha) * (l + pb)
+        s_obs = gamma * (yt - l_obs) + (1 - gamma) * si
+    b_obs = beta * (l_obs - l) + (1 - beta) * pb
+    l_new = jnp.where(mt > 0, l_obs, l + pb)
+    b_new = jnp.where(mt > 0, b_obs, pb)
+    s_new = s.at[it].set(jnp.where(mt > 0, s_obs, si))
+    return l_new, b_new, s_new, pred
+
+
 def _filter(y, mask, alpha, beta, gamma, m, mode, phi=1.0):
     """One-step-ahead filter for one series & one candidate.
 
@@ -129,20 +156,9 @@ def _filter(y, mask, alpha, beta, gamma, m, mode, phi=1.0):
     def step(carry, inp):
         l, b, s, sse, n = carry
         yt, mt, it = inp
-        si = s[it]
-        pb = phi * b
-        if mode == "multiplicative":
-            pred = (l + pb) * si
-            l_obs = alpha * yt / jnp.maximum(si, _EPS) + (1 - alpha) * (l + pb)
-            s_obs = gamma * yt / jnp.maximum(l_obs, _EPS) + (1 - gamma) * si
-        else:
-            pred = l + pb + si
-            l_obs = alpha * (yt - si) + (1 - alpha) * (l + pb)
-            s_obs = gamma * (yt - l_obs) + (1 - gamma) * si
-        b_obs = beta * (l_obs - l) + (1 - beta) * pb
-        l_new = jnp.where(mt > 0, l_obs, l + pb)
-        b_new = jnp.where(mt > 0, b_obs, pb)
-        s_new = s.at[it].set(jnp.where(mt > 0, s_obs, si))
+        l_new, b_new, s_new, pred = _hw_step(
+            l, b, s, yt, mt, it, alpha, beta, gamma, phi, mode
+        )
         err = (yt - pred) * mt
         return (l_new, b_new, s_new, sse + err**2, n + mt), pred
 
@@ -433,5 +449,81 @@ def forecast(params: HWParams, day_all, t_end, config: HoltWintersConfig, key=No
     return yhat, yhat - z * sd, yhat + z * sd
 
 
+@partial(jax.jit, static_argnames=("config",))
+def update_state(params: HWParams, aux, y_new, mask_new, valid, day_new,
+                 config: HoltWintersConfig):
+    """Continue the HW filter over K appended day-columns in one dispatch.
+
+    y_new/mask_new: (S, K); valid: (K,) 1.0 for real appended days, 0.0 for
+    shape-bucket padding; day_new: (K,) absolute day ordinals (contiguous
+    from t_fit_end+1 in the streaming path, but only the seasonal-slot and
+    t_fit_end arithmetic depend on them).  Each valid step runs
+    :func:`_hw_step` — the byte-identical expression sequence the fit
+    filter scans — so level/trend/season after k updates equal a refit of
+    the extended series bit-for-bit (given the same winning candidate;
+    tests/unit/test_state_update.py pins a 1-candidate grid to prove it).
+    Padding columns gate the whole carry through ``where(valid, ...)``,
+    leaving it bit-identical — HW's masked branch still advances the level,
+    so padding must skip the step entirely rather than masquerade as
+    mask==0.  ``sigma`` continues from aux's (sse, n_obs) running moments;
+    ``fitted`` is left untouched (the state store owns that buffer).
+    """
+    m = config.season_length
+    mode = config.seasonality_mode
+    dayf = day_new.astype(jnp.float32)
+    # training rows are indexed (day - day0), so the slot of appended day d
+    # is (d - day0) mod m — same formula forecast() uses for future days
+    slots = jnp.mod((dayf - params.day0).astype(jnp.int32), m)  # (K,)
+
+    def per_series(l, b, s, al, be, ga, ph, ys, ms, sse, n):
+        def step(carry, inp):
+            l, b, s, sse, n = carry
+            yt, mt, it, vt = inp
+            l2, b2, s2, pred = _hw_step(l, b, s, yt, mt, it, al, be, ga,
+                                        ph, mode)
+            l3 = jnp.where(vt > 0, l2, l)
+            b3 = jnp.where(vt > 0, b2, b)
+            s3 = jnp.where(vt > 0, s2, s)
+            err = (yt - pred) * mt * vt
+            return (l3, b3, s3, sse + err**2, n + mt * vt), pred
+
+        (l, b, s, sse, n), preds = jax.lax.scan(
+            step, (l, b, s, sse, n), (ys, ms, slots, valid)
+        )
+        return l, b, s, sse, n, preds
+
+    l, b, s, sse, n, preds = jax.vmap(per_series)(
+        params.level, params.trend, params.season, params.alpha, params.beta,
+        params.gamma, params.phi, y_new, mask_new, aux["sse"], aux["n_obs"]
+    )
+    sigma = jnp.sqrt(sse / jnp.maximum(n, 1.0))
+    t2 = jnp.maximum(
+        params.t_fit_end,
+        jnp.max(jnp.where(valid > 0, dayf, params.t_fit_end)),
+    )
+    params2 = dataclasses.replace(
+        params, level=l, trend=b, season=s, sigma=sigma, t_fit_end=t2
+    )
+    return params2, {"sse": sse, "n_obs": n}, preds
+
+
+def init_update_aux(params: HWParams, y=None, mask=None):
+    """Seed the streaming carry pieces fit() does not persist.
+
+    With the training mask, n_obs is exact; sse is recovered as
+    sigma^2 * max(n, 1) — the sqrt/square round-trip is the only seeding
+    error, so sigma after updates matches a refit within float tolerance
+    while the filter state stays bitwise.  Without history, n_obs falls
+    back to the grid length (exact only for fully-observed series).
+    """
+    if mask is not None:
+        n = jnp.sum(jnp.asarray(mask, jnp.float32), axis=1)
+    else:
+        n = jnp.full_like(params.sigma, float(params.fitted.shape[1]))
+    sse = params.sigma**2 * jnp.maximum(n, 1.0)
+    return {"sse": sse, "n_obs": n}
+
+
 register_model("holt_winters", fit, forecast, HoltWintersConfig,
-               forecast_quantiles=gaussian_quantiles(forecast))
+               forecast_quantiles=gaussian_quantiles(forecast),
+               update_state=update_state, init_update_aux=init_update_aux)

@@ -76,6 +76,17 @@ def _seasonal_indices(y, mask, dow, m):
     return idx / jnp.maximum(jnp.mean(idx, axis=1, keepdims=True), _EPS)
 
 
+def _ses_step(level, zt, mt, alpha):
+    """One masked SES step: (level) -> (level', pred).  Shared verbatim by
+    the fit-time path (``_ses_path``) and the streaming ``update_state``
+    kernel — one body, so the incremental filter is the same float
+    expression sequence as a refit continuation (docs/streaming.md).
+    Masked steps are state-preserving (pred = frozen level)."""
+    pred = level
+    new = alpha * zt + (1 - alpha) * level
+    return jnp.where(mt > 0, new, level), pred
+
+
 def _ses_path(z, mask, alpha):
     """Masked SES: returns (one-step preds, final level).
 
@@ -88,9 +99,7 @@ def _ses_path(z, mask, alpha):
 
     def step(level, inp):
         zt, mt = inp
-        pred = level
-        new = alpha * zt + (1 - alpha) * level
-        return jnp.where(mt > 0, new, level), pred
+        return _ses_step(level, zt, mt, alpha)
 
     level, preds = jax.lax.scan(step, l0, (z, mask))
     return preds, level
@@ -187,5 +196,74 @@ def forecast(params: ThetaParams, day_all, t_end, config: ThetaConfig, key=None)
     return yhat, yhat - z * sd, yhat + z * sd
 
 
+@partial(jax.jit, static_argnames=("config",))
+def update_state(params: ThetaParams, aux, y_new, mask_new, valid, day_new,
+                 config: ThetaConfig):
+    """Continue the theta SES filter over K appended day-columns.
+
+    The decomposition fit() estimated — seasonal indices, OLS trend,
+    selected alpha — is FROZEN (re-estimating it is exactly what the refit
+    scheduler is for); only the SES level and the (sse, n) running moments
+    evolve.  Each valid step runs :func:`_ses_step`, the byte-identical
+    expression the fit filter scans, so the level after k updates equals
+    continuing that filter over the extended series bit-for-bit
+    (tests/unit/test_state_update.py).  The SES masked step is
+    state-preserving, so shape-bucket padding columns simply ride in as
+    ``mask * valid == 0`` steps — with valid == 1 that product is bitwise
+    the original mask.
+    """
+    m = config.season_length
+    dayf = day_new.astype(jnp.float32)
+    dow = jnp.mod(day_new, m).astype(jnp.int32)          # absolute-day slot
+    t = dayf - params.day0                                # (K,)
+    si = params.seas[:, dow]                              # (S, K)
+    y_sa = y_new / jnp.maximum(si, _EPS)
+    trend = params.intercept[:, None] + params.slope[:, None] * t[None, :]
+    th = config.theta
+    zline = th * y_sa + (1.0 - th) * trend
+    w_ses = 1.0 / th
+    m_eff = mask_new * valid[None, :]
+
+    def per_series(level, al, zs, ms, tr, sis, ys, sse, n):
+        def step(carry, inp):
+            level, sse, n = carry
+            zt, mt, trt, sit, yt = inp
+            level2, pred = _ses_step(level, zt, mt, al)
+            fitted = (w_ses * pred + (1.0 - w_ses) * trt) * sit
+            err = (yt - fitted) * mt
+            return (level2, sse + err * err, n + mt), fitted
+
+        (level, sse, n), fitted = jax.lax.scan(
+            step, (level, sse, n), (zs, ms, tr, sis, ys)
+        )
+        return level, sse, n, fitted
+
+    level, sse, n, preds = jax.vmap(per_series)(
+        params.level, params.alpha, zline, m_eff, trend, si, y_new,
+        aux["sse"], aux["n_obs"]
+    )
+    sigma = jnp.sqrt(sse / jnp.maximum(n, 1.0))
+    t2 = jnp.maximum(
+        params.t_fit_end,
+        jnp.max(jnp.where(valid > 0, dayf, params.t_fit_end)),
+    )
+    params2 = dataclasses.replace(
+        params, level=level, sigma=sigma, t_fit_end=t2
+    )
+    return params2, {"sse": sse, "n_obs": n}, preds
+
+
+def init_update_aux(params: ThetaParams, y=None, mask=None):
+    """Seed (sse, n_obs) for sigma continuation; see the holt_winters
+    counterpart for the sqrt/square round-trip caveat."""
+    if mask is not None:
+        n = jnp.sum(jnp.asarray(mask, jnp.float32), axis=1)
+    else:
+        n = jnp.full_like(params.sigma, float(params.fitted.shape[1]))
+    sse = params.sigma**2 * jnp.maximum(n, 1.0)
+    return {"sse": sse, "n_obs": n}
+
+
 register_model("theta", fit, forecast, ThetaConfig,
-               forecast_quantiles=gaussian_quantiles(forecast))
+               forecast_quantiles=gaussian_quantiles(forecast),
+               update_state=update_state, init_update_aux=init_update_aux)

@@ -943,3 +943,68 @@ def pipeline_metrics() -> PipelineMetrics:
         if _pipeline_metrics is None:
             _pipeline_metrics = PipelineMetrics()
         return _pipeline_metrics
+
+
+class IngestMetrics:
+    """Telemetry for the streaming ingest path (``dftpu_ingest_*``).
+
+    One instance per :class:`serving.ingest.IngestRuntime`, its registry
+    appended to the serving ``GET /metrics`` exposition.  Same discipline
+    as :class:`PipelineMetrics`: attributes are created once here, the
+    metric objects themselves are thread-safe, so the HTTP handler
+    threads, the WAL follower, and the refit scheduler observe freely.
+
+    Fleet note: ``wal_bytes`` / ``wal_segments`` / ``applied_day`` describe
+    SHARED state when replicas converge over one WAL directory — the fleet
+    aggregator max-merges them (serving/fleet.aggregate_prometheus) instead
+    of summing, or a 3-replica fleet would report its WAL three times over.
+    """
+
+    def __init__(self) -> None:
+        self.registry = MetricsRegistry()
+        self.points_total = self.registry.counter(
+            "dftpu_ingest_points_total",
+            "observation points accepted into the WAL")
+        self.late_points_total = self.registry.counter(
+            "dftpu_ingest_late_points_total",
+            "points at or before the applied day (history-only until the "
+            "next full refit)")
+        self.unknown_series_total = self.registry.counter(
+            "dftpu_ingest_unknown_series_total",
+            "points dropped because their key matches no fitted series")
+        self.wal_appends_total = self.registry.counter(
+            "dftpu_ingest_wal_appends_total",
+            "WAL append batches written (one O_APPEND write each)")
+        self.applied_points_total = self.registry.counter(
+            "dftpu_ingest_applied_points_total",
+            "points applied to model state via batched update dispatches")
+        self.refits_total = self.registry.counter(
+            "dftpu_ingest_refits_total",
+            "background full refits completed and swapped in")
+        self.wal_bytes = self.registry.gauge(
+            "dftpu_ingest_wal_bytes",
+            "total bytes across WAL segments (shared in fleet mode: "
+            "max-merged by the aggregator)")
+        self.wal_segments = self.registry.gauge(
+            "dftpu_ingest_wal_segments",
+            "number of WAL segment files (shared in fleet mode: "
+            "max-merged by the aggregator)")
+        self.dirty_series = self.registry.gauge(
+            "dftpu_ingest_dirty_series",
+            "series with pending unapplied points")
+        self.pending_days = self.registry.gauge(
+            "dftpu_ingest_pending_days",
+            "distinct future days waiting in the pending buffer")
+        self.applied_day = self.registry.gauge(
+            "dftpu_ingest_applied_day",
+            "absolute day ordinal the model state is current through "
+            "(shared in fleet mode: max-merged by the aggregator)")
+        self.refit_backlog = self.registry.gauge(
+            "dftpu_ingest_refit_backlog",
+            "points applied incrementally since the last full refit")
+        self.update_seconds = self.registry.histogram(
+            "dftpu_ingest_update_seconds", _STAGE_BUCKETS,
+            "wall seconds per batched state-update dispatch")
+        self.refit_seconds = self.registry.histogram(
+            "dftpu_ingest_refit_seconds", _STAGE_BUCKETS,
+            "wall seconds per background full refit (fit + replay + swap)")

@@ -52,6 +52,65 @@ from distributed_forecasting_tpu.utils import get_logger
 _SEG_RE = re.compile(r"^seg-(\d{8})\.jsonl$")
 
 
+# -- segment-file machinery (module level: shared with serving/ingest's WAL) --
+
+def segment_path(directory: str, index: int) -> str:
+    """Path of numbered segment ``index`` under ``directory``."""
+    return os.path.join(directory, f"seg-{index:08d}.jsonl")
+
+
+def segment_indices(directory: str) -> List[int]:
+    """Sorted indices of the on-disk ``seg-NNNNNNNN.jsonl`` files."""
+    out = []
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    for name in names:
+        m = _SEG_RE.match(name)
+        if m:
+            out.append(int(m.group(1)))
+    return sorted(out)
+
+
+def read_segments_from(
+    directory: str, cursor: Optional[Dict[int, int]] = None,
+) -> Tuple[List[str], Dict[int, int]]:
+    """Follower read: every COMPLETE line appended past ``cursor``.
+
+    ``cursor`` maps segment index -> consumed byte offset; the returned
+    cursor is the input advanced past every fully ``\\n``-terminated line
+    read this poll.  A torn tail (a writer's ``os.write`` still in flight,
+    or a crash mid-write) is left unconsumed — the next poll re-reads it
+    once the newline lands — so a follower never sees a partial record.
+    This is the replay half of the WAL contract (serving/ingest): appends
+    are single ``O_APPEND`` writes of whole lines, reads consume whole
+    lines, and the pair is torn-line tolerant end to end.
+    """
+    new_cursor = dict(cursor or {})
+    lines: List[str] = []
+    for idx in segment_indices(directory):
+        path = segment_path(directory, idx)
+        offset = new_cursor.get(idx, 0)
+        try:
+            if os.path.getsize(path) <= offset:
+                continue
+            with open(path, "rb") as f:
+                f.seek(offset)
+                chunk = f.read()
+        except OSError:
+            continue  # unlinked between listdir and open
+        end = chunk.rfind(b"\n")
+        if end < 0:
+            continue  # only a torn tail so far; retry next poll
+        complete = chunk[:end + 1]
+        new_cursor[idx] = offset + len(complete)
+        for raw in complete.split(b"\n"):
+            if raw.strip():
+                lines.append(raw.decode("utf-8", "replace"))
+    return lines, new_cursor
+
+
 @dataclasses.dataclass(frozen=True)
 class QualityStoreConfig:
     """The ``monitoring.quality_store`` conf block."""
@@ -118,17 +177,12 @@ class TimeSeriesStore:
         path = self._seg_path(self._seg)
         self._seg_bytes = os.path.getsize(path) if os.path.exists(path) else 0
 
-    # -- layout --------------------------------------------------------------
+    # -- layout (delegates to the module-level segment machinery) ------------
     def _seg_path(self, index: int) -> str:
-        return os.path.join(self.directory, f"seg-{index:08d}.jsonl")
+        return segment_path(self.directory, index)
 
     def _segment_indices(self) -> List[int]:
-        out = []
-        for name in os.listdir(self.directory):
-            m = _SEG_RE.match(name)
-            if m:
-                out.append(int(m.group(1)))
-        return sorted(out)
+        return segment_indices(self.directory)
 
     # -- writes --------------------------------------------------------------
     def append(self, points: List[Dict]) -> int:

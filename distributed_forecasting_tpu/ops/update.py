@@ -1,0 +1,77 @@
+"""Batched incremental state-update dispatch for the streaming ingest path.
+
+ARIMA_PLUS (arXiv:2510.24452) frames always-fresh forecasting as an
+in-database loop: ingest, state update, forecast — no offline retrain in
+the serving path.  This module is that loop's device step: ALL dirty
+series' newly-arrived day-columns are applied to the per-series filter
+state in ONE jitted dispatch per model family, O(1) work per appended
+point, routed through the same AOT executable store as the fit/predict
+entrypoints so a warm process never recompiles it.
+
+The kernel itself lives with each family (``update_state`` registered on
+``models/base.ModelFns``); this module owns the dispatch discipline:
+
+- **column bucketing**: the K axis (new days per apply) is padded to the
+  next power of two with a ``valid`` flag per column, so the stream of
+  1-day / 3-day / burst applies reuses a handful of compiled programs
+  instead of one per K.  Padding columns are gated inside the kernels to
+  leave the carry bit-identical (docs/streaming.md exactness contract).
+- **AOT + tracing**: dispatch runs under a ``state.update`` span with the
+  standard ``device_annotation``, keyed ``state_update:<model>`` in the
+  AOT store — the steady-state single-day apply is a cache hit.
+"""
+
+from __future__ import annotations
+
+from distributed_forecasting_tpu.engine.compile_cache import aot_call
+from distributed_forecasting_tpu.models.base import get_model
+from distributed_forecasting_tpu.monitoring.trace import (
+    device_annotation,
+    get_tracer,
+)
+
+
+def column_bucket(k: int) -> int:
+    """Smallest power of two >= k (minimum 1): the K-axis shape ladder.
+
+    Mirrors the serving S-axis bucket ladder (serving/predictor._bucket)
+    without the mesh rounding — the update dispatch is replicated, not
+    sharded, so pure powers of two maximize program reuse.
+    """
+    if k < 1:
+        raise ValueError(f"column_bucket needs k >= 1, got {k}")
+    return 1 << (k - 1).bit_length()
+
+
+def apply_update(model: str, config, params, aux, y_new, mask_new, valid,
+                 day_new):
+    """One batched ``update_state`` dispatch through the AOT store.
+
+    Arguments are already bucketed device arrays (``engine/state_store``
+    builds them): y_new/mask_new (S, K_alloc), valid/day_new (K_alloc,).
+    Returns the family's ``(params', aux', preds)``.  Raises KeyError for
+    an unknown model and ValueError for a family without a streaming
+    kernel (curve/arima — their state is not a filter carry).
+    """
+    fns = get_model(model)
+    if fns.update_state is None:
+        raise ValueError(
+            f"model {model!r} has no update_state kernel; streaming ingest "
+            f"supports the state-space families (holt_winters, theta, "
+            f"croston)"
+        )
+    entry = f"state_update:{model}"
+    tracer = get_tracer()
+    with tracer.span(
+        "state.update",
+        model=model,
+        series=int(y_new.shape[0]),
+        k_alloc=int(y_new.shape[1]),
+    ):
+        with device_annotation(entry):
+            return aot_call(
+                entry,
+                fns.update_state,
+                args=(params, aux, y_new, mask_new, valid, day_new),
+                static_kwargs={"config": config},
+            )

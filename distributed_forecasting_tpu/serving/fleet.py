@@ -171,6 +171,15 @@ def _le_value(raw: str) -> float:
     return float("inf") if raw == "+Inf" else float(raw)
 
 
+#: gauges describing SHARED fleet state (the one ingest WAL on disk, the
+#: converged applied frontier) — max-merged, not summed, across replicas
+_GAUGE_MAX_MERGE = frozenset({
+    "dftpu_ingest_wal_bytes",
+    "dftpu_ingest_wal_segments",
+    "dftpu_ingest_applied_day",
+})
+
+
 def aggregate_prometheus(texts: List[str]) -> str:
     """Merge replica ``/metrics`` expositions, TYPE-aware.
 
@@ -187,7 +196,11 @@ def aggregate_prometheus(texts: List[str]) -> str:
       * **``dftpu_slo_*`` gauges** merge by MAX: an SLO burning or firing
         on ANY replica is burning fleet-wide — summing would overstate burn
         rates by the replica count, and averaging would hide a single
-        burning replica behind healthy peers.
+        burning replica behind healthy peers.  The shared-WAL ingest gauges
+        (:data:`_GAUGE_MAX_MERGE`) merge the same way: every replica
+        reports the SAME on-disk log and applied frontier, so summing a
+        3-replica fleet would triple the WAL size and the convergence
+        point is the furthest-ahead replica.
       * everything else — counters, additive gauges (queue depth in flight
         across the fleet) — sums by name+labels.
     """
@@ -245,7 +258,8 @@ def aggregate_prometheus(texts: List[str]) -> str:
                 group.setdefault(replica_i, {})[le] = v
                 continue
             if key in values:
-                if name.startswith("dftpu_slo_") and \
+                if (name.startswith("dftpu_slo_")
+                        or name in _GAUGE_MAX_MERGE) and \
                         types.get(name) == "gauge":
                     values[key] = max(values[key], v)
                 else:
@@ -339,6 +353,10 @@ def default_spawn_fn(
             # directory with the port so two processes never share a
             # segment cursor
             "monitoring": serving_conf.get("monitoring"),
+            # streaming ingest conf: unlike the quality store, wal_dir is
+            # shared verbatim — replicas converge by following one log
+            # (the replica defaults apply_mode to "interval" in a fleet)
+            "ingest": serving_conf.get("ingest"),
         }
         env = dict(os.environ)
         existing = env.get("PYTHONPATH", "")

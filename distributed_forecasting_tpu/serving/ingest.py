@@ -1,0 +1,398 @@
+"""Streaming ingest: WAL-backed always-fresh forecasts.
+
+ARIMA_PLUS's core serving claim (arXiv:2510.24452 §3) is that forecasts
+never go stale because new rows flow INTO the model between full
+re-trains.  This module is that path for the served JAX artifact:
+
+    POST /ingest ──► WriteAheadLog (append-only JSONL segments)
+                          │ follower read (torn-line tolerant)
+                          ▼
+                 SeriesStateStore.ingest ──► apply_pending
+                          │                    (ONE batched update
+                          ▼                     dispatch, AOT-cached)
+                 BatchForecaster.swap_state ──► /invocations is fresh
+
+The WAL is the source of truth and the ONLY route into model state:
+``submit`` appends and then (sync mode) polls the log like any other
+follower, so a single replica and a fleet sharing ``wal_dir`` run the
+exact same code path — fleet convergence is just every replica's
+follower cursor catching up to the same byte offset.  Segment naming,
+``O_APPEND`` whole-line appends and the torn-line-tolerant follower read
+are the ``monitoring/store`` machinery, reused
+(:func:`monitoring.store.read_segments_from`).
+
+Lock discipline mirrors :class:`monitoring.store.TimeSeriesStore`: the
+append lock covers segment-cursor bookkeeping ONLY — the ``os.write``
+itself happens outside, so an ingest burst never serializes behind disk
+(and the dflint blocking-under-lock rule keeps it that way).  The poll
+path serializes followers with a capacity-1 semaphore, the lint-exempt
+capacity-limiter idiom, because a poll legitimately spans file reads and
+a device dispatch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+import pandas as pd
+
+from distributed_forecasting_tpu.data.tensorize import period_ordinals
+from distributed_forecasting_tpu.engine.state_store import SeriesStateStore
+from distributed_forecasting_tpu.monitoring.monitor import IngestMetrics
+from distributed_forecasting_tpu.monitoring.store import (
+    read_segments_from,
+    segment_indices,
+    segment_path,
+)
+from distributed_forecasting_tpu.monitoring.trace import get_tracer
+from distributed_forecasting_tpu.utils import get_logger
+
+
+@dataclasses.dataclass(frozen=True)
+class IngestConfig:
+    """The ``serving.ingest`` conf block (see conf/tasks/serve_config.yml)."""
+
+    enabled: bool = False
+    wal_dir: str = ""                 # "" -> caller supplies a default root
+    max_segment_bytes: int = 4194304
+    apply_mode: str = "sync"          # "sync": apply inline with POST /ingest
+                                      # "interval": background follower poll
+    apply_interval_ms: float = 200.0
+    time_bucket: int = 32             # fitted/predict-grid growth increment
+    observe_feeds_ingest: bool = False  # POST /observe actuals also ingest
+    max_points_per_request: int = 10000
+    refit: dict = dataclasses.field(default_factory=dict)  # serving/refit.py
+
+    def __post_init__(self):
+        if self.apply_mode not in ("sync", "interval"):
+            raise ValueError(
+                f"apply_mode must be 'sync' or 'interval', "
+                f"got {self.apply_mode!r}")
+        if self.apply_interval_ms <= 0:
+            raise ValueError("apply_interval_ms must be > 0")
+        if self.time_bucket < 1:
+            raise ValueError("time_bucket must be >= 1")
+        if self.max_segment_bytes < 1024:
+            raise ValueError("max_segment_bytes must be >= 1024")
+        if self.max_points_per_request < 1:
+            raise ValueError("max_points_per_request must be >= 1")
+
+    @classmethod
+    def from_conf(cls, conf: Optional[dict]) -> "IngestConfig":
+        conf = conf or {}
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(conf) - known
+        if unknown:
+            # a typo like aply_mode must not silently fall back to sync
+            raise ValueError(
+                f"unknown serving.ingest conf key(s) {sorted(unknown)}; "
+                f"valid: {sorted(known)}")
+        kwargs = {}
+        for f in dataclasses.fields(cls):
+            if f.name not in conf or conf[f.name] is None:
+                continue
+            if f.name == "refit":
+                kwargs[f.name] = dict(conf[f.name])
+            else:
+                kwargs[f.name] = type(f.default)(conf[f.name])
+        return cls(**kwargs)
+
+
+class WriteAheadLog:
+    """Append-only JSONL record log over numbered segments.
+
+    Same on-disk format and discipline as the quality store's segments —
+    one atomic ``O_APPEND`` write per batch, whole lines only, roll to a
+    new segment past ``max_segment_bytes`` — but holding ingest RECORDS,
+    and read through the follower API (:meth:`read_new`) instead of
+    time-range queries.  Multiple processes may append to the same
+    directory: ``O_APPEND`` keeps single-write lines atomic on POSIX, and
+    the follower's rfind-newline read tolerates whatever interleaving
+    lands.
+    """
+
+    def __init__(self, directory: str, max_segment_bytes: int = 4194304):
+        self.directory = str(directory)
+        self.max_segment_bytes = int(max_segment_bytes)
+        os.makedirs(self.directory, exist_ok=True)
+        idxs = segment_indices(self.directory)
+        seg = idxs[-1] if idxs else 0
+        try:
+            seg_bytes = os.path.getsize(segment_path(self.directory, seg))
+        except OSError:
+            seg_bytes = 0
+        self._lock = threading.Lock()  # segment-cursor bookkeeping ONLY
+        self._seg = seg
+        self._seg_bytes = seg_bytes
+
+    def append(self, records: List[Dict]) -> int:
+        """Append record dicts as JSONL; one ``os.write``, outside the
+        lock (snapshot-then-write, the TimeSeriesStore.append idiom)."""
+        if not records:
+            return 0
+        payload = "".join(
+            json.dumps(r, separators=(",", ":")) + "\n" for r in records
+        ).encode()
+        with self._lock:
+            if self._seg_bytes >= self.max_segment_bytes:
+                self._seg += 1
+                self._seg_bytes = 0
+            path = segment_path(self.directory, self._seg)
+            self._seg_bytes += len(payload)
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+        try:
+            os.write(fd, payload)
+        finally:
+            os.close(fd)
+        return len(records)
+
+    def read_new(self, cursor: Optional[Dict[int, int]] = None,
+                 ) -> Tuple[List[Dict], Dict[int, int]]:
+        """(decoded records past ``cursor``, advanced cursor).  Lines that
+        fail to decode (foreign writers, disk corruption) are skipped —
+        the log must stay replayable end to end."""
+        lines, cursor = read_segments_from(self.directory, cursor)
+        records = []
+        for line in lines:
+            try:
+                records.append(json.loads(line))
+            except ValueError:
+                continue
+        return records, cursor
+
+    def stats(self) -> Dict[str, int]:
+        idxs = segment_indices(self.directory)
+        total = 0
+        for i in idxs:
+            try:
+                total += os.path.getsize(segment_path(self.directory, i))
+            except OSError:
+                continue
+        return {"segments": len(idxs), "bytes": total}
+
+
+class IngestRuntime:
+    """Glue between HTTP, the WAL, and the state store.
+
+    ``submit`` validates + appends; applying ALWAYS goes through the
+    follower read (:meth:`poll_apply`) so replicas sharing the WAL and
+    the appending replica itself converge through one code path.
+    """
+
+    def __init__(self, config: IngestConfig, forecaster,
+                 store: SeriesStateStore, wal: WriteAheadLog,
+                 metrics: Optional[IngestMetrics] = None,
+                 refit_scheduler=None):
+        self.config = config
+        self.forecaster = forecaster
+        self.store = store
+        self.wal = wal
+        self.metrics = metrics if metrics is not None else IngestMetrics()
+        self.refit = refit_scheduler
+        self.logger = get_logger("IngestRuntime")
+        self.key_names = tuple(forecaster.key_names)
+        self._key_index = {
+            tuple(k): i for i, k in enumerate(forecaster.keys.tolist())
+        }
+        self._cursor: Dict[int, int] = {}
+        # capacity-1 semaphore, not a Lock: a poll spans file reads and a
+        # device dispatch, the capacity-limiter case the lock lint exempts
+        self._poll_gate = threading.BoundedSemaphore(1)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- record parsing ------------------------------------------------------
+    def _parse_record(self, rec: Dict) -> Tuple[Optional[Tuple], str]:
+        """One request item -> ((sidx, day, y), "") or (None, reason).
+
+        Accepts ``{"keys": {...}|[...], "ds": <date>|"d": <ordinal>,
+        "y": <float>}``, or the flat ``/observe`` record shape with the
+        key columns inline (``{"store": 1, "item": 2, "ds": ..., "y":
+        ...}``); WAL rows use the compact ``{"k": [...], "d": n, "y": v}``
+        form, which parses through the same path on replay.
+        """
+        try:
+            raw = rec.get("k", rec.get("keys"))
+            if raw is None:
+                raw = {n: rec[n] for n in self.key_names}
+            if isinstance(raw, dict):
+                key = tuple(int(raw[n]) for n in self.key_names)
+            else:
+                key = tuple(int(v) for v in raw)
+            if len(key) != len(self.key_names):
+                return None, "key_arity"
+            if "d" in rec:
+                day = int(rec["d"])
+            else:
+                day = int(period_ordinals(
+                    pd.DatetimeIndex([pd.Timestamp(rec["ds"])]),
+                    self.forecaster.freq)[0])
+            y = float(rec["y"])
+        except (KeyError, TypeError, ValueError):
+            return None, "malformed"
+        if not np.isfinite(y):
+            return None, "malformed"
+        sidx = self._key_index.get(key)
+        if sidx is None:
+            return None, "unknown_series"
+        return (sidx, day, y), ""
+
+    # -- write path ----------------------------------------------------------
+    def submit(self, records: List[Dict]) -> Dict:
+        """Validate, WAL-append, and (sync mode) apply a request batch.
+
+        Only points whose key matches a fitted series reach the WAL — the
+        keyset is frozen at fit time and shared by every replica, so
+        filtering before the append keeps the log replayable anywhere.
+        """
+        if len(records) > self.config.max_points_per_request:
+            raise ValueError(
+                f"request has {len(records)} points; "
+                f"max_points_per_request={self.config.max_points_per_request}")
+        rows, unknown, malformed = [], 0, 0
+        for rec in records:
+            parsed, reason = self._parse_record(rec)
+            if parsed is None:
+                if reason == "unknown_series":
+                    unknown += 1
+                else:
+                    malformed += 1
+                continue
+            sidx, day, y = parsed
+            rows.append({"k": list(self._row_key(sidx)), "d": day, "y": y})
+        out = {"written": len(rows), "unknown_series": unknown,
+               "malformed": malformed}
+        if rows:
+            with get_tracer().span("ingest.append", points=len(rows),
+                                   wal_dir=self.wal.directory):
+                self.wal.append(rows)  # dflint: disable=unlocked-shared-state — WriteAheadLog is internally synchronized; deliberately outside _poll_gate so appends never queue behind an apply
+            self.metrics.points_total.inc(len(rows))
+            self.metrics.wal_appends_total.inc()
+        if unknown:
+            self.metrics.unknown_series_total.inc(unknown)
+        if rows and self.config.apply_mode == "sync":
+            out["applied"] = self.poll_apply()
+        return out
+
+    def _row_key(self, sidx: int) -> Tuple:
+        return tuple(int(v) for v in self.forecaster.keys[sidx])
+
+    # -- read/apply path (the follower) --------------------------------------
+    def poll_apply(self) -> Dict:
+        """Consume new WAL lines into the state store, then apply pending
+        points in one batched dispatch.  Safe to call from any thread; the
+        gate serializes concurrent followers, and a blocked caller re-reads
+        after acquiring, so its own freshly appended lines are never missed.
+        """
+        with self._poll_gate:
+            records, self._cursor = self.wal.read_new(self._cursor)
+            counts = {"accepted": 0, "late": 0, "rejected": 0}
+            if records:
+                points = []
+                for rec in records:
+                    parsed, _ = self._parse_record(rec)
+                    if parsed is not None:
+                        points.append(parsed)
+                routed = self.store.ingest(points)
+                for k in counts:
+                    counts[k] += routed[k]
+                if counts["late"]:
+                    self.metrics.late_points_total.inc(counts["late"])
+            applied = self.store.apply_pending()
+        self._publish_gauges()
+        return {**counts, **applied}
+
+    def _publish_gauges(self) -> None:
+        st = self.store.stats()
+        wal = self.wal.stats()
+        m = self.metrics
+        m.dirty_series.set(st["dirty_series"])
+        m.pending_days.set(st["pending_days"])
+        m.applied_day.set(st["day_cur"])
+        m.refit_backlog.set(st["applied_since_refit"])
+        m.wal_bytes.set(wal["bytes"])
+        m.wal_segments.set(wal["segments"])
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> None:
+        if self.config.apply_mode == "interval" and self._thread is None:
+            self._stop.clear()  # dflint: disable=unlocked-shared-state — lifecycle field touched only by the owning thread
+            self._thread = threading.Thread(  # dflint: disable=unlocked-shared-state — lifecycle field touched only by the owning thread
+                target=self._run, name="ingest-follower", daemon=True)
+            self._thread.start()
+        if self.refit is not None:
+            self.refit.start()
+
+    def _run(self) -> None:
+        interval = self.config.apply_interval_ms / 1000.0
+        while not self._stop.wait(interval):
+            try:
+                self.poll_apply()
+            except Exception:
+                self.logger.exception("WAL follower poll failed")
+
+    def stop(self) -> None:
+        if self.refit is not None:
+            self.refit.stop()
+        self._stop.set()
+        if self._thread is not None:
+            # NOT under _poll_gate: the follower takes the gate inside
+            # poll_apply, so joining while holding it would deadlock
+            self._thread.join(timeout=10.0)
+            self._thread = None  # dflint: disable=unlocked-shared-state — lifecycle field touched only by the owning thread
+
+    # -- exposition ----------------------------------------------------------
+    def render_metrics(self) -> str:
+        self._publish_gauges()
+        return self.metrics.registry.render_prometheus()
+
+    def snapshot(self) -> Dict:
+        out = {"store": self.store.stats(), "wal": self.wal.stats(),
+               "apply_mode": self.config.apply_mode}
+        if self.refit is not None:
+            out["refit"] = self.refit.snapshot()
+        return out
+
+
+def build_ingest_runtime(conf: Optional[dict], forecaster,
+                         history_y=None, history_mask=None,
+                         quality=None,
+                         default_wal_dir: Optional[str] = None,
+                         ) -> Optional[IngestRuntime]:
+    """``serving.ingest`` conf block -> a started-able runtime (or None
+    when the block is absent/disabled).  ``history_y``/``history_mask``
+    enable full refits; without them the scheduler is skipped and only
+    the incremental path runs (a bare-artifact deployment)."""
+    config = IngestConfig.from_conf(conf)
+    if not config.enabled:
+        return None
+    wal_dir = config.wal_dir or default_wal_dir
+    if not wal_dir:
+        raise ValueError(
+            "serving.ingest.wal_dir is empty and no default was supplied")
+    metrics = IngestMetrics()
+    store = SeriesStateStore(
+        forecaster, time_bucket=config.time_bucket,
+        history_y=history_y, history_mask=history_mask, metrics=metrics)
+    wal = WriteAheadLog(wal_dir, max_segment_bytes=config.max_segment_bytes)
+    refit_scheduler = None
+    if config.refit:
+        from distributed_forecasting_tpu.serving.refit import (
+            RefitConfig,
+            RefitScheduler,
+        )
+        refit_config = RefitConfig.from_conf(config.refit)
+        if refit_config.enabled:
+            if not store.can_refit:
+                raise ValueError(
+                    "serving.ingest.refit is enabled but no training "
+                    "history was supplied to build_ingest_runtime")
+            refit_scheduler = RefitScheduler(
+                store, refit_config, quality=quality, metrics=metrics)
+    return IngestRuntime(config, forecaster, store, wal, metrics=metrics,
+                         refit_scheduler=refit_scheduler)

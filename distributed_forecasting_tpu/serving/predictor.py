@@ -22,6 +22,7 @@ import dataclasses
 import importlib
 import json
 import os
+import threading
 from typing import Optional
 
 import jax
@@ -185,6 +186,19 @@ class BatchForecaster:
         self._index = {tuple(k): i for i, k in enumerate(self.keys.tolist())}
         # optional device mesh (enable_mesh): predict shards the series axis
         self._mesh = None
+        # streaming state swap (serving/ingest): _state_lock makes the
+        # (params, day1) pair one atomic unit — a predict must never pair a
+        # pre-update day1 with post-update params or vice versa.  Held only
+        # for the reference swap/snapshot, never across device work or I/O.
+        self._state_lock = threading.Lock()
+        # time-grid bucket (engine/state_store sets this when streaming is
+        # attached): the forecast grid end is padded up to the next multiple
+        # of this many days so the per-apply day1 advance reuses O(T/B)
+        # compiled shapes instead of one per day; 1 = exact grid (default,
+        # every non-streaming forecaster).  Per-day forecast values of the
+        # scan families are invariant to trailing grid padding (the padded
+        # rows are computed then trimmed before include_history logic).
+        self.time_bucket = 1
 
     # -- construction -------------------------------------------------------
     @classmethod
@@ -207,8 +221,11 @@ class BatchForecaster:
     # -- persistence --------------------------------------------------------
     def save(self, directory: str) -> None:
         os.makedirs(directory, exist_ok=True)
+        # one consistent (params, day1) unit: a save racing a streaming
+        # apply must not persist post-update params with a pre-update day1
+        params, day1 = self._state_snapshot()
         params_type = save_params_npz(
-            os.path.join(directory, _PARAMS_FILE), self.params
+            os.path.join(directory, _PARAMS_FILE), params
         )
         scale_path = os.path.join(directory, _SCALE_FILE)
         if self.interval_scale is not None:
@@ -227,7 +244,7 @@ class BatchForecaster:
             "key_names": list(self.key_names),
             "keys": self.keys.tolist(),
             "day0": self.day0,
-            "day1": self.day1,
+            "day1": day1,
             "freq": self.freq,
             "serving_schema": self.serving_schema,
         }
@@ -284,11 +301,11 @@ class BatchForecaster:
         n = int(mesh.devices.size)
         if n < 1:
             raise ValueError("mesh has no devices")
-        self._mesh = mesh
+        self._mesh = mesh  # dflint: disable=unlocked-shared-state — deploy-time toggle, flipped before traffic is admitted
 
     def disable_mesh(self) -> None:
         """Back to single-device predict (mesh-size-1 buckets)."""
-        self._mesh = None
+        self._mesh = None  # dflint: disable=unlocked-shared-state — deploy-time toggle, flipped before traffic is admitted
 
     def _aot_entry(self, kind: str) -> str:
         """AOT-store entry name for this forecaster's predict programs.
@@ -344,7 +361,25 @@ class BatchForecaster:
             # on_missing == 'skip': drop silently
         return np.asarray(idx, dtype=np.int64)
 
-    def gather_params(self, sidx: np.ndarray):
+    def swap_state(self, params=None, day1: Optional[int] = None) -> None:
+        """Atomically install updated filter state — the streaming ingest /
+        background-refit commit point.  ``params`` (when given) must be the
+        same pytree structure as the current params; ``day1`` advances the
+        last-observed day the forecast grid ends at.  Concurrent predicts
+        either see the whole old state or the whole new one, never a mix
+        (:meth:`_state_snapshot`)."""
+        with self._state_lock:
+            if params is not None:
+                self.params = params
+            if day1 is not None:
+                self.day1 = int(day1)
+
+    def _state_snapshot(self):
+        """(params, day1) as one consistent unit; see :meth:`swap_state`."""
+        with self._state_lock:
+            return self.params, self.day1
+
+    def gather_params(self, sidx: np.ndarray, params=None):
         """Row-gather the requested series out of the param pytree.
 
         Leaves whose leading axis is the series axis (shape[0] == S) are
@@ -353,9 +388,13 @@ class BatchForecaster:
         instead of O(S_trained) — the scale regime (50k-series artifacts,
         BASELINE #4) where forecasting everything and row-selecting after
         would reintroduce the reference's serve-everything cost profile.
+        ``params`` overrides the live pytree (the request path passes its
+        own snapshot so a concurrent swap cannot tear a request).
         """
         S = self.keys.shape[0]
         take = jnp.asarray(sidx)
+        if params is None:
+            params, _ = self._state_snapshot()
 
         def g(leaf):
             leaf = jnp.asarray(leaf)
@@ -363,7 +402,7 @@ class BatchForecaster:
                 return leaf[take]
             return leaf
 
-        return jax.tree_util.tree_map(g, self.params)
+        return jax.tree_util.tree_map(g, params)
 
     def _prepare_request(self, request, horizon, on_missing, xreg):
         """Shared predict prologue: resolve series, bucket the request size,
@@ -378,17 +417,31 @@ class BatchForecaster:
         bucketed to the next power of two (capped at S) so a serving
         process sees O(log S) compiled shapes; padding rows repeat sidx[0]
         and are dropped by the caller.
+
+        Returns ``(sidx, params, day_all, fc_kwargs, scale, t_end, n_real)``:
+        ``(params, t_end)`` are one atomic state snapshot (a concurrent
+        streaming swap cannot tear the pair), and ``n_real`` is the count
+        of grid rows the caller keeps — with ``time_bucket > 1`` the grid
+        end is padded up to the next bucket multiple so streaming day1
+        advances reuse compiled shapes, and the trailing padded rows are
+        trimmed (before any include_history logic) rather than served.
         """
         sidx = self.series_indices(request, on_missing=on_missing)
         if sidx.size == 0:
-            return sidx, None, None, None, None
+            return sidx, None, None, None, None, None, 0
+        params_snap, day1_snap = self._state_snapshot()
+        span = day1_snap - self.day0 + 1
+        if self.time_bucket > 1:
+            b = int(self.time_bucket)
+            span = ((span + b - 1) // b) * b
         day_all = jnp.arange(
-            self.day0, self.day1 + horizon + 1, dtype=jnp.int32
+            self.day0, self.day0 + span + horizon, dtype=jnp.int32
         )
+        n_real = day1_snap - self.day0 + horizon + 1
         k = int(sidx.size)
         bucket = self._bucket(k)
         padded = np.concatenate([sidx, np.full(bucket - k, sidx[0], sidx.dtype)])
-        params = self.gather_params(padded)
+        params = self.gather_params(padded, params=params_snap)
         scale = (
             None if self.interval_scale is None
             else jnp.asarray(self.interval_scale[padded])
@@ -407,10 +460,18 @@ class BatchForecaster:
                     f"xreg must be (T_all, R) or (S_trained, T_all, R), got "
                     f"{xreg.ndim}-D"
                 )
-            if xreg.shape[-2] != int(day_all.shape[0]):
+            T_grid = int(day_all.shape[0])
+            if xreg.shape[-2] == n_real and n_real != T_grid:
+                # time-bucketed grid: callers supply regressors for the REAL
+                # day0..day1+horizon rows; the padded tail rows are trimmed
+                # from the output, so zero rows are never served
+                widths = ([(0, 0)] * (xreg.ndim - 2)
+                          + [(0, T_grid - n_real), (0, 0)])
+                xreg = jnp.pad(xreg, widths)
+            elif xreg.shape[-2] != T_grid:
                 raise ValueError(
                     f"xreg time axis is {xreg.shape[-2]}, expected the full "
-                    f"history+horizon grid {int(day_all.shape[0])}"
+                    f"history+horizon grid {n_real}"
                 )
             if xreg.ndim == 3:
                 # the row gather below clamps out-of-bounds indices silently
@@ -433,7 +494,7 @@ class BatchForecaster:
             params, day_all, scale, fc_kwargs = shard_forecast_inputs(
                 params, day_all, scale, fc_kwargs, self._mesh, bucket
             )
-        return sidx, params, day_all, fc_kwargs, scale
+        return sidx, params, day_all, fc_kwargs, scale, day1_snap, n_real
 
     def _frame_skeleton(self, sidx, day_all):
         """ds + key columns for a long result frame over ``day_all`` —
@@ -509,13 +570,14 @@ class BatchForecaster:
         xreg = None
         R = getattr(self.config, "n_regressors", 0)
         if R:
-            T_all = self.day1 - self.day0 + horizon + 1
+            _, day1 = self._state_snapshot()
+            T_all = day1 - self.day0 + horizon + 1
             xreg = jnp.zeros((T_all, R), jnp.float32)
         hits0 = cache_stats()["hits"]
         for b in buckets:
             req = pd.DataFrame(self.keys[:b], columns=self.key_names)
             self.predict(req, horizon=horizon, xreg=xreg)
-        self.last_warmup_from_store = int(cache_stats()["hits"] - hits0)
+        self.last_warmup_from_store = int(cache_stats()["hits"] - hits0)  # dflint: disable=unlocked-shared-state — warmup stat, written at boot before concurrent traffic
         return len(buckets)
 
     def predict(
@@ -536,9 +598,8 @@ class BatchForecaster:
         was fit with ``n_regressors > 0`` — (T_all, R) shared or
         (S_trained, T_all, R) per-series over the FULL day0..day1+horizon
         grid (per-series rows are gathered down to the request)."""
-        sidx, params, day_all, fc_kwargs, scale = self._prepare_request(
-            request, horizon, on_missing, xreg
-        )
+        (sidx, params, day_all, fc_kwargs, scale, t_end,
+         n_real) = self._prepare_request(request, horizon, on_missing, xreg)
         if sidx.size == 0:
             return pd.DataFrame(
                 columns=["ds", *self.key_names, "yhat", "yhat_upper", "yhat_lower"]
@@ -563,10 +624,16 @@ class BatchForecaster:
             with device_annotation(entry):
                 yhat, lo, hi = aot_call(
                     entry, fns.forecast,
-                    args=(params, day_all, jnp.float32(self.day1)),
+                    args=(params, day_all, jnp.float32(t_end)),
                     static_kwargs={"config": self.config},
                     dynamic_kwargs={"key": key, **fc_kwargs},
                 )
+            if n_real < int(day_all.shape[0]):
+                # drop the time-bucket padding rows BEFORE the history trim
+                # so [-horizon:] lands on the real last training day
+                day_all = day_all[:n_real]
+                yhat, lo, hi = (yhat[:, :n_real], lo[:, :n_real],
+                                hi[:, :n_real])
             if scale is not None:
                 from distributed_forecasting_tpu.engine.calibrate import (
                     apply_interval_scale,
@@ -610,9 +677,8 @@ class BatchForecaster:
                 f"implementation"
             )
         quantiles = tuple(float(q) for q in quantiles)
-        sidx, params, day_all, fc_kwargs, scale = self._prepare_request(
-            request, horizon, on_missing, xreg
-        )
+        (sidx, params, day_all, fc_kwargs, scale, t_end,
+         n_real) = self._prepare_request(request, horizon, on_missing, xreg)
         qcols = quantile_columns(quantiles)
         if sidx.size == 0:
             return pd.DataFrame(columns=["ds", *self.key_names, *qcols])
@@ -632,9 +698,12 @@ class BatchForecaster:
             with device_annotation(
                     self._aot_entry("serving_predict_quantiles")):
                 yq = fns.forecast_quantiles(
-                    params, day_all, jnp.float32(self.day1), self.config,
+                    params, day_all, jnp.float32(t_end), self.config,
                     priced, key, **fc_kwargs,
                 )  # (bucket, Q, T_all)
+            if n_real < int(day_all.shape[0]):
+                day_all = day_all[:n_real]
+                yq = yq[:, :, :n_real]
             if scale is not None:
                 med = yq[:, priced.index(0.5), :][:, None, :]
                 yq = med + scale[:, None, None] * (yq - med)

@@ -1,0 +1,175 @@
+"""Background full-refit scheduling for the streaming ingest path.
+
+Incremental updates (engine/state_store) keep filter STATE exact, but the
+HYPERPARAMETERS (smoothing grid winners, seasonal profile, sigma regime)
+stay frozen at fit time — ARIMA_PLUS re-trains for the same reason.  The
+:class:`RefitScheduler` watches three signals and, when any fires, runs a
+full grid-search refit as a background pipeline experiment through
+``engine/executor.TrainingExecutor`` — prep/dispatch on the scheduler
+thread, the swap (with replay of points applied mid-fit) on the
+executor's writer thread, atomically, under a ``refit.swap`` span:
+
+* **backlog** — points applied incrementally since the last refit
+  (``max_applied_points``): the cheap staleness proxy;
+* **staleness** — wall seconds since the last refit
+  (``max_staleness_s``): bounds hyperparameter age even under a trickle;
+* **drift** — the PR-8 quality gauges: when rolling interval coverage
+  strays more than ``drift_coverage_tol`` from nominal, the sigma regime
+  no longer matches reality and incremental updates cannot fix it.
+
+Serving keeps answering from the last-good state throughout — the swap
+is the only moment ingest appliers and the refit contend, and it is a
+pure in-memory pointer install.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import threading
+from typing import Dict, Optional
+
+from distributed_forecasting_tpu.engine.executor import (
+    PipelineConfig,
+    TrainingExecutor,
+)
+from distributed_forecasting_tpu.utils import get_logger
+
+
+@dataclasses.dataclass(frozen=True)
+class RefitConfig:
+    """The ``serving.ingest.refit`` conf block."""
+
+    enabled: bool = False
+    max_applied_points: int = 5000
+    max_staleness_s: float = 3600.0
+    check_interval_s: float = 5.0
+    drift_coverage_tol: float = 0.15  # |coverage - nominal| trigger; <= 0
+                                      # disables the drift signal
+
+    def __post_init__(self):
+        if self.max_applied_points < 1:
+            raise ValueError("max_applied_points must be >= 1")
+        if self.max_staleness_s <= 0:
+            raise ValueError("max_staleness_s must be > 0")
+        if self.check_interval_s <= 0:
+            raise ValueError("check_interval_s must be > 0")
+
+    @classmethod
+    def from_conf(cls, conf: Optional[dict]) -> "RefitConfig":
+        conf = conf or {}
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(conf) - known
+        if unknown:
+            # a typo like max_stalenes_s must not silently drop a trigger
+            raise ValueError(
+                f"unknown serving.ingest.refit conf key(s) "
+                f"{sorted(unknown)}; valid: {sorted(known)}")
+        kwargs = {
+            f.name: type(f.default)(conf[f.name])
+            for f in dataclasses.fields(cls)
+            if f.name in conf and conf[f.name] is not None
+        }
+        return cls(**kwargs)
+
+
+class RefitScheduler:
+    """Watches staleness/drift; schedules at most one refit in flight."""
+
+    def __init__(self, store, config: RefitConfig, quality=None,
+                 metrics=None):
+        self.store = store
+        self.config = config
+        self.quality = quality
+        self.metrics = metrics
+        self.logger = get_logger("RefitScheduler")
+        # own executor: refits must never queue behind (or hold slots
+        # from) a training task's pipeline, and one in flight is plenty
+        self._executor = TrainingExecutor(
+            config=PipelineConfig(enabled=True, max_in_flight=1,
+                                  prefetch_depth=0, async_tracking=False))
+        self._handle = None
+        self._refits_done = 0
+        self._last_trigger = ""
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- trigger logic -------------------------------------------------------
+    def due(self) -> str:
+        """The name of the first firing trigger, or "" when fresh."""
+        st = self.store.stats()
+        if st["applied_since_refit"] >= self.config.max_applied_points:
+            return "backlog"
+        if st["seconds_since_refit"] >= self.config.max_staleness_s:
+            return "staleness"
+        if self.config.drift_coverage_tol > 0 and self.quality is not None:
+            monitor = getattr(self.quality, "monitor", None)
+            if monitor is not None:
+                cov = monitor.coverage()
+                if (not math.isnan(cov)
+                        and abs(cov - monitor.nominal_coverage)
+                        > self.config.drift_coverage_tol):
+                    return "coverage_drift"
+        return ""
+
+    def maybe_refit(self, force: bool = False) -> Optional[str]:
+        """Submit a refit if a trigger fired (or ``force``) and none is in
+        flight; returns the trigger name when one was submitted."""
+        if self._handle is not None and not self._handle.done():
+            return None
+        trigger = "forced" if force else self.due()
+        if not trigger:
+            return None
+        prep, dispatch, complete = self.store.refit_stages()
+        self._last_trigger = trigger
+        self._handle = self._executor.submit(
+            f"refit:{trigger}", prep, dispatch, complete)
+        self.logger.info("refit submitted (trigger=%s)", trigger)
+        return trigger
+
+    def wait(self, timeout: Optional[float] = None) -> Optional[Dict]:
+        """Block until the in-flight refit (if any) has swapped in."""
+        if self._handle is None:
+            return None
+        result = self._handle.result(timeout=timeout)
+        self._refits_done += 1
+        return result
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> None:
+        if not self.config.enabled or self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="refit-scheduler", daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.config.check_interval_s):
+            try:
+                if self._handle is not None and self._handle.done():
+                    # surface stage-C errors instead of silently retrying
+                    self._handle.result(timeout=0)
+                    self._refits_done += 1
+                    self._handle = None
+                self.maybe_refit()
+            except Exception:
+                self.logger.exception("refit cycle failed")
+                self._handle = None
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        self._executor.close()
+
+    def snapshot(self) -> Dict:
+        return {
+            "enabled": self.config.enabled,
+            "in_flight": bool(self._handle is not None
+                              and not self._handle.done()),
+            "refits_done": self._refits_done,
+            "last_trigger": self._last_trigger,
+            "due": self.due(),
+        }

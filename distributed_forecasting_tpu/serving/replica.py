@@ -9,9 +9,15 @@ The conf object carries: ``artifact_dir`` (the saved forecaster to load),
 ``warmup_sizes``/``warmup_horizon``, optional ``batching``/``tracing``
 blocks (same shapes as the ``serving:`` conf), ``model_version``,
 ``mesh_devices`` (>1 shards every predict's series axis over a device mesh
-— ``BatchForecaster.enable_mesh``), and an optional ``monitoring`` block
+— ``BatchForecaster.enable_mesh``), an optional ``monitoring`` block
 (quality/store/SLO — ``monitoring/quality.py``; the replica suffixes the
-store directory with its port so replicas never share an append cursor).
+store directory with its port so replicas never share an append cursor),
+and an optional ``ingest`` block (``serving/ingest.py``).  Unlike the
+quality store, the ingest WAL directory is deliberately SHARED across the
+fleet: each replica appends O_APPEND whole lines and follows the log with
+its own cursor in ``interval`` apply mode, so a point posted through any
+replica converges into every replica's model state — the front door can
+round-robin /ingest like any other POST.
 
 Boot order is the contract the supervisor routes on: bind the port with
 ``/readyz`` at 503 first, warm the bucket ladder, THEN flip ready — a
@@ -120,6 +126,28 @@ def main(argv=None) -> None:
                 conf["artifact_dir"], "quality_store",
                 f"replica-{int(conf['port'])}"),
         )
+    ingest = None
+    ingest_conf = conf.get("ingest")
+    if ingest_conf:
+        from distributed_forecasting_tpu.serving.ingest import (
+            build_ingest_runtime,
+        )
+
+        ingest_conf = dict(ingest_conf)
+        if ingest_conf.get("apply_mode") is None:
+            # fleet default: every replica FOLLOWS the shared WAL on an
+            # interval — sync mode would only freshen the replica that
+            # happened to receive the POST
+            ingest_conf["apply_mode"] = "interval"
+        ingest = build_ingest_runtime(
+            ingest_conf,
+            forecaster,
+            quality=quality,
+            default_wal_dir=os.path.join(conf["artifact_dir"], "ingest_wal"),
+        )
+        if ingest is not None:
+            logger.info("streaming ingest: shared WAL at %s (%s mode)",
+                        ingest.wal.directory, ingest.config.apply_mode)
     srv = start_server(
         forecaster,
         host=conf.get("host", "127.0.0.1"),
@@ -128,6 +156,7 @@ def main(argv=None) -> None:
         batching=batching,
         ready=False,  # warm first; the supervisor routes on /readyz
         quality=quality,
+        ingest=ingest,
     )
     sizes = conf.get("warmup_sizes")
     if sizes:

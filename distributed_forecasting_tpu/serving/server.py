@@ -37,9 +37,19 @@ image, and none needed for a single-model scorer):
                               ...]} — ground-truth actuals scored against
                              what this model serves for those dates
                              (``monitoring/quality.py``); 503 when no
-                             quality runtime is configured
+                             quality runtime is configured; with
+                             ``serving.ingest.observe_feeds_ingest`` set,
+                             the same actuals also flow into the WAL so
+                             scoring traffic keeps the model fresh
+  POST /ingest            -> {"points": [{<keys>, "ds"|"d": ..., "y": ...},
+                              ...]} — new observations into the streaming
+                             WAL (``serving/ingest.py``); in sync mode the
+                             response reports the batched state update that
+                             already made /invocations reflect them; 503
+                             when no ingest runtime is configured
   GET  /debug/quality     -> rolling quality + SLO + store snapshot (behind
                              tracing.debug_endpoints, like /debug/trace)
+  GET  /debug/ingest      -> WAL/state-store/refit snapshot (same gate)
 
 ``serve`` blocks; ``start_server`` returns the live server for tests/
 embedding.  Model resolution goes through the registry exactly like the
@@ -199,6 +209,8 @@ class _Handler(BaseHTTPRequestHandler):
             text = self.server.metrics.render()
             if self.server.quality is not None:
                 text += self.server.quality.render_metrics()
+            if self.server.ingest is not None:
+                text += self.server.ingest.render_metrics()
             body = text.encode()
             self.send_response(200)
             self.send_header(
@@ -249,12 +261,22 @@ class _Handler(BaseHTTPRequestHandler):
                                           "(monitoring.quality conf block)"})
                 return
             self._send(200, quality.snapshot())
+        elif parsed.path == "/debug/ingest":
+            ingest = self.server.ingest
+            if ingest is None:
+                self._send(503, {"error": "streaming ingest not enabled "
+                                          "(serving.ingest conf block)"})
+                return
+            self._send(200, ingest.snapshot())
         else:
             self._send(404, {"error": f"no route {parsed.path}"})
 
     def do_POST(self):
         if self.path == "/observe":
             self._observe()
+            return
+        if self.path == "/ingest":
+            self._ingest()
             return
         if self.path not in ("/invocations", "/predict"):
             self._send(404, {"error": f"no route {self.path}"})
@@ -429,6 +451,17 @@ class _Handler(BaseHTTPRequestHandler):
                 summary = quality.observe(
                     pd.DataFrame(observations),
                     on_missing=req.get("on_missing", "skip"))
+                ingest = self.server.ingest
+                if ingest is not None and ingest.config.observe_feeds_ingest:
+                    # the scoring feedback loop doubles as an ingest source:
+                    # actuals flow into the WAL so the model stays fresh
+                    # without a second client integration.  A feed failure
+                    # must not fail the observe — scoring already happened.
+                    try:
+                        summary["ingest"] = ingest.submit(observations)
+                    except Exception:  # noqa: BLE001
+                        self.server.logger.exception(
+                            "observe -> ingest feed failed")
                 self._send(200, summary)
                 root.set_attribute("status", self._status)
         except UnknownSeriesError as e:
@@ -437,6 +470,49 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(400, {"error": f"{type(e).__name__}: {e}"})
         except Exception as e:  # noqa: BLE001 — scorer must not die mid-request
             self.server.logger.exception("observe failed")
+            self._send(500, {"error": f"{type(e).__name__}: {e}"})
+
+    def _ingest(self):
+        """POST /ingest: new observations into the streaming WAL.
+
+        Body: ``{"points": [{<key cols> | "keys": {...}, "ds": "..." or
+        "d": <ordinal>, "y": ...}, ...]}``.  The append is durable before
+        the response; in sync apply mode the response's ``applied`` block
+        means a subsequent /invocations already reflects these points —
+        the always-fresh contract, one batched update dispatch, no refit.
+        """
+        ingest = self.server.ingest
+        if ingest is None:
+            self._send(503, {"error": "streaming ingest not enabled "
+                                      "(serving.ingest conf block)"})
+            return
+        tracer = get_tracer()
+        self._trace_id = _safe_trace_id(self.headers.get("X-Trace-Id"))
+        try:
+            with tracer.root_span(
+                "http.request", trace_id=self._trace_id,
+                method="POST", path="/ingest",
+            ) as root:
+                self._trace_id = root.trace_id or self._trace_id
+                length = int(self.headers.get("Content-Length", "0"))
+                req = json.loads(self.rfile.read(length) or b"{}")
+                if not isinstance(req, dict):
+                    self._send(400, {"error": "body must be a JSON object "
+                                              "with 'points'"})
+                    return
+                points = req.get("points")
+                if not points or not isinstance(points, list):
+                    self._send(400, {"error": "body needs a non-empty "
+                                              "'points' list"})
+                    return
+                out = ingest.submit(points)
+                root.set_attribute("points", len(points))
+                self._send(200, out)
+                root.set_attribute("status", self._status)
+        except (ValueError, TypeError, KeyError, json.JSONDecodeError) as e:
+            self._send(400, {"error": f"{type(e).__name__}: {e}"})
+        except Exception as e:  # noqa: BLE001 — scorer must not die mid-request
+            self.server.logger.exception("ingest failed")
             self._send(500, {"error": f"{type(e).__name__}: {e}"})
 
 
@@ -455,6 +531,7 @@ class ForecastServer(ThreadingHTTPServer):
         model_version: Optional[str] = None,
         batching: Optional[BatchingConfig] = None,
         quality=None,
+        ingest=None,
     ):
         super().__init__(addr, _Handler)
         self.forecaster = forecaster
@@ -470,6 +547,16 @@ class ForecastServer(ThreadingHTTPServer):
         if quality is not None:
             quality.attach_server_metrics(self.metrics)
             quality.start()
+        # the streaming ingest runtime (serving/ingest.IngestRuntime) —
+        # owns the WAL follower + refit scheduler threads; same lifecycle
+        # story as quality: started here, stopped in shutdown()
+        self.ingest = ingest
+        if ingest is not None:
+            ingest.start()
+            self.logger.info(
+                "streaming ingest on: wal_dir=%s apply_mode=%s refit=%s",
+                ingest.wal.directory, ingest.config.apply_mode,
+                "on" if ingest.refit is not None else "off")
         # readiness is an Event, not a guarded flag: it is set exactly once
         # after warmup and cleared at shutdown, and /readyz polls it
         self._ready = threading.Event()
@@ -547,6 +634,10 @@ class ForecastServer(ThreadingHTTPServer):
         self._ready.clear()
         if self.batcher is not None:
             self.batcher.close()
+        if self.ingest is not None:
+            # stop the follower + refit threads; the WAL itself stays on
+            # disk — it is the durable half of the streaming contract
+            self.ingest.stop()
         if self.quality is not None:
             # stop the SLO/scrape threads and flush one final scrape so the
             # on-disk history covers the full process lifetime
@@ -562,13 +653,14 @@ def start_server(
     batching: Optional[BatchingConfig] = None,
     ready: bool = True,
     quality=None,
+    ingest=None,
 ) -> ForecastServer:
     """Start serving on a background thread; returns the server (its
     ``server_address[1]`` is the bound port — port=0 picks a free one).
     ``ready=False`` starts with /readyz at 503 until ``mark_ready()`` —
     for launchers that warm the compile ladder against the live server."""
     srv = ForecastServer((host, port), forecaster, model_version, batching,
-                         quality=quality)
+                         quality=quality, ingest=ingest)
     if ready:
         srv.mark_ready()
     t = threading.Thread(target=srv.serve_forever, daemon=True)
@@ -583,9 +675,10 @@ def serve(
     model_version: Optional[str] = None,
     batching: Optional[BatchingConfig] = None,
     quality=None,
+    ingest=None,
 ) -> None:
     srv = ForecastServer((host, port), forecaster, model_version, batching,
-                         quality=quality)
+                         quality=quality, ingest=ingest)
     srv.mark_ready()
     srv.logger.info("serving on %s:%d", host, port)
     srv.serve_forever()

@@ -32,6 +32,21 @@ Conf::
         debug_endpoints: false  # /debug/trace + /debug/profile?seconds=N
         profile_dir: null     # jax.profiler capture root for /debug/profile
         max_profile_seconds: 60
+      ingest:                 # optional streaming ingest (serving/ingest.py)
+        enabled: true         # default false: POST /ingest returns 503
+        wal_dir: null         # default <env.root>/ingest_wal
+        apply_mode: sync      # sync: apply inline with the POST;
+                              # interval: background WAL follower
+        apply_interval_ms: 200
+        time_bucket: 32       # fitted/predict-grid growth increment
+        observe_feeds_ingest: false  # /observe actuals also enter the WAL
+        max_points_per_request: 10000
+        refit:                # background full-refit scheduler
+          enabled: true       # (serving/refit.py; needs fit-time history,
+          max_applied_points: 5000   # so registry-served artifacts run
+          max_staleness_s: 3600      # incremental-only unless the artifact
+          check_interval_s: 5        # dir carries history.npz)
+          drift_coverage_tol: 0.15
     compile_cache:            # optional persistent compile cache + AOT
       enabled: true           # store (engine/compile_cache): warmup loads
       directory: null         # serialized bucket programs from disk
@@ -101,6 +116,8 @@ class ServeTask(Task):
                 "quality observability on (monitor=%s store=%s slo=%s)",
                 quality.monitor is not None, quality.store is not None,
                 quality.slo is not None)
+        ingest = self._build_ingest(conf.get("ingest"), forecaster,
+                                    version, quality, env)
         sizes = conf.get("warmup_sizes")
         if sizes:
             import time
@@ -135,7 +152,59 @@ class ServeTask(Task):
             model_version=str(version.version),
             batching=batching,
             quality=quality,
+            ingest=ingest,
         )
+
+    def _build_ingest(self, ingest_conf, forecaster, version, quality, env):
+        """``serving.ingest`` conf -> runtime (or None when absent).
+
+        Full refits need the training series, which the registry artifact
+        does not carry — a ``history.npz`` sidecar (arrays ``y``/``mask``,
+        written by whoever registered the model) next to the artifact
+        enables them; without it the refit block is dropped with a warning
+        and the incremental path serves alone.
+        """
+        if not ingest_conf:
+            return None
+        import numpy as np
+
+        from distributed_forecasting_tpu.serving.ingest import (
+            build_ingest_runtime,
+        )
+
+        history_y = history_mask = None
+        for candidate in (
+            os.path.join(version.artifact_dir, "history.npz"),
+            os.path.join(version.artifact_dir, "forecaster", "history.npz"),
+        ):
+            if os.path.exists(candidate):
+                with np.load(candidate) as hist:
+                    history_y = hist["y"]
+                    history_mask = hist["mask"]
+                self.logger.info("training history sidecar: %s", candidate)
+                break
+        ingest_conf = dict(ingest_conf)
+        if history_y is None and (ingest_conf.get("refit") or {}).get(
+                "enabled"):
+            self.logger.warning(
+                "serving.ingest.refit is enabled but the artifact has no "
+                "history.npz sidecar; serving incremental-only")
+            ingest_conf.pop("refit")
+        ingest = build_ingest_runtime(
+            ingest_conf,
+            forecaster,
+            history_y=history_y,
+            history_mask=history_mask,
+            quality=quality,
+            default_wal_dir=os.path.join(
+                env.get("root", "./dftpu_store"), "ingest_wal"),
+        )
+        if ingest is not None:
+            self.logger.info(
+                "streaming ingest on: wal_dir=%s apply_mode=%s refit=%s",
+                ingest.wal.directory, ingest.config.apply_mode,
+                "on" if ingest.refit is not None else "off")
+        return ingest
 
 
 def entrypoint():

@@ -1,0 +1,349 @@
+"""Streaming ingest bench: freshness proof + staleness-vs-ingest-rate curve.
+
+Two jobs in one driver, both against a REAL in-process serving stack
+(``serving/server.py`` + ``serving/ingest.py`` over HTTP, tracing on):
+
+**Smoke** (``--smoke``, the CI gate) proves the always-fresh contract
+end to end:
+
+  1. POST /ingest with new points -> the response's ``applied`` block
+     shows ONE batched state update, and a /invocations forecast differs
+     from the pre-ingest baseline WITHOUT any full refit;
+  2. repeated single-point ingests hit the AOT executable store (the
+     update kernel compiles once per (family, K-bucket), then reloads);
+  3. a full refit through the background scheduler converges: the swap
+     lands, /invocations still answers, and the refit counter ticks;
+  4. the trace export carries the streaming span kinds
+     (``ingest.append`` / ``state.update`` / ``refit.swap``) and
+     GET /metrics carries the ``dftpu_ingest_*`` family;
+  5. a short open-loop sweep completes with ZERO failed requests.
+
+**Sweep** (default) drives open-loop ingest at each ``--rates`` level for
+``--duration`` seconds — points are scheduled on the wall clock and sent
+regardless of completion, so a saturated server shows up as queueing
+delay, not a slower driver — and reports per-rate staleness percentiles
+(POST scheduled -> forecast fresh) as one JSON object::
+
+    {"report": "bench_streaming", "rates": [
+        {"rate": 25.0, "sent": 50, "failed": 0,
+         "staleness_ms": {"p50": ..., "p95": ..., "max": ...}}, ...]}
+
+Run::
+
+    python scripts/bench_streaming.py --smoke
+    python scripts/bench_streaming.py --rates 5 25 100 --duration 4 \\
+        --json-out /tmp/streaming_curve.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import os
+import shutil
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _post(port: int, path: str, payload: dict, timeout: float = 60.0):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("POST", path, json.dumps(payload),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read() or b"{}")
+    finally:
+        conn.close()
+
+
+def _get(port: int, path: str, timeout: float = 10.0):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, resp.read().decode()
+    finally:
+        conn.close()
+
+
+def _percentile(sorted_vals, q):
+    if not sorted_vals:
+        return None
+    i = min(int(q * len(sorted_vals)), len(sorted_vals) - 1)
+    return round(sorted_vals[i], 3)
+
+
+class _Stack:
+    """Fit a small theta model and serve it with streaming ingest on."""
+
+    def __init__(self, workdir: str, refit_conf=None, series: int = 4,
+                 days: int = 160):
+        import numpy as np
+
+        from distributed_forecasting_tpu.data import (
+            synthetic_store_item_sales,
+            tensorize,
+        )
+        from distributed_forecasting_tpu.engine import fit_forecast
+        from distributed_forecasting_tpu.models import ThetaConfig
+        from distributed_forecasting_tpu.serving import BatchForecaster
+        from distributed_forecasting_tpu.serving.ingest import (
+            build_ingest_runtime,
+        )
+        from distributed_forecasting_tpu.serving.server import start_server
+
+        df = synthetic_store_item_sales(
+            n_stores=2, n_items=max(series // 2, 1), n_days=days, seed=11)
+        batch = tensorize(df)
+        cfg = ThetaConfig()
+        params, _ = fit_forecast(batch, model="theta", config=cfg, horizon=14)
+        self.fc = BatchForecaster.from_fit(batch, params, "theta", cfg)
+        self.keys = [dict(zip(self.fc.key_names, k))
+                     for k in self.fc.keys.tolist()]
+        self.day1_fit = int(self.fc.day1)
+        self.ingest = build_ingest_runtime(
+            {"enabled": True,
+             "wal_dir": os.path.join(workdir, "ingest_wal"),
+             "apply_mode": "sync", "time_bucket": 64,
+             **({"refit": refit_conf} if refit_conf else {})},
+            self.fc,
+            history_y=np.asarray(batch.y),
+            history_mask=np.asarray(batch.mask),
+        )
+        self.srv = start_server(self.fc, port=0, ingest=self.ingest)
+        self.port = self.srv.server_address[1]
+
+    def predict_one(self, horizon: int = 7):
+        status, body = _post(self.port, "/invocations",
+                             {"inputs": [self.keys[0]], "horizon": horizon})
+        assert status == 200, body
+        return [p["yhat"] for p in body["predictions"]]
+
+    def close(self):
+        self.srv.shutdown()
+        self.srv.server_close()
+
+
+def run_sweep(stack: _Stack, rates, duration: float) -> list:
+    """Open-loop driver: one point per tick, day advancing per full pass
+    over the series set; staleness = scheduled send time -> fresh."""
+    out = []
+    day = [stack.ingest.store.day_cur]  # shared frontier across rates
+    for rate in rates:
+        n = max(int(rate * duration), 1)
+        interval = 1.0 / rate
+        results = []  # (ok, staleness_s)
+        lock = threading.Lock()
+        t0 = time.monotonic() + 0.05
+
+        def fire(i, sched):
+            wait = sched - time.monotonic()
+            if wait > 0:
+                time.sleep(wait)
+            key = stack.keys[i % len(stack.keys)]
+            if i % len(stack.keys) == 0:
+                day[0] += 1
+            status, _ = _post(
+                stack.port, "/ingest",
+                {"points": [{**key, "d": day[0],
+                             "y": 20.0 + (i % 7)}]})
+            done = time.monotonic()
+            with lock:
+                results.append((status == 200, done - sched))
+
+        threads = [threading.Thread(target=fire, args=(i, t0 + i * interval))
+                   for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        lat = sorted(s for ok, s in results if ok)
+        failed = sum(1 for ok, _ in results if not ok)
+        out.append({
+            "rate": float(rate),
+            "sent": n,
+            "failed": failed,
+            "staleness_ms": {
+                "p50": _percentile([1e3 * s for s in lat], 0.50),
+                "p95": _percentile([1e3 * s for s in lat], 0.95),
+                "max": round(1e3 * lat[-1], 3) if lat else None,
+            },
+        })
+    return out
+
+
+def run_smoke(workdir: str) -> list:
+    """The CI freshness proof; returns a list of failure strings."""
+    from distributed_forecasting_tpu.engine.compile_cache import (
+        cache_stats,
+        enable_from_env,
+    )
+    from distributed_forecasting_tpu.monitoring.trace import (
+        TraceConfig,
+        configure_tracing,
+    )
+
+    # AOT store on: the second same-shape update dispatch must be a cache
+    # hit, which is the "no recompile on the hot path" half of the claim
+    os.environ["DFTPU_COMPILE_CACHE"] = os.path.join(workdir, "aot")
+    enable_from_env()
+    trace_path = os.path.join(workdir, "trace.jsonl")
+    configure_tracing(TraceConfig.from_conf(
+        {"enabled": True, "jsonl_path": trace_path}))
+
+    failures = []
+    stack = _Stack(workdir, refit_conf={
+        "enabled": True, "max_applied_points": 100000,
+        "max_staleness_s": 100000.0, "check_interval_s": 0.2})
+    try:
+        baseline = stack.predict_one()
+
+        # 1. burst ingest: 3 new days for every series, ONE update dispatch
+        points = []
+        for off in range(1, 4):
+            for key in stack.keys:
+                points.append({**key, "d": stack.day1_fit + off,
+                               "y": 30.0 + off})
+        status, body = _post(stack.port, "/ingest", {"points": points})
+        if status != 200 or body.get("written") != len(points):
+            failures.append(f"/ingest burst failed: {status} {body}")
+        applied = body.get("applied", {})
+        if applied.get("days") != 3 or applied.get("points") != len(points):
+            failures.append(f"expected one 3-day batched apply, got {applied}")
+        fresh = stack.predict_one()
+        if fresh == baseline:
+            failures.append("forecast unchanged after ingest — not fresh")
+        if stack.fc.day1 != stack.day1_fit + 3:
+            failures.append(f"day1 did not advance: {stack.fc.day1}")
+        if stack.ingest.store.stats()["applied_since_refit"] != len(points):
+            failures.append("refit backlog did not count applied points")
+
+        # 2. repeated single-point ingests: the first compiles the K=1
+        # update program into the AOT store, the second reuses it — the
+        # steady-state path must not compile (misses stay flat; the trace
+        # check below confirms the reuse outcome on the aot.call span)
+        status, body = _post(
+            stack.port, "/ingest",
+            {"points": [{**stack.keys[0], "d": stack.day1_fit + 4,
+                         "y": 25.0}]})
+        if status != 200:
+            failures.append(f"single-point ingest failed: {status} {body}")
+        misses_before = cache_stats()["misses"]
+        status, body = _post(
+            stack.port, "/ingest",
+            {"points": [{**stack.keys[0], "d": stack.day1_fit + 5,
+                         "y": 25.0}]})
+        if status != 200:
+            failures.append(f"single-point ingest failed: {status} {body}")
+        if cache_stats()["misses"] != misses_before:
+            failures.append(
+                "same-shape update dispatch recompiled instead of reusing "
+                f"the AOT entry: {cache_stats()}")
+
+        # 3. full refit through the scheduler converges
+        refits_before = stack.ingest.refit._refits_done
+        stack.ingest.refit.maybe_refit(force=True)
+        stack.ingest.refit.wait(timeout=300)
+        if stack.ingest.refit._refits_done != refits_before + 1:
+            failures.append("refit did not complete")
+        post_refit = stack.predict_one()
+        if not all(isinstance(v, float) for v in post_refit):
+            failures.append(f"post-refit forecast not finite: {post_refit}")
+        if stack.ingest.store.stats()["applied_since_refit"] != 0:
+            failures.append("refit did not reset the applied backlog")
+
+        # 4. metrics exposition
+        _, metrics = _get(stack.port, "/metrics")
+        for needle in ("dftpu_ingest_points_total",
+                       "dftpu_ingest_applied_points_total",
+                       "dftpu_ingest_refits_total 1",
+                       "dftpu_ingest_applied_day"):
+            if needle not in metrics:
+                failures.append(f"{needle} missing from /metrics")
+
+        # 5. short open-loop sweep, zero failed requests
+        curve = run_sweep(stack, rates=(5.0, 25.0), duration=1.5)
+        for row in curve:
+            if row["failed"]:
+                failures.append(f"sweep had failed requests: {row}")
+        print(json.dumps({"report": "bench_streaming_smoke_curve",
+                          "rates": curve}))
+    finally:
+        stack.close()
+
+    # 6. the trace export carries the streaming span kinds, and the
+    # update-kernel aot.call spans show program REUSE (memo/hit), which is
+    # the span-level form of the no-recompile assertion in step 2
+    spans = []
+    with open(trace_path) as f:
+        for ln in f:
+            if ln.strip():
+                spans.append(json.loads(ln))
+    names = {s.get("name") for s in spans}
+    for kind in ("ingest.append", "state.update", "refit.swap"):
+        if kind not in names:
+            failures.append(f"span kind {kind!r} missing from trace export")
+    reused = [
+        s for s in spans
+        if s.get("name") == "aot.call"
+        and str((s.get("attrs") or {}).get("entry", "")).startswith(
+            "state_update:")
+        and (s.get("attrs") or {}).get("outcome") in ("memo", "hit")
+    ]
+    if not reused:
+        failures.append("no reused (memo/hit) aot.call span for the "
+                        "state-update kernel in the trace export")
+    return failures
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--workdir", default="/tmp/bench_streaming")
+    ap.add_argument("--smoke", action="store_true",
+                    help="run the CI freshness proof instead of a full sweep")
+    ap.add_argument("--rates", type=float, nargs="+",
+                    default=[5.0, 25.0, 100.0],
+                    help="open-loop ingest rates (points/s)")
+    ap.add_argument("--duration", type=float, default=4.0,
+                    help="seconds per rate level")
+    ap.add_argument("--json-out", default=None,
+                    help="also write the JSON report to this path")
+    args = ap.parse_args()
+
+    if os.path.exists(args.workdir):
+        shutil.rmtree(args.workdir)
+    os.makedirs(args.workdir)
+
+    if args.smoke:
+        failures = run_smoke(args.workdir)
+        if failures:
+            for f in failures:
+                print("FAIL:", f, file=sys.stderr)
+            sys.exit(1)
+        print("streaming smoke ok")
+        return
+
+    stack = _Stack(args.workdir)
+    try:
+        curve = run_sweep(stack, args.rates, args.duration)
+    finally:
+        stack.close()
+    report = {"report": "bench_streaming", "model": "theta",
+              "series": len(stack.keys), "duration_s": args.duration,
+              "rates": curve}
+    text = json.dumps(report)
+    print(text)
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            f.write(text + "\n")
+    if any(r["failed"] for r in curve):
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -173,6 +173,35 @@ def quality_rollup(spans: List[Dict]) -> List[Dict]:
     return sorted(rows.values(), key=lambda r: -r["total_ms"])
 
 
+#: the streaming ingest span kinds (serving/ingest + engine/state_store):
+#: WAL append -> batched state update -> background refit swap
+_STREAMING_PREFIXES = ("ingest.", "state.", "refit.")
+
+
+def streaming_rollup(spans: List[Dict]) -> List[Dict]:
+    """Per-kind rollup of the streaming path's spans (``ingest.append``,
+    ``state.update``, ``refit.swap``): counts, total wall time, and the
+    points/series volume they carried — the slice that answers "where does
+    an ingested point spend its time before the forecast is fresh"."""
+    rows: Dict[str, Dict] = {}
+    for s in spans:
+        name = str(s["name"])
+        if not name.startswith(_STREAMING_PREFIXES):
+            continue
+        r = rows.setdefault(name, {"kind": name, "count": 0,
+                                   "total_ms": 0.0, "points": 0,
+                                   "series": 0})
+        r["count"] += 1
+        r["total_ms"] = round(r["total_ms"] + float(s["duration_ms"]), 3)
+        attrs = s.get("attrs") or {}
+        try:
+            r["points"] += int(attrs.get("points", 0))
+            r["series"] += int(attrs.get("series", 0))
+        except (TypeError, ValueError):
+            pass
+    return sorted(rows.values(), key=lambda r: -r["total_ms"])
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("paths", nargs="+",
@@ -207,6 +236,9 @@ def main() -> None:
     quality = quality_rollup(spans)
     if quality:
         report["quality"] = quality
+    streaming = streaming_rollup(spans)
+    if streaming:
+        report["streaming"] = streaming
     if args.trace:
         path_spans = critical_path(spans, args.trace)
         if not path_spans:

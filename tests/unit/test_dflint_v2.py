@@ -835,3 +835,121 @@ def test_store_snapshot_then_write_negative(tmp_path):
     """)
     found = _lint(tmp_path, "monitoring/qstore.py")
     assert "blocking-under-lock" not in _rules(found)
+
+
+# ---------------------------------------------------------------------------
+# ISSUE-9 fixtures: the serving.ingest conf block + the ingest WAL's
+# never-block-under-the-state-lock append discipline
+# ---------------------------------------------------------------------------
+
+def test_ingest_conf_block_drift_positive_and_negative(tmp_path):
+    # mirrors conf/tasks/serve_config.yml's serving.ingest block: a typo'd
+    # apply key is spellable from YAML but no IngestConfig field consumes
+    # it -> drift; every real key lands on a field
+    _write(tmp_path, "conf/serve.yml", """
+        serving:
+          ingest:
+            enabled: false
+            wal_dir: null
+            apply_mode: sync
+            aply_interval_ms: 200
+            time_bucket: 32
+    """)
+    _write(tmp_path, "src/ingest_cfg.py", """
+        import dataclasses
+
+        @dataclasses.dataclass(frozen=True)
+        class IngestConfig:
+            enabled: bool = False
+            wal_dir: str = ""
+            apply_mode: str = "sync"
+            apply_interval_ms: float = 200.0
+            time_bucket: int = 32
+
+            @classmethod
+            def from_conf(cls, conf):
+                block = conf.get("serving", {}).get("ingest", {})
+                known = {f.name for f in dataclasses.fields(cls)}
+                return cls(**{k: v for k, v in block.items() if k in known})
+    """)
+    found = _lint(tmp_path, "src/ingest_cfg.py")
+    assert [f.rule for f in found] == ["config-drift"]
+    assert "aply_interval_ms" in found[0].message
+    assert found[0].path == "conf/serve.yml"
+
+    # fixing the typo makes the block clean
+    _write(tmp_path, "conf/serve.yml", """
+        serving:
+          ingest:
+            enabled: false
+            wal_dir: null
+            apply_mode: sync
+            apply_interval_ms: 200
+            time_bucket: 32
+    """)
+    assert _lint(tmp_path, "src/ingest_cfg.py") == []
+
+
+def test_wal_append_under_state_lock_positive(tmp_path):
+    # the anti-pattern the ingest WAL must avoid: holding the segment lock
+    # across the O_APPEND write — every concurrent POST /ingest would
+    # serialize behind disk latency, defeating the append-only design
+    _write(tmp_path, "serving/wal.py", """
+        import os
+        import threading
+
+        class WriteAheadLog:
+            def __init__(self, path):
+                self._lock = threading.Lock()
+                self._path = path
+                self._bytes = 0
+
+            def append(self, payload):
+                with self._lock:
+                    self._bytes += len(payload)
+                    with open(self._path, "a") as fh:
+                        fh.write(payload)
+    """)
+    found = _lint(tmp_path, "serving/wal.py")
+    assert "blocking-under-lock" in _rules(found)
+
+
+def test_wal_append_snapshot_then_write_negative(tmp_path):
+    # the shape serving/ingest.py actually uses: segment-cursor bookkeeping
+    # under the lock, the O_APPEND write OUTSIDE it; the follower poll
+    # holds a capacity-1 SEMAPHORE (a limiter, exempt by design) across
+    # its file read + device dispatch
+    _write(tmp_path, "serving/wal.py", """
+        import os
+        import threading
+
+        class WriteAheadLog:
+            def __init__(self, path):
+                self._lock = threading.Lock()
+                self._path = path
+                self._bytes = 0
+
+            def append(self, payload):
+                with self._lock:
+                    self._bytes += len(payload)
+                    path = self._path
+                fd = os.open(path, os.O_WRONLY | os.O_APPEND)
+                try:
+                    os.write(fd, payload)
+                finally:
+                    os.close(fd)
+
+        class Follower:
+            def __init__(self, wal, apply_fn):
+                self._wal = wal
+                self._apply = apply_fn
+                self._gate = threading.BoundedSemaphore(1)
+
+            def poll(self):
+                with self._gate:
+                    with open(self._wal._path) as fh:
+                        lines = fh.readlines()
+                    self._apply(lines)
+    """)
+    found = _lint(tmp_path, "serving/wal.py")
+    assert "blocking-under-lock" not in _rules(found)

@@ -1,0 +1,726 @@
+"""Streaming ingest subsystem: WAL, state store, runtime, refit, serving.
+
+The pieces under test, bottom-up:
+
+- ``serving/ingest.WriteAheadLog``: segment roll, the torn-line-tolerant
+  follower cursor, foreign-garbage resilience (the monitoring/store
+  machinery reused for ingest records);
+- ``engine/state_store.SeriesStateStore``: point routing (pending / late
+  / rejected), the ONE-batched-dispatch apply, and time-bucket growth of
+  the fitted/history buffers across a bucket boundary — bitwise equal to
+  a genuine pinned-grid full refit of the extended series;
+- ``serving/ingest.IngestRuntime``: record-shape parsing, strict conf,
+  sync-mode freshness, and two followers converging through one shared
+  WAL (the fleet story in miniature);
+- ``serving/refit.RefitScheduler``: backlog / staleness / coverage-drift
+  triggers and the forced refit's atomic swap + backlog reset;
+- the HTTP surface: POST /ingest -> /invocations is fresh without a full
+  refit, /metrics carries dftpu_ingest_*, /debug/ingest snapshots, and
+  POST /observe can feed the WAL;
+- the fleet merge: shared-WAL gauges max across replicas, counters sum.
+
+Numeric exactness of the update kernels themselves is test_state_update's
+job; here the claims are about the plumbing that carries them.
+"""
+
+import importlib.util
+import json
+import os
+import time
+import types
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from distributed_forecasting_tpu.engine.state_store import (
+    SeriesStateStore,
+    time_cap,
+)
+from distributed_forecasting_tpu.serving.ingest import (
+    IngestConfig,
+    IngestRuntime,
+    WriteAheadLog,
+    build_ingest_runtime,
+)
+from distributed_forecasting_tpu.serving.refit import (
+    RefitConfig,
+    RefitScheduler,
+)
+
+REPO = Path(__file__).resolve().parents[2]
+
+
+# ---------------------------------------------------------------------------
+# shared artifact: one theta fit, fresh forecaster views per test (the
+# state store installs live state INTO its forecaster, so tests must not
+# share one)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def theta_fit():
+    import jax.numpy as jnp  # noqa: F401 — ensure jax is importable here
+
+    from distributed_forecasting_tpu.data import (
+        synthetic_store_item_sales,
+        tensorize,
+    )
+    from distributed_forecasting_tpu.models import ThetaConfig
+    from distributed_forecasting_tpu.models.base import get_model
+
+    df = synthetic_store_item_sales(n_stores=2, n_items=2, n_days=120,
+                                    seed=13)
+    batch = tensorize(df)
+    cfg = ThetaConfig()
+    params = get_model("theta").fit(batch.y, batch.mask, batch.day, cfg)
+    return batch, params, cfg
+
+
+def _fresh_fc(theta_fit):
+    from distributed_forecasting_tpu.serving import BatchForecaster
+
+    batch, params, cfg = theta_fit
+    return BatchForecaster.from_fit(batch, params, "theta", cfg)
+
+
+def _history(theta_fit):
+    batch, _, _ = theta_fit
+    return np.asarray(batch.y), np.asarray(batch.mask)
+
+
+def _all_keys(fc):
+    return [dict(zip(fc.key_names, map(int, row))) for row in fc.keys]
+
+
+# ---------------------------------------------------------------------------
+# conf parsing
+# ---------------------------------------------------------------------------
+
+def test_ingest_config_strict_parse():
+    cfg = IngestConfig.from_conf({
+        "enabled": True, "apply_mode": "interval", "time_bucket": 64,
+        "refit": {"enabled": True, "max_applied_points": 10},
+    })
+    assert cfg.enabled and cfg.apply_mode == "interval"
+    assert cfg.time_bucket == 64
+    assert cfg.refit == {"enabled": True, "max_applied_points": 10}
+    # None values fall through to defaults (YAML null)
+    assert not IngestConfig.from_conf({"enabled": None}).enabled
+
+    with pytest.raises(ValueError, match="serving.ingest.*aply_mode"):
+        IngestConfig.from_conf({"aply_mode": "sync"})
+    with pytest.raises(ValueError, match="apply_mode"):
+        IngestConfig.from_conf({"apply_mode": "eventually"})
+    with pytest.raises(ValueError, match="apply_interval_ms"):
+        IngestConfig.from_conf({"apply_interval_ms": 0})
+    with pytest.raises(ValueError, match="time_bucket"):
+        IngestConfig.from_conf({"time_bucket": 0})
+    with pytest.raises(ValueError, match="max_points_per_request"):
+        IngestConfig.from_conf({"max_points_per_request": 0})
+
+
+def test_refit_config_strict_parse():
+    cfg = RefitConfig.from_conf({"enabled": True, "max_applied_points": 7})
+    assert cfg.enabled and cfg.max_applied_points == 7
+    with pytest.raises(ValueError, match="serving.ingest.refit"):
+        RefitConfig.from_conf({"max_stalenes_s": 10})
+    with pytest.raises(ValueError, match="max_staleness_s"):
+        RefitConfig.from_conf({"max_staleness_s": 0})
+
+
+def test_shipped_conf_block_parses():
+    """The committed serve_config.yml ingest block must parse through the
+    strict loaders — the config-drift guard in executable form."""
+    import yaml
+
+    with open(REPO / "conf" / "tasks" / "serve_config.yml") as fh:
+        conf = yaml.safe_load(fh)
+    block = conf["serving"]["ingest"]
+    cfg = IngestConfig.from_conf(block)
+    assert not cfg.enabled  # shipped off by default
+    rcfg = RefitConfig.from_conf(block["refit"])
+    assert not rcfg.enabled
+
+
+def test_build_runtime_gating(tmp_path, theta_fit):
+    assert build_ingest_runtime(None, None) is None
+    assert build_ingest_runtime({"enabled": False}, None) is None
+    with pytest.raises(ValueError, match="wal_dir"):
+        build_ingest_runtime({"enabled": True}, _fresh_fc(theta_fit))
+    # refit without history is a loud misconfiguration, not a silent no-op
+    with pytest.raises(ValueError, match="history"):
+        build_ingest_runtime(
+            {"enabled": True, "wal_dir": str(tmp_path / "w"),
+             "refit": {"enabled": True}},
+            _fresh_fc(theta_fit))
+
+
+# ---------------------------------------------------------------------------
+# the WAL
+# ---------------------------------------------------------------------------
+
+def test_wal_roll_and_follower_cursor(tmp_path):
+    wal = WriteAheadLog(str(tmp_path / "wal"), max_segment_bytes=256)
+    recs = [{"k": [1, i], "d": 100 + i, "y": float(i)} for i in range(20)]
+    for r in recs:
+        wal.append([r])
+    stats = wal.stats()
+    assert stats["segments"] > 1          # rolled past 256 bytes
+    assert stats["bytes"] > 256
+
+    got, cursor = wal.read_new()
+    assert got == recs                    # in order, across segments
+    # incremental: nothing new at the same cursor, new lines appear after
+    again, cursor = wal.read_new(cursor)
+    assert again == []
+    wal.append([{"k": [1, 99], "d": 200, "y": 1.5}])
+    tail, cursor = wal.read_new(cursor)
+    assert tail == [{"k": [1, 99], "d": 200, "y": 1.5}]
+
+    # a new WAL over the same directory resumes the segment counter
+    wal2 = WriteAheadLog(str(tmp_path / "wal"), max_segment_bytes=256)
+    wal2.append([{"k": [2, 1], "d": 201, "y": 2.0}])
+    assert wal2.stats()["segments"] == stats["segments"]
+
+
+def test_wal_torn_line_and_garbage(tmp_path):
+    from distributed_forecasting_tpu.monitoring.store import segment_path
+
+    wal = WriteAheadLog(str(tmp_path / "wal"))
+    wal.append([{"k": [1, 1], "d": 100, "y": 1.0}])
+    seg = segment_path(wal.directory, 0)
+    # a torn write: a record cut mid-line must be invisible to followers
+    with open(seg, "a") as fh:
+        fh.write('{"k":[1,2],"d":10')
+    got, cursor = wal.read_new()
+    assert got == [{"k": [1, 1], "d": 100, "y": 1.0}]
+    # completing the line makes it visible at the SAME cursor — no loss
+    with open(seg, "a") as fh:
+        fh.write('1,"y":2.0}\n')
+    got, cursor = wal.read_new(cursor)
+    assert got == [{"k": [1, 2], "d": 101, "y": 2.0}]
+    # a foreign garbage line is skipped, not fatal, and later records flow
+    with open(seg, "a") as fh:
+        fh.write("not json at all\n")
+    wal.append([{"k": [1, 3], "d": 102, "y": 3.0}])
+    got, cursor = wal.read_new(cursor)
+    assert got == [{"k": [1, 3], "d": 102, "y": 3.0}]
+
+
+# ---------------------------------------------------------------------------
+# the state store
+# ---------------------------------------------------------------------------
+
+def test_state_store_requires_streaming_family():
+    fake = types.SimpleNamespace(model="prophet")
+    with pytest.raises(ValueError, match="holt_winters, theta, and croston"):
+        SeriesStateStore(fake)
+
+
+def test_state_store_routes_late_and_rejected(theta_fit):
+    fc = _fresh_fc(theta_fit)
+    y, mask = _history(theta_fit)
+    store = SeriesStateStore(fc, time_bucket=16, history_y=y,
+                             history_mask=mask)
+    day1 = store.day_cur
+    routed = store.ingest([
+        (0, day1 + 1, 5.0),          # future -> pending
+        (1, day1, 6.0),              # inside the applied window -> late
+        (0, store.day0 - 10, 7.0),   # before the training grid -> rejected
+    ])
+    assert routed == {"accepted": 1, "late": 1, "rejected": 1}
+    st = store.stats()
+    assert st["pending_points"] == 1 and st["late_points"] == 1
+    # the late point landed in the history buffer for the next refit
+    assert store._y[1, day1 - store.day0] == 6.0
+    assert store._mask[1, day1 - store.day0] == 1.0
+    # last write wins per (series, day)
+    store.ingest([(0, day1 + 1, 9.0)])
+    assert store.stats()["pending_points"] == 1
+    out = store.apply_pending()
+    assert out == {"days": 1, "points": 1}
+    assert store.day_cur == day1 + 1
+    assert fc.day1 == day1 + 1
+    # empty apply is a cheap no-op
+    assert store.apply_pending() == {"days": 0, "points": 0}
+
+
+def test_gap_days_are_masked_columns(theta_fit):
+    """A point 3 days ahead applies days +1..+3 as columns; the gap days
+    carry mask 0 — the same rows an extended contiguous refit grid has."""
+    fc = _fresh_fc(theta_fit)
+    store = SeriesStateStore(fc, time_bucket=16)
+    day1 = store.day_cur
+    store.ingest([(2, day1 + 3, 42.0)])
+    out = store.apply_pending()
+    assert out == {"days": 3, "points": 1}
+    assert store.day_cur == day1 + 3 and fc.day1 == day1 + 3
+
+
+def test_bucket_boundary_growth_bitwise_vs_refit():
+    """Streaming across a time-bucket boundary grows the fitted buffer and
+    stays BITWISE equal to a genuine pinned-grid full refit of the
+    extended series — the growth path adds no arithmetic."""
+    import jax.numpy as jnp
+
+    from distributed_forecasting_tpu.data import (
+        synthetic_store_item_sales,
+        tensorize,
+    )
+    from distributed_forecasting_tpu.models import HoltWintersConfig
+    from distributed_forecasting_tpu.models.base import get_model
+    from distributed_forecasting_tpu.serving import BatchForecaster
+
+    df = synthetic_store_item_sales(n_stores=1, n_items=3, n_days=70,
+                                    seed=7)
+    batch = tensorize(df)
+    # one grid candidate: the extended refit cannot pick different
+    # hyperparameters, so the comparison is pure-recursion vs recursion
+    cfg = HoltWintersConfig(n_alpha=1, n_beta=1, n_gamma=1, damped=False,
+                            filter="scan")
+    fns = get_model("holt_winters")
+    params = fns.fit(batch.y, batch.mask, batch.day, cfg)
+    fc = BatchForecaster.from_fit(batch, params, "holt_winters", cfg)
+
+    bucket, t0 = 8, batch.n_time
+    store = SeriesStateStore(fc, time_bucket=bucket,
+                             history_y=np.asarray(batch.y),
+                             history_mask=np.asarray(batch.mask))
+    cap0 = time_cap(t0, bucket)
+    assert store._params.fitted.shape[1] == cap0
+
+    k = (cap0 - t0) + 3                   # lands 3 columns past the cap
+    day1 = store.day_cur
+    rng = np.random.default_rng(8)
+    y_new = (50 + rng.normal(0, 2, (batch.y.shape[0], k))).astype(np.float32)
+    store.ingest([(s, day1 + 1 + j, float(y_new[s, j]))
+                  for s in range(batch.y.shape[0]) for j in range(k)])
+    out = store.apply_pending()
+    assert out["days"] == k
+
+    cap1 = time_cap(t0 + k, bucket)
+    assert cap1 > cap0
+    assert store._params.fitted.shape[1] == cap1   # grew one bucket
+    assert store._y.shape[1] == cap1               # history grew with it
+
+    day_ext = jnp.concatenate([
+        batch.day,
+        jnp.arange(day1 + 1, day1 + 1 + k, dtype=batch.day.dtype)])
+    y_ext = jnp.concatenate([batch.y, jnp.asarray(y_new)], axis=1)
+    m_ext = jnp.concatenate(
+        [batch.mask, jnp.ones((batch.y.shape[0], k), batch.mask.dtype)],
+        axis=1)
+    ref = fns.fit(y_ext, m_ext, day_ext, cfg)
+    got = store._params
+    np.testing.assert_array_equal(np.asarray(got.level),
+                                  np.asarray(ref.level))
+    np.testing.assert_array_equal(np.asarray(got.trend),
+                                  np.asarray(ref.trend))
+    np.testing.assert_array_equal(np.asarray(got.season),
+                                  np.asarray(ref.season))
+    np.testing.assert_array_equal(np.asarray(got.fitted[:, :t0 + k]),
+                                  np.asarray(ref.fitted))
+    assert not np.any(np.asarray(got.fitted[:, t0 + k:]))  # padding stays 0
+    # and the served grid followed: predictions start after the new day1
+    req = pd.DataFrame(fc.keys[:1], columns=list(fc.key_names))
+    pred = fc.predict(req, horizon=5)
+    epoch = pd.Timestamp("1970-01-01")
+    assert pred.ds.min() == epoch + pd.Timedelta(days=int(fc.day1) + 1)
+    assert np.isfinite(pred.yhat).all()
+
+
+# ---------------------------------------------------------------------------
+# the runtime
+# ---------------------------------------------------------------------------
+
+def test_runtime_parses_every_record_shape(tmp_path, theta_fit):
+    fc = _fresh_fc(theta_fit)
+    rt = build_ingest_runtime(
+        {"enabled": True, "wal_dir": str(tmp_path / "wal"),
+         "apply_mode": "interval", "time_bucket": 16}, fc)
+    day = int(fc.day1) + 1
+    ds = (pd.Timestamp("1970-01-01")
+          + pd.Timedelta(days=day)).strftime("%Y-%m-%d")
+    key = dict(zip(fc.key_names, map(int, fc.keys[0])))
+    flat = {**key, "d": day, "y": 1.0}
+    keyed = {"keys": key, "d": day, "y": 2.0}
+    listed = {"k": [int(v) for v in fc.keys[0]], "d": day, "y": 3.0}
+    dated = {**key, "ds": ds, "y": 4.0}
+    out = rt.submit([flat, keyed, listed, dated])
+    assert out == {"written": 4, "unknown_series": 0, "malformed": 0}
+
+    bad = rt.submit([
+        {"store": 999, "item": 999, "d": day, "y": 1.0},   # unknown key
+        {**key, "d": day},                                 # no y
+        {**key, "d": day, "y": float("nan")},              # non-finite
+        {"k": [1], "d": day, "y": 1.0},                    # key arity
+        {"y": 1.0},                                        # no key at all
+    ])
+    assert bad == {"written": 0, "unknown_series": 1, "malformed": 4}
+
+    with pytest.raises(ValueError, match="max_points_per_request"):
+        rt.submit([flat] * 10001)
+
+
+def test_sync_submit_freshens_forecast(tmp_path, theta_fit):
+    fc = _fresh_fc(theta_fit)
+    rt = build_ingest_runtime(
+        {"enabled": True, "wal_dir": str(tmp_path / "wal"),
+         "apply_mode": "sync", "time_bucket": 16}, fc)
+    req = pd.DataFrame(fc.keys[:1], columns=list(fc.key_names))
+    before = fc.predict(req, horizon=7)
+    day1 = int(fc.day1)
+
+    key = dict(zip(fc.key_names, map(int, fc.keys[0])))
+    out = rt.submit([{**key, "d": day1 + 1, "y": 500.0}])
+    assert out["written"] == 1
+    assert out["applied"]["days"] == 1 and out["applied"]["points"] == 1
+
+    after = fc.predict(req, horizon=7)
+    assert int(fc.day1) == day1 + 1
+    assert after.ds.min() > before.ds.min()
+    # a 500 against a ~50-level series must move the forecast
+    assert not np.allclose(before.yhat.to_numpy()[1:],
+                           after.yhat.to_numpy()[:-1])
+    snap = rt.snapshot()
+    assert snap["apply_mode"] == "sync"
+    assert snap["store"]["day_cur"] == day1 + 1
+    text = rt.render_metrics()
+    assert "dftpu_ingest_points_total 1" in text
+    assert f"dftpu_ingest_applied_day {day1 + 1}\n" in text
+
+
+def test_two_followers_converge_through_shared_wal(tmp_path, theta_fit):
+    """The fleet story in miniature: two replicas (two forecasters, two
+    runtimes, two cursors) sharing one WAL directory converge to the same
+    applied frontier and identical forecasts."""
+    wal_dir = str(tmp_path / "shared_wal")
+    fc_a, fc_b = _fresh_fc(theta_fit), _fresh_fc(theta_fit)
+    conf = {"enabled": True, "wal_dir": wal_dir, "apply_mode": "interval",
+            "time_bucket": 16}
+    rt_a = build_ingest_runtime(conf, fc_a)
+    rt_b = build_ingest_runtime(conf, fc_b)
+
+    day1 = int(fc_a.day1)
+    points = [{"k": [int(v) for v in row], "d": day1 + 1 + (i % 2),
+               "y": 100.0 + i}
+              for i, row in enumerate(fc_a.keys.tolist())]
+    out = rt_a.submit(points)            # interval mode: append only
+    assert out["written"] == len(points) and "applied" not in out
+    assert int(fc_a.day1) == day1       # not yet applied anywhere
+
+    applied_a = rt_a.poll_apply()
+    applied_b = rt_b.poll_apply()
+    assert applied_a["days"] == applied_b["days"] == 2
+    assert int(fc_a.day1) == int(fc_b.day1) == day1 + 2
+
+    req = pd.DataFrame(fc_a.keys, columns=list(fc_a.key_names))
+    pred_a = fc_a.predict(req, horizon=7)
+    pred_b = fc_b.predict(req, horizon=7)
+    np.testing.assert_array_equal(pred_a.yhat.to_numpy(),
+                                  pred_b.yhat.to_numpy())
+
+
+# ---------------------------------------------------------------------------
+# the refit scheduler
+# ---------------------------------------------------------------------------
+
+def _apply_one(store, y=77.0):
+    day1 = store.day_cur
+    store.ingest([(0, day1 + 1, y)])
+    store.apply_pending()
+
+
+def test_refit_triggers(tmp_path, theta_fit):
+    fc = _fresh_fc(theta_fit)
+    y, mask = _history(theta_fit)
+    store = SeriesStateStore(fc, time_bucket=16, history_y=y,
+                             history_mask=mask)
+
+    sched = RefitScheduler(store, RefitConfig(
+        enabled=True, max_applied_points=1, max_staleness_s=1e9,
+        check_interval_s=60, drift_coverage_tol=0))
+    try:
+        assert sched.due() == ""
+        _apply_one(store)
+        assert sched.due() == "backlog"
+    finally:
+        sched.stop()
+
+    sched = RefitScheduler(store, RefitConfig(
+        enabled=True, max_applied_points=10**9, max_staleness_s=1e-6,
+        check_interval_s=60, drift_coverage_tol=0))
+    try:
+        assert sched.due() == "staleness"
+    finally:
+        sched.stop()
+
+    drifted = types.SimpleNamespace(monitor=types.SimpleNamespace(
+        coverage=lambda: 0.5, nominal_coverage=0.95))
+    fresh = types.SimpleNamespace(monitor=types.SimpleNamespace(
+        coverage=lambda: float("nan"), nominal_coverage=0.95))
+    cfg = RefitConfig(enabled=True, max_applied_points=10**9,
+                      max_staleness_s=1e9, check_interval_s=60,
+                      drift_coverage_tol=0.15)
+    sched = RefitScheduler(store, cfg, quality=drifted)
+    try:
+        assert sched.due() == "coverage_drift"
+    finally:
+        sched.stop()
+    sched = RefitScheduler(store, cfg, quality=fresh)
+    try:
+        assert sched.due() == ""   # NaN coverage (no actuals yet) is quiet
+    finally:
+        sched.stop()
+
+
+def test_forced_refit_swaps_and_resets_backlog(theta_fit):
+    fc = _fresh_fc(theta_fit)
+    y, mask = _history(theta_fit)
+    store = SeriesStateStore(fc, time_bucket=16, history_y=y,
+                             history_mask=mask)
+    _apply_one(store, y=300.0)
+    day_after = int(fc.day1)
+    assert store.stats()["applied_since_refit"] == 1
+
+    sched = RefitScheduler(store, RefitConfig(
+        enabled=True, max_applied_points=10**9, max_staleness_s=1e9,
+        check_interval_s=60))
+    try:
+        assert sched.maybe_refit(force=True) == "forced"
+        sched.wait(timeout=300)
+        snap = sched.snapshot()
+        assert snap["refits_done"] == 1
+        assert snap["last_trigger"] == "forced"
+    finally:
+        sched.stop()
+    st = store.stats()
+    assert st["applied_since_refit"] == 0          # backlog reset
+    assert store.day_cur == day_after              # frontier kept
+    # the streamed 300 is now TRAINING data: the refit saw it
+    assert store._y[0, day_after - store.day0] == 300.0
+    req = pd.DataFrame(fc.keys[:1], columns=list(fc.key_names))
+    pred = fc.predict(req, horizon=5)
+    assert np.isfinite(pred.yhat).all()
+
+
+def test_refit_without_history_raises(theta_fit):
+    fc = _fresh_fc(theta_fit)
+    store = SeriesStateStore(fc, time_bucket=16)
+    assert not store.can_refit
+    with pytest.raises(ValueError, match="history"):
+        store.refit_stages()
+
+
+# ---------------------------------------------------------------------------
+# the HTTP surface
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def ingest_server(tmp_path_factory, theta_fit):
+    from distributed_forecasting_tpu.serving import start_server
+
+    fc = _fresh_fc(theta_fit)
+    wal_dir = str(tmp_path_factory.mktemp("wal"))
+    ingest = build_ingest_runtime(
+        {"enabled": True, "wal_dir": wal_dir, "apply_mode": "sync",
+         "time_bucket": 16}, fc)
+    srv = start_server(fc, model_version="9", ingest=ingest)
+    yield srv, fc
+    srv.shutdown()
+
+
+def _call(srv, path, payload=None):
+    url = f"http://127.0.0.1:{srv.server_address[1]}{path}"
+    if payload is None:
+        req = urllib.request.Request(url)
+    else:
+        req = urllib.request.Request(
+            url, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_post_ingest_freshens_invocations(ingest_server):
+    srv, fc = ingest_server
+    key = dict(zip(fc.key_names, map(int, fc.keys[0])))
+    day1 = int(fc.day1)
+    _, before = _call(srv, "/invocations",
+                      {"inputs": [key], "horizon": 7})
+
+    code, out = _call(srv, "/ingest",
+                      {"points": [{**key, "d": day1 + 1, "y": 450.0}]})
+    assert code == 200
+    assert out["written"] == 1
+    assert out["applied"]["days"] == 1 and out["applied"]["points"] == 1
+
+    # the point is visible to the very next request — no full refit ran
+    _, after = _call(srv, "/invocations", {"inputs": [key], "horizon": 7})
+    ds_b = pd.to_datetime(pd.DataFrame(before["predictions"]).ds).min()
+    ds_a = pd.to_datetime(pd.DataFrame(after["predictions"]).ds).min()
+    assert ds_a == ds_b + pd.Timedelta(days=1)
+
+    # /debug/* stays dark unless tracing.debug_endpoints opts in
+    from distributed_forecasting_tpu.monitoring.trace import (
+        TraceConfig,
+        configure_tracing,
+    )
+
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _call(srv, "/debug/ingest")
+    assert e.value.code == 404
+    configure_tracing(TraceConfig(enabled=True, debug_endpoints=True))
+    try:
+        code, snap = _call(srv, "/debug/ingest")
+        assert code == 200
+        assert snap["store"]["day_cur"] == day1 + 1
+        assert snap["apply_mode"] == "sync"
+    finally:
+        configure_tracing(TraceConfig())
+
+
+def test_ingest_metrics_on_metrics_endpoint(ingest_server):
+    srv, fc = ingest_server
+    url = f"http://127.0.0.1:{srv.server_address[1]}/metrics"
+    with urllib.request.urlopen(url, timeout=30) as r:
+        text = r.read().decode()
+    assert "# TYPE dftpu_ingest_points_total counter" in text
+    assert "dftpu_ingest_applied_day" in text
+    assert "dftpu_ingest_wal_bytes" in text
+
+
+def test_ingest_http_errors(ingest_server):
+    srv, fc = ingest_server
+    for bad in ({}, {"points": []}, {"points": "nope"}, ["not a dict"]):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _call(srv, "/ingest", bad)
+        assert e.value.code == 400, bad
+    # unknown series are reported, not erred — the log must stay clean
+    code, out = _call(srv, "/ingest", {"points": [
+        {"store": 999, "item": 999, "d": int(fc.day1) + 1, "y": 1.0}]})
+    assert code == 200
+    assert out == {"written": 0, "unknown_series": 1, "malformed": 0}
+
+
+def test_ingest_503_when_not_configured(theta_fit):
+    from distributed_forecasting_tpu.serving import start_server
+
+    from distributed_forecasting_tpu.monitoring.trace import (
+        TraceConfig,
+        configure_tracing,
+    )
+
+    srv = start_server(_fresh_fc(theta_fit), model_version="9")
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _call(srv, "/ingest", {"points": [{"y": 1.0}]})
+        assert e.value.code == 503
+        assert "serving.ingest" in json.loads(e.value.read())["error"]
+        configure_tracing(TraceConfig(enabled=True, debug_endpoints=True))
+        try:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _call(srv, "/debug/ingest")
+            assert e.value.code == 503
+        finally:
+            configure_tracing(TraceConfig())
+    finally:
+        srv.shutdown()
+
+
+def test_observe_feeds_ingest(tmp_path, theta_fit):
+    """POST /observe actuals flow into the WAL when the conf opts in —
+    the scoring feedback loop doubles as the freshness source."""
+    from distributed_forecasting_tpu.monitoring.quality import (
+        build_quality_runtime,
+    )
+    from distributed_forecasting_tpu.serving import start_server
+
+    fc = _fresh_fc(theta_fit)
+    quality = build_quality_runtime({"quality": {"enabled": True}}, fc)
+    ingest = build_ingest_runtime(
+        {"enabled": True, "wal_dir": str(tmp_path / "wal"),
+         "apply_mode": "sync", "time_bucket": 16,
+         "observe_feeds_ingest": True}, fc)
+    srv = start_server(fc, model_version="9", quality=quality,
+                       ingest=ingest)
+    try:
+        day1 = int(fc.day1)
+        ds = (pd.Timestamp("1970-01-01")
+              + pd.Timedelta(days=day1 + 1)).strftime("%Y-%m-%d")
+        obs = [{**dict(zip(fc.key_names, map(int, row))), "ds": ds,
+                "y": 60.0} for row in fc.keys]
+        code, summary = _call(srv, "/observe", {"observations": obs})
+        assert code == 200
+        assert summary["ingest"]["written"] == len(obs)
+        assert summary["ingest"]["applied"]["points"] == len(obs)
+        assert int(fc.day1) == day1 + 1
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# fleet merge + trace rollup (pure functions)
+# ---------------------------------------------------------------------------
+
+def test_fleet_merge_maxes_shared_wal_gauges():
+    from distributed_forecasting_tpu.serving.fleet import (
+        aggregate_prometheus,
+    )
+
+    a = ("# TYPE dftpu_ingest_wal_bytes gauge\n"
+         "dftpu_ingest_wal_bytes 100\n"
+         "# TYPE dftpu_ingest_applied_day gauge\n"
+         "dftpu_ingest_applied_day 20000\n"
+         "# TYPE dftpu_ingest_points_total counter\n"
+         "dftpu_ingest_points_total 5\n"
+         "# TYPE dftpu_ingest_dirty_series gauge\n"
+         "dftpu_ingest_dirty_series 2\n")
+    b = ("# TYPE dftpu_ingest_wal_bytes gauge\n"
+         "dftpu_ingest_wal_bytes 160\n"
+         "# TYPE dftpu_ingest_applied_day gauge\n"
+         "dftpu_ingest_applied_day 20002\n"
+         "# TYPE dftpu_ingest_points_total counter\n"
+         "dftpu_ingest_points_total 7\n"
+         "# TYPE dftpu_ingest_dirty_series gauge\n"
+         "dftpu_ingest_dirty_series 3\n")
+    merged = aggregate_prometheus([a, b])
+    # one shared WAL on disk: max, not x2
+    assert "dftpu_ingest_wal_bytes 160\n" in merged
+    # convergence frontier: the furthest-ahead replica
+    assert "dftpu_ingest_applied_day 20002\n" in merged
+    # per-replica work still sums
+    assert "dftpu_ingest_points_total 12\n" in merged
+    # a NON-shared ingest gauge keeps the additive default
+    assert "dftpu_ingest_dirty_series 5\n" in merged
+
+
+def test_trace_report_streaming_rollup():
+    spec = importlib.util.spec_from_file_location(
+        "trace_report_under_test", REPO / "scripts" / "trace_report.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    def span(name, ms, **attrs):
+        return {"name": name, "trace_id": "t1", "span_id": "s",
+                "parent_id": None, "start": 0.0, "duration_ms": ms,
+                "thread": "main", "status": "ok", "attrs": attrs}
+
+    spans = [
+        span("ingest.append", 1.0, points=3),
+        span("ingest.append", 2.0, points=5),
+        span("state.update", 10.0, series=4, points=8),
+        span("refit.swap", 0.5, replayed_days=2),
+        span("predict", 30.0),              # not a streaming kind
+    ]
+    rows = {r["kind"]: r for r in mod.streaming_rollup(spans)}
+    assert set(rows) == {"ingest.append", "state.update", "refit.swap"}
+    assert rows["ingest.append"]["count"] == 2
+    assert rows["ingest.append"]["points"] == 8
+    assert rows["state.update"]["series"] == 4
+    assert rows["state.update"]["total_ms"] == 10.0
+    # sorted by total time: the batched update dominates
+    assert mod.streaming_rollup(spans)[0]["kind"] == "state.update"
