@@ -21,9 +21,9 @@ Shape discipline — the whole point of routing streaming through here:
   every predict program.
 
 Concurrency contract (the dflint blocking-under-lock rules apply):
-``_lock`` guards the in-memory pending buffer and the installed-state
-references — snapshot-then-release, never held across a device dispatch
-or file I/O; ``_apply_gate`` is a capacity-1 ``BoundedSemaphore``
+``_lock`` guards the in-memory pending buffer, the installed-state
+references, and the history buffers' late-point writes and grow-swap —
+snapshot-then-release, never held across a device dispatch or file I/O; ``_apply_gate`` is a capacity-1 ``BoundedSemaphore``
 serializing state WRITERS (apply_pending, the refit install) against
 each other so their read-modify-write of the param pytree is atomic — a
 semaphore, not a lock, deliberately: writers legitimately hold the gate
@@ -62,7 +62,7 @@ class SeriesStateStore:
     def __init__(self, forecaster, time_bucket: int = 32,
                  history_y: Optional[np.ndarray] = None,
                  history_mask: Optional[np.ndarray] = None,
-                 metrics=None):
+                 metrics=None, max_pending_days: int = 366):
         fns = get_model(forecaster.model)
         if fns.update_state is None or fns.init_update_aux is None:
             raise ValueError(
@@ -75,6 +75,7 @@ class SeriesStateStore:
         self.config = forecaster.config
         self.day0 = int(forecaster.day0)
         self.time_bucket = max(int(time_bucket), 1)
+        self.max_pending_days = max(int(max_pending_days), 1)
         self.metrics = metrics
         self.logger = get_logger("SeriesStateStore")
 
@@ -150,15 +151,21 @@ class SeriesStateStore:
         wins per (series, day)); days inside the applied window fold into
         the history buffers only — they are "late" and reach model state at
         the next full refit, exactly like a warehouse backfill; days before
-        the training grid are rejected.  In-memory only: callers persist to
-        the WAL first (serving/ingest) — this buffer is reconstructible by
-        replay.
+        the training grid OR beyond ``day_cur + max_pending_days`` are
+        rejected — the apply densifies ``max_day - day_cur`` columns, so
+        one typo'd far-future ordinal would otherwise size multi-GB host
+        and device buffers and silently advance the frontier past every
+        real day.  In-memory only: callers persist to the WAL first
+        (serving/ingest) — this buffer is reconstructible by replay.
         """
         accepted = late = rejected = 0
         with self._lock:
             day_cur = self._day_cur
+            horizon = day_cur + self.max_pending_days
             for sidx, day, y in points:
-                if day > day_cur:
+                if day > horizon:
+                    rejected += 1
+                elif day > day_cur:
                     self._pending.setdefault(int(day), {})[int(sidx)] = \
                         float(y)
                     accepted += 1
@@ -192,6 +199,21 @@ class SeriesStateStore:
                 pending, self._pending = self._pending, {}
             t0 = time.monotonic()
             max_day = max(pending)
+            horizon = day_cur + self.max_pending_days
+            if max_day > horizon:
+                # ingest() already rejects beyond-horizon days; this guards
+                # direct callers and WALs written before the horizon
+                # existed, whose replay must not OOM every follower
+                dropped = sum(len(p) for d, p in pending.items()
+                              if d > horizon)
+                self.logger.warning(
+                    "dropping %d pending point(s) beyond the %d-day "
+                    "horizon (max day %d, frontier %d)", dropped,
+                    self.max_pending_days, max_day, day_cur)
+                pending = {d: p for d, p in pending.items() if d <= horizon}
+                if not pending:
+                    return {"days": 0, "points": 0}
+                max_day = max(pending)
             k = max_day - day_cur
             n_points = sum(len(p) for p in pending.values())
             k_alloc = column_bucket(k)
@@ -245,8 +267,14 @@ class SeriesStateStore:
             return
         new_cap = time_cap(t_need, self.time_bucket)
         pad = new_cap - t_cap
-        self._y = np.pad(self._y, ((0, 0), (0, pad)))
-        self._mask = np.pad(self._mask, ((0, 0), (0, pad)))
+        # pad-and-swap under _lock: ingest() writes late points into
+        # self._y under the same lock, and a copy-then-reassign outside it
+        # would drop any write landing in the old buffer mid-copy — the
+        # next refit would silently train without that point.  Memory-only
+        # work, so holding the lock here stays within the contract.
+        with self._lock:
+            self._y = np.pad(self._y, ((0, 0), (0, pad)))
+            self._mask = np.pad(self._mask, ((0, 0), (0, pad)))
 
     # -- background full refit ----------------------------------------------
     def refit_stages(self):

@@ -972,6 +972,10 @@ class IngestMetrics:
         self.unknown_series_total = self.registry.counter(
             "dftpu_ingest_unknown_series_total",
             "points dropped because their key matches no fitted series")
+        self.out_of_range_total = self.registry.counter(
+            "dftpu_ingest_out_of_range_total",
+            "points dropped before the WAL because their day falls before "
+            "the training grid or beyond the max_pending_days horizon")
         self.wal_appends_total = self.registry.counter(
             "dftpu_ingest_wal_appends_total",
             "WAL append batches written (one O_APPEND write each)")

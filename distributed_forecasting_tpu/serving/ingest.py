@@ -66,6 +66,10 @@ class IngestConfig:
     time_bucket: int = 32             # fitted/predict-grid growth increment
     observe_feeds_ingest: bool = False  # POST /observe actuals also ingest
     max_points_per_request: int = 10000
+    max_pending_days: int = 366       # reject days past frontier + this:
+                                      # the apply densifies that many
+                                      # columns, so one typo'd far-future
+                                      # ordinal must not OOM the fleet
     refit: dict = dataclasses.field(default_factory=dict)  # serving/refit.py
 
     def __post_init__(self):
@@ -81,6 +85,8 @@ class IngestConfig:
             raise ValueError("max_segment_bytes must be >= 1024")
         if self.max_points_per_request < 1:
             raise ValueError("max_points_per_request must be >= 1")
+        if self.max_pending_days < 1:
+            raise ValueError("max_pending_days must be >= 1")
 
     @classmethod
     def from_conf(cls, conf: Optional[dict]) -> "IngestConfig":
@@ -142,13 +148,26 @@ class WriteAheadLog:
             if self._seg_bytes >= self.max_segment_bytes:
                 self._seg += 1
                 self._seg_bytes = 0
-            path = segment_path(self.directory, self._seg)
+            seg = self._seg
+            path = segment_path(self.directory, seg)
             self._seg_bytes += len(payload)
-        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+        written = 0
         try:
-            os.write(fd, payload)
-        finally:
-            os.close(fd)
+            fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+            try:
+                while written < len(payload):
+                    written += os.write(fd, payload[written:])
+            finally:
+                os.close(fd)
+        except OSError:
+            # ENOSPC/EIO: compensate the cursor for bytes that never hit
+            # disk, so roll decisions and stats() keep tracking durable
+            # bytes instead of drifting ahead of the file forever
+            with self._lock:
+                if self._seg == seg:
+                    self._seg_bytes = max(
+                        self._seg_bytes - (len(payload) - written), 0)
+            raise
         return len(records)
 
     def read_new(self, cursor: Optional[Dict[int, int]] = None,
@@ -246,15 +265,21 @@ class IngestRuntime:
     def submit(self, records: List[Dict]) -> Dict:
         """Validate, WAL-append, and (sync mode) apply a request batch.
 
-        Only points whose key matches a fitted series reach the WAL — the
-        keyset is frozen at fit time and shared by every replica, so
-        filtering before the append keeps the log replayable anywhere.
+        Only points whose key matches a fitted series AND whose day falls
+        inside ``[day0, frontier + max_pending_days]`` reach the WAL — the
+        keyset and grid are frozen at fit time and shared by every
+        replica, so filtering before the append keeps the log replayable
+        anywhere: a typo'd far-future ordinal (or a wrong-century ``ds``)
+        must never become a durable line that every restart and every
+        fleet follower re-reads into a multi-GB apply allocation.
         """
         if len(records) > self.config.max_points_per_request:
             raise ValueError(
                 f"request has {len(records)} points; "
                 f"max_points_per_request={self.config.max_points_per_request}")
-        rows, unknown, malformed = [], 0, 0
+        horizon = self.store.day_cur + self.config.max_pending_days
+        day0 = self.store.day0
+        rows, unknown, malformed, out_of_range = [], 0, 0, 0
         for rec in records:
             parsed, reason = self._parse_record(rec)
             if parsed is None:
@@ -264,9 +289,12 @@ class IngestRuntime:
                     malformed += 1
                 continue
             sidx, day, y = parsed
+            if day < day0 or day > horizon:
+                out_of_range += 1
+                continue
             rows.append({"k": list(self._row_key(sidx)), "d": day, "y": y})
         out = {"written": len(rows), "unknown_series": unknown,
-               "malformed": malformed}
+               "malformed": malformed, "out_of_range": out_of_range}
         if rows:
             with get_tracer().span("ingest.append", points=len(rows),
                                    wal_dir=self.wal.directory):
@@ -275,6 +303,8 @@ class IngestRuntime:
             self.metrics.wal_appends_total.inc()
         if unknown:
             self.metrics.unknown_series_total.inc(unknown)
+        if out_of_range:
+            self.metrics.out_of_range_total.inc(out_of_range)
         if rows and self.config.apply_mode == "sync":
             out["applied"] = self.poll_apply()
         return out
@@ -378,7 +408,8 @@ def build_ingest_runtime(conf: Optional[dict], forecaster,
     metrics = IngestMetrics()
     store = SeriesStateStore(
         forecaster, time_bucket=config.time_bucket,
-        history_y=history_y, history_mask=history_mask, metrics=metrics)
+        history_y=history_y, history_mask=history_mask, metrics=metrics,
+        max_pending_days=config.max_pending_days)
     wal = WriteAheadLog(wal_dir, max_segment_bytes=config.max_segment_bytes)
     refit_scheduler = None
     if config.refit:

@@ -88,7 +88,11 @@ class RefitScheduler:
         self._executor = TrainingExecutor(
             config=PipelineConfig(enabled=True, max_in_flight=1,
                                   prefetch_depth=0, async_tracking=False))
+        # _lock guards _handle/_refits_done/_last_trigger: the scheduler
+        # thread, forced maybe_refit() callers, and wait() all touch them
+        self._lock = threading.Lock()
         self._handle = None
+        self._submitting = False
         self._refits_done = 0
         self._last_trigger = ""
         self._stop = threading.Event()
@@ -112,64 +116,100 @@ class RefitScheduler:
                     return "coverage_drift"
         return ""
 
+    def _reap(self) -> Optional[Dict]:
+        """Collect a finished refit handle exactly once.
+
+        The ONLY place ``_handle`` is cleared and ``_refits_done``
+        incremented — ``wait()`` and the scheduler loop both funnel
+        through here, so a refit a caller waited on is never also counted
+        by the loop.  Surfaces stage errors (the handle is cleared first,
+        matching the loop's old drop-on-error behavior)."""
+        with self._lock:
+            handle = self._handle
+            if handle is None or not handle.done():
+                return None
+            self._handle = None
+        result = handle.result(timeout=0)
+        with self._lock:
+            self._refits_done += 1
+        return result
+
     def maybe_refit(self, force: bool = False) -> Optional[str]:
         """Submit a refit if a trigger fired (or ``force``) and none is in
         flight; returns the trigger name when one was submitted."""
-        if self._handle is not None and not self._handle.done():
-            return None
+        self._reap()
         trigger = "forced" if force else self.due()
         if not trigger:
             return None
-        prep, dispatch, complete = self.store.refit_stages()
-        self._last_trigger = trigger
-        self._handle = self._executor.submit(
-            f"refit:{trigger}", prep, dispatch, complete)
+        # claim the submission slot under the lock, but run submit()
+        # outside it — prep/dispatch execute inline in the caller (history
+        # snapshot + the fit dispatch, possibly a compile), far too long
+        # to hold _lock across
+        with self._lock:
+            if self._handle is not None or self._submitting:
+                return None
+            self._submitting = True
+        try:
+            prep, dispatch, complete = self.store.refit_stages()
+            handle = self._executor.submit(
+                f"refit:{trigger}", prep, dispatch, complete)
+            with self._lock:
+                self._last_trigger = trigger
+                self._handle = handle
+        finally:
+            with self._lock:
+                self._submitting = False
         self.logger.info("refit submitted (trigger=%s)", trigger)
         return trigger
 
     def wait(self, timeout: Optional[float] = None) -> Optional[Dict]:
         """Block until the in-flight refit (if any) has swapped in."""
-        if self._handle is None:
+        with self._lock:
+            handle = self._handle
+        if handle is None:
             return None
-        result = self._handle.result(timeout=timeout)
-        self._refits_done += 1
-        return result
+        result = handle.result(timeout=timeout)
+        # _reap() counts it unless the scheduler loop got there first, in
+        # which case the result is still the one we waited on
+        reaped = self._reap()
+        return result if reaped is None else reaped
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> None:
         if not self.config.enabled or self._thread is not None:
             return
-        self._stop.clear()
-        self._thread = threading.Thread(
+        self._stop.clear()  # dflint: disable=unlocked-shared-state — lifecycle field touched only by the owning thread
+        self._thread = threading.Thread(  # dflint: disable=unlocked-shared-state — lifecycle field touched only by the owning thread
             target=self._run, name="refit-scheduler", daemon=True)
         self._thread.start()
 
     def _run(self) -> None:
         while not self._stop.wait(self.config.check_interval_s):
             try:
-                if self._handle is not None and self._handle.done():
-                    # surface stage-C errors instead of silently retrying
-                    self._handle.result(timeout=0)
-                    self._refits_done += 1
-                    self._handle = None
+                # maybe_refit reaps first, so stage-C errors surface here
+                # instead of silently retrying (a failed handle is cleared
+                # by _reap before its result re-raises)
                 self.maybe_refit()
             except Exception:
                 self.logger.exception("refit cycle failed")
-                self._handle = None
 
     def stop(self) -> None:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=10.0)
-            self._thread = None
+            self._thread = None  # dflint: disable=unlocked-shared-state — lifecycle field touched only by the owning thread
         self._executor.close()
 
     def snapshot(self) -> Dict:
+        with self._lock:
+            in_flight = bool(self._handle is not None
+                             and not self._handle.done())
+            refits_done = self._refits_done
+            last_trigger = self._last_trigger
         return {
             "enabled": self.config.enabled,
-            "in_flight": bool(self._handle is not None
-                              and not self._handle.done()),
-            "refits_done": self._refits_done,
-            "last_trigger": self._last_trigger,
+            "in_flight": in_flight,
+            "refits_done": refits_done,
+            "last_trigger": last_trigger,
             "due": self.due(),
         }

@@ -120,6 +120,8 @@ def test_ingest_config_strict_parse():
         IngestConfig.from_conf({"time_bucket": 0})
     with pytest.raises(ValueError, match="max_points_per_request"):
         IngestConfig.from_conf({"max_points_per_request": 0})
+    with pytest.raises(ValueError, match="max_pending_days"):
+        IngestConfig.from_conf({"max_pending_days": 0})
 
 
 def test_refit_config_strict_parse():
@@ -210,6 +212,32 @@ def test_wal_torn_line_and_garbage(tmp_path):
     assert got == [{"k": [1, 3], "d": 102, "y": 3.0}]
 
 
+def test_wal_append_failure_keeps_cursor_on_durable_bytes(tmp_path,
+                                                          monkeypatch):
+    """A failed os.write must not leave the in-memory segment cursor ahead
+    of the file: stats() would overstate durable bytes and later appends
+    would roll segments early."""
+    wal = WriteAheadLog(str(tmp_path / "wal"), max_segment_bytes=4096)
+    wal.append([{"k": [1, 1], "d": 100, "y": 1.0}])
+    before = wal._seg_bytes
+    assert before == os.path.getsize(
+        os.path.join(wal.directory, os.listdir(wal.directory)[0]))
+
+    real_write = os.write
+    monkeypatch.setattr(os, "write", lambda fd, b: (_ for _ in ()).throw(
+        OSError(28, "No space left on device")))
+    with pytest.raises(OSError):
+        wal.append([{"k": [1, 2], "d": 101, "y": 2.0}])
+    assert wal._seg_bytes == before       # compensated, still tracks disk
+
+    monkeypatch.setattr(os, "write", real_write)
+    wal.append([{"k": [1, 3], "d": 102, "y": 3.0}])
+    got, _ = wal.read_new()
+    assert [r["d"] for r in got] == [100, 102]
+    assert wal._seg_bytes == os.path.getsize(
+        os.path.join(wal.directory, os.listdir(wal.directory)[0]))
+
+
 # ---------------------------------------------------------------------------
 # the state store
 # ---------------------------------------------------------------------------
@@ -230,8 +258,10 @@ def test_state_store_routes_late_and_rejected(theta_fit):
         (0, day1 + 1, 5.0),          # future -> pending
         (1, day1, 6.0),              # inside the applied window -> late
         (0, store.day0 - 10, 7.0),   # before the training grid -> rejected
+        (0, day1 + 10**6, 8.0),      # beyond the horizon -> rejected, NOT
+                                     # a million dense apply columns
     ])
-    assert routed == {"accepted": 1, "late": 1, "rejected": 1}
+    assert routed == {"accepted": 1, "late": 1, "rejected": 2}
     st = store.stats()
     assert st["pending_points"] == 1 and st["late_points"] == 1
     # the late point landed in the history buffer for the next refit
@@ -258,6 +288,38 @@ def test_gap_days_are_masked_columns(theta_fit):
     out = store.apply_pending()
     assert out == {"days": 3, "points": 1}
     assert store.day_cur == day1 + 3 and fc.day1 == day1 + 3
+
+
+def test_far_future_points_capped_by_horizon(theta_fit):
+    """One typo'd ordinal must not size the dense apply columns: ingest
+    rejects beyond-horizon days, and apply_pending defensively drops any
+    that reach the pending buffer some other way (a WAL written before
+    the horizon existed, a direct caller)."""
+    fc = _fresh_fc(theta_fit)
+    store = SeriesStateStore(fc, time_bucket=16, max_pending_days=30)
+    day1 = store.day_cur
+
+    routed = store.ingest([(0, day1 + 31, 1.0)])
+    assert routed == {"accepted": 0, "late": 0, "rejected": 1}
+    assert store.stats()["pending_points"] == 0
+    # at the horizon is still fine
+    assert store.ingest([(0, day1 + 30, 1.0)])["accepted"] == 1
+    with store._lock:
+        store._pending.clear()
+
+    # defensive cap: smuggle a wild day straight into the buffer
+    with store._lock:
+        store._pending[day1 + 10**6] = {0: 9.0}
+    assert store.apply_pending() == {"days": 0, "points": 0}
+    assert store.day_cur == day1            # frontier did not jump
+
+    # mixed: the in-horizon point applies, the wild one is dropped
+    store.ingest([(0, day1 + 1, 5.0)])
+    with store._lock:
+        store._pending[day1 + 10**6] = {0: 9.0}
+    out = store.apply_pending()
+    assert out == {"days": 1, "points": 1}
+    assert store.day_cur == day1 + 1
 
 
 def test_bucket_boundary_growth_bitwise_vs_refit():
@@ -350,7 +412,8 @@ def test_runtime_parses_every_record_shape(tmp_path, theta_fit):
     listed = {"k": [int(v) for v in fc.keys[0]], "d": day, "y": 3.0}
     dated = {**key, "ds": ds, "y": 4.0}
     out = rt.submit([flat, keyed, listed, dated])
-    assert out == {"written": 4, "unknown_series": 0, "malformed": 0}
+    assert out == {"written": 4, "unknown_series": 0, "malformed": 0,
+                   "out_of_range": 0}
 
     bad = rt.submit([
         {"store": 999, "item": 999, "d": day, "y": 1.0},   # unknown key
@@ -358,8 +421,17 @@ def test_runtime_parses_every_record_shape(tmp_path, theta_fit):
         {**key, "d": day, "y": float("nan")},              # non-finite
         {"k": [1], "d": day, "y": 1.0},                    # key arity
         {"y": 1.0},                                        # no key at all
+        {**key, "d": day + 10**6, "y": 1.0},               # beyond horizon
+        {**key, "ds": "2200-01-01", "y": 1.0},             # wrong century
+        {**key, "d": -10**6, "y": 1.0},                    # before the grid
     ])
-    assert bad == {"written": 0, "unknown_series": 1, "malformed": 4}
+    assert bad == {"written": 0, "unknown_series": 1, "malformed": 4,
+                   "out_of_range": 3}
+    # the out-of-range points never became durable WAL lines: a replaying
+    # follower (or a restart) only ever sees the 4 good records
+    replayed, _ = rt.wal.read_new()
+    assert len(replayed) == 4
+    assert all(abs(r["d"] - day) <= 1 for r in replayed)
 
     with pytest.raises(ValueError, match="max_points_per_request"):
         rt.submit([flat] * 10001)
@@ -495,6 +567,11 @@ def test_forced_refit_swaps_and_resets_backlog(theta_fit):
         snap = sched.snapshot()
         assert snap["refits_done"] == 1
         assert snap["last_trigger"] == "forced"
+        # the handle was reaped by wait(): neither a second wait nor the
+        # scheduler loop's reap path may count the same refit again
+        assert sched.wait(timeout=1) is None
+        assert sched._reap() is None
+        assert sched.snapshot()["refits_done"] == 1
     finally:
         sched.stop()
     st = store.stats()
@@ -603,7 +680,8 @@ def test_ingest_http_errors(ingest_server):
     code, out = _call(srv, "/ingest", {"points": [
         {"store": 999, "item": 999, "d": int(fc.day1) + 1, "y": 1.0}]})
     assert code == 200
-    assert out == {"written": 0, "unknown_series": 1, "malformed": 0}
+    assert out == {"written": 0, "unknown_series": 1, "malformed": 0,
+                   "out_of_range": 0}
 
 
 def test_ingest_503_when_not_configured(theta_fit):
