@@ -167,6 +167,33 @@ class CompileCacheConfig:
         )
 
 
+def _extract_program_cost(compiled) -> Optional[dict]:
+    """Cost/memory analysis of a freshly compiled executable, or None.
+    Guarded end to end: cost capture is telemetry riding on the compile
+    path and must never turn a working compile into an error."""
+    try:
+        from distributed_forecasting_tpu.monitoring.cost import (
+            extract_cost_analysis,
+        )
+
+        return extract_cost_analysis(compiled) or None
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def _record_program_cost(entry: str, key: str, cost: Optional[dict]) -> None:
+    """Publish captured costs into the process cost registry (the key
+    prefix distinguishes shape buckets of one entry on /metrics)."""
+    if not cost:
+        return
+    try:
+        from distributed_forecasting_tpu.monitoring.cost import cost_metrics
+
+        cost_metrics().record_program(entry or key, cost, key=key[:8])
+    except Exception:  # noqa: BLE001
+        pass
+
+
 # -- key fingerprinting ------------------------------------------------------
 
 def backend_fingerprint() -> Dict[str, Any]:
@@ -309,6 +336,11 @@ class AOTStore:
             self.invalidate(key)
             return None
         _load_seconds.observe(time.perf_counter() - t0)
+        # cost registry warm-load: the analysis captured at compile time
+        # rides in the record's meta, so a warm process serves the
+        # dftpu_cost_program_* gauges without ever compiling
+        _record_program_cost(record.get("entry") or "", key,
+                             (record.get("meta") or {}).get("cost"))
         # touch for the LRU sweep: eviction orders by mtime
         try:
             os.utime(path, None)
@@ -442,8 +474,15 @@ class AOTStore:
                     result if isinstance(result, tuple) else (result, True)
                 )
                 _compile_seconds.observe(time.perf_counter() - t0)
+                # capture the program's cost analysis ONCE, at the only
+                # point a genuine compile happens: it feeds the live cost
+                # registry and persists beside the executable so warm
+                # loads repopulate without compiling
+                cost = _extract_program_cost(compiled)
+                _record_program_cost(entry, key, cost)
                 if storable:
-                    self.store(key, compiled, entry=entry)
+                    self.store(key, compiled, entry=entry,
+                               meta={"cost": cost} if cost else None)
             with self._lock:
                 self._memo[key] = compiled
             return compiled

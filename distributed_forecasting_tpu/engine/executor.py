@@ -240,6 +240,20 @@ class TrainingExecutor:
                 self._busy_until = t_end
             self._last_pull = t_end
 
+    def _record_device_seconds(self, name: str, seconds: float) -> None:
+        # per-experiment device-time attribution (monitoring/cost.py):
+        # guarded because the cost registry is telemetry, never a reason
+        # for a training run to fail
+        try:
+            from distributed_forecasting_tpu.monitoring.cost import (
+                cost_metrics,
+            )
+
+            cost_metrics().record_dispatch("pipeline.dispatch", name,
+                                           seconds)
+        except Exception:  # noqa: BLE001
+            pass
+
     def _observe(self, stage: str, seconds: float) -> None:
         with self._lock:
             self._stage_totals[stage] += seconds
@@ -296,8 +310,10 @@ class TrainingExecutor:
             raise
         self._set_in_flight(+1)
         # ctx rides along so the writer thread's pull/complete spans land in
-        # the same trace as this thread's prep/dispatch spans
-        self._queue.put((handle, state, complete, ctx))
+        # the same trace as this thread's prep/dispatch spans; t1 (dispatch
+        # start) rides too so the writer can attribute the full
+        # dispatch-to-drain interval as device time (monitoring/cost.py)
+        self._queue.put((handle, state, complete, ctx, t1))
         return handle
 
     def _experiment_ctx(self) -> Optional[TraceContext]:
@@ -331,11 +347,16 @@ class TrainingExecutor:
         t2 = time.perf_counter()
         self._observe("dispatch", t2 - t1)
         try:
-            with tracer.span("pipeline.pull", ctx=ctx, experiment=name):
+            with tracer.span("pipeline.pull", ctx=ctx,
+                             experiment=name) as pull_span:
                 state = device_pull(state)
-            t3 = time.perf_counter()
+                t3 = time.perf_counter()
+                # dispatch start through drained device: the experiment's
+                # device-seconds, attributed like the serving predict path
+                pull_span.set_attribute("device_seconds", t3 - t1)
             self._record_pull_end(t3)
             self._observe("pull", t3 - t2)
+            self._record_device_seconds(name, t3 - t1)
             self._inject_stage_seconds(state, t1 - t0, t2 - t1, t3 - t2)
             with tracer.span("pipeline.complete", ctx=ctx, experiment=name):
                 result = complete(state)
@@ -379,18 +400,21 @@ class TrainingExecutor:
             if task is _STOP:
                 self._queue.task_done()
                 return
-            handle, state, complete, ctx = task
+            handle, state, complete, ctx, t_dispatch = task
             try:
                 t0 = time.perf_counter()
                 # pull duration IS the queue-wait + device-wait for this
                 # experiment's stage C: it starts when the writer picks the
                 # task up and ends when the device has drained
                 with tracer.span("pipeline.pull", ctx=ctx,
-                                 experiment=handle.name):
+                                 experiment=handle.name) as pull_span:
                     state = device_pull(state)
-                t1 = time.perf_counter()
+                    t1 = time.perf_counter()
+                    pull_span.set_attribute("device_seconds",
+                                            t1 - t_dispatch)
                 self._record_pull_end(t1)
                 self._observe("pull", t1 - t0)
+                self._record_device_seconds(handle.name, t1 - t_dispatch)
                 self._inject_stage_seconds(state, 0.0, 0.0, t1 - t0)
                 with tracer.span("pipeline.complete", ctx=ctx,
                                  experiment=handle.name):

@@ -1,0 +1,474 @@
+"""Runtime cost & capacity observability (``dftpu_cost_*``).
+
+The roofline math lived only in the offline ``scripts/mfu_roofline.py``:
+nobody serving traffic could answer "what did that dispatch cost in
+device-seconds, FLOPs and HBM, and how much headroom does the fleet
+have?".  This module productionizes that analysis into the runtime:
+
+  * **Program cost registry** — at AOT compile time the compile cache
+    (``engine/compile_cache.py``) extracts ``compiled.cost_analysis()`` +
+    ``memory_analysis()`` through :func:`extract_cost_analysis` and records
+    it here per entry x shape-bucket (the bucket rides as a key-prefix
+    label); the numbers are persisted beside the serialized executable, so
+    a warm process repopulates the registry at load time without ever
+    compiling.  Exposed as ``dftpu_cost_program_*`` labeled gauges —
+    REPLICATED across a fleet (every replica shares one AOT store, so the
+    aggregator keeps one copy instead of summing).
+  * **Device-time attribution** — the serving predictor, the batcher, and
+    the training executor stamp each dispatch's device interval (dispatch
+    through host pull, on the span clock) into per-entry/per-family
+    device-seconds counters (summed fleet-wide), and a sliding window
+    turns them into ``dftpu_cost_device_saturation`` = device-seconds
+    consumed per wall-second — the fleet's capacity gauge (sums across
+    replicas: 2.0 means two devices' worth of work).
+  * **Memory watermarks** — ``dftpu_cost_watermark_*`` gauges for host RSS
+    (+ peak) from ``/proc/self/status`` and device bytes-in-use (+ peak)
+    from ``device.memory_stats()`` where the backend provides it; the
+    quality scrape loop (``monitoring/store.py``) samples them on every
+    tick so the store keeps queryable history.  Max-merged across a fleet
+    (the worst replica is the capacity signal).
+
+Conf block ``monitoring.cost`` (strict — unknown keys raise)::
+
+    monitoring:
+      cost:
+        enabled: true
+        peak_flops: 0.0          # backend peak FLOP/s; 0 disables the
+        peak_bytes_per_s: 0.0    # roofline placement in /debug/cost
+        saturation_window_s: 60
+
+``GET /debug/cost`` (behind ``tracing.debug_endpoints``, like the other
+debug surfaces) renders the registry as a per-entry table with
+achieved-vs-peak roofline placement when the peaks are configured.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import math
+import threading
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from distributed_forecasting_tpu.monitoring.monitor import MetricsRegistry
+from distributed_forecasting_tpu.monitoring.trace import clock
+
+#: cost_analysis / memory_analysis fields captured per compiled program,
+#: in the order the /debug/cost table shows them.  Each becomes a
+#: ``dftpu_cost_program_<field>`` labeled gauge.
+PROGRAM_FIELDS = (
+    "flops",
+    "bytes_accessed",
+    "argument_bytes",
+    "output_bytes",
+    "temp_bytes",
+    "peak_bytes",
+)
+
+
+def extract_cost_analysis(compiled) -> Dict[str, float]:
+    """FLOPs / bytes / memory footprint of a compiled XLA program.
+
+    Tolerant by construction — ``cost_analysis()`` may return a per-device
+    list (take the first), either analysis may be missing on a backend, and
+    any failure yields an empty dict (cost capture is telemetry, never an
+    error).  ``peak_bytes`` falls back to argument+output+temp when the
+    backend reports no explicit peak.  The single shared extraction point:
+    the compile cache and ``scripts/mfu_roofline.py`` both call this, so
+    the two can never drift on how the numbers are read.
+    """
+    out: Dict[str, float] = {}
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        for field, key in (("flops", "flops"),
+                           ("bytes_accessed", "bytes accessed")):
+            v = float(ca.get(key, float("nan")))
+            if math.isfinite(v):
+                out[field] = v
+    except Exception:  # noqa: BLE001 — backends without cost analysis
+        pass
+    try:
+        ma = compiled.memory_analysis()
+        for field, attr in (
+            ("argument_bytes", "argument_size_in_bytes"),
+            ("output_bytes", "output_size_in_bytes"),
+            ("temp_bytes", "temp_size_in_bytes"),
+            ("peak_bytes", "peak_memory_in_bytes"),
+        ):
+            v = getattr(ma, attr, None)
+            if v is not None and math.isfinite(float(v)):
+                out[field] = float(v)
+    except Exception:  # noqa: BLE001
+        pass
+    if "peak_bytes" not in out:
+        parts = [out.get(k) for k in
+                 ("argument_bytes", "output_bytes", "temp_bytes")]
+        if any(p is not None for p in parts):
+            out["peak_bytes"] = sum(p for p in parts if p is not None)
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class CostConfig:
+    """The ``monitoring.cost`` conf block."""
+
+    enabled: bool = True
+    peak_flops: float = 0.0        # 0: no roofline placement
+    peak_bytes_per_s: float = 0.0  # 0: no roofline placement
+    saturation_window_s: float = 60.0
+
+    def __post_init__(self):
+        if self.saturation_window_s <= 0:
+            raise ValueError(
+                f"saturation_window_s must be > 0, got "
+                f"{self.saturation_window_s}")
+        if self.peak_flops < 0:
+            raise ValueError(
+                f"peak_flops must be >= 0, got {self.peak_flops}")
+        if self.peak_bytes_per_s < 0:
+            raise ValueError(
+                f"peak_bytes_per_s must be >= 0, got "
+                f"{self.peak_bytes_per_s}")
+
+    @property
+    def ridge_intensity(self) -> float:
+        """FLOP/byte at which the roofline bends; 0 when peaks unset."""
+        if self.peak_flops > 0 and self.peak_bytes_per_s > 0:
+            return self.peak_flops / self.peak_bytes_per_s
+        return 0.0
+
+    @classmethod
+    def from_conf(cls, conf: Optional[dict]) -> "CostConfig":
+        conf = conf or {}
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(conf) - known
+        if unknown:
+            # a typo like peak_flop must not silently disable the roofline
+            raise ValueError(
+                f"unknown monitoring.cost conf key(s) {sorted(unknown)}; "
+                f"valid: {sorted(known)}")
+        kwargs = {
+            f.name: type(f.default)(conf[f.name])
+            for f in dataclasses.fields(cls)
+            if f.name in conf and conf[f.name] is not None
+        }
+        return cls(**kwargs)
+
+
+def _read_host_rss() -> Dict[str, float]:
+    """Current and peak RSS of THIS process, in bytes.
+
+    ``/proc/self/status`` (VmRSS/VmHWM) where available; the ``resource``
+    module's maxrss as the peak fallback elsewhere.  No psutil — the
+    container doesn't ship it.
+    """
+    out: Dict[str, float] = {}
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    out["rss_bytes"] = float(line.split()[1]) * 1024.0
+                elif line.startswith("VmHWM:"):
+                    out["rss_peak_bytes"] = float(line.split()[1]) * 1024.0
+    except OSError:
+        pass
+    if "rss_peak_bytes" not in out:
+        try:
+            import resource
+
+            ru = resource.getrusage(resource.RUSAGE_SELF)
+            # linux reports KiB, macOS bytes; this fallback only runs
+            # where /proc is absent, i.e. the latter
+            out["rss_peak_bytes"] = float(ru.ru_maxrss)
+        except Exception:  # noqa: BLE001
+            pass
+    return out
+
+
+def _read_device_memory() -> Dict[str, float]:
+    """bytes_in_use / peak_bytes_in_use of the first local device, where
+    the backend exposes ``memory_stats()`` (TPU/GPU; CPU returns None)."""
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:  # noqa: BLE001 — no backend / no stats on CPU
+        return {}
+    if not stats:
+        return {}
+    out: Dict[str, float] = {}
+    if "bytes_in_use" in stats:
+        out["device_bytes"] = float(stats["bytes_in_use"])
+    if "peak_bytes_in_use" in stats:
+        out["device_peak_bytes"] = float(stats["peak_bytes_in_use"])
+    return out
+
+
+class CostMetrics:
+    """The ``dftpu_cost_*`` registry, one per process.
+
+    Same discipline as :class:`monitor.PipelineMetrics`: every attribute is
+    created in ``__init__`` and the metric objects are themselves
+    thread-safe.  The only mutable state beyond them is the saturation
+    window (``_recent``/``_recent_sum``), guarded by ``_lock`` — readers
+    snapshot under the lock, never touch the deque unlocked.
+
+    Fleet merge semantics (serving/fleet.aggregate_prometheus):
+
+      * ``dftpu_cost_device_seconds_total`` / ``_dispatches_total``
+        counters and the ``device_saturation`` gauge SUM — work is
+        additive across replicas;
+      * ``dftpu_cost_watermark_*`` gauges MAX — capacity headroom is set
+        by the worst replica;
+      * ``dftpu_cost_program_*`` gauges REPLICATE (first replica wins) —
+        the fleet shares one AOT store, so every replica reports the same
+        program fingerprints and summing would multiply FLOPs by the
+        replica count.
+    """
+
+    def __init__(self) -> None:
+        self.registry = MetricsRegistry()
+        self.device_seconds_total = self.registry.labeled_counter(
+            "dftpu_cost_device_seconds_total", ("entry", "family"),
+            "device-seconds attributed per AOT entry and model family "
+            "(dispatch through host pull, span clock)")
+        self.dispatches_total = self.registry.labeled_counter(
+            "dftpu_cost_dispatches_total", ("entry", "family"),
+            "attributed device dispatches per AOT entry and model family")
+        self.device_saturation = self.registry.gauge(
+            "dftpu_cost_device_saturation",
+            "device-seconds consumed per wall-second over the saturation "
+            "window (fleet-summed: 2.0 = two devices' worth of work)")
+        self.program = {
+            field: self.registry.labeled_gauge(
+                f"dftpu_cost_program_{field}", ("entry", "key"),
+                f"XLA {field.replace('_', ' ')} of the compiled program, "
+                f"per AOT entry and shape-bucket key (replicated across a "
+                f"fleet sharing one store)")
+            for field in PROGRAM_FIELDS
+        }
+        self.host_rss_bytes = self.registry.gauge(
+            "dftpu_cost_watermark_host_rss_bytes",
+            "resident set size of this process (fleet: max-merged)")
+        self.host_rss_peak_bytes = self.registry.gauge(
+            "dftpu_cost_watermark_host_rss_peak_bytes",
+            "high-water resident set size of this process "
+            "(fleet: max-merged)")
+        self.device_bytes = self.registry.gauge(
+            "dftpu_cost_watermark_device_bytes",
+            "device memory in use, first local device; 0 where the "
+            "backend reports none (fleet: max-merged)")
+        self.device_peak_bytes = self.registry.gauge(
+            "dftpu_cost_watermark_device_peak_bytes",
+            "peak device memory in use, first local device "
+            "(fleet: max-merged)")
+        self.saturation_window_s = 60.0
+        self._lock = threading.Lock()
+        self._recent: deque = deque()   # (span-clock ts, device_seconds)
+        self._recent_sum = 0.0
+        self._t0 = clock()
+        self._tls = threading.local()
+
+    # -- attribution ---------------------------------------------------------
+    def record_dispatch(self, entry: str, family: str,
+                        device_seconds: float) -> None:
+        """Attribute one dispatch's device interval; updates the counters,
+        the saturation window, and any open :meth:`attribution` scope on
+        this thread.  Two clock reads and a few dict ops — cheap enough for
+        the request path (the <2% overhead bar PR 6 set for tracing)."""
+        dev = max(float(device_seconds), 0.0)
+        self.device_seconds_total.inc(dev, entry=entry, family=family)
+        self.dispatches_total.inc(1.0, entry=entry, family=family)
+        acc = getattr(self._tls, "acc", None)
+        if acc is not None:
+            acc["device_seconds"] += dev
+            acc["dispatches"] += 1
+        now = clock()
+        window = self.saturation_window_s
+        with self._lock:
+            self._recent.append((now, dev))
+            self._recent_sum += dev
+            floor = now - window
+            while self._recent and self._recent[0][0] < floor:
+                _, old = self._recent.popleft()
+                self._recent_sum -= old
+            # a young process has observed less than a full window;
+            # dividing by the window would understate load during warmup
+            elapsed = min(window, max(now - self._t0, 1e-9))
+            saturation = self._recent_sum / elapsed
+        self.device_saturation.set(saturation)
+
+    @contextlib.contextmanager
+    def attribution(self):
+        """Scope that accumulates this THREAD's recorded dispatches —
+        the batcher wraps a merged dispatch in one so the total device
+        time lands on its ``batcher.dispatch`` span without threading a
+        value through the predictor's return."""
+        prev = getattr(self._tls, "acc", None)
+        acc = {"device_seconds": 0.0, "dispatches": 0}
+        self._tls.acc = acc
+        try:
+            yield acc
+        finally:
+            self._tls.acc = prev
+
+    # -- program registry ----------------------------------------------------
+    def record_program(self, entry: str, costs: Dict[str, float],
+                       key: str = "") -> None:
+        """Publish one compiled program's cost analysis.  ``key`` is the
+        store fingerprint prefix distinguishing shape buckets of the same
+        entry; empty for callers without one (offline tools)."""
+        if not costs:
+            return
+        for field, gauge in self.program.items():
+            v = costs.get(field)
+            if v is not None and math.isfinite(float(v)):
+                gauge.set(float(v), entry=entry, key=key)
+
+    # -- watermarks ----------------------------------------------------------
+    def sample_watermarks(self) -> None:
+        """Refresh the RSS/device-memory gauges.  All file I/O happens
+        before any metric is touched and no CostMetrics lock is held —
+        the scrape loop calls this on its tick."""
+        host = _read_host_rss()
+        dev = _read_device_memory()
+        if "rss_bytes" in host:
+            self.host_rss_bytes.set(host["rss_bytes"])
+        if "rss_peak_bytes" in host:
+            self.host_rss_peak_bytes.set(host["rss_peak_bytes"])
+        if "device_bytes" in dev:
+            self.device_bytes.set(dev["device_bytes"])
+        if "device_peak_bytes" in dev:
+            self.device_peak_bytes.set(dev["device_peak_bytes"])
+
+    # -- the /debug/cost view ------------------------------------------------
+    def cost_table(self, config: Optional[CostConfig] = None) -> List[Dict]:
+        """Per-(entry, shape-bucket) rows joining the program registry with
+        the attribution counters, plus roofline placement when the config
+        carries backend peaks.
+
+        Device seconds are attributed per ENTRY (the predictor doesn't see
+        the store key), so rows of a multi-bucket entry share the entry's
+        dispatch totals and the achieved-FLOP/s estimate uses each row's
+        own program FLOPs — an estimate, exact when one bucket dominates.
+        """
+        config = config or get_cost_config()
+        programs: Dict[tuple, Dict[str, float]] = {}
+        for field, gauge in self.program.items():
+            for label_str, v in gauge.snapshot().items():
+                labels = dict(
+                    part.partition("=")[::2] for part in label_str.split(","))
+                programs.setdefault(
+                    (labels.get("entry", ""), labels.get("key", "")), {},
+                )[field] = v
+        per_entry: Dict[str, Dict[str, float]] = {}
+        for counter, out_field in ((self.device_seconds_total,
+                                    "device_seconds"),
+                                   (self.dispatches_total, "dispatches")):
+            for label_str, v in counter.snapshot().items():
+                labels = dict(
+                    part.partition("=")[::2] for part in label_str.split(","))
+                agg = per_entry.setdefault(
+                    labels.get("entry", ""),
+                    {"device_seconds": 0.0, "dispatches": 0.0,
+                     "family": labels.get("family", "")})
+                agg[out_field] += v
+        rows: List[Dict] = []
+        for (entry, key) in sorted(set(programs) | {
+                (e, "") for e in per_entry if not any(
+                    pe == e for pe, _ in programs)}):
+            row: Dict[str, Any] = {"entry": entry, "key": key}
+            row.update(programs.get((entry, key), {}))
+            stats = per_entry.get(entry)
+            if stats:
+                row["family"] = stats["family"]
+                row["device_seconds"] = stats["device_seconds"]
+                row["dispatches"] = stats["dispatches"]
+            flops = row.get("flops")
+            byts = row.get("bytes_accessed")
+            if flops and byts:
+                row["operational_intensity"] = flops / byts
+            if (stats and stats["device_seconds"] > 0 and flops
+                    and stats["dispatches"] > 0):
+                row["achieved_flops_per_s"] = (
+                    flops * stats["dispatches"] / stats["device_seconds"])
+            ridge = config.ridge_intensity
+            if ridge and "operational_intensity" in row:
+                oi = row["operational_intensity"]
+                row["bound"] = "compute" if oi >= ridge else "memory"
+                attainable = min(config.peak_flops,
+                                 oi * config.peak_bytes_per_s)
+                row["attainable_flops_per_s"] = attainable
+                if "achieved_flops_per_s" in row and attainable > 0:
+                    row["fraction_of_attainable"] = (
+                        row["achieved_flops_per_s"] / attainable)
+            rows.append(row)
+        return rows
+
+    def snapshot(self, config: Optional[CostConfig] = None) -> Dict:
+        """The ``GET /debug/cost`` body: config echo, live saturation and
+        watermarks, and the per-entry cost table."""
+        config = config or get_cost_config()
+        self.sample_watermarks()
+        return {
+            "config": {
+                "peak_flops": config.peak_flops,
+                "peak_bytes_per_s": config.peak_bytes_per_s,
+                "ridge_intensity": config.ridge_intensity,
+                "saturation_window_s": self.saturation_window_s,
+            },
+            "device_saturation": self.device_saturation.value,
+            "watermarks": {
+                "host_rss_bytes": self.host_rss_bytes.value,
+                "host_rss_peak_bytes": self.host_rss_peak_bytes.value,
+                "device_bytes": self.device_bytes.value,
+                "device_peak_bytes": self.device_peak_bytes.value,
+            },
+            "entries": self.cost_table(config),
+        }
+
+
+_state_lock = threading.Lock()
+_cost_metrics: Optional[CostMetrics] = None
+_active_config: Optional[CostConfig] = None
+
+
+def cost_metrics() -> CostMetrics:
+    """Process-wide :class:`CostMetrics` singleton (lazy)."""
+    global _cost_metrics
+    with _state_lock:
+        if _cost_metrics is None:
+            _cost_metrics = CostMetrics()
+        return _cost_metrics
+
+
+def configure_cost(config: CostConfig) -> CostMetrics:
+    """Apply the ``monitoring.cost`` conf block process-wide (peaks feed
+    the /debug/cost roofline; the window resizes the saturation gauge)."""
+    global _active_config
+    with _state_lock:
+        _active_config = config
+    cm = cost_metrics()
+    cm.saturation_window_s = float(config.saturation_window_s)
+    return cm
+
+
+def get_cost_config() -> CostConfig:
+    """The active config; defaults (enabled, no peaks) when no conf block
+    has been parsed — attribution is on unless explicitly disabled."""
+    with _state_lock:
+        return _active_config if _active_config is not None else CostConfig()
+
+
+__all__ = [
+    "PROGRAM_FIELDS",
+    "CostConfig",
+    "CostMetrics",
+    "configure_cost",
+    "cost_metrics",
+    "extract_cost_analysis",
+    "get_cost_config",
+]

@@ -469,8 +469,14 @@ def build_quality_runtime(
         TimeSeriesStore,
     )
 
+    from distributed_forecasting_tpu.monitoring.cost import (
+        CostConfig,
+        configure_cost,
+        cost_metrics,
+    )
+
     conf = dict(conf or {})
-    known = {"quality", "quality_store", "slo", "tracking_root"}
+    known = {"quality", "quality_store", "slo", "tracking_root", "cost"}
     unknown = set(conf) - known
     if unknown:
         raise ValueError(
@@ -483,6 +489,10 @@ def build_quality_runtime(
     qconf = QualityConfig.from_conf(conf.get("quality"))
     sconf = QualityStoreConfig.from_conf(conf.get("quality_store"))
     slo_conf = SLOConfig.from_conf(conf.get("slo"))
+    # the cost layer applies even when nothing below creates a runtime:
+    # attribution counters and /debug/cost work store-less
+    cconf = CostConfig.from_conf(conf.get("cost"))
+    configure_cost(cconf)
     if not (qconf.enabled or sconf.enabled or slo_conf.enabled):
         return None
     if slo_conf.enabled and not sconf.enabled:
@@ -526,6 +536,15 @@ def build_quality_runtime(
             sources.append(({}, lambda: monitor.registry))
         if slo is not None:
             sources.append(({}, lambda: slo.registry))
+        if cconf.enabled:
+            # host-RSS / device-memory watermarks refresh on the scrape
+            # tick, so the store keeps queryable capacity history
+            def _cost_source():
+                cm = cost_metrics()
+                cm.sample_watermarks()
+                return cm.registry
+
+            sources.append(({}, _cost_source))
         scrape = ScrapeLoop(
             store, sources,
             scrape_interval_s=sconf.scrape_interval_s,

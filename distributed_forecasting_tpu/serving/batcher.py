@@ -108,19 +108,22 @@ class ServingMetrics:
             "requests coalesced into each dispatch")
 
     def render(self) -> str:
-        # The compile-cache and training-pipeline registries ride along on
-        # /metrics so operators can watch warmup hit/miss behaviour and
-        # executor occupancy without a second endpoint.
+        # The compile-cache, training-pipeline, and cost registries ride
+        # along on /metrics so operators can watch warmup hit/miss
+        # behaviour, executor occupancy, and device-time/FLOPs/watermark
+        # telemetry without a second endpoint.
         from distributed_forecasting_tpu.engine.compile_cache import (
             metrics_registry,
         )
+        from distributed_forecasting_tpu.monitoring.cost import cost_metrics
         from distributed_forecasting_tpu.monitoring.monitor import (
             pipeline_metrics,
         )
 
         return (self.registry.render_prometheus()
                 + metrics_registry().render_prometheus()
-                + pipeline_metrics().registry.render_prometheus())
+                + pipeline_metrics().registry.render_prometheus()
+                + cost_metrics().registry.render_prometheus())
 
     def snapshot(self) -> dict:
         return self.registry.snapshot()
@@ -351,7 +354,17 @@ class RequestBatcher:
                 trace_ids=[item.trace_ctx.trace_id for item in chunk
                            if item.trace_ctx is not None],
             ) as span:
-                self._dispatch_inner(chunk, span)
+                # the predictor records per-dispatch device time into the
+                # cost registry; the attribution scope sums THIS thread's
+                # recordings so the span carries the chunk's total even
+                # when a solo-retry fans one chunk into many dispatches
+                from distributed_forecasting_tpu.monitoring.cost import (
+                    cost_metrics,
+                )
+
+                with cost_metrics().attribution() as acc:
+                    self._dispatch_inner(chunk, span)
+                span.set_attribute("device_seconds", acc["device_seconds"])
 
     def _dispatch_inner(self, chunk: list, span) -> None:
         if len(chunk) == 1:

@@ -179,6 +179,17 @@ _GAUGE_MAX_MERGE = frozenset({
     "dftpu_ingest_applied_day",
 })
 
+#: per-replica capacity watermarks (host RSS, device bytes in use) —
+#: max-merged: fleet headroom is set by the WORST replica, and summing
+#: would invent memory pressure no single process has
+_GAUGE_MAX_PREFIX = "dftpu_cost_watermark_"
+
+#: compiled-program cost registry gauges — REPLICATED, not summed: every
+#: replica shares one AOT store and reports the same program fingerprints,
+#: so the first replica's copy stands for the fleet (summing would
+#: multiply FLOPs by the replica count)
+_GAUGE_REPLICATE_PREFIX = "dftpu_cost_program_"
+
 
 def aggregate_prometheus(texts: List[str]) -> str:
     """Merge replica ``/metrics`` expositions, TYPE-aware.
@@ -200,9 +211,16 @@ def aggregate_prometheus(texts: List[str]) -> str:
         (:data:`_GAUGE_MAX_MERGE`) merge the same way: every replica
         reports the SAME on-disk log and applied frontier, so summing a
         3-replica fleet would triple the WAL size and the convergence
-        point is the furthest-ahead replica.
+        point is the furthest-ahead replica.  The per-replica capacity
+        watermarks (:data:`_GAUGE_MAX_PREFIX` — host RSS, device bytes)
+        also merge by MAX: headroom is set by the worst replica.
+      * **``dftpu_cost_program_*`` gauges** REPLICATE — first replica
+        wins: the fleet shares one AOT store, every replica reports the
+        same compiled-program fingerprints, and summing would multiply a
+        program's FLOPs by the replica count.
       * everything else — counters, additive gauges (queue depth in flight
-        across the fleet) — sums by name+labels.
+        across the fleet, ``dftpu_cost_device_saturation``) — sums by
+        name+labels.
     """
     entries: List[tuple] = []      # ("meta", raw) | ("sample", key) |
     #                                ("hist", group_key), in first-seen order
@@ -259,9 +277,13 @@ def aggregate_prometheus(texts: List[str]) -> str:
                 continue
             if key in values:
                 if (name.startswith("dftpu_slo_")
+                        or name.startswith(_GAUGE_MAX_PREFIX)
                         or name in _GAUGE_MAX_MERGE) and \
                         types.get(name) == "gauge":
                     values[key] = max(values[key], v)
+                elif (name.startswith(_GAUGE_REPLICATE_PREFIX)
+                        and types.get(name) == "gauge"):
+                    pass  # replicated registry value: keep the first copy
                 else:
                     values[key] += v
             else:

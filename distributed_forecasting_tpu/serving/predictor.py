@@ -31,7 +31,9 @@ import numpy as np
 import pandas as pd
 
 from distributed_forecasting_tpu.models.base import get_model
+from distributed_forecasting_tpu.monitoring.cost import cost_metrics
 from distributed_forecasting_tpu.monitoring.trace import (
+    clock as trace_clock,
     device_annotation,
     get_tracer,
 )
@@ -618,7 +620,11 @@ class BatchForecaster:
         with get_tracer().span(
             "serving.predict", model=self.model, k=k,
             bucket=self._bucket(k), horizon=int(horizon),
-        ):
+        ) as span:
+            # device-time attribution (monitoring/cost.py): the interval
+            # from dispatch through the np.asarray host pulls below, on the
+            # span clock — what this request cost in device-seconds
+            t_disp = trace_clock()
             # the annotation stamps this dispatch onto the device timeline
             # of a profiler capture, keyed like the AOT entry
             with device_annotation(entry):
@@ -651,6 +657,9 @@ class BatchForecaster:
             frame["yhat"] = np.asarray(yhat)[:k].reshape(-1)
             frame["yhat_upper"] = np.asarray(hi)[:k].reshape(-1)
             frame["yhat_lower"] = np.asarray(lo)[:k].reshape(-1)
+            dev = trace_clock() - t_disp
+            span.set_attribute("device_seconds", dev)
+            cost_metrics().record_dispatch(entry, self.model, dev)
             return pd.DataFrame(frame)
 
     def predict_quantiles(
@@ -683,11 +692,12 @@ class BatchForecaster:
         if sidx.size == 0:
             return pd.DataFrame(columns=["ds", *self.key_names, *qcols])
         k = int(sidx.size)
+        entry = self._aot_entry("serving_predict_quantiles")
         with get_tracer().span(
             "serving.predict_quantiles", model=self.model, k=k,
             bucket=self._bucket(k), horizon=int(horizon),
             n_quantiles=len(quantiles),
-        ):
+        ) as span:
             # conformal scaling spreads every level around the median, so
             # the median is priced alongside when calibration is on (one
             # extra column in the same compiled program) and dropped if
@@ -695,8 +705,8 @@ class BatchForecaster:
             priced = quantiles
             if scale is not None and 0.5 not in priced:
                 priced = tuple(sorted((*priced, 0.5)))
-            with device_annotation(
-                    self._aot_entry("serving_predict_quantiles")):
+            t_disp = trace_clock()
+            with device_annotation(entry):
                 yq = fns.forecast_quantiles(
                     params, day_all, jnp.float32(t_end), self.config,
                     priced, key, **fc_kwargs,
@@ -718,6 +728,9 @@ class BatchForecaster:
                 day_all = day_all[-horizon:]
                 yq = yq[:, :, -horizon:]
             yq = np.asarray(yq)[:k]
+            dev = trace_clock() - t_disp
+            span.set_attribute("device_seconds", dev)
+            cost_metrics().record_dispatch(entry, self.model, dev)
             frame = self._frame_skeleton(sidx, day_all)
             for qi, col in enumerate(qcols):
                 frame[col] = yq[:, qi, :].reshape(-1)

@@ -268,6 +268,20 @@ class _Handler(BaseHTTPRequestHandler):
                                           "(serving.ingest conf block)"})
                 return
             self._send(200, ingest.snapshot())
+        elif parsed.path == "/debug/cost":
+            from distributed_forecasting_tpu.monitoring.cost import (
+                cost_metrics,
+                get_cost_config,
+            )
+
+            cconf = get_cost_config()
+            if not cconf.enabled:
+                self._send(503, {"error": "cost observability disabled "
+                                          "(monitoring.cost conf block)"})
+                return
+            # per-entry cost table + roofline placement when the conf
+            # carries backend peaks; watermarks are freshly sampled
+            self._send(200, cost_metrics().snapshot(cconf))
         else:
             self._send(404, {"error": f"no route {parsed.path}"})
 

@@ -64,6 +64,11 @@ Conf::
         directory: null       # default <env.root>/quality_store
         retention_s: 604800
         scrape_interval_s: 30
+      cost:                   # runtime cost & capacity (monitoring/cost.py
+        enabled: true         # — dftpu_cost_* gauges, device-seconds
+        peak_flops: 0.0       # attribution, watermarks, /debug/cost;
+        peak_bytes_per_s: 0.0 # peaks > 0 add roofline placement)
+        saturation_window_s: 60
       slo:                    # burn-rate alerting (monitoring/slo.py)
         enabled: true
         error_budget: 0.05

@@ -81,19 +81,19 @@ def main() -> None:
         return yh.sum() + lo.sum() + hi.sum()
 
     # ---- XLA cost analysis of ONE batch's full engine pass ----------------
+    # same extraction the serving-side cost registry uses (monitoring/cost.py)
+    from distributed_forecasting_tpu.monitoring.cost import (
+        extract_cost_analysis,
+    )
+
     jitted = jax.jit(full_pass)
     lowered = jitted.lower(Y[0], M[0])
     compiled = lowered.compile()
-    flops = bytes_acc = None
-    try:
-        ca = compiled.cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0]
-        flops = float(ca.get("flops", float("nan")))
-        bytes_acc = float(ca.get("bytes accessed", float("nan")))
-    except Exception as e:
-        print(f"cost_analysis unavailable: {type(e).__name__}: {e}",
-              file=sys.stderr)
+    costs = extract_cost_analysis(compiled)
+    flops = costs.get("flops")
+    bytes_acc = costs.get("bytes_accessed")
+    if not costs:
+        print("cost_analysis unavailable on this backend", file=sys.stderr)
 
     # analytic floor for cross-check: Gram einsum + forecast matmul + chol
     from distributed_forecasting_tpu.models.prophet_glm import _design

@@ -1,0 +1,421 @@
+"""Perf-regression sentinel: cost-fingerprint + warm-path timing diffs.
+
+Two modes, designed around one CI invariant: a change that silently makes
+the compiled programs bigger (more FLOPs / more bytes moved), reintroduces
+warm-path recompiles, or changes what the model actually serves must fail
+the build — while ordinary shared-runner timing noise must not.
+
+Collect (writes one JSON record)::
+
+    python scripts/perf_report.py --collect cold.json --workdir /tmp/perf
+    python scripts/perf_report.py --collect warm.json --workdir /tmp/perf \\
+        --expect-warm        # fresh process + warm store: zero misses or die
+
+The collect workload is the serving warm path in miniature: fit a small
+prophet batch through the AOT compile cache, then time repeated
+``BatchForecaster.predict`` dispatches.  The record carries the backend
+fingerprint, the per-entry compiled-program cost registry
+(``monitoring/cost.py``), AOT-store outcome counters, warm-dispatch latency
+quantiles, and a sha256 of the served frame.
+
+Diff (compares records, exits non-zero under ``--strict`` on any FAIL)::
+
+    python scripts/perf_report.py --baseline PERF_BASELINE.json \\
+        --current warm.json --cold cold.json --strict \\
+        --report report.json --bench-out BENCH_r06.json
+
+Severity model — what fails vs what only warns:
+
+* compiled-program cost drift (FLOPs / bytes / peak memory per entry) with
+  MATCHING backend fingerprints: **fail** — costs are deterministic
+  program properties, so any delta is a real code change, not noise;
+* warm-path recompiles (``outcome=miss`` in the current record): **fail**;
+* cold-vs-current output hash mismatch (same process ladder, same
+  machine): **fail** — the cache changed what the model serves;
+* timing regression: compared against a noise floor that widens to 35%
+  when either side ran on CPU (shared-runner fallback; docs/benchmarks.md
+  records why CPU numbers are not perf statements) and tightens to 15%
+  on a real accelerator — beyond the floor **fails**, within it is ok;
+* differing backend fingerprints: cost + timing comparisons are skipped
+  with a **warn** (an XLA upgrade legitimately re-costs every program —
+  refresh the baseline with --write-baseline instead of chasing deltas).
+
+``--write-baseline`` rewrites the baseline file from the current record
+after an intentional change (new model, new jaxlib); the diff output in
+the PR shows reviewers exactly what moved.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional
+
+FORMAT = "dftpu-perf-baseline-v1"
+
+#: relative timing tolerance: CPU-fallback runs (shared CI runners, tunnel
+#: outages) jitter far more than a reserved accelerator does
+NOISE_FLOOR_CPU = 0.35
+NOISE_FLOOR_DEVICE = 0.15
+
+#: cost fields compared entry-by-entry; peak memory drifts with XLA's
+#: allocator so it gets a small relative tolerance, the rest are exact
+COST_FIELDS_EXACT = ("flops", "bytes_accessed", "argument_bytes",
+                     "output_bytes")
+COST_FIELDS_LOOSE = ("temp_bytes", "peak_bytes")
+COST_LOOSE_RTOL = 0.10
+
+
+# -- collect -----------------------------------------------------------------
+
+def collect(workdir: str, reps: int = 20, expect_warm: bool = False) -> Dict:
+    """Run the miniature warm path and return the perf record."""
+    import distributed_forecasting_tpu  # noqa: F401  (platform override)
+    import pandas as pd
+
+    from distributed_forecasting_tpu.data import (
+        synthetic_store_item_sales,
+        tensorize,
+    )
+    from distributed_forecasting_tpu.engine import fit_forecast
+    from distributed_forecasting_tpu.engine.compile_cache import (
+        CompileCacheConfig,
+        backend_fingerprint,
+        cache_stats,
+        configure_compile_cache,
+        metrics_registry,
+    )
+    from distributed_forecasting_tpu.models import CurveModelConfig
+    from distributed_forecasting_tpu.monitoring.cost import cost_metrics
+    from distributed_forecasting_tpu.serving import BatchForecaster
+
+    configure_compile_cache(
+        CompileCacheConfig(enabled=True, directory=workdir))
+
+    df = synthetic_store_item_sales(n_stores=2, n_items=3, n_days=400, seed=7)
+    batch = tensorize(df)
+    cfg = CurveModelConfig()
+    params, _ = fit_forecast(batch, model="prophet", config=cfg, horizon=30)
+    fc = BatchForecaster.from_fit(batch, params, "prophet", cfg)
+
+    req = pd.DataFrame({"store": [1, 1, 2], "item": [1, 2, 3]})
+    out = fc.predict(req, horizon=30)  # warmup: compile or store-load
+    samples = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fc.predict(req, horizon=30)
+        samples.append(time.perf_counter() - t0)
+    samples.sort()
+    rows_per_dispatch = len(out)
+
+    # per-(entry, shape-bucket) compiled-program costs, re-keyed by entry
+    cm = cost_metrics()
+    programs: Dict[str, Dict[str, float]] = {}
+    for field, gauge in cm.program.items():
+        for label_str, value in gauge.snapshot().items():
+            labels = dict(part.partition("=")[::2]
+                          for part in label_str.split(","))
+            bucket = programs.setdefault(
+                f"{labels.get('entry', '')}|{labels.get('key', '')}", {})
+            bucket[field] = value
+
+    outcomes = _entry_outcomes(metrics_registry().snapshot())
+    misses = sorted(e for e, o in outcomes.items() if o.get("miss"))
+    if expect_warm and misses:
+        raise SystemExit(
+            f"perf_report --expect-warm: warm path recompiled "
+            f"{len(misses)} entr{'y' if len(misses) == 1 else 'ies'}: "
+            f"{', '.join(misses)} (the AOT store should have served these)")
+
+    p50 = samples[len(samples) // 2]
+    return {
+        "format": FORMAT,
+        "backend": backend_fingerprint(),
+        "workload": {"n_stores": 2, "n_items": 3, "n_days": 400,
+                     "horizon": 30, "request_series": 3, "reps": reps},
+        "cache": cache_stats(),
+        "entry_outcomes": outcomes,
+        "programs": programs,
+        "timings_ms": {
+            "min": round(samples[0] * 1e3, 3),
+            "p50": round(p50 * 1e3, 3),
+            "p90": round(samples[int(0.9 * (len(samples) - 1))] * 1e3, 3),
+            "max": round(samples[-1] * 1e3, 3),
+        },
+        "throughput_rows_per_s": round(rows_per_dispatch / p50, 1),
+        "output_sha256": hashlib.sha256(
+            out.to_csv(index=False).encode()).hexdigest(),
+    }
+
+
+def _entry_outcomes(registry_snapshot: Dict) -> Dict[str, Dict[str, float]]:
+    """``compile_cache_entry_requests_total`` snapshot -> per-entry outcome
+    counts ``{entry: {memo|hit|miss: n}}``."""
+    raw = registry_snapshot.get("compile_cache_entry_requests_total") or {}
+    out: Dict[str, Dict[str, float]] = {}
+    for label_str, value in raw.items():
+        labels = dict(part.partition("=")[::2]
+                      for part in label_str.split(","))
+        entry = labels.get("entry", "")
+        out.setdefault(entry, {})[labels.get("outcome", "")] = value
+    return out
+
+
+# -- diff --------------------------------------------------------------------
+
+def _finding(check: str, level: str, detail: str) -> Dict:
+    return {"check": check, "level": level, "detail": detail}
+
+
+def _programs_by_entry(record: Dict) -> Dict[str, List[Dict[str, float]]]:
+    """Shape-bucket cost dicts grouped per entry, value-sorted so bucket-key
+    churn (fingerprints shift with statics ordering) doesn't alias drift."""
+    by_entry: Dict[str, List[Dict[str, float]]] = {}
+    for key, costs in (record.get("programs") or {}).items():
+        entry = key.split("|", 1)[0]
+        by_entry.setdefault(entry, []).append(costs)
+    for buckets in by_entry.values():
+        buckets.sort(key=lambda c: sorted(c.items()))
+    return by_entry
+
+
+def diff_records(baseline: Dict, current: Dict,
+                 cold: Optional[Dict] = None) -> List[Dict]:
+    """Compare perf records; returns findings ``{check, level, detail}``
+    with level ok | warn | fail."""
+    findings: List[Dict] = []
+    same_backend = baseline.get("backend") == current.get("backend")
+    platforms = {(r.get("backend") or {}).get("platform")
+                 for r in (baseline, current)}
+    on_cpu = "cpu" in platforms
+
+    if not same_backend:
+        findings.append(_finding(
+            "backend", "warn",
+            f"backend fingerprints differ (baseline "
+            f"{baseline.get('backend')}, current {current.get('backend')}); "
+            f"cost + timing comparisons skipped — refresh the baseline if "
+            f"this is an intentional toolchain change"))
+    else:
+        findings.append(_finding(
+            "backend", "ok",
+            f"matching backend: {current.get('backend', {}).get('platform')}"
+            f" ({current.get('backend', {}).get('device_kind')})"))
+        findings.extend(_diff_costs(baseline, current))
+        findings.append(_diff_timing(baseline, current, on_cpu))
+
+    findings.append(_diff_recompiles(current))
+
+    if cold is not None:
+        a, b = cold.get("output_sha256"), current.get("output_sha256")
+        if a and b and a != b:
+            findings.append(_finding(
+                "output_hash", "fail",
+                f"cold-run output {a[:12]} != warm-run output {b[:12]}: the "
+                f"compile cache changed what the model serves"))
+        else:
+            findings.append(_finding(
+                "output_hash", "ok",
+                f"cold and warm runs served byte-identical frames "
+                f"({(a or '?')[:12]})"))
+    return findings
+
+
+def _diff_costs(baseline: Dict, current: Dict) -> List[Dict]:
+    findings: List[Dict] = []
+    base, cur = _programs_by_entry(baseline), _programs_by_entry(current)
+    drifted = False
+    for entry in sorted(set(base) | set(cur)):
+        if entry not in cur:
+            findings.append(_finding(
+                "cost_registry", "warn",
+                f"entry {entry!r} in baseline but not exercised by the "
+                f"current run"))
+            continue
+        if entry not in base:
+            findings.append(_finding(
+                "cost_registry", "warn",
+                f"new compiled entry {entry!r} not in the baseline "
+                f"(refresh with --write-baseline if intentional)"))
+            continue
+        b_buckets, c_buckets = base[entry], cur[entry]
+        if len(b_buckets) != len(c_buckets):
+            drifted = True
+            findings.append(_finding(
+                "cost_registry", "fail",
+                f"{entry}: shape-bucket count {len(b_buckets)} -> "
+                f"{len(c_buckets)} on an identical backend"))
+            continue
+        for b_costs, c_costs in zip(b_buckets, c_buckets):
+            for field in COST_FIELDS_EXACT:
+                bv, cv = b_costs.get(field), c_costs.get(field)
+                if bv is not None and cv is not None and bv != cv:
+                    drifted = True
+                    findings.append(_finding(
+                        "cost_registry", "fail",
+                        f"{entry}: {field} {bv:g} -> {cv:g} "
+                        f"({_pct(bv, cv)}) on an identical backend"))
+            for field in COST_FIELDS_LOOSE:
+                bv, cv = b_costs.get(field), c_costs.get(field)
+                if (bv and cv is not None
+                        and abs(cv - bv) > COST_LOOSE_RTOL * bv):
+                    drifted = True
+                    findings.append(_finding(
+                        "cost_registry", "fail",
+                        f"{entry}: {field} {bv:g} -> {cv:g} "
+                        f"({_pct(bv, cv)}, tolerance "
+                        f"{COST_LOOSE_RTOL:.0%})"))
+    if not drifted:
+        findings.append(_finding(
+            "cost_registry", "ok",
+            f"compiled-program costs unchanged across "
+            f"{len(set(base) & set(cur))} shared entr"
+            f"{'y' if len(set(base) & set(cur)) == 1 else 'ies'}"))
+    return findings
+
+
+def _diff_timing(baseline: Dict, current: Dict, on_cpu: bool) -> Dict:
+    floor = NOISE_FLOOR_CPU if on_cpu else NOISE_FLOOR_DEVICE
+    b = (baseline.get("timings_ms") or {}).get("p50")
+    c = (current.get("timings_ms") or {}).get("p50")
+    if not b or not c:
+        return _finding("warm_latency", "warn",
+                        "p50 missing from a record; timing diff skipped")
+    ratio = c / b
+    detail = (f"warm predict p50 {b:.3f}ms -> {c:.3f}ms "
+              f"(x{ratio:.2f}; noise floor {floor:.0%}"
+              f"{', CPU-fallback' if on_cpu else ''})")
+    if ratio > 1.0 + floor:
+        return _finding("warm_latency", "fail", detail)
+    return _finding("warm_latency", "ok", detail)
+
+
+def _diff_recompiles(current: Dict) -> Dict:
+    missed = sorted(
+        e for e, o in (current.get("entry_outcomes") or {}).items()
+        if o.get("miss"))
+    if missed:
+        return _finding(
+            "warm_recompiles", "fail",
+            f"current run recompiled {len(missed)} entr"
+            f"{'y' if len(missed) == 1 else 'ies'} the AOT store should "
+            f"have served: {', '.join(missed)}")
+    return _finding("warm_recompiles", "ok",
+                    "zero warm-path recompiles (all memo/hit)")
+
+
+def _pct(bv: float, cv: float) -> str:
+    return f"{100.0 * (cv - bv) / bv:+.1f}%" if bv else "n/a"
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def _load(path: str) -> Dict:
+    with open(path) as f:
+        record = json.load(f)
+    if record.get("format") != FORMAT:
+        raise SystemExit(
+            f"{path}: format {record.get('format')!r} != {FORMAT!r}")
+    return record
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--collect", metavar="OUT",
+                    help="run the warm-path workload, write a perf record")
+    ap.add_argument("--workdir", default="/tmp/dftpu_perf",
+                    help="compile-cache directory for --collect")
+    ap.add_argument("--reps", type=int, default=20)
+    ap.add_argument("--expect-warm", action="store_true",
+                    help="--collect fails if any AOT entry recompiles")
+    ap.add_argument("--baseline", help="committed baseline record to diff")
+    ap.add_argument("--current", help="freshly collected record")
+    ap.add_argument("--cold", help="cold-run record for output-hash check")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 when any finding is level=fail")
+    ap.add_argument("--report", metavar="OUT",
+                    help="write the findings JSON here as well")
+    ap.add_argument("--bench-out", metavar="OUT",
+                    help="emit a BENCH_r*.json-shaped artifact")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite --baseline from --current after the diff")
+    args = ap.parse_args()
+
+    if args.collect:
+        sys.path.insert(0, os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        record = collect(args.workdir, reps=args.reps,
+                         expect_warm=args.expect_warm)
+        with open(args.collect, "w") as f:
+            json.dump(record, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"perf_report: wrote {args.collect} "
+              f"(p50 {record['timings_ms']['p50']}ms, "
+              f"{len(record['programs'])} program bucket(s), "
+              f"backend {record['backend']['platform']})")
+        return
+
+    if not (args.baseline and args.current):
+        ap.error("either --collect OUT or --baseline B --current C")
+    baseline, current = _load(args.baseline), _load(args.current)
+    cold = _load(args.cold) if args.cold else None
+    findings = diff_records(baseline, current, cold=cold)
+    worst = ("fail" if any(f["level"] == "fail" for f in findings)
+             else "warn" if any(f["level"] == "warn" for f in findings)
+             else "ok")
+    report = {"report": "perf_sentinel", "status": worst,
+              "baseline": args.baseline, "current": args.current,
+              "findings": findings}
+    print(json.dumps(report, indent=2))
+    if args.report:
+        with open(args.report, "w") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
+    if args.bench_out:
+        b = (baseline.get("timings_ms") or {}).get("p50") or 0.0
+        c = (current.get("timings_ms") or {}).get("p50") or 0.0
+        backend = current.get("backend") or {}
+        _write_bench(args.bench_out, report, current, b, c, backend)
+    if args.write_baseline:
+        with open(args.baseline, "w") as f:
+            json.dump(current, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"perf_report: baseline {args.baseline} refreshed",
+              file=sys.stderr)
+    if args.strict and worst == "fail":
+        sys.exit(1)
+
+
+def _write_bench(path: str, report: Dict, current: Dict,
+                 base_p50: float, cur_p50: float, backend: Dict) -> None:
+    """BENCH_r*.json-shaped artifact so the bench trajectory stays one
+    schema (see BENCH_r05.json)."""
+    tail = "\n".join(
+        f"[sentinel] {f['check']}: {f['level']} — {f['detail']}"
+        for f in report["findings"]) + "\n"
+    bench = {
+        "n": 6,
+        "cmd": ("python scripts/perf_report.py --baseline PERF_BASELINE.json"
+                " --current warm.json --cold cold.json --strict"),
+        "rc": 0 if report["status"] != "fail" else 1,
+        "tail": tail,
+        "parsed": {
+            "metric": "serving_warm_predict_p50_ms",
+            "value": cur_p50,
+            "unit": "ms",
+            "vs_baseline": round(cur_p50 / base_p50, 3) if base_p50 else None,
+            "device": f"{backend.get('platform', '?')}:"
+                      f"{backend.get('device_kind', '?')}",
+        },
+    }
+    with open(path, "w") as f:
+        json.dump(bench, f, indent=2)
+        f.write("\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -113,14 +113,23 @@ def load_spans(path: str) -> List[Dict]:
 def by_kind(spans: List[Dict]) -> List[Dict]:
     kinds: Dict[str, List[float]] = {}
     errors: Dict[str, int] = {}
+    device_ms: Dict[str, float] = {}
     for s in spans:
         kinds.setdefault(s["name"], []).append(float(s["duration_ms"]))
         if s.get("status", "ok") != "ok":
             errors[s["name"]] = errors.get(s["name"], 0) + 1
+        # dispatch spans stamped by the cost-attribution layer carry a
+        # device_seconds attribute; older traces simply don't have it
+        try:
+            dev = float((s.get("attrs") or {}).get("device_seconds", 0.0))
+        except (TypeError, ValueError):
+            dev = 0.0
+        if dev:
+            device_ms[s["name"]] = device_ms.get(s["name"], 0.0) + dev * 1e3
     out = []
     for name, durs in kinds.items():
         durs.sort()
-        out.append({
+        row = {
             "kind": name,
             "count": len(durs),
             "errors": errors.get(name, 0),
@@ -129,7 +138,10 @@ def by_kind(spans: List[Dict]) -> List[Dict]:
             "p99_ms": round(_percentile(durs, 0.99), 3),
             "max_ms": round(durs[-1], 3),
             "total_ms": round(sum(durs), 3),
-        })
+        }
+        if name in device_ms:
+            row["device_ms"] = round(device_ms[name], 3)
+        out.append(row)
     return out
 
 

@@ -1,0 +1,411 @@
+"""Runtime cost & capacity observability (monitoring/cost.py): the program
+cost registry fed by the compile cache, device-time attribution and the
+saturation gauge, memory watermarks, fleet merge semantics for the
+``dftpu_cost_*`` families, the /debug/cost surface, and the perf-regression
+sentinel's diff logic (scripts/perf_report.py)."""
+
+import importlib.util
+import json
+import os
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from distributed_forecasting_tpu.monitoring import cost as cost_mod
+from distributed_forecasting_tpu.monitoring.cost import (
+    CostConfig,
+    CostMetrics,
+    extract_cost_analysis,
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _load_script(name):
+    path = os.path.join(_REPO, "scripts", f"{name}.py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture
+def fresh_cost():
+    """Isolate the process-wide cost singleton + active config."""
+    with cost_mod._state_lock:
+        prev = cost_mod._cost_metrics, cost_mod._active_config
+        cost_mod._cost_metrics, cost_mod._active_config = None, None
+    yield
+    with cost_mod._state_lock:
+        cost_mod._cost_metrics, cost_mod._active_config = prev
+
+
+# -- extraction ---------------------------------------------------------------
+
+def test_extract_cost_analysis_real_program():
+    import jax
+    import jax.numpy as jnp
+
+    fn = jax.jit(lambda x: (x @ x.T).sum())
+    compiled = fn.lower(jnp.ones((16, 16), jnp.float32)).compile()
+    costs = extract_cost_analysis(compiled)
+    assert costs.get("flops", 0) > 0
+    # memory_analysis holds on every backend; peak falls back to
+    # arg+out+temp where no explicit peak is reported
+    assert costs.get("peak_bytes", 0) > 0
+    assert costs.get("argument_bytes", 0) >= 16 * 16 * 4
+
+
+def test_extract_cost_analysis_tolerates_broken_backends():
+    class Broken:
+        def cost_analysis(self):
+            raise NotImplementedError
+
+        def memory_analysis(self):
+            raise NotImplementedError
+
+    assert extract_cost_analysis(Broken()) == {}
+
+
+# -- config -------------------------------------------------------------------
+
+def test_cost_config_strict():
+    cfg = CostConfig.from_conf(None)
+    assert cfg.enabled and cfg.ridge_intensity == 0.0
+    cfg = CostConfig.from_conf(
+        {"enabled": True, "peak_flops": 197e12, "peak_bytes_per_s": 819e9})
+    assert cfg.ridge_intensity == pytest.approx(197e12 / 819e9)
+    with pytest.raises(ValueError, match="unknown"):
+        CostConfig.from_conf({"peak_flop": 1.0})
+    with pytest.raises(ValueError):
+        CostConfig(saturation_window_s=0.0)
+    with pytest.raises(ValueError):
+        CostConfig(peak_flops=-1.0)
+
+
+# -- attribution --------------------------------------------------------------
+
+def test_record_dispatch_counters_and_saturation():
+    cm = CostMetrics()
+    for _ in range(3):
+        cm.record_dispatch("serving_predict:prophet", "prophet", 0.05)
+    secs = cm.device_seconds_total.snapshot()
+    disp = cm.dispatches_total.snapshot()
+    label = "entry=serving_predict:prophet,family=prophet"
+    assert secs[label] == pytest.approx(0.15)
+    assert disp[label] == 3.0
+    # three dispatches landed in well under the window, so the young-process
+    # elapsed divisor makes saturation visibly positive
+    assert cm.device_saturation.value > 0
+    # negative intervals (clock skew) clip to zero, never subtract
+    cm.record_dispatch("serving_predict:prophet", "prophet", -1.0)
+    assert cm.device_seconds_total.snapshot()[label] == pytest.approx(0.15)
+
+
+def test_attribution_scope_is_thread_local():
+    cm = CostMetrics()
+    with cm.attribution() as acc:
+        cm.record_dispatch("e", "f", 0.01)
+        t = threading.Thread(
+            target=lambda: cm.record_dispatch("e", "f", 5.0))
+        t.start()
+        t.join()
+    # the other thread's 5s dispatch hit the counters but not this scope
+    assert acc["dispatches"] == 1
+    assert acc["device_seconds"] == pytest.approx(0.01)
+    assert cm.device_seconds_total.snapshot()["entry=e,family=f"] == \
+        pytest.approx(5.01)
+    # outside the scope, recording no longer accumulates anywhere
+    cm.record_dispatch("e", "f", 0.02)
+    assert acc["dispatches"] == 1
+
+
+# -- program registry + roofline ----------------------------------------------
+
+def test_cost_table_joins_registry_with_attribution():
+    cm = CostMetrics()
+    cm.record_program(
+        "fit_forecast:prophet",
+        {"flops": 1e9, "bytes_accessed": 1e8, "peak_bytes": 5e6},
+        key="abcd1234")
+    cm.record_dispatch("fit_forecast:prophet", "prophet", 0.01)
+    cm.record_dispatch("fit_forecast:prophet", "prophet", 0.01)
+    cfg = CostConfig(peak_flops=1e12, peak_bytes_per_s=1e11)  # ridge = 10
+    rows = cm.cost_table(cfg)
+    assert len(rows) == 1
+    row = rows[0]
+    assert row["entry"] == "fit_forecast:prophet"
+    assert row["key"] == "abcd1234"
+    assert row["dispatches"] == 2.0
+    assert row["operational_intensity"] == pytest.approx(10.0)
+    # oi == ridge -> compute-bound, attainable = peak_flops
+    assert row["bound"] == "compute"
+    assert row["attainable_flops_per_s"] == pytest.approx(1e12)
+    # 2 dispatches x 1e9 FLOPs over 0.02s device = 1e11 FLOP/s achieved
+    assert row["achieved_flops_per_s"] == pytest.approx(1e11)
+    assert row["fraction_of_attainable"] == pytest.approx(0.1)
+
+
+def test_cost_table_attribution_only_entries_get_rows():
+    # dispatches recorded for an entry the registry never saw (cost
+    # analysis unavailable) still show up, just without program numbers
+    cm = CostMetrics()
+    cm.record_dispatch("pipeline.dispatch", "theta", 0.2)
+    rows = cm.cost_table(CostConfig())
+    assert [r["entry"] for r in rows] == ["pipeline.dispatch"]
+    assert rows[0]["device_seconds"] == pytest.approx(0.2)
+    assert "flops" not in rows[0]
+    assert "bound" not in rows[0]
+
+
+def test_watermarks_sampled_into_gauges():
+    cm = CostMetrics()
+    cm.sample_watermarks()
+    # /proc/self/status exists on the CI/container hosts these tests run on
+    assert cm.host_rss_bytes.value > 0
+    assert cm.host_rss_peak_bytes.value >= cm.host_rss_bytes.value
+    text = cm.registry.render_prometheus()
+    assert "dftpu_cost_watermark_host_rss_bytes" in text
+    assert "dftpu_cost_device_saturation" in text
+
+
+# -- fleet merge semantics ----------------------------------------------------
+
+def test_fleet_merge_semantics_for_cost_families():
+    from distributed_forecasting_tpu.serving.fleet import aggregate_prometheus
+
+    def exposition(secs, rss, flops, sat):
+        return (
+            "# TYPE dftpu_cost_device_seconds_total counter\n"
+            f'dftpu_cost_device_seconds_total{{entry="e",family="prophet"}} '
+            f"{secs}\n"
+            "# TYPE dftpu_cost_watermark_host_rss_bytes gauge\n"
+            f"dftpu_cost_watermark_host_rss_bytes {rss}\n"
+            "# TYPE dftpu_cost_program_flops gauge\n"
+            f'dftpu_cost_program_flops{{entry="e",key="abcd1234"}} {flops}\n'
+            "# TYPE dftpu_cost_device_saturation gauge\n"
+            f"dftpu_cost_device_saturation {sat}\n")
+
+    merged = aggregate_prometheus([
+        exposition(1.5, 100, 7e9, 0.5),
+        exposition(2.5, 300, 7e9, 0.25),
+    ])
+    # counters SUM: device work is additive across replicas
+    assert ('dftpu_cost_device_seconds_total{entry="e",family="prophet"} 4'
+            in merged)
+    # watermarks MAX: headroom is set by the worst replica
+    assert "dftpu_cost_watermark_host_rss_bytes 300" in merged
+    # program registry REPLICATES: shared AOT store, first copy stands
+    assert 'dftpu_cost_program_flops{entry="e",key="abcd1234"} 7000000000' \
+        in merged
+    # saturation SUMS: 0.75 device-seconds/s of work across the fleet
+    assert "dftpu_cost_device_saturation 0.75" in merged
+
+
+# -- compile-cache capture ----------------------------------------------------
+
+def test_compile_cache_records_program_costs(tmp_path, fresh_cost):
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_forecasting_tpu.engine.compile_cache import (
+        CompileCacheConfig,
+        aot_call,
+        configure_compile_cache,
+    )
+
+    configure_compile_cache(CompileCacheConfig(
+        enabled=True, directory=str(tmp_path / "cc")))
+    try:
+        fn = jax.jit(lambda x: (x * 2.0).sum())
+        aot_call("test_cost_capture", fn, (jnp.arange(64.0),))
+        snap = cost_mod.cost_metrics().program["flops"].snapshot()
+        mine = {k: v for k, v in snap.items()
+                if k.startswith("entry=test_cost_capture,")}
+        assert len(mine) == 1
+        (label, flops), = mine.items()
+        assert flops > 0
+        # the shape-bucket key label is the 8-char fingerprint prefix
+        assert len(label.split("key=")[1]) == 8
+    finally:
+        configure_compile_cache(CompileCacheConfig(enabled=False))
+
+
+# -- /debug/cost + /metrics ---------------------------------------------------
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30) as r:
+        body = r.read()
+        try:
+            return r.status, json.loads(body)
+        except ValueError:
+            return r.status, body.decode()
+
+
+def test_debug_cost_endpoint_gated(fresh_cost):
+    from test_batcher import FakeForecaster
+
+    from distributed_forecasting_tpu.monitoring.trace import (
+        TraceConfig,
+        configure_tracing,
+    )
+    from distributed_forecasting_tpu.serving import start_server
+
+    try:
+        # dark by default: debug endpoints are a tracing opt-in
+        configure_tracing(TraceConfig(enabled=True, debug_endpoints=False))
+        srv = start_server(FakeForecaster())
+        try:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _get(srv.server_address[1], "/debug/cost")
+            assert e.value.code == 404
+        finally:
+            srv.shutdown()
+
+        configure_tracing(TraceConfig(enabled=True, debug_endpoints=True))
+        srv = start_server(FakeForecaster())
+        port = srv.server_address[1]
+        try:
+            # conf-disabled cost observability -> 503, like the other
+            # debug surfaces whose subsystem is off
+            cost_mod.configure_cost(CostConfig(enabled=False))
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _get(port, "/debug/cost")
+            assert e.value.code == 503
+
+            cost_mod.configure_cost(CostConfig(
+                enabled=True, peak_flops=1e12, peak_bytes_per_s=1e11))
+            cm = cost_mod.cost_metrics()
+            cm.record_program("serving_predict:fake",
+                              {"flops": 4e8, "bytes_accessed": 2e7},
+                              key="beefcafe")
+            cm.record_dispatch("serving_predict:fake", "fake", 0.004)
+            code, snap = _get(port, "/debug/cost")
+            assert code == 200
+            assert snap["config"]["ridge_intensity"] == pytest.approx(10.0)
+            assert snap["watermarks"]["host_rss_bytes"] > 0
+            (row,) = [r for r in snap["entries"]
+                      if r["entry"] == "serving_predict:fake"]
+            assert row["bound"] == "compute"  # oi 20 vs ridge 10
+            assert row["dispatches"] == 1.0
+
+            # the cost registry rides the replica /metrics exposition
+            code, text = _get(port, "/metrics")
+            assert code == 200
+            assert "dftpu_cost_device_saturation" in text
+            assert 'dftpu_cost_program_flops{entry="serving_predict:fake"' \
+                in text
+        finally:
+            srv.shutdown()
+    finally:
+        configure_tracing(TraceConfig())
+
+
+# -- perf sentinel ------------------------------------------------------------
+
+def _perf_record(p50=5.0, flops=1e6, miss=0, sha="aa11", backend=None):
+    return {
+        "format": "dftpu-perf-baseline-v1",
+        "backend": backend or {"platform": "cpu", "device_kind": "cpu",
+                               "n_devices": 1, "jax": "j", "jaxlib": "jl"},
+        "programs": {
+            "serving_predict:prophet|abcd1234": {
+                "flops": flops, "bytes_accessed": 2e6, "peak_bytes": 1e5},
+        },
+        "entry_outcomes": {
+            "serving_predict:prophet": {"hit": 3.0, "miss": float(miss)},
+        },
+        "timings_ms": {"p50": p50},
+        "output_sha256": sha,
+    }
+
+
+def _levels(findings):
+    return {f["check"]: f["level"] for f in findings}
+
+
+def test_perf_sentinel_clean_diff_passes():
+    pr = _load_script("perf_report")
+    findings = pr.diff_records(_perf_record(), _perf_record(),
+                               cold=_perf_record())
+    assert set(_levels(findings).values()) == {"ok"}
+
+
+def test_perf_sentinel_fails_on_injected_cost_regression():
+    pr = _load_script("perf_report")
+    findings = pr.diff_records(_perf_record(flops=1e6),
+                               _perf_record(flops=1.5e6))
+    levels = _levels(findings)
+    assert levels["cost_registry"] == "fail"
+    # costs are deterministic: even a tiny drift on an identical backend
+    # is a real change, not noise
+    findings = pr.diff_records(_perf_record(flops=1e6),
+                               _perf_record(flops=1e6 + 1))
+    assert _levels(findings)["cost_registry"] == "fail"
+
+
+def test_perf_sentinel_fails_on_warm_recompiles_and_output_drift():
+    pr = _load_script("perf_report")
+    findings = pr.diff_records(_perf_record(), _perf_record(miss=2))
+    assert _levels(findings)["warm_recompiles"] == "fail"
+    findings = pr.diff_records(_perf_record(), _perf_record(sha="bb22"),
+                               cold=_perf_record(sha="aa11"))
+    assert _levels(findings)["output_hash"] == "fail"
+
+
+def test_perf_sentinel_cpu_noise_floor():
+    pr = _load_script("perf_report")
+    # 20% slower on a CPU-fallback runner sits inside the 35% floor
+    findings = pr.diff_records(_perf_record(p50=5.0), _perf_record(p50=6.0))
+    assert _levels(findings)["warm_latency"] == "ok"
+    # 50% slower does not
+    findings = pr.diff_records(_perf_record(p50=5.0), _perf_record(p50=7.5))
+    assert _levels(findings)["warm_latency"] == "fail"
+
+
+def test_perf_sentinel_backend_mismatch_skips_cost_and_timing():
+    pr = _load_script("perf_report")
+    tpu = {"platform": "tpu", "device_kind": "v5e", "n_devices": 1,
+           "jax": "j", "jaxlib": "jl"}
+    findings = pr.diff_records(
+        _perf_record(flops=1e6),
+        _perf_record(flops=9e9, p50=500.0, backend=tpu))
+    levels = _levels(findings)
+    # a toolchain/backend change legitimately re-costs every program:
+    # warn and skip instead of failing on meaningless deltas
+    assert levels["backend"] == "warn"
+    assert "cost_registry" not in levels
+    assert "warm_latency" not in levels
+    assert levels["warm_recompiles"] == "ok"
+
+
+def test_perf_sentinel_committed_baseline_parses():
+    baseline = json.load(open(os.path.join(_REPO, "PERF_BASELINE.json")))
+    assert baseline["format"] == "dftpu-perf-baseline-v1"
+    assert baseline["programs"], "baseline must carry program costs"
+    assert baseline["timings_ms"]["p50"] > 0
+
+
+# -- trace_report device column -----------------------------------------------
+
+def test_trace_report_by_kind_device_column():
+    tr = _load_script("trace_report")
+    spans = [
+        {"name": "serving.predict", "duration_ms": 5.0,
+         "attrs": {"device_seconds": 0.002}},
+        {"name": "serving.predict", "duration_ms": 6.0,
+         "attrs": {"device_seconds": 0.003}},
+        {"name": "http.request", "duration_ms": 7.0},
+        {"name": "batcher.dispatch", "duration_ms": 3.0,
+         "attrs": {"device_seconds": "not-a-number"}},
+    ]
+    rows = {r["kind"]: r for r in tr.by_kind(spans)}
+    assert rows["serving.predict"]["device_ms"] == pytest.approx(5.0)
+    # spans that never carried the attribute (older traces) get no column
+    assert "device_ms" not in rows["http.request"]
+    # and a malformed attribute degrades to absent, never a crash
+    assert "device_ms" not in rows["batcher.dispatch"]

@@ -297,6 +297,58 @@ def test_unlocked_shared_state_negative(tmp_path):
     assert _lint(tmp_path, "serving/box.py") == []
 
 
+def test_unlocked_read_of_sliding_window(tmp_path):
+    # the cost saturation window's shape (monitoring/cost.py): a deque +
+    # running sum appended under the lock — a reader that sums the deque
+    # without taking the lock races the append/trim pair
+    _write(tmp_path, "monitoring/window.py", """
+        import threading
+        from collections import deque
+
+        class Window:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._recent = deque()
+                self._recent_sum = 0.0
+
+            def add(self, v):
+                with self._lock:
+                    self._recent.append(v)
+                    self._recent_sum += v
+
+            def rate(self):
+                return self._recent_sum / 60.0   # torn read
+    """)
+    found = _lint(tmp_path, "monitoring/window.py")
+    assert "unlocked-shared-state" in [f.rule for f in found]
+    assert any("rate" in f.message for f in found)
+
+
+def test_unlocked_read_snapshot_under_lock_passes(tmp_path):
+    # the fix the real module uses: compute from state INSIDE the lock,
+    # publish the snapshot outside it
+    _write(tmp_path, "monitoring/window.py", """
+        import threading
+        from collections import deque
+
+        class Window:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._recent = deque()
+                self._recent_sum = 0.0
+
+            def add(self, v):
+                with self._lock:
+                    self._recent.append(v)
+                    self._recent_sum += v
+
+            def rate(self):
+                with self._lock:
+                    return self._recent_sum / 60.0
+    """)
+    assert _lint(tmp_path, "monitoring/window.py") == []
+
+
 # ---------------------------------------------------------------------------
 # nondeterminism
 # ---------------------------------------------------------------------------
@@ -429,6 +481,70 @@ def test_config_drift_reverse_required_field(tmp_path):
     assert found[0].rule == "config-drift"
     assert found[0].severity == "warning"
     assert "beta" in found[0].message
+
+
+def test_config_drift_monitoring_cost_block(tmp_path):
+    # the monitoring.cost conf block: its keys are consumed as CostConfig
+    # dataclass fields, so a typo'd key (peak_bytesper_s) is drift while
+    # the real spelling passes
+    _write(tmp_path, "conf/serve.yml", """
+        monitoring:
+          cost:
+            enabled: true
+            peak_flops: 0.0
+            peak_bytesper_s: 0.0
+            saturation_window_s: 60
+    """)
+    _write(tmp_path, "monitoring/cost.py", """
+        import dataclasses
+
+        @dataclasses.dataclass(frozen=True)
+        class CostConfig:
+            enabled: bool = True
+            peak_flops: float = 0.0
+            peak_bytes_per_s: float = 0.0
+            saturation_window_s: float = 60.0
+
+            @classmethod
+            def from_conf(cls, conf):
+                return cls(**(conf or {}))
+
+        def build(conf):
+            return CostConfig.from_conf(
+                (conf.get("monitoring") or {}).get("cost"))
+    """)
+    found = _lint(tmp_path, "monitoring/cost.py")
+    assert [f.rule for f in found] == ["config-drift"]
+    assert "peak_bytesper_s" in found[0].message
+    assert found[0].path == "conf/serve.yml"
+
+
+def test_config_drift_monitoring_cost_block_clean(tmp_path):
+    _write(tmp_path, "conf/serve.yml", """
+        monitoring:
+          cost:
+            enabled: true
+            peak_flops: 197.0e12
+            peak_bytes_per_s: 819.0e9
+    """)
+    _write(tmp_path, "monitoring/cost.py", """
+        import dataclasses
+
+        @dataclasses.dataclass(frozen=True)
+        class CostConfig:
+            enabled: bool = True
+            peak_flops: float = 0.0
+            peak_bytes_per_s: float = 0.0
+
+            @classmethod
+            def from_conf(cls, conf):
+                return cls(**(conf or {}))
+
+        def build(conf):
+            return CostConfig.from_conf(
+                (conf.get("monitoring") or {}).get("cost"))
+    """)
+    assert _lint(tmp_path, "monitoring/cost.py") == []
 
 
 # ---------------------------------------------------------------------------
