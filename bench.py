@@ -600,6 +600,142 @@ def _overlap_probe():
     return out
 
 
+_KERNEL_PROBE_CODE = r"""
+import json
+import os
+import time
+
+import numpy as np
+
+from distributed_forecasting_tpu.utils import apply_platform_override
+apply_platform_override()
+
+import jax
+import jax.numpy as jnp
+
+from distributed_forecasting_tpu.models import holt_winters as hw
+from distributed_forecasting_tpu.ops.fused_scan import (
+    _pallas_available,
+    select_filter,
+)
+
+backend = jax.default_backend()
+S = int(os.environ.get("DFTPU_KPROBE_SERIES", "8"))
+T = int(os.environ.get("DFTPU_KPROBE_DAYS", "2048"))
+m = 7
+grid = dict(n_alpha=3, n_beta=2, n_gamma=2)
+lanes = grid["n_alpha"] * grid["n_beta"] * grid["n_gamma"]
+
+rng = np.random.default_rng(0)
+t = np.arange(T)
+y = jnp.asarray(
+    10.0 + 0.01 * t[None, :] + 2.0 * np.sin(2 * np.pi * t[None, :] / m)
+    + rng.normal(0.0, 0.3, (S, T)), jnp.float32)
+mask = jnp.ones((S, T), jnp.float32)
+day = jnp.arange(T, dtype=jnp.float32)
+
+solvers = {
+    "scan": hw.HoltWintersConfig(seasonality_mode="additive", filter="scan",
+                                 **grid),
+    "pscan": hw.HoltWintersConfig(seasonality_mode="additive",
+                                  filter="pscan", **grid),
+}
+# the fused kernel is a TPU kernel; its interpret mode is a correctness
+# emulator whose wall time says nothing about the chip
+if backend == "tpu" and _pallas_available():
+    solvers["pallas"] = hw.HoltWintersConfig(
+        seasonality_mode="additive", filter="pallas", **grid)
+
+timings = {}
+for label, cfg in solvers.items():
+    p = hw.fit(y, mask, day, cfg)
+    jax.block_until_ready(p.level)  # compile + barrier
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        p = hw.fit(y, mask, day, cfg)
+        jax.block_until_ready(p.level)
+        ts.append(time.perf_counter() - t0)
+    timings[label] = round(min(ts), 4)
+
+out = {
+    "backend": backend,
+    "workload": {"n_series": S, "n_time": T, "grid_lanes": lanes,
+                 "season_length": m},
+    "timings_s": timings,
+    "pscan_slowdown_x": (
+        round(timings["pscan"] / max(timings["scan"], 1e-9), 1)
+        if "pscan" in timings else None),
+    "selected": select_filter(backend, S, T, lanes=lanes),
+}
+if "pallas" not in timings:
+    out["pallas"] = ("not timed: interpret-only emulation off-TPU (a "
+                     "correctness mode, not a kernel)")
+print("KERNELPROBE=" + json.dumps(out))
+"""
+
+
+def _kernel_probe_child(platform: str, timeout: float = 300.0):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = platform
+    env["DFTPU_FORCE_PLATFORM"] = platform
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", _KERNEL_PROBE_CODE],
+            env=env, capture_output=True, text=True, timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        print(f"[bench] kernel probe timed out ({timeout:.0f}s, "
+              f"{platform})", file=sys.stderr)
+        return None
+    for line in p.stdout.splitlines():
+        if line.startswith("KERNELPROBE="):
+            return json.loads(line.split("=", 1)[1])
+    tail = (p.stderr or "").strip().splitlines()
+    print(f"[bench] kernel probe failed ({platform}, rc={p.returncode}): "
+          f"{tail[-1] if tail else '?'}", file=sys.stderr)
+    return None
+
+
+def _kernel_probe(platform: str):
+    """Per-backend filter-solver micro-benchmark for the headline JSON.
+
+    The successor to the retired round-4 pallas-vs-einsum probe: one
+    fresh child per backend times the SAME small HW grid-search fit
+    (S x T x candidate lanes) through each time-recurrence solver —
+    sequential ``scan``, associative ``pscan``, and (TPU only) the fused
+    pallas scoring kernel — and reports per-solver wall times plus what
+    ``ops/fused_scan.select_filter`` picks for that shape.  Capped: one
+    compile + 3 timed reps per solver, ~2k-step series, 300 s child
+    timeout.  The CPU child is the standing regression evidence behind
+    ``prefer_pscan``'s backend gate (pscan 50-100x slower than scan off
+    accelerator); the TPU child, when the tunnel is up, gives the
+    pallas-vs-scan number the heuristic's TPU tier rests on.
+
+    Returns ``{backend: probe_dict_or_None}`` for the headline's
+    ``kernel_probe`` field.  ``DFTPU_BENCH_KERNEL=0`` skips.
+    """
+    if os.environ.get("DFTPU_BENCH_KERNEL", "1") == "0":
+        return None
+    out = {}
+    for plat in dict.fromkeys(["cpu", platform]):
+        t0 = time.perf_counter()
+        res = _kernel_probe_child(plat)
+        out[plat] = res
+        if res:
+            tm = res["timings_s"]
+            extra = (f", pscan x{res['pscan_slowdown_x']:.0f} slower"
+                     if res.get("pscan_slowdown_x") else "")
+            print(
+                f"[bench] kernel probe [{res['backend']}] "
+                f"({time.perf_counter() - t0:.0f}s): "
+                + " ".join(f"{k}={v:.3f}s" for k, v in tm.items())
+                + f"{extra}; select_filter -> {res['selected']}",
+                file=sys.stderr,
+            )
+    return out
+
+
 def main() -> None:
     if "--overlap-only" in sys.argv:
         # CI smoke mode: run just the pipeline-overlap probe (no backend
@@ -645,6 +781,7 @@ def main() -> None:
     # while the numbers that go into the headline line are produced
     compile_cache = _compile_cache_probe()
     pipeline_overlap = _overlap_probe()
+    kernel_probe = _kernel_probe(platform)
 
     import jax
 
@@ -811,6 +948,10 @@ def main() -> None:
                 # device_idle_fraction, byte-identity control; null when
                 # skipped or failed) — see _overlap_probe
                 "pipeline_overlap": pipeline_overlap,
+                # per-backend filter-solver timings (scan vs pscan vs
+                # fused pallas) from fresh children — the measurements
+                # behind ops/fused_scan.select_filter; see _kernel_probe
+                "kernel_probe": kernel_probe,
             }
         ),
         flush=True,
@@ -985,9 +1126,12 @@ def main() -> None:
         print(f"[bench] long-T probe failed: {type(e).__name__}: {e}",
               file=sys.stderr)
 
-    # (A pallas-vs-einsum probe ran here through round 4.  The hand kernel
+    # (A pallas-vs-einsum probe ran here through round 4; the hand kernel
     # lost at every completed width — x0.79/x0.93/x0.99 at F=64/128/192 on
-    # chip — and was retired in round 5; ops/solve.py records the ladder.)
+    # chip — and was retired in round 5; ops/solve.py records the ladder.
+    # Round 7 revived the slot as _kernel_probe above: per-backend
+    # scan/pscan/pallas FILTER timings, front-loaded as a child so its
+    # numbers make the headline line.)
 
 if __name__ == "__main__":
     main()

@@ -21,6 +21,7 @@ from distributed_forecasting_tpu.analysis.core import (  # noqa: F401
 from distributed_forecasting_tpu.analysis import (  # noqa: F401
     absint,
     rules_config,
+    rules_donation,
     rules_jax,
     rules_lockorder,
     rules_purity,

@@ -1,0 +1,203 @@
+"""Buffer-donation discipline: no host reads of a donated reference.
+
+``donate_argnums`` (kernel round, engine/compile_cache.py) lets XLA write
+program outputs into an input's buffer.  The flip side is host-visible:
+after the call, the caller's Python reference still points at the donated
+``jax.Array``, whose buffer is now deleted or aliased to an output.
+Reading it raises ``INVALID_ARGUMENT: buffer has been deleted or
+donated`` — but only at RUN time, only on paths where the donating call
+actually dispatched (a tracer context silently skips donation), which is
+exactly the kind of latent bug a unit test with a fresh array per call
+never sees.
+
+The **host-reuse-after-donation** rule flags reads of a bare local name
+after it was passed in a donated argument position of the same function
+body.  Three donating call shapes are recognized:
+
+* ``aot_call(entry, fn, args=(a, b, ...), donate_argnums=(i, ...))`` —
+  the donated names are the ``args`` tuple elements at those positions;
+* ``g = donated_variant(fn, donate_argnums=(i, ...)); ...; g(a, b)`` —
+  the factory's result consumes its positional args at those positions;
+* ``g = jax.jit(fn, donate_argnums=(i, ...)); ...; g(a, b)`` — same.
+
+Analysis is linear per function body (headers of compound statements are
+processed, then their blocks, in source order); rebinding the name
+(``aux = g(aux)``) clears it — that is the sanctioned idiom.  Non-literal
+``donate_argnums`` and non-name arguments (``g(prepared["y"])``) are
+skipped conservatively: the rule exists to catch the common accident, not
+to prove absence.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from distributed_forecasting_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    Rule,
+    register,
+)
+from distributed_forecasting_tpu.analysis.jaxast import ImportMap
+
+#: statement fields holding nested blocks (processed after the header)
+_BLOCK_FIELDS = ("body", "orelse", "finalbody")
+
+_FN_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _terminal_name(call: ast.Call, imap: ImportMap) -> Optional[str]:
+    dotted = imap.dotted(call.func)
+    if dotted == "jax.jit":
+        return "jax.jit"
+    if dotted is not None:
+        return dotted.rsplit(".", 1)[-1]
+    if isinstance(call.func, ast.Name):
+        return call.func.id
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr
+    return None
+
+
+def _donate_positions(call: ast.Call) -> Optional[Tuple[int, ...]]:
+    """Literal donate_argnums of a call; () when absent, None when the
+    keyword exists but is not a literal (conservative skip)."""
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return (v.value,)
+        if isinstance(v, (ast.Tuple, ast.List)) and all(
+                isinstance(e, ast.Constant) and isinstance(e.value, int)
+                for e in v.elts):
+            return tuple(int(e.value) for e in v.elts)
+        return None
+    return ()
+
+
+def _consumed_names(call: ast.Call, imap: ImportMap,
+                    donors: Dict[str, Tuple[int, ...]]) -> List[str]:
+    """Bare names this call passes in donated argument positions."""
+    out: List[str] = []
+    term = _terminal_name(call, imap)
+    if term == "aot_call":
+        pos = _donate_positions(call)
+        if not pos:
+            return out
+        for kw in call.keywords:
+            if kw.arg == "args" and isinstance(kw.value, (ast.Tuple, ast.List)):
+                elts = kw.value.elts
+                out.extend(
+                    e.id for i in pos if i < len(elts)
+                    for e in [elts[i]] if isinstance(e, ast.Name))
+    elif (isinstance(call.func, ast.Name)
+          and call.func.id in donors):
+        for i in donors[call.func.id]:
+            if i < len(call.args) and isinstance(call.args[i], ast.Name):
+                out.append(call.args[i].id)
+    return out
+
+
+def _add_target(t: ast.AST, out: set) -> None:
+    if isinstance(t, ast.Name):
+        out.add(t.id)
+    elif isinstance(t, (ast.Tuple, ast.List)):
+        for e in t.elts:
+            _add_target(e, out)
+    elif isinstance(t, ast.Starred):
+        _add_target(t.value, out)
+
+
+@register
+class HostReuseAfterDonation(Rule):
+    name = "host-reuse-after-donation"
+    dir_names = frozenset({"ops", "engine", "serving", "parallel"})
+
+    def check_module(self, module: ModuleInfo, project) -> List[Finding]:
+        imap = ImportMap(module.tree, package=getattr(module, "package", None))
+        out: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if isinstance(node, _FN_NODES):
+                self._check_fn(module, node, imap, out)
+        return out
+
+    def _check_fn(self, module: ModuleInfo, fn, imap: ImportMap,
+                  out: List[Finding]) -> None:
+        donors: Dict[str, Tuple[int, ...]] = {}
+        consumed: Dict[str, int] = {}  # name -> donating call lineno
+
+        def header_nodes(stmt):
+            for field, value in ast.iter_fields(stmt):
+                if field in _BLOCK_FIELDS or field == "handlers":
+                    continue
+                for v in value if isinstance(value, list) else [value]:
+                    if isinstance(v, ast.AST):
+                        yield v
+
+        def do_stmt(stmt) -> None:
+            if isinstance(stmt, (*_FN_NODES, ast.ClassDef)):
+                # nested scope: runs later, analyzed as its own function
+                return
+            headers = list(header_nodes(stmt))
+            # 1. reads in the header (the donating call's own args are
+            #    reads of the still-live buffer — fine)
+            for h in headers:
+                for n in ast.walk(h):
+                    if (isinstance(n, ast.Name)
+                            and isinstance(n.ctx, ast.Load)
+                            and n.id in consumed):
+                        out.append(self.finding(
+                            module, n,
+                            f"'{n.id}' was donated to the device at line "
+                            f"{consumed[n.id]} (donate_argnums) — its "
+                            f"buffer is deleted or aliased to an output, "
+                            f"so this host read fails at run time; copy "
+                            f"before donating, or rebind the name to the "
+                            f"call's result"))
+                        del consumed[n.id]  # one finding per donation
+            # 2. consumption + donor-factory registration
+            for h in headers:
+                for n in ast.walk(h):
+                    if not isinstance(n, ast.Call):
+                        continue
+                    for name in _consumed_names(n, imap, donors):
+                        consumed[name] = n.lineno
+            if isinstance(stmt, ast.Assign) and isinstance(
+                    stmt.value, ast.Call):
+                term = _terminal_name(stmt.value, imap)
+                if term in ("donated_variant", "jax.jit"):
+                    pos = _donate_positions(stmt.value)
+                    if pos:
+                        for t in stmt.targets:
+                            if isinstance(t, ast.Name):
+                                donors[t.id] = pos
+            # 3. rebinding clears the consumed mark (and donor entries)
+            kills: set = set()
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    _add_target(t, kills)
+            elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign, ast.For)):
+                _add_target(stmt.target, kills)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    if item.optional_vars is not None:
+                        _add_target(item.optional_vars, kills)
+            for name in kills:
+                consumed.pop(name, None)
+                if not (isinstance(stmt, ast.Assign)
+                        and isinstance(stmt.value, ast.Call)
+                        and _terminal_name(stmt.value, imap)
+                        in ("donated_variant", "jax.jit")):
+                    donors.pop(name, None)
+            # 4. nested blocks, in source order
+            for field in _BLOCK_FIELDS:
+                for sub in getattr(stmt, field, []) or []:
+                    do_stmt(sub)
+            for handler in getattr(stmt, "handlers", []) or []:
+                for sub in handler.body:
+                    do_stmt(sub)
+
+        for stmt in fn.body:
+            do_stmt(stmt)

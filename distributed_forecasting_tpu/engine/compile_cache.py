@@ -255,9 +255,18 @@ def _shape_signature(tree) -> Dict[str, Any]:
 
 
 def fingerprint(entry: str, statics: Optional[dict] = None, tree=None,
-                backend: Optional[dict] = None) -> str:
+                backend: Optional[dict] = None,
+                donate: tuple = (),
+                extra: Optional[dict] = None) -> str:
     """Store key = sha256 over (entry/family, canonical statics = config
-    fingerprint, shape bucket, backend + topology + jax/jaxlib versions)."""
+    fingerprint, shape bucket, backend + topology + jax/jaxlib versions).
+
+    ``donate`` (argument positions compiled with input-output aliasing) and
+    ``extra`` (process-global compile context, e.g. the mixed-precision
+    mode) fold into the key only when set, so every pre-existing entry keeps
+    its key: a donated program aliases inputs into outputs and must never be
+    served where the caller still owns its buffers, and vice versa.
+    """
     parts = {
         "format": _FORMAT_VERSION,
         "entry": entry,
@@ -265,6 +274,10 @@ def fingerprint(entry: str, statics: Optional[dict] = None, tree=None,
         "shapes": _shape_signature(tree),
         "backend": backend if backend is not None else backend_fingerprint(),
     }
+    if donate:
+        parts["donate"] = sorted(int(i) for i in donate)
+    if extra:
+        parts["extra"] = _canon(extra)
     blob = json.dumps(parts, sort_keys=True).encode()
     return hashlib.sha256(blob).hexdigest()[:32]
 
@@ -576,6 +589,72 @@ def _has_tracer(tree) -> bool:
     )
 
 
+# donated outer-jit wrappers, memoized per (fn, donate positions, statics):
+# a fresh jax.jit wrapper per call would defeat jit's dispatch cache and
+# retrace every invocation
+_donated_fns: Dict[tuple, Any] = {}
+_donated_lock = threading.Lock()
+
+
+def donated_variant(fn, donate_argnums: tuple, static_argnames: tuple = ()):
+    """An outer ``jax.jit`` of ``fn`` with ``donate_argnums`` applied.
+
+    The framework's entrypoints are jitted at module level without
+    donation (most callers still own their buffers afterwards); the hot
+    serving/streaming paths opt in per call site through
+    :func:`aot_call`'s ``donate_argnums``.  Wrapping jit-in-jit is free —
+    the inner jit inlines into the outer trace — and the wrapper is
+    memoized so repeat calls hit the outer jit's dispatch cache.
+
+    CALLER CONTRACT: every argument at a donated position is consumed —
+    the Python reference becomes invalid after the call (dflint's
+    host-reuse-after-donation rule enforces this in hot paths).
+    """
+    key = (fn, tuple(sorted(donate_argnums)), tuple(sorted(static_argnames)))
+    with _donated_lock:
+        wrapped = _donated_fns.get(key)
+        if wrapped is None:
+            wrapped = jax.jit(
+                fn,
+                donate_argnums=key[1],
+                static_argnames=key[2] or None,
+            )
+            _donated_fns[key] = wrapped
+    return wrapped
+
+
+def _compile_context_extra() -> Optional[dict]:
+    """Process-global compile context that changes the generated program
+    without appearing in the call signature — today only the mixed-
+    precision mode (ops/precision.py).  None in the default configuration
+    so every pre-existing key is unchanged."""
+    try:
+        from distributed_forecasting_tpu.ops.precision import (
+            fingerprint_extra,
+        )
+
+        return fingerprint_extra() or None
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def _donated_leaves_deleted(args: tuple, donate: tuple) -> bool:
+    """After a failed donated call: were any donated buffers actually
+    consumed?  If so, re-running on the undonated jit path would feed it
+    deleted arrays — the caller must see the original error instead."""
+    for i in donate:
+        if i >= len(args):
+            continue
+        for leaf in jax.tree_util.tree_leaves(args[i]):
+            is_deleted = getattr(leaf, "is_deleted", None)
+            try:
+                if is_deleted is not None and is_deleted():
+                    return True
+            except Exception:  # noqa: BLE001
+                continue
+    return False
+
+
 def _serializable_lowering(lowered) -> bool:
     """Whether this program's executable survives serialization on CPU.
 
@@ -598,7 +677,8 @@ def _serializable_lowering(lowered) -> bool:
 
 def aot_call(entry: str, fn, args: tuple = (),
              static_kwargs: Optional[dict] = None,
-             dynamic_kwargs: Optional[dict] = None):
+             dynamic_kwargs: Optional[dict] = None,
+             donate_argnums: tuple = ()):
     """Call a jitted ``fn`` through the AOT store when one is configured.
 
     ``fn(*args, **dynamic_kwargs, **static_kwargs)`` must be a valid call
@@ -611,21 +691,34 @@ def aot_call(entry: str, fn, args: tuple = (),
     a tracer (an outer jit is tracing through — executables cannot run
     inside a trace).  A stale executable that fails at call time is
     discarded and the call repeats on the jit path.
+
+    ``donate_argnums`` marks positional arguments whose buffers the caller
+    hands over: the program is compiled with input-output aliasing (XLA
+    writes results in place of the donated inputs instead of allocating +
+    copying), the positions fold into the store key so donated and
+    undonated programs never collide, and the aliasing shows up in the
+    cost registry as ``alias_bytes``.  Donation applies on EVERY path —
+    AOT, jit bypass, post-failure fallback — except under a tracer, where
+    the donated buffers are not real and jit would reject them; the
+    caller's buffers-are-consumed contract is identical everywhere.
     """
     static_kwargs = dict(static_kwargs or {})
     dynamic_kwargs = dict(dynamic_kwargs or {})
+    donate = tuple(sorted(donate_argnums)) if donate_argnums else ()
     store = _active_store
-    if (
-        store is None
-        or getattr(fn, "lower", None) is None
-        or _has_tracer((args, dynamic_kwargs))
-    ):
+    if _has_tracer((args, dynamic_kwargs)):
         return fn(*args, **dynamic_kwargs, **static_kwargs)
+    call_fn = fn
+    if donate:
+        call_fn = donated_variant(fn, donate, tuple(sorted(static_kwargs)))
+    if store is None or getattr(call_fn, "lower", None) is None:
+        return call_fn(*args, **dynamic_kwargs, **static_kwargs)
     key = fingerprint(entry, statics=static_kwargs,
-                      tree=(args, dynamic_kwargs))
+                      tree=(args, dynamic_kwargs), donate=donate,
+                      extra=_compile_context_extra())
 
     def compile_fn():
-        lowered = fn.lower(*args, **dynamic_kwargs, **static_kwargs)
+        lowered = call_fn.lower(*args, **dynamic_kwargs, **static_kwargs)
         if not _serializable_lowering(lowered):
             # CPU custom calls segfault after a serialize round trip, so
             # this program stays on layer 1: compile WITH the persistent
@@ -656,4 +749,8 @@ def aot_call(entry: str, fn, args: tuple = (),
         _logger.warning("AOT call failed for %s (%s: %s); falling through "
                         "to jit", entry, type(e).__name__, e)
         store.invalidate(key)
-        return fn(*args, **dynamic_kwargs, **static_kwargs)
+        if donate and _donated_leaves_deleted(args, donate):
+            # the failed executable already consumed donated buffers; a
+            # retry would dispatch on deleted arrays — surface the error
+            raise
+        return call_fn(*args, **dynamic_kwargs, **static_kwargs)

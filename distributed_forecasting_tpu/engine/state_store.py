@@ -44,6 +44,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from distributed_forecasting_tpu.engine.compile_cache import donated_variant
 from distributed_forecasting_tpu.models.base import get_model
 from distributed_forecasting_tpu.monitoring.trace import get_tracer
 from distributed_forecasting_tpu.ops.update import apply_update, column_bucket
@@ -307,7 +308,15 @@ class SeriesStateStore:
         def dispatch(prepared):
             day = jnp.arange(self.day0, prepared["day_snap"] + 1,
                              dtype=jnp.int32)
-            params = self._fns.fit(
+            # the (S, T) y/mask staging buffers are donated: prep() made
+            # them as private copies, nothing reads them after this call,
+            # and fit's dominant output (params.fitted, same shape/dtype
+            # as y) can then be written in place of the history instead of
+            # doubling the refit's working set
+            fit_donated = donated_variant(
+                self._fns.fit, donate_argnums=(0, 1),
+                static_argnames=("config",))
+            params = fit_donated(
                 jnp.asarray(prepared["y"]), jnp.asarray(prepared["mask"]),
                 day, self.config)
             return {**prepared, "params": params}

@@ -188,7 +188,9 @@ def _kalman_loglik(z, mask, phi, theta, r):
     the covariance recursion over ~1.8k steps, and the parallel-scan
     variant (``ops/pkalman``) holds the same precision so the two filters
     agree on hardware (integration tier, round 3).  FLOPs at r <= ~10 are
-    negligible either way."""
+    negligible either way.  Excluded from the ops/precision.py bf16 gate:
+    the loglik feeds gradient-free optimization whose convergence test is
+    tighter than bf16 resolution."""
     with jax.default_matmul_precision("float32"):
         return _kalman_loglik_impl(z, mask, phi, theta, r)
 

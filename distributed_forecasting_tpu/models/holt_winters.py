@@ -60,11 +60,13 @@ class HoltWintersConfig:
     # 'pscan' = associative parallel prefix over affine maps (O(log T) depth,
     # additive mode only) — the long-series regime where the scan's serial
     # chain, not the series axis, bounds wall time.  See docs/parallelism.md
-    # for the measured crossover.  'auto' picks per trace from (backend, S,
-    # T, grid lanes) via ops/pscan.prefer_pscan — a pinned 'pscan' conf
-    # pessimizes the CPU fallback ~50-100x (BENCH_r05), so prefer 'auto'
-    # unless benchmarking a specific solver.
-    filter: str = "scan"  # 'scan' | 'pscan' | 'auto'
+    # for the measured crossover.  'pallas' = fused TPU scoring kernel for
+    # the candidate grid (ops/fused_scan.hw_score; additive only) with the
+    # winner refit on the sequential scan.  'auto' picks per trace from
+    # (backend, S, T, grid lanes) via ops/fused_scan.select_filter — a
+    # pinned 'pscan' conf pessimizes the CPU fallback ~50-100x (BENCH_r05),
+    # so prefer 'auto' unless benchmarking a specific solver.
+    filter: str = "scan"  # 'scan' | 'pscan' | 'pallas' | 'auto'
 
 
 @jax.tree_util.register_dataclass
@@ -346,14 +348,41 @@ def fit(y, mask, day, config: HoltWintersConfig) -> HWParams:
         # Resolved at trace time from the actual backend + problem shape
         # (batch S, length T, grid-candidate lanes) — a conf that says
         # 'pscan' pessimizes the CPU fallback ~50-100x (BENCH_r05), and
-        # multiplicative seasonality has no affine form at all.
-        from distributed_forecasting_tpu.ops.pscan import prefer_pscan
+        # multiplicative seasonality has no affine form (pscan) and no
+        # fused scoring kernel (pallas), so it always scans.
+        from distributed_forecasting_tpu.ops.fused_scan import select_filter
 
-        which = "pscan" if (
-            mode == "additive"
-            and prefer_pscan(jax.default_backend(), int(y.shape[0]),
-                             int(y.shape[1]), lanes=int(A.shape[0]))
-        ) else "scan"
+        which = select_filter(
+            jax.default_backend(), int(y.shape[0]), int(y.shape[1]),
+            lanes=int(A.shape[0]),
+        ) if mode == "additive" else "scan"
+
+    if which == "pallas":
+        # Fused Pallas kernel scores the candidate grid; the WINNER is
+        # refit with the sequential scan below, so the returned state/
+        # sigma/fitted path remain the bitwise ``_hw_step`` products the
+        # streaming contract pins — only the argmin ranking runs fused.
+        if mode != "additive":
+            raise ValueError(
+                "filter='pallas' supports additive seasonality only"
+            )
+        from distributed_forecasting_tpu.ops.fused_scan import hw_score
+
+        msec = hw_score(y, mask, A, B, G, P, m)  # (S, C)
+        best = jnp.argmin(msec, axis=1)  # (S,)
+        a, b, g, p = A[best], B[best], G[best], P[best]
+
+        def winner(ys, ms, aa, bb, gg, pp):
+            (l, tr, s), mse, preds = _filter(ys, ms, aa, bb, gg, m, mode, pp)
+            return l, tr, s, jnp.sqrt(mse), preds
+
+        l, t, s, sig, fitted = jax.vmap(winner)(y, mask, a, b, g, p)
+        return HWParams(
+            alpha=a, beta=b, gamma=g, phi=p, level=l, trend=t, season=s,
+            sigma=sig, fitted=fitted,
+            day0=day[0].astype(jnp.float32),
+            t_fit_end=day[-1].astype(jnp.float32),
+        )
 
     if which == "pscan":
         if mode != "additive":
@@ -366,11 +395,25 @@ def fit(y, mask, day, config: HoltWintersConfig) -> HWParams:
         filt = lambda ys, ms, a, b, g, p: _filter(ys, ms, a, b, g, m, mode, p)
     else:
         raise ValueError(
-            f"unknown filter {config.filter!r}; 'scan', 'pscan', or 'auto'"
+            f"unknown filter {config.filter!r}; "
+            f"'scan', 'pscan', 'pallas', or 'auto'"
         )
+
+    # Config-gated mixed precision (ops/precision.py): bf16 accumulation is
+    # tolerable ONLY in the scoring pass — the argmin is its sole consumer
+    # and the winner below is refit in float32, so the bitwise streaming
+    # contract never sees a bf16 value.  OFF by default; outputs are only
+    # baseline-identical when the gate is off.
+    from distributed_forecasting_tpu.ops.precision import scoring_dtype
+
+    sd = scoring_dtype()
 
     def per_series(ys, ms):
         def score(a, b, g, p):
+            if sd is not None:
+                _, mse, _ = filt(ys.astype(sd), ms.astype(sd), a.astype(sd),
+                                 b.astype(sd), g.astype(sd), p.astype(sd))
+                return mse.astype(jnp.float32)
             _, mse, _ = filt(ys, ms, a, b, g, p)
             return mse
 

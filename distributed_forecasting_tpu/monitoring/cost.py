@@ -64,6 +64,7 @@ PROGRAM_FIELDS = (
     "output_bytes",
     "temp_bytes",
     "peak_bytes",
+    "alias_bytes",
 )
 
 
@@ -97,6 +98,10 @@ def extract_cost_analysis(compiled) -> Dict[str, float]:
             ("output_bytes", "output_size_in_bytes"),
             ("temp_bytes", "temp_size_in_bytes"),
             ("peak_bytes", "peak_memory_in_bytes"),
+            # donated arguments: bytes XLA aliases input->output instead of
+            # copying.  argument_size does NOT shrink under donation on
+            # XLA:CPU — the alias is how donation proves it took effect
+            ("alias_bytes", "alias_size_in_bytes"),
         ):
             v = getattr(ma, attr, None)
             if v is not None and math.isfinite(float(v)):
@@ -226,7 +231,10 @@ class CostMetrics:
       * ``dftpu_cost_program_*`` gauges REPLICATE (first replica wins) —
         the fleet shares one AOT store, so every replica reports the same
         program fingerprints and summing would multiply FLOPs by the
-        replica count.
+        replica count;
+      * ``dftpu_cost_padding_waste`` gauges MAX — the pad-row fraction is
+        a ratio, so summing would be meaningless; the worst replica is the
+        signal (the underlying ``_padding_rows_total`` counters SUM).
     """
 
     def __init__(self) -> None:
@@ -265,10 +273,19 @@ class CostMetrics:
             "dftpu_cost_watermark_device_peak_bytes",
             "peak device memory in use, first local device "
             "(fleet: max-merged)")
+        self.padding_rows_total = self.registry.labeled_counter(
+            "dftpu_cost_padding_rows_total", ("entry", "kind"),
+            "dispatched batch rows per AOT entry, split kind=real|pad "
+            "(pad rows are bucket-ladder fill whose FLOPs are pure waste)")
+        self.padding_waste = self.registry.labeled_gauge(
+            "dftpu_cost_padding_waste", ("entry",),
+            "cumulative fraction of dispatched rows that were bucket "
+            "padding, per AOT entry (fleet: max-merged)")
         self.saturation_window_s = 60.0
         self._lock = threading.Lock()
         self._recent: deque = deque()   # (span-clock ts, device_seconds)
         self._recent_sum = 0.0
+        self._padding: Dict[str, List[float]] = {}  # entry -> [real+pad, pad]
         self._t0 = clock()
         self._tls = threading.local()
 
@@ -300,6 +317,25 @@ class CostMetrics:
             elapsed = min(window, max(now - self._t0, 1e-9))
             saturation = self._recent_sum / elapsed
         self.device_saturation.set(saturation)
+
+    def record_padding(self, entry: str, rows: int, pad_rows: int) -> None:
+        """Attribute one dispatch's bucket-ladder padding: ``rows`` total
+        batch rows dispatched, of which ``pad_rows`` were ladder fill.
+        Updates the split counters and the per-entry cumulative waste
+        fraction — the number the kernel round drives down by tightening
+        the ladder (pow2 -> pow2x3)."""
+        rows = max(int(rows), 0)
+        pad = min(max(int(pad_rows), 0), rows)
+        if rows == 0:
+            return
+        self.padding_rows_total.inc(rows - pad, entry=entry, kind="real")
+        self.padding_rows_total.inc(pad, entry=entry, kind="pad")
+        with self._lock:
+            acc = self._padding.setdefault(entry, [0.0, 0.0])
+            acc[0] += rows
+            acc[1] += pad
+            frac = acc[1] / acc[0]
+        self.padding_waste.set(frac, entry=entry)
 
     @contextlib.contextmanager
     def attribution(self):

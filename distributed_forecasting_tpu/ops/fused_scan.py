@@ -1,0 +1,180 @@
+"""Fused sequential-scan kernels for the filter recurrences (kernel round).
+
+The per-family filter recurrences (Holt-Winters, theta, croston) are
+sequential in time but embarrassingly parallel over series x candidates.
+Three solvers exist for that shape, and the roofline says which wins:
+
+- ``scan``: ``jax.lax.scan`` over time, vmapped over lanes.  Lowest FLOP
+  count; XLA fuses the step body into one loop kernel.  The only solver
+  that is bitwise-pinned to the streaming exactness contract
+  (``_hw_step`` has exactly one body — docs/streaming.md), so the winner
+  refit ALWAYS runs here regardless of how candidates were scored.
+- ``pscan``: associative parallel prefix over affine maps
+  (ops/pscan.py).  O(log T) depth at O(d) extra FLOPs — a win only on an
+  accelerator with idle lanes AND very long series.  Measured 50-100x
+  SLOWER than scan on CPU (BENCH_r05, re-confirmed by the bench.py
+  kernel probe), so the heuristic never picks it off-TPU.
+- ``pallas``: a hand-fused Pallas TPU kernel for the candidate-SCORING
+  pass only (:func:`hw_score`).  It keeps the (level, trend, season)
+  carry in VMEM registers across the whole time loop instead of
+  round-tripping through XLA's scan carry buffers, and it re-reads each
+  series' (1, T) history from the same VMEM block for every candidate
+  block instead of materializing the (S*C, T) broadcast.  Scoring is
+  tolerance-grade by construction (only the argmin over candidate MSEs
+  is consumed; the winner is refit with ``scan``), which is exactly the
+  slack a fused kernel needs — so the exactness contract is untouched.
+
+:func:`select_filter` is the one heuristic behind ``filter='auto'``:
+it extends ``ops.pscan.prefer_pscan`` with the pallas tier.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+
+from distributed_forecasting_tpu.ops.pscan import prefer_pscan
+
+# Candidate-lane block width: one VPU lane register row.  Candidate counts
+# are padded up to a multiple of this; the pad lanes score garbage that the
+# wrapper slices off before the argmin.
+_LANE_BLOCK = 128
+
+
+@lru_cache(maxsize=1)
+def _pallas_available() -> bool:
+    """Whether ``jax.experimental.pallas`` imports on this jaxlib."""
+    try:  # pragma: no cover - trivially true or false per install
+        import jax.experimental.pallas  # noqa: F401
+
+        return True
+    except Exception:
+        return False
+
+
+def select_filter(backend: str, n_series: int, n_time: int,
+                  lanes: int = 1) -> str:
+    """Pick the time-recurrence solver for a (backend, S, T, lanes) shape.
+
+    Returns ``'pallas'`` | ``'scan'`` | ``'pscan'``.  The pscan branch
+    delegates to :func:`ops.pscan.prefer_pscan` (very long series, lanes
+    below MXU saturation, TPU only).  On TPU everything else takes the
+    fused pallas scoring kernel — the state-in-VMEM fusion wins across
+    the short-T regime where pscan's prefix tree never amortizes.  Off
+    TPU the answer is always ``'scan'``: pscan is 50-100x slower on CPU
+    (BENCH_r05 + bench.py kernel probe) and the pallas kernel would run
+    in interpret mode, which is an emulator, not an optimization.
+    """
+    if backend != "tpu":
+        return "scan"
+    if prefer_pscan(backend, n_series, n_time, lanes=lanes):
+        return "pscan"
+    if _pallas_available():
+        return "pallas"
+    return "scan"
+
+
+def _score_kernel(y_ref, mk_ref, a_ref, b_ref, g_ref, p_ref,
+                  l0_ref, b0_ref, s0_ref, out_ref, *, m: int, T: int,
+                  bc: int):
+    """Additive-HW one-step-ahead MSE for one (series, candidate-block).
+
+    Refs (all VMEM): y/mask (1, T) — ONE series' history, shared by every
+    candidate block of that series via the BlockSpec index map; alpha/
+    beta/gamma/phi (1, bc) candidate lanes; l0/b0 (1, 1) and s0 (1, m)
+    the series' initial state; out (1, bc) masked MSE per candidate.
+
+    The body mirrors ``models/holt_winters._hw_step`` (additive branch)
+    expression-for-expression; the seasonal slot is selected with a
+    one-hot built from ``broadcasted_iota`` (1D iota does not lower on
+    TPU) and written back as ``s*(1-onehot) + onehot*s_new`` so the slot
+    lane gets exactly ``s_new`` — no add/subtract round-off.
+    """
+    a = a_ref[...]
+    be = b_ref[...]
+    g = g_ref[...]
+    p = p_ref[...]
+    l = jnp.full((1, bc), l0_ref[0, 0], dtype=jnp.float32)
+    b = jnp.full((1, bc), b0_ref[0, 0], dtype=jnp.float32)
+    s = jnp.broadcast_to(s0_ref[0, :][:, None], (m, bc)).astype(jnp.float32)
+    zero = jnp.zeros((1, bc), dtype=jnp.float32)
+    rows = jax.lax.broadcasted_iota(jnp.int32, (m, bc), 0)
+
+    def body(t, carry):
+        l, b, s, sse, n = carry
+        yt = y_ref[0, t]
+        mt = mk_ref[0, t]
+        onehot = (rows == (t % m)).astype(jnp.float32)
+        si = jnp.sum(s * onehot, axis=0, keepdims=True)
+        pb = p * b
+        pred = l + pb + si
+        l_obs = a * (yt - si) + (1 - a) * (l + pb)
+        s_obs = g * (yt - l_obs) + (1 - g) * si
+        b_obs = be * (l_obs - l) + (1 - be) * pb
+        l2 = jnp.where(mt > 0, l_obs, l + pb)
+        b2 = jnp.where(mt > 0, b_obs, pb)
+        s2 = s * (1.0 - onehot) + onehot * jnp.where(mt > 0, s_obs, si)
+        err = (yt - pred) * mt
+        return l2, b2, s2, sse + err * err, n + mt
+
+    l, b, s, sse, n = jax.lax.fori_loop(0, T, body, (l, b, s, zero, zero))
+    out_ref[...] = sse / jnp.maximum(n, 1.0)
+
+
+@partial(jax.jit, static_argnames=("m", "interpret"))
+def hw_score(y, mask, alpha, beta, gamma, phi, m: int,
+             interpret: bool | None = None):
+    """Score every (series, candidate) pair's additive-HW filter MSE.
+
+    y/mask: (S, T); alpha/beta/gamma/phi: (C,) candidate grid.  Returns
+    (S, C) masked one-step-ahead MSE — the ranking input for the grid
+    search's argmin.  Initial states come from the same
+    ``_init_state`` the sequential filter uses, computed once per series
+    outside the kernel.
+
+    ``interpret`` defaults to True off-TPU (the Pallas interpreter — a
+    correctness emulator for tests, never a fast path; ``select_filter``
+    only routes here on real TPU).
+    """
+    from jax.experimental import pallas as pl
+
+    from distributed_forecasting_tpu.models.holt_winters import _init_state
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    S, T = y.shape
+    C = alpha.shape[0]
+    bc = min(_LANE_BLOCK, max(C, 1))
+    c_pad = -(-C // bc) * bc
+
+    y = y.astype(jnp.float32)
+    mask = mask.astype(jnp.float32)
+    l0, b0, s0 = jax.vmap(
+        lambda ys, ms: _init_state(ys, ms, m, "additive")
+    )(y, mask)
+
+    def cand(v):
+        v = jnp.pad(v.astype(jnp.float32), (0, c_pad - C))
+        return v[None, :]  # (1, c_pad)
+
+    lane = pl.BlockSpec((1, bc), lambda i, j: (0, j))
+    per_series = lambda blk: pl.BlockSpec(blk, lambda i, j: (i, 0))
+    out = pl.pallas_call(
+        partial(_score_kernel, m=m, T=T, bc=bc),
+        grid=(S, c_pad // bc),
+        in_specs=[
+            per_series((1, T)),  # y
+            per_series((1, T)),  # mask
+            lane, lane, lane, lane,  # alpha, beta, gamma, phi
+            per_series((1, 1)),  # l0
+            per_series((1, 1)),  # b0
+            per_series((1, m)),  # s0
+        ],
+        out_specs=pl.BlockSpec((1, bc), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((S, c_pad), jnp.float32),
+        interpret=bool(interpret),
+    )(y, mask, cand(alpha), cand(beta), cand(gamma), cand(phi),
+      l0[:, None], b0[:, None], s0)
+    return out[:, :C]

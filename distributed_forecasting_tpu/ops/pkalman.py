@@ -131,7 +131,10 @@ def parallel_kalman_filter(
     # O(log T) products of increasingly ill-conditioned elements — observed
     # on real hardware as ~0.5% drift of the filtered means vs the
     # sequential filter (integration tier, round 3).  The (r, r) ops are
-    # FLOP-negligible at r <= ~10, so precision is free.
+    # FLOP-negligible at r <= ~10, so precision is free.  For the same
+    # reason this site is excluded from the ops/precision.py bf16 gate:
+    # covariance compositions subtract near-equal PSD terms, which bf16's
+    # 8 mantissa bits cannot represent.
     with jax.default_matmul_precision("float32"):
         return _parallel_kalman_impl(z, mask, T_mat, RRt, P0, block_size)
 

@@ -1,0 +1,115 @@
+"""Config-gated mixed-precision policy for the candidate-scoring passes.
+
+The kernel round's third thrust: on matmul-free filter recurrences the
+CPU/TPU roofline is bandwidth-bound, so halving the working-set dtype is
+a real throughput lever — but ONLY where the exactness contract tolerates
+it.  The one place it does is candidate SCORING: the grid search consumes
+nothing but the argmin over per-candidate MSEs, and the winning candidate
+is always refit in float32 through the bitwise ``_hw_step``/theta scan
+(the streaming contract of docs/streaming.md never sees a bf16 value).
+A rank flip between two near-tied candidates changes which near-optimal
+parameter vector wins — a model-quality question, not a correctness one,
+which is why the gate is guarded by the PR-8 quality monitors
+(monitoring/quality.py): WAPE/RMSSE drift from a bad flip trips the same
+alerts as any other regression.
+
+Explicitly OUT of scope for this gate (kept float32 unconditionally):
+
+- ``ops/pscan.py`` — the affine-map composition tree already pins
+  ``jax.default_matmul_precision('float32')``; bf16 passes compound
+  roundoff through O(log T) matmul layers until the prefix states drift
+  from the sequential recurrence (caught by the round-3 hardware tier).
+- ``ops/pkalman.py`` — the Kalman 5-tuple composition and covariance
+  updates: subtraction of near-equal PSD matrices loses all significance
+  in bf16's 8 mantissa bits.
+- ``models/arima.py`` — the CSS objective's lag matmuls and the
+  innovation recursions feed gradient-free optimization directly; the
+  optimizer's convergence test is tighter than bf16 resolution.
+
+The gate is OFF by default and flips only via the strict ``precision:``
+conf block (``tasks/common.Task``) or an explicit
+:func:`configure_precision` call:
+
+    precision:
+      bf16_scoring: true
+
+Process-wide flag semantics: the flag is read at TRACE time, so it must
+be configured at startup before the first fit (tasks/common.py does this
+in ``Task.__init__``).  ``jax.jit`` caches do not key on it — only the
+AOT executable store does, via :func:`fingerprint_extra` (wired into
+``engine/compile_cache.fingerprint``); flipping the flag mid-process
+invalidates AOT entries correctly but would reuse any already-traced
+plain-jit fits, so don't.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class PrecisionConfig:
+    # bf16 accumulation in the HW candidate-scoring filter (fit grid
+    # search only; winner refit and streaming updates stay float32)
+    bf16_scoring: bool = False
+
+    @classmethod
+    def from_conf(cls, conf: Optional[dict]) -> "PrecisionConfig":
+        conf = conf or {}
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(conf) - known
+        if unknown:
+            # a typo like bf16_score must not silently run full precision
+            # while the operator believes the experiment is live — or the
+            # reverse
+            raise ValueError(
+                f"unknown precision conf key(s) {sorted(unknown)}; "
+                f"valid: {sorted(known)}")
+        kwargs = {
+            f.name: type(f.default)(conf[f.name])
+            for f in dataclasses.fields(cls)
+            if f.name in conf and conf[f.name] is not None
+        }
+        return cls(**kwargs)
+
+
+_lock = threading.Lock()
+_config = PrecisionConfig()
+
+
+def configure_precision(config: PrecisionConfig) -> None:
+    """Install the process-wide precision policy (call before first trace)."""
+    global _config
+    with _lock:
+        _config = config
+
+
+def get_precision() -> PrecisionConfig:
+    return _config
+
+
+def scoring_dtype():
+    """Accumulation dtype for candidate scoring: bf16 when gated on, else
+    None (meaning: leave everything float32 — the default and the only
+    mode whose outputs are covered by ``outputs_identical`` in the perf
+    baseline)."""
+    if _config.bf16_scoring:
+        import jax.numpy as jnp
+
+        return jnp.bfloat16
+    return None
+
+
+def fingerprint_extra() -> Optional[dict]:
+    """Non-default precision state for AOT executable-store keys.
+
+    Returns None when everything is at defaults so pre-existing cache
+    keys (and the perf baseline's program fingerprints) are unchanged;
+    any active gate shows up as an ``extra`` dict folded into the key,
+    giving gated programs their own cache lineage.
+    """
+    if _config == PrecisionConfig():
+        return None
+    return {"bf16_scoring": bool(_config.bf16_scoring)}

@@ -199,8 +199,10 @@ def affine_scan_batched(A, c, x0):
 # the sequential scan's lower FLOP count wins.
 _PSCAN_MAX_LANES = 4096
 # Serial-depth threshold: under ~20k steps the lax.scan chain fits wall
-# time comfortably (BENCH_r05's T=2k regime) and the prefix tree's setup
-# cost is not amortized.
+# time comfortably (BENCH_r05's T=2k regime; the bench.py kernel probe
+# re-measures that regime every round — r07: scan 4.7ms vs pscan 722ms at
+# S=8, T=2048, 12 lanes on CPU) and the prefix tree's setup cost is not
+# amortized.
 _PSCAN_MIN_TIME = 20_000
 
 
@@ -216,10 +218,14 @@ def prefer_pscan(backend: str, n_series: int, n_time: int,
     The prefix trades O(T d^2) FLOPs for O(T d^3) at O(log T) depth — a win
     only where depth, not FLOPs, bounds wall time.  BENCH_r05 measured
     pscan at x0.01-0.02 of scan throughput on CPU in BOTH the short-T and
-    long-T regimes (a CPU has no idle lanes for the extra matmul factor),
-    so anything but an accelerator always scans.  On TPU the prefix needs
-    long series (serial depth dominating) AND few enough total batch lanes
-    that the MXU is not already saturated by the series axis.
+    long-T regimes (a CPU has no idle lanes for the extra matmul factor);
+    the bench.py kernel probe re-confirms it every round (r07: x153
+    slower at S=8, T=2048, 12 lanes), so anything but an accelerator
+    always scans.  On TPU the prefix needs long series (serial depth
+    dominating) AND few enough total batch lanes that the MXU is not
+    already saturated by the series axis.  This is one tier of
+    ``ops/fused_scan.select_filter``, which adds the fused-pallas tier
+    above it — callers picking a solver should go through that.
     """
     if backend != "tpu":
         return False

@@ -177,6 +177,10 @@ _GAUGE_MAX_MERGE = frozenset({
     "dftpu_ingest_wal_bytes",
     "dftpu_ingest_wal_segments",
     "dftpu_ingest_applied_day",
+    # a FRACTION (pad rows / dispatched rows): summing is meaningless,
+    # the worst replica is the capacity-waste signal — the underlying
+    # dftpu_cost_padding_rows_total counters still SUM
+    "dftpu_cost_padding_waste",
 })
 
 #: per-replica capacity watermarks (host RSS, device bytes in use) —

@@ -103,21 +103,40 @@ def quantile_columns(quantiles) -> list:
     return [f"q{float(q):g}" for q in quantiles]
 
 
+def _ladder_value(k: int) -> int:
+    """Smallest pow2x3 ladder value >= k: {2^i} ∪ {3·2^i} = 1, 2, 3, 4, 6,
+    8, 12, 16, 24, 32, ...
+
+    The kernel round replaced the pure power-of-two request ladder: pow2
+    wastes up to ~47% of dispatched rows as padding just past a boundary
+    (k=17 -> bucket 32, 15 pad rows), while interleaving the 3·2^i rungs
+    caps the waste at ~29% (k=17 -> 24) for one extra compiled program per
+    octave — O(2·log S) programs total, still warmup-coverable.  The
+    ``dftpu_cost_padding_waste`` gauge measures the fraction this buys.
+    """
+    if k <= 1:
+        return 1
+    p = 1 << (k - 1).bit_length()       # next power of two >= k
+    three_quarters = 3 * (p >> 2)       # the 3·2^(i-2) rung below p
+    return three_quarters if three_quarters >= k else p
+
+
 def _bucket_ladder(sizes) -> tuple:
-    """Every power-of-two request bucket up to the largest requested size.
+    """Every pow2x3 request bucket up to the largest requested size.
 
     Composite forecasters (ensemble/bucketed) split a request across
     members by per-series routing, so a listed warmup size can reach a
     member as ANY smaller sub-request; warming the whole ladder covers
-    every possible split.  (1, 2, 4, ..., bucket(max(sizes))).
+    every possible split.  (1, 2, 3, 4, 6, ..., bucket(max(sizes))).
     """
-    top = max(max(int(k), 1) for k in sizes)
-    top_bucket = 1 << (top - 1).bit_length() if top > 1 else 1
+    top_bucket = _ladder_value(max(max(int(k), 1) for k in sizes))
     ladder, b = [], 1
     while b <= top_bucket:
         ladder.append(b)
+        if 3 * (b >> 1) > b:            # the 3·2^(i-1) rung between b and 2b
+            ladder.append(3 * (b >> 1))
         b <<= 1
-    return tuple(ladder)
+    return tuple(v for v in ladder if v <= top_bucket)
 
 
 def result_block_index(out: pd.DataFrame, key_names) -> tuple:
@@ -416,7 +435,7 @@ class BatchForecaster:
         the fit grid, which is only exact when such grids start at day0; the
         history part is a cheap gather, so the full grid costs almost
         nothing and keeps every request pattern exact.  The request size is
-        bucketed to the next power of two (capped at S) so a serving
+        bucketed to the next pow2x3 ladder value (capped at S) so a serving
         process sees O(log S) compiled shapes; padding rows repeat sidx[0]
         and are dropped by the caller.
 
@@ -521,18 +540,21 @@ class BatchForecaster:
         return int(self.keys.shape[0])
 
     def _bucket(self, k: int) -> int:
-        """Request-size bucket: next power of two, capped at S.
+        """Request-size bucket: next pow2x3 ladder value, capped at S.
 
         The ONE bucketing policy — shared by the live request path
         (`_prepare_request`) and `warmup`, so startup always compiles
-        exactly the shapes production requests will hit.  With a mesh
-        enabled the bucket additionally rounds up to a mesh multiple so
-        every device gets an identical static shard (the padding rows
-        repeat sidx[0] like any other bucket padding).
+        exactly the shapes production requests will hit.  The ladder
+        interleaves 3·2^i rungs between the powers of two
+        (:func:`_ladder_value`) to cap pad-row waste at ~29% instead of
+        pow2's ~47%.  With a mesh enabled the bucket additionally rounds
+        up to a mesh multiple so every device gets an identical static
+        shard (the padding rows repeat sidx[0] like any other bucket
+        padding).
         """
         S = self.keys.shape[0]
-        bucket = min(1 << (k - 1).bit_length(), S)
-        bucket = max(bucket, k)  # k == S but S not a power of two
+        bucket = min(_ladder_value(k), S)
+        bucket = max(bucket, k)  # k == S but S not on the ladder
         if self._mesh is not None:
             n = int(self._mesh.devices.size)
             bucket = ((bucket + n - 1) // n) * n
@@ -614,6 +636,12 @@ class BatchForecaster:
         # executable from disk instead of trace+compiling it.  Families
         # whose forecast is a plain wrapper (arima) bypass to jit inside
         # aot_call and still get the persistent XLA cache.
+        # NOT donated: the kernel round measured donation of the gathered
+        # params across all families — XLA finds zero usable aliases here
+        # (every forecast output is (bucket, T_all), matching no param
+        # leaf's shape), so donating would invalidate request buffers and
+        # warn per compile for no copy saved.  Donation lives where it
+        # pays: ops/update.apply_update and the refit fit dispatch.
         from distributed_forecasting_tpu.engine.compile_cache import aot_call
 
         entry = self._aot_entry("serving_predict")
@@ -659,7 +687,10 @@ class BatchForecaster:
             frame["yhat_lower"] = np.asarray(lo)[:k].reshape(-1)
             dev = trace_clock() - t_disp
             span.set_attribute("device_seconds", dev)
-            cost_metrics().record_dispatch(entry, self.model, dev)
+            cm = cost_metrics()
+            cm.record_dispatch(entry, self.model, dev)
+            bucket = self._bucket(k)
+            cm.record_padding(entry, bucket, bucket - k)
             return pd.DataFrame(frame)
 
     def predict_quantiles(
@@ -730,7 +761,10 @@ class BatchForecaster:
             yq = np.asarray(yq)[:k]
             dev = trace_clock() - t_disp
             span.set_attribute("device_seconds", dev)
-            cost_metrics().record_dispatch(entry, self.model, dev)
+            cm = cost_metrics()
+            cm.record_dispatch(entry, self.model, dev)
+            bucket = self._bucket(k)
+            cm.record_padding(entry, bucket, bucket - k)
             frame = self._frame_skeleton(sidx, day_all)
             for qi, col in enumerate(qcols):
                 frame[col] = yq[:, qi, :].reshape(-1)

@@ -117,6 +117,20 @@ class Task(ABC):
             )
 
             configure_pipeline(PipelineConfig.from_conf(pl))
+        # Mixed-precision gate (ops/precision.py) — installed here, before
+        # any trace in launch(), because the flag is read at trace time
+        # and plain-jit caches do not key on it (the AOT store does):
+        #
+        #     precision:
+        #       bf16_scoring: false      # bf16 candidate scoring (fit only)
+        pr = self.conf.get("precision") if isinstance(self.conf, dict) else None
+        if pr is not None:
+            from distributed_forecasting_tpu.ops.precision import (
+                PrecisionConfig,
+                configure_precision,
+            )
+
+            configure_precision(PrecisionConfig.from_conf(pr))
 
     # lazy infra handles ----------------------------------------------------
     @property

@@ -32,6 +32,9 @@ Severity model — what fails vs what only warns:
 * warm-path recompiles (``outcome=miss`` in the current record): **fail**;
 * cold-vs-current output hash mismatch (same process ladder, same
   machine): **fail** — the cache changed what the model serves;
+* donation-proof regression (the dispatched state-update program loses
+  its stripped/donated shape — argument_bytes no longer below the raw
+  kernel's, or alias_bytes back to 0): **fail**;
 * timing regression: compared against a noise floor that widens to 35%
   when either side ran on CPU (shared-runner fallback; docs/benchmarks.md
   records why CPU numbers are not perf statements) and tightens to 15%
@@ -51,6 +54,7 @@ import argparse
 import hashlib
 import json
 import os
+import re
 import sys
 import time
 from typing import Dict, List, Optional
@@ -63,9 +67,12 @@ NOISE_FLOOR_CPU = 0.35
 NOISE_FLOOR_DEVICE = 0.15
 
 #: cost fields compared entry-by-entry; peak memory drifts with XLA's
-#: allocator so it gets a small relative tolerance, the rest are exact
+#: allocator so it gets a small relative tolerance, the rest are exact.
+#: ``alias_bytes`` is exact too: it is how buffer donation proves it took
+#: effect (argument_size does NOT shrink under donation on XLA:CPU), so a
+#: silent donation regression shows up as alias_bytes dropping to 0
 COST_FIELDS_EXACT = ("flops", "bytes_accessed", "argument_bytes",
-                     "output_bytes")
+                     "output_bytes", "alias_bytes")
 COST_FIELDS_LOOSE = ("temp_bytes", "peak_bytes")
 COST_LOOSE_RTOL = 0.10
 
@@ -140,6 +147,8 @@ def collect(workdir: str, reps: int = 20, expect_warm: bool = False) -> Dict:
         "cache": cache_stats(),
         "entry_outcomes": outcomes,
         "programs": programs,
+        "padding": _padding_section(cm),
+        "donation_proof": _donation_proof(),
         "timings_ms": {
             "min": round(samples[0] * 1e3, 3),
             "p50": round(p50 * 1e3, 3),
@@ -149,6 +158,126 @@ def collect(workdir: str, reps: int = 20, expect_warm: bool = False) -> Dict:
         "throughput_rows_per_s": round(rows_per_dispatch / p50, 1),
         "output_sha256": hashlib.sha256(
             out.to_csv(index=False).encode()).hexdigest(),
+    }
+
+
+def _padding_section(cm) -> Dict:
+    """Observed + worst-case padding waste for the request-bucket ladder.
+
+    ``entries`` re-keys the ``padding_rows_total`` counter per dispatch
+    entry: how many batch rows were actually served vs padded in by the
+    shape-bucket ladder.  ``ladder`` is the analytic worst case over every
+    request size up to 2048 for the live pow2x3 ladder vs the pure-pow2
+    ladder it replaced — the deterministic headline of the kernel round
+    (docs/benchmarks.md 'kernel round' table); the observed fraction
+    depends on the workload's request sizes (this collector's 3-series
+    request buckets EXACTLY under pow2x3, where pow2 padded it to 4)."""
+    from distributed_forecasting_tpu.serving.predictor import _ladder_value
+
+    acc: Dict[str, Dict[str, float]] = {}
+    for label_str, value in cm.padding_rows_total.snapshot().items():
+        labels = dict(part.partition("=")[::2]
+                      for part in label_str.split(","))
+        acc.setdefault(labels.get("entry", ""),
+                       {})[labels.get("kind", "")] = value
+    entries: Dict[str, Dict[str, float]] = {}
+    for entry, kinds in sorted(acc.items()):
+        real, pad = kinds.get("real", 0.0), kinds.get("pad", 0.0)
+        total = real + pad
+        entries[entry] = {
+            "rows": real,
+            "pad_rows": pad,
+            "waste_frac": round(pad / total, 4) if total else 0.0,
+        }
+    worst_new = max((_ladder_value(k) - k) / _ladder_value(k)
+                    for k in range(1, 2049))
+    worst_old = max(((1 << (k - 1).bit_length()) - k)
+                    / (1 << (k - 1).bit_length())
+                    for k in range(2, 2049))
+    return {
+        "entries": entries,
+        "ladder": {
+            "kind": "pow2x3",
+            "worst_waste_frac": round(worst_new, 4),
+            "worst_waste_frac_pow2": round(worst_old, 4),
+            "worst_case_improvement_x": round(worst_old / worst_new, 2),
+        },
+    }
+
+
+def _donation_proof() -> Dict:
+    """Compile the holt_winters streaming update twice — the raw kernel vs
+    the shape ``ops/update.apply_update`` actually dispatches (fitted leaf
+    stripped to (S, 0), aux buffers donated) — and record both programs'
+    XLA cost analyses.
+
+    On XLA:CPU donation does NOT shrink ``argument_bytes``; it surfaces as
+    nonzero ``alias_bytes`` (the donated input aliased onto an output),
+    while fitted-stripping genuinely drops argument AND output bytes.  The
+    diff side (:func:`_diff_donation`) fails the build if either signal
+    disappears, so a refactor that silently un-donates the hot path can't
+    land green.  ``.lower().compile()`` only — nothing executes, so the aux
+    buffers here are never actually consumed.
+    """
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_forecasting_tpu.models.base import get_model
+    from distributed_forecasting_tpu.monitoring.cost import (
+        extract_cost_analysis,
+    )
+
+    fns = get_model("holt_winters")
+    cfg = fns.config_cls()
+    S, T = 4, 64
+    rng = np.random.default_rng(11)
+    y = jnp.asarray(np.abs(rng.normal(10.0, 2.0, (S, T))).astype(np.float32))
+    mask = jnp.ones((S, T), jnp.float32)
+    day = jnp.asarray(np.arange(T, dtype=np.float32))
+    params = fns.fit(y, mask, day, cfg)
+    aux = fns.init_update_aux(params, y, mask)
+    y_new = jnp.full((S, 1), 10.0, jnp.float32)
+    ones = jnp.ones((S, 1), jnp.float32)
+    valid = jnp.ones((1,), jnp.float32)
+    day_new = jnp.asarray([float(T)], jnp.float32)
+
+    # compile OUTSIDE jax's layer-1 persistent cache (enabled by
+    # configure_compile_cache): an executable deserialized from that cache
+    # reports alias_bytes=0 from memory_analysis(), which would make the
+    # proof flap between cold and warm collects.  Clearing the dir alone
+    # is not enough — is_cache_used() memoizes per process once the first
+    # cached compile runs — so the cache singleton is reset around the
+    # proof and again after, letting later compiles re-engage the dir
+    from jax.experimental.compilation_cache import (
+        compilation_cache as _comp_cache,
+    )
+
+    prev = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", None)
+    _comp_cache.reset_cache()
+    try:
+        plain = jax.jit(
+            fns.update_state, static_argnames=("config",)
+        ).lower(params, aux, y_new, ones, valid, day_new,
+                config=cfg).compile()
+        slim = dataclasses.replace(
+            params, fitted=jnp.zeros((S, 0), params.fitted.dtype))
+        donated = jax.jit(
+            fns.update_state, static_argnames=("config",),
+            donate_argnums=(1,)
+        ).lower(slim, aux, y_new, ones, valid, day_new,
+                config=cfg).compile()
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
+        _comp_cache.reset_cache()
+    return {
+        "entry": "state_update:holt_winters",
+        "workload": {"series": S, "history_days": T, "k_alloc": 1},
+        "plain": extract_cost_analysis(plain),
+        "donated": extract_cost_analysis(donated),
     }
 
 
@@ -209,6 +338,7 @@ def diff_records(baseline: Dict, current: Dict,
         findings.append(_diff_timing(baseline, current, on_cpu))
 
     findings.append(_diff_recompiles(current))
+    findings.append(_diff_donation(current))
 
     if cold is not None:
         a, b = cold.get("output_sha256"), current.get("output_sha256")
@@ -308,6 +438,49 @@ def _diff_recompiles(current: Dict) -> Dict:
                     "zero warm-path recompiles (all memo/hit)")
 
 
+def _diff_donation(current: Dict) -> Dict:
+    """Assert the donation/stripping optimizations are still compiled in.
+
+    Two invariants from the collect-side proof (:func:`_donation_proof`):
+    the dispatched program's ``argument_bytes`` must sit BELOW the raw
+    kernel's (fitted-stripping took effect), and its ``alias_bytes`` must
+    be nonzero (aux donation aliased an input onto an output).  Either one
+    reverting means the steady-state apply quietly regained its full
+    history-buffer copy, which per-entry cost diffs alone would only catch
+    after the next --write-baseline."""
+    proof = current.get("donation_proof")
+    if not proof:
+        return _finding(
+            "donation", "warn",
+            "current record has no donation_proof section (collected by an "
+            "older perf_report?); re-collect to assert donation is live")
+    plain = proof.get("plain") or {}
+    donated = proof.get("donated") or {}
+    pa, da = plain.get("argument_bytes"), donated.get("argument_bytes")
+    alias = (donated.get("alias_bytes") or 0.0)
+    if pa is None or da is None:
+        return _finding(
+            "donation", "warn",
+            "donation_proof lacks argument_bytes on this backend; "
+            "donation assertion skipped")
+    entry = proof.get("entry", "state_update:?")
+    if da >= pa:
+        return _finding(
+            "donation", "fail",
+            f"{entry}: dispatched argument_bytes {da:g} >= raw kernel's "
+            f"{pa:g} — fitted-stripping is no longer shrinking the "
+            f"compiled program")
+    if alias <= 0:
+        return _finding(
+            "donation", "fail",
+            f"{entry}: alias_bytes is 0 on the dispatched program — aux "
+            f"donation no longer reaches XLA (donate_argnums dropped?)")
+    return _finding(
+        "donation", "ok",
+        f"{entry}: argument_bytes {pa:g} -> {da:g} "
+        f"({_pct(pa, da)}) with {alias:g} alias bytes donated")
+
+
 def _pct(bv: float, cv: float) -> str:
     return f"{100.0 * (cv - bv) / bv:+.1f}%" if bv else "n/a"
 
@@ -393,24 +566,47 @@ def main() -> None:
 def _write_bench(path: str, report: Dict, current: Dict,
                  base_p50: float, cur_p50: float, backend: Dict) -> None:
     """BENCH_r*.json-shaped artifact so the bench trajectory stays one
-    schema (see BENCH_r05.json)."""
+    schema (see BENCH_r05.json).  The round number is read off the
+    ``--bench-out`` filename (BENCH_r07.json -> 7)."""
     tail = "\n".join(
         f"[sentinel] {f['check']}: {f['level']} — {f['detail']}"
         for f in report["findings"]) + "\n"
+    m = re.search(r"r(\d+)", os.path.basename(path))
+    parsed = {
+        "metric": "serving_warm_predict_p50_ms",
+        "value": cur_p50,
+        "unit": "ms",
+        "vs_baseline": round(cur_p50 / base_p50, 3) if base_p50 else None,
+        "device": f"{backend.get('platform', '?')}:"
+                  f"{backend.get('device_kind', '?')}",
+    }
+    padding = current.get("padding") or {}
+    entries = padding.get("entries") or {}
+    if entries:
+        worst = max(entries.values(), key=lambda p: p.get("waste_frac", 0.0))
+        parsed["padding_waste_frac_observed"] = worst.get("waste_frac")
+    ladder = padding.get("ladder") or {}
+    if ladder:
+        parsed["padding_ladder"] = ladder.get("kind")
+        parsed["padding_worst_waste_frac"] = ladder.get("worst_waste_frac")
+        parsed["padding_worst_waste_frac_pow2"] = ladder.get(
+            "worst_waste_frac_pow2")
+        parsed["padding_worst_case_improvement_x"] = ladder.get(
+            "worst_case_improvement_x")
+    proof = current.get("donation_proof") or {}
+    if proof:
+        parsed["donated_argument_bytes"] = (
+            proof.get("donated") or {}).get("argument_bytes")
+        parsed["plain_argument_bytes"] = (
+            proof.get("plain") or {}).get("argument_bytes")
     bench = {
-        "n": 6,
+        "n": int(m.group(1)) if m else None,
         "cmd": ("python scripts/perf_report.py --baseline PERF_BASELINE.json"
-                " --current warm.json --cold cold.json --strict"),
+                " --current warm.json --cold cold.json --strict"
+                f" --bench-out {os.path.basename(path)}"),
         "rc": 0 if report["status"] != "fail" else 1,
         "tail": tail,
-        "parsed": {
-            "metric": "serving_warm_predict_p50_ms",
-            "value": cur_p50,
-            "unit": "ms",
-            "vs_baseline": round(cur_p50 / base_p50, 3) if base_p50 else None,
-            "device": f"{backend.get('platform', '?')}:"
-                      f"{backend.get('device_kind', '?')}",
-        },
+        "parsed": parsed,
     }
     with open(path, "w") as f:
         json.dump(bench, f, indent=2)

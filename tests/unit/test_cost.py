@@ -307,7 +307,8 @@ def test_debug_cost_endpoint_gated(fresh_cost):
 
 # -- perf sentinel ------------------------------------------------------------
 
-def _perf_record(p50=5.0, flops=1e6, miss=0, sha="aa11", backend=None):
+def _perf_record(p50=5.0, flops=1e6, miss=0, sha="aa11", backend=None,
+                 donated_arg=288.0, alias=32.0):
     return {
         "format": "dftpu-perf-baseline-v1",
         "backend": backend or {"platform": "cpu", "device_kind": "cpu",
@@ -318,6 +319,11 @@ def _perf_record(p50=5.0, flops=1e6, miss=0, sha="aa11", backend=None):
         },
         "entry_outcomes": {
             "serving_predict:prophet": {"hit": 3.0, "miss": float(miss)},
+        },
+        "donation_proof": {
+            "entry": "state_update:holt_winters",
+            "plain": {"argument_bytes": 1312.0, "alias_bytes": 0.0},
+            "donated": {"argument_bytes": donated_arg, "alias_bytes": alias},
         },
         "timings_ms": {"p50": p50},
         "output_sha256": sha,
@@ -355,6 +361,26 @@ def test_perf_sentinel_fails_on_warm_recompiles_and_output_drift():
     findings = pr.diff_records(_perf_record(), _perf_record(sha="bb22"),
                                cold=_perf_record(sha="aa11"))
     assert _levels(findings)["output_hash"] == "fail"
+
+
+def test_perf_sentinel_donation_proof_gate():
+    pr = _load_script("perf_report")
+    # stripped + donated shape intact: ok
+    findings = pr.diff_records(_perf_record(), _perf_record())
+    assert _levels(findings)["donation"] == "ok"
+    # argument_bytes back at (or above) the raw kernel's: the fitted leaf
+    # is being copied through the compiled program again
+    findings = pr.diff_records(_perf_record(),
+                               _perf_record(donated_arg=1312.0))
+    assert _levels(findings)["donation"] == "fail"
+    # alias_bytes gone: donate_argnums no longer reaches XLA
+    findings = pr.diff_records(_perf_record(), _perf_record(alias=0.0))
+    assert _levels(findings)["donation"] == "fail"
+    # a record collected by an older perf_report degrades to warn, not fail
+    old = _perf_record()
+    del old["donation_proof"]
+    findings = pr.diff_records(_perf_record(), old)
+    assert _levels(findings)["donation"] == "warn"
 
 
 def test_perf_sentinel_cpu_noise_floor():

@@ -548,6 +548,87 @@ def test_config_drift_monitoring_cost_block_clean(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# host-reuse-after-donation
+# ---------------------------------------------------------------------------
+
+def test_donation_reuse_positive_aot_call(tmp_path):
+    _write(tmp_path, "engine/upd.py", """
+        from distributed_forecasting_tpu.engine.compile_cache import aot_call
+
+        def apply(entry, fn, params, aux, y):
+            p2, a2, preds = aot_call(
+                entry, fn, args=(params, aux, y), donate_argnums=(1,))
+            return a2["sse"] + aux["sse"]   # aux's buffer is gone
+    """)
+    found = _lint(tmp_path, "engine/upd.py")
+    assert [f.rule for f in found] == ["host-reuse-after-donation"]
+    assert "'aux'" in found[0].message
+
+
+def test_donation_reuse_positive_donated_variant(tmp_path):
+    _write(tmp_path, "engine/fitd.py", """
+        from distributed_forecasting_tpu.engine.compile_cache import (
+            donated_variant,
+        )
+
+        def refit(fit, y, mask, day, config):
+            g = donated_variant(fit, donate_argnums=(0, 1),
+                                static_argnames=("config",))
+            params = g(y, mask, day, config=config)
+            return params, mask.sum()       # mask was donated at position 1
+    """)
+    found = _lint(tmp_path, "engine/fitd.py")
+    assert [f.rule for f in found] == ["host-reuse-after-donation"]
+    assert "'mask'" in found[0].message
+
+
+def test_donation_reuse_negative_idioms(tmp_path):
+    # rebinding the name, reading undonated args, and undonated calls are
+    # all the sanctioned patterns and must stay quiet
+    _write(tmp_path, "engine/ok.py", """
+        from distributed_forecasting_tpu.engine.compile_cache import (
+            aot_call,
+            donated_variant,
+        )
+
+        def rebind(entry, fn, params, aux, y):
+            p2, aux, preds = aot_call(
+                entry, fn, args=(params, aux, y), donate_argnums=(1,))
+            return p2, aux                  # aux now names the NEW buffer
+
+        def undonated_read(entry, fn, params, aux, y):
+            p2, a2, preds = aot_call(
+                entry, fn, args=(params, aux, y), donate_argnums=(1,))
+            return p2, params, y            # positions 0/2 were not donated
+
+        def no_donation(entry, fn, params, aux, y):
+            p2, a2, preds = aot_call(entry, fn, args=(params, aux, y))
+            return a2, aux
+
+        def variant_rebind(fit, y, mask, day, config):
+            g = donated_variant(fit, donate_argnums=(0,),
+                                static_argnames=("config",))
+            y = g(y, mask, day, config=config)
+            return y, mask
+    """)
+    assert _lint(tmp_path, "engine/ok.py") == []
+
+
+def test_donation_reuse_scoped_to_hot_dirs(tmp_path):
+    # tests/tools that intentionally re-read (e.g. to assert the failure
+    # mode) live outside ops/engine/serving/parallel and stay unflagged
+    _write(tmp_path, "workflows/upd.py", """
+        from distributed_forecasting_tpu.engine.compile_cache import aot_call
+
+        def apply(entry, fn, params, aux, y):
+            p2, a2, preds = aot_call(
+                entry, fn, args=(params, aux, y), donate_argnums=(1,))
+            return aux
+    """)
+    assert _lint(tmp_path, "workflows/upd.py") == []
+
+
+# ---------------------------------------------------------------------------
 # suppressions
 # ---------------------------------------------------------------------------
 

@@ -97,6 +97,10 @@ def _update(model, config, params, aux, y_new, m_new, day_new,
     k = y_new.shape[1]
     k_alloc = k_alloc or k
     yp, mp, dp, valid = _pad_cols(y_new, m_new, day_new, k_alloc)
+    # apply_update DONATES aux (the caller's buffers are consumed — the
+    # store always hands over its private carry); these tests reuse one
+    # aux across calls and read it after, so pass a copy each time
+    aux = jax.tree_util.tree_map(jnp.array, aux)
     return apply_update(model, config, params, aux, yp, mp, valid, dp)
 
 
