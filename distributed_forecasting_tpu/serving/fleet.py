@@ -42,6 +42,15 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, List, Optional
 
 from distributed_forecasting_tpu.monitoring.monitor import MetricsRegistry
+from distributed_forecasting_tpu.monitoring.trace import get_tracer
+from distributed_forecasting_tpu.serving.sharding import (
+    ShardingConfig,
+    TokenBucket,
+    compute_assignments,
+    merge_ingest_responses,
+    merge_invocation_responses,
+    plan_request,
+)
 from distributed_forecasting_tpu.utils import get_logger
 
 
@@ -181,6 +190,10 @@ _GAUGE_MAX_MERGE = frozenset({
     # the worst replica is the capacity-waste signal — the underlying
     # dftpu_cost_padding_rows_total counters still SUM
     "dftpu_cost_padding_waste",
+    # per-shard resident-series gauges: with replication > 1 every owner
+    # of a shard reports the SAME resident count for that shard's label,
+    # so summing would multiply series by the replication factor
+    "dftpu_shard_series",
 })
 
 #: per-replica capacity watermarks (host RSS, device bytes in use) —
@@ -331,6 +344,8 @@ class Replica:
         self.restarts = 0
         self.backoff_s = 0.0        # current restart delay (0 = next crash
         self.next_restart_at = 0.0  # restarts immediately); monotonic clock
+        self.shards: tuple = ()     # owned shards (sharded fleets only);
+        #                             rewritten under the lock on rebalance
 
     def describe(self) -> dict:
         alive = self.proc is not None and self.proc.poll() is None
@@ -340,10 +355,13 @@ class Replica:
             "alive": alive,
             "ready": self.ready,
             "restarts": self.restarts,
+            "shards": list(self.shards),
         }
 
 
-SpawnFn = Callable[[int, int], object]
+#: spawn_fn(index, port) for round-robin fleets; sharded fleets call it as
+#: spawn_fn(index, port, shards) so the child knows its assignment at boot
+SpawnFn = Callable[..., object]
 
 
 def default_spawn_fn(
@@ -351,6 +369,7 @@ def default_spawn_fn(
     artifact_dir: str,
     serving_conf: Optional[dict] = None,
     env_extra: Optional[dict] = None,
+    sharding: Optional[ShardingConfig] = None,
 ) -> SpawnFn:
     """A spawn_fn launching ``serving/replica.py`` subprocesses.
 
@@ -358,12 +377,15 @@ def default_spawn_fn(
     process boundary), binds its assigned port with ``/readyz`` at 503,
     warms the bucket ladder, then flips ready.  ``env_extra`` typically
     carries ``DFTPU_COMPILE_CACHE`` so every replica shares one AOT store.
+    With ``sharding``, the supervisor passes each replica its shard
+    assignment and the child subsets its params/state/WAL to those shards
+    before marking ready.
     """
     serving_conf = dict(serving_conf or {})
     pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
 
-    def spawn(index: int, port: int):
+    def spawn(index: int, port: int, shards=None):
         replica_conf = {
             "artifact_dir": artifact_dir,
             "host": config.replica_host,
@@ -383,6 +405,11 @@ def default_spawn_fn(
             # shared verbatim — replicas converge by following one log
             # (the replica defaults apply_mode to "interval" in a fleet)
             "ingest": serving_conf.get("ingest"),
+            # series partition: the child subsets its forecaster/WAL to
+            # these shards and follows only their wal_dir/shard-<k>/ logs
+            "sharding": (None if sharding is None
+                         else dataclasses.asdict(sharding)),
+            "shards": None if shards is None else sorted(shards),
         }
         env = dict(os.environ)
         existing = env.get("PYTHONPATH", "")
@@ -411,7 +438,9 @@ class FleetSupervisor:
     blocking call ever runs inside the critical section.
     """
 
-    def __init__(self, config: FleetConfig, spawn_fn: SpawnFn):
+    def __init__(self, config: FleetConfig, spawn_fn: SpawnFn,
+                 sharding: Optional[ShardingConfig] = None,
+                 key_names: Optional[tuple] = None):
         self._config = config
         self._spawn = spawn_fn
         self._lock = threading.Lock()
@@ -424,6 +453,25 @@ class FleetSupervisor:
             for i in range(config.replicas)
         ]
         self._replicas = [Replica(i, p) for i, p in enumerate(ports)]
+        # series partition (None = classic round-robin fleet).  The
+        # assignment table and per-replica shard tuples are shared state
+        # under _lock like every Replica field; the sharding config and
+        # quota bucket are immutable/internally-locked.
+        self.sharding = sharding
+        self._assignments: dict = {}
+        self._schema_key_names: Optional[tuple] = (
+            tuple(key_names) if key_names else None)
+        self.quota = None
+        if sharding is not None:
+            self._assignments = compute_assignments(
+                sharding, range(config.replicas))
+            for rep in self._replicas:
+                rep.shards = tuple(sorted(
+                    k for k, owners in self._assignments.items()
+                    if rep.index in owners))
+            if sharding.quota_rps > 0:
+                self.quota = TokenBucket(
+                    sharding.quota_rps, sharding.quota_burst)
         self.logger = get_logger("FleetSupervisor")
         self.registry = MetricsRegistry()
         self._g_total = self.registry.gauge(
@@ -442,6 +490,27 @@ class FleetSupervisor:
         self._c_unrouted = self.registry.counter(
             "fleet_unrouted_total",
             "requests that exhausted the retry window with no ready replica")
+        self._c_unowned = self.registry.counter(
+            "fleet_unowned_shard_total",
+            "requests for a shard with no owner in the assignment table — "
+            "retryable (503 + Retry-After), distinct from no-ready-replica")
+        self._c_routed = self.registry.counter(
+            "dftpu_shard_routed_total",
+            "single-shard requests forwarded straight to an owning replica")
+        self._c_scatter = self.registry.counter(
+            "dftpu_shard_scatter_total",
+            "multi-shard requests fanned out to owners and merged")
+        self._c_shard_unrouted = self.registry.counter(
+            "dftpu_shard_unrouted_total",
+            "POSTs that could not be shard-planned (missing key columns, "
+            "unknown path) and fell back to round-robin")
+        self._c_rebalance = self.registry.counter(
+            "dftpu_shard_rebalance_total",
+            "shard-assignment changes applied (resize or owner hand-off)")
+        self._c_quota_rejected = self.registry.counter(
+            "dftpu_shard_quota_rejected_total",
+            "requests rejected 429 by per-tenant admission at the front "
+            "door")
         self._g_total.set(config.replicas)
 
     # -- introspection (snapshot under lock, return plain data) -------------
@@ -455,7 +524,8 @@ class FleetSupervisor:
 
     @property
     def size(self) -> int:
-        return len(self._replicas)
+        with self._lock:
+            return len(self._replicas)
 
     def describe(self) -> List[dict]:
         with self._lock:
@@ -480,6 +550,39 @@ class FleetSupervisor:
             self._rr += 1
         return ports[start:] + ports[:start]
 
+    # -- shard routing (sharded fleets only) ---------------------------------
+    def assignments(self) -> dict:
+        """shard -> owner replica-index list, one locked snapshot."""
+        with self._lock:
+            return {k: list(v) for k, v in self._assignments.items()}
+
+    def shard_owners(self, shard: int) -> List[int]:
+        with self._lock:
+            return list(self._assignments.get(int(shard), ()))
+
+    def owner_rotation(self, shard: int) -> List[int]:
+        """Ready ports among the shard's owners, rotated per call — the
+        shard-restricted analogue of :meth:`rotation`."""
+        with self._lock:
+            owners = set(self._assignments.get(int(shard), ()))
+            ports = [r.port for r in self._replicas
+                     if r.index in owners and r.ready]
+            if not ports:
+                return []
+            start = self._rr % len(ports)
+            self._rr += 1
+        return ports[start:] + ports[:start]
+
+    def key_names(self) -> Optional[tuple]:
+        with self._lock:
+            return self._schema_key_names
+
+    def set_key_names(self, names) -> None:
+        """Cache the artifact's key columns (the front door discovers them
+        from a replica's ``/schema`` on the first routed request)."""
+        with self._lock:
+            self._schema_key_names = tuple(names)
+
     # -- front-door feedback ------------------------------------------------
     def report_failure(self, port: int) -> None:
         """A connection-level forward failure: stop routing to this replica
@@ -496,23 +599,49 @@ class FleetSupervisor:
     def note_unrouted(self) -> None:
         self._c_unrouted.inc()
 
+    def note_unowned(self, shard: int) -> None:
+        self._c_unowned.inc()
+        self.logger.warning("request for shard %d, which has no owner "
+                            "in the assignment table", shard)
+
+    def note_routed(self) -> None:
+        self._c_routed.inc()
+
+    def note_scatter(self) -> None:
+        self._c_scatter.inc()
+
+    def note_shard_unrouted(self) -> None:
+        self._c_shard_unrouted.inc()
+
+    def note_quota_rejected(self) -> None:
+        self._c_quota_rejected.inc()
+
     def render_metrics(self) -> str:
         return self.registry.render_prometheus()
 
     # -- lifecycle ----------------------------------------------------------
+    def _spawn_replica(self, index: int, port: int, shards):
+        """Sharded fleets pass the assignment; classic spawn fns (and every
+        pre-sharding test fake) keep their two-argument signature."""
+        if self.sharding is not None:
+            return self._spawn(index, port, shards)
+        return self._spawn(index, port)
+
     def start(self) -> None:
         """Spawn every replica and start the health-poll loop."""
-        spawned = [(rep.index, rep.port, self._spawn(rep.index, rep.port))
-                   for rep in self._replicas]
+        with self._lock:
+            replicas = list(self._replicas)
+        spawned = [(rep, self._spawn_replica(rep.index, rep.port, rep.shards))
+                   for rep in replicas]
         thread = threading.Thread(
             target=self._poll_loop, name="fleet-health-poll", daemon=True)
         with self._lock:
-            for (_, _, proc), rep in zip(spawned, self._replicas):
+            for rep, proc in spawned:
                 rep.proc = proc
             self._poll_thread = thread
         self.logger.info(
             "spawned %d replica(s) on ports %s", len(spawned),
-            [p for _, p, _ in spawned])
+            [rep.port for rep, _ in spawned])
         thread.start()
 
     def wait_ready(self, min_ready: int = 1,
@@ -567,15 +696,113 @@ class FleetSupervisor:
                 "replica %d (port %d) is down; restarting "
                 "(attempt %d, next backoff %.1fs)",
                 rep.index, rep.port, rep.restarts, rep.backoff_s)
+            with self._lock:
+                shards = rep.shards  # current assignment, not spawn-time's
             try:
-                proc = self._spawn(rep.index, rep.port)
+                proc = self._spawn_replica(rep.index, rep.port, shards)
             except Exception:
                 self.logger.exception(
                     "respawn of replica %d failed; will retry after backoff",
                     rep.index)
                 continue
+            if self.sharding is not None:
+                # the respawn IS the hand-off: the child replays its shard
+                # WALs and loads the shard state before /readyz flips
+                self._c_rebalance.inc()
             with self._lock:
                 rep.proc = proc
+
+    def kill_replica(self, index: int) -> None:
+        """Chaos hook (bench/CI smoke): SIGKILL one replica's process.  The
+        poll loop restarts it with its current shard assignment — in a
+        sharded fleet that restart IS the hand-off path (shard WAL replay
+        + state load before /readyz), which is exactly what the smoke
+        gates on converging."""
+        proc = None
+        with self._lock:
+            for r in self._replicas:
+                if r.index == int(index):
+                    proc = r.proc
+                    r.ready = False
+                    break
+            else:
+                raise ValueError(f"no replica with index {index}")
+        if proc is not None and proc.poll() is None:
+            try:
+                proc.kill()
+            except OSError:
+                pass
+
+    def resize(self, replicas: int) -> None:
+        """Grow or shrink the replica set and rebalance shard ownership.
+
+        The consistent-hash ring makes the diff small (adding one replica
+        to N remaps ~1/(N+1) of the shards); a replica whose assignment
+        changed is terminated and the poll loop respawns it with the new
+        shard set — the respawned owner replays the shard WALs and loads
+        the shard state sidecar before ``/readyz`` flips, so hand-off
+        never serves a half-loaded shard.  Ports/spawns happen OUTSIDE the
+        lock; only the table/replica-list swap is inside it.
+        """
+        replicas = int(replicas)
+        if replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got {replicas}")
+        cfg = self._config
+        with self._lock:
+            current = len(self._replicas)
+        new_ports = [
+            _free_port(cfg.replica_host) if cfg.base_port == 0
+            else cfg.base_port + i
+            for i in range(current, replicas)
+        ]
+        new_assign = (compute_assignments(self.sharding, range(replicas))
+                      if self.sharding is not None else {})
+        added = [Replica(current + i, p) for i, p in enumerate(new_ports)]
+        to_terminate = []
+        to_spawn = []
+        changed = 0
+        with self._lock:
+            victims = self._replicas[replicas:]
+            self._replicas = self._replicas[:replicas] + added
+            self._assignments = new_assign
+            for rep in self._replicas:
+                shards = tuple(sorted(
+                    k for k, owners in new_assign.items()
+                    if rep.index in owners))
+                if self.sharding is not None and shards != rep.shards:
+                    rep.shards = shards
+                    if rep not in added:
+                        changed += 1
+                        rep.ready = False
+                        to_terminate.append(rep.proc)
+                else:
+                    rep.shards = shards
+            for rep in victims:
+                rep.ready = False
+                to_terminate.append(rep.proc)
+            to_spawn = list(added)
+        if self.sharding is not None and (changed or added or victims):
+            self._c_rebalance.inc(changed + len(added) + len(victims))
+        self._g_total.set(replicas)
+        for rep in to_spawn:
+            try:
+                proc = self._spawn_replica(rep.index, rep.port, rep.shards)
+            except Exception:
+                self.logger.exception(
+                    "spawn of replica %d failed; the poll loop will retry",
+                    rep.index)
+                continue
+            with self._lock:
+                rep.proc = proc
+        for proc in to_terminate:
+            if proc is not None and proc.poll() is None:
+                try:
+                    proc.terminate()
+                except OSError:
+                    pass
+        self.logger.info(
+            "resized fleet to %d replica(s) (%d reassigned, %d added, "
+            "%d removed)", replicas, changed, len(added), len(victims))
 
     def _poll_loop(self) -> None:
         while not self._stop.wait(
@@ -653,7 +880,11 @@ class _FrontDoorHandler(BaseHTTPRequestHandler):
 
     def do_POST(self):
         length = int(self.headers.get("Content-Length", "0"))
-        self._proxy("POST", self.rfile.read(length))
+        body = self.rfile.read(length)
+        if self.server.supervisor.sharding is not None:
+            if self._routed_post(body):
+                return
+        self._proxy("POST", body)
 
     def _metrics(self) -> None:
         sup = self.server.supervisor
@@ -686,6 +917,187 @@ class _FrontDoorHandler(BaseHTTPRequestHandler):
                 "Content-Type", "application/json"), resp.read()
         finally:
             conn.close()
+
+    # -- routed dispatch (sharded fleets) ------------------------------------
+
+    def _trace_id(self) -> Optional[str]:
+        """Same header sanitation as the replica handler: a hostile
+        X-Trace-Id must not ride into span files."""
+        raw = (self.headers.get("X-Trace-Id") or "").strip()
+        if 1 <= len(raw) <= 64 and all(c.isalnum() or c in "-_" for c in raw):
+            return raw
+        return None
+
+    def _schema_key_names(self) -> Optional[tuple]:
+        """The artifact's key columns, discovered once from any ready
+        replica's ``/schema`` and cached on the supervisor."""
+        sup = self.server.supervisor
+        names = sup.key_names()
+        if names:
+            return names
+        cfg = sup.config
+        for port in sup.rotation():
+            payload = _fetch(cfg.replica_host, port, "/schema",
+                             cfg.probe_timeout_s)
+            if payload is None:
+                continue
+            try:
+                names = tuple(json.loads(payload).get("key_names") or ())
+            except (ValueError, AttributeError):
+                continue
+            if names:
+                sup.set_key_names(names)
+                return names
+        return None
+
+    def _forward_with_retry(self, ports_fn, method: str, body):
+        """Retry-on-next-port over ``ports_fn()`` until the retry window
+        closes.  Returns ``(status, ctype, payload, port)`` or ``None`` —
+        unlike :meth:`_proxy` it never writes the response itself, so
+        scatter threads can call it concurrently."""
+        sup = self.server.supervisor
+        cfg = sup.config
+        deadline = time.monotonic() + cfg.retry_window_s
+        attempts = 0
+        while True:
+            for port in ports_fn():
+                attempts += 1
+                if attempts > 1:
+                    sup.note_retry()
+                try:
+                    status, ctype, payload = self._forward(
+                        cfg.replica_host, port, method, body)
+                except (OSError, http.client.HTTPException):
+                    sup.report_failure(port)
+                    continue
+                return status, ctype, payload, port
+            if time.monotonic() >= deadline:
+                return None
+            # no ready owner right now; wait for the poll loop's hand-off
+            time.sleep(0.05)
+
+    def _routed_post(self, body) -> bool:
+        """Shard-route a POST.  Returns True when the request was fully
+        handled here; False falls back to round-robin ``_proxy`` (body not
+        shard-plannable: unknown path, missing key columns, non-JSON)."""
+        sup = self.server.supervisor
+        names = self._schema_key_names()
+        if names is None:
+            return False
+        try:
+            parsed = json.loads(body or b"{}")
+        except ValueError:
+            return False
+        tid = self._trace_id()
+        tracer = get_tracer()
+        with tracer.root_span("route.lookup", trace_id=tid,
+                              path=self.path) as span:
+            plan = plan_request(self.path, parsed, names,
+                                sup.sharding.num_shards)
+            if plan is not None:
+                span.set_attribute("shards", len(plan.shards))
+                span.set_attribute("series", len(plan.key_order))
+        if plan is None:
+            sup.note_shard_unrouted()
+            return False
+        quota = sup.quota
+        if quota is not None:
+            for tenant, charge in sorted(plan.tenants.items()):
+                if not quota.allow(tenant, charge):
+                    sup.note_quota_rejected()
+                    self._send_json(
+                        429,
+                        {"error": f"tenant {tenant} over admission quota",
+                         "tenant": tenant, "charge": charge},
+                        extra_headers=(("Retry-After", "1"),))
+                    return True
+        if len(plan.shards) == 1:
+            return self._routed_single(plan, body)
+        return self._scatter(plan, parsed, tid)
+
+    def _routed_single(self, plan, body) -> bool:
+        """Single-shard fast path: the original body forwards VERBATIM to
+        an owning replica, so the client sees that replica's exact bytes —
+        the round-robin path's contract, now shard-aware."""
+        sup = self.server.supervisor
+        shard = plan.shards[0]
+        if not sup.shard_owners(shard):
+            sup.note_unowned(shard)
+            self._send_json(
+                503,
+                {"error": "shard has no owner", "shard": shard,
+                 "detail": "assignment table maps this shard to no "
+                           "replica; retry after rebalance"},
+                extra_headers=(("Retry-After", "1"),))
+            return True
+        res = self._forward_with_retry(
+            lambda: sup.owner_rotation(shard), "POST", body)
+        if res is None:
+            sup.note_unrouted()
+            self._send_json(
+                503,
+                {"error": "no ready replica for shard", "shard": shard},
+                extra_headers=(("Retry-After", "1"),))
+            return True
+        sup.note_routed()
+        status, ctype, payload, port = res
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(payload)))
+        self.send_header("X-Fleet-Replica", str(port))
+        self.send_header("X-Fleet-Shard", str(shard))
+        self.end_headers()
+        self.wfile.write(payload)
+        return True
+
+    def _scatter(self, plan, parsed: dict, tid) -> bool:
+        """Fan a multi-shard request out to one owner per shard and merge.
+
+        A failed shard degrades to per-key ``errors`` entries in the merged
+        body — the other shards' results still ship (partial failure is
+        NOT a whole-request 5xx; only every-shard-failed is)."""
+        sup = self.server.supervisor
+        responses: dict = {}
+
+        def one(shard: int):
+            if not sup.shard_owners(shard):
+                sup.note_unowned(shard)
+                return 503, json.dumps(
+                    {"error": "shard has no owner"}).encode()
+            sub = json.dumps(plan.sub_body(parsed, shard)).encode()
+            res = self._forward_with_retry(
+                lambda: sup.owner_rotation(shard), "POST", sub)
+            if res is None:
+                sup.note_unrouted()
+                return 503, json.dumps(
+                    {"error": "no ready replica for shard"}).encode()
+            status, _, payload, _ = res
+            return status, payload
+
+        tracer = get_tracer()
+        with tracer.root_span("route.scatter", trace_id=tid, path=self.path,
+                              shards=len(plan.shards)):
+            threads = [
+                threading.Thread(
+                    target=lambda k=shard: responses.__setitem__(k, one(k)),
+                    daemon=True)
+                for shard in plan.shards
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        sup.note_scatter()
+        if plan.field == "inputs":
+            status, merged = merge_invocation_responses(
+                plan, self._schema_key_names() or (), responses)
+        else:
+            status, merged = merge_ingest_responses(plan, responses)
+        headers = [("X-Fleet-Scatter", str(len(plan.shards)))]
+        if status >= 500:
+            headers.append(("Retry-After", "1"))
+        self._send_json(status, merged, extra_headers=tuple(headers))
+        return True
 
     def _proxy(self, method: str, body) -> None:
         """Round-robin with retry-on-next-replica.
@@ -754,21 +1166,30 @@ def start_fleet(
     env_extra: Optional[dict] = None,
     spawn_fn: Optional[SpawnFn] = None,
     wait: bool = True,
+    sharding: Optional[ShardingConfig] = None,
+    key_names: Optional[tuple] = None,
 ):
     """Boot the whole subsystem: supervisor + replicas + front door.
 
     Returns ``(supervisor, front_door_server)``; the front door runs on a
     daemon thread (its bound port is ``front.server_address[1]``).  Callers
-    stop with ``front.shutdown(); supervisor.stop()``.
+    stop with ``front.shutdown(); supervisor.stop()``.  With ``sharding``
+    the front door routes by series key instead of round-robinning
+    (``key_names`` pre-seeds the routing schema; omitted, it is discovered
+    from a replica's ``/schema``).
     """
+    if sharding is not None and not sharding.enabled:
+        sharding = None
     if spawn_fn is None:
         if artifact_dir is None:
             raise ValueError(
                 "pass artifact_dir (for the default subprocess spawner) or "
                 "an explicit spawn_fn")
         spawn_fn = default_spawn_fn(
-            config, artifact_dir, serving_conf, env_extra=env_extra)
-    supervisor = FleetSupervisor(config, spawn_fn)
+            config, artifact_dir, serving_conf, env_extra=env_extra,
+            sharding=sharding)
+    supervisor = FleetSupervisor(config, spawn_fn, sharding=sharding,
+                                 key_names=key_names)
     supervisor.start()
     if wait and not supervisor.wait_ready(min_ready=1):
         supervisor.stop()

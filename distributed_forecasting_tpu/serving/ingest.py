@@ -393,11 +393,15 @@ def build_ingest_runtime(conf: Optional[dict], forecaster,
                          history_y=None, history_mask=None,
                          quality=None,
                          default_wal_dir: Optional[str] = None,
+                         wal_factory=None,
                          ) -> Optional[IngestRuntime]:
     """``serving.ingest`` conf block -> a started-able runtime (or None
     when the block is absent/disabled).  ``history_y``/``history_mask``
     enable full refits; without them the scheduler is skipped and only
-    the incremental path runs (a bare-artifact deployment)."""
+    the incremental path runs (a bare-artifact deployment).
+    ``wal_factory(wal_dir, max_segment_bytes)`` overrides the log
+    construction — sharded replicas substitute a per-shard-namespace
+    facade (``serving/sharding.py``) that duck-types the single log."""
     config = IngestConfig.from_conf(conf)
     if not config.enabled:
         return None
@@ -410,7 +414,11 @@ def build_ingest_runtime(conf: Optional[dict], forecaster,
         forecaster, time_bucket=config.time_bucket,
         history_y=history_y, history_mask=history_mask, metrics=metrics,
         max_pending_days=config.max_pending_days)
-    wal = WriteAheadLog(wal_dir, max_segment_bytes=config.max_segment_bytes)
+    if wal_factory is not None:
+        wal = wal_factory(wal_dir, config.max_segment_bytes)
+    else:
+        wal = WriteAheadLog(
+            wal_dir, max_segment_bytes=config.max_segment_bytes)
     refit_scheduler = None
     if config.refit:
         from distributed_forecasting_tpu.serving.refit import (

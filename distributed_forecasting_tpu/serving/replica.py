@@ -19,6 +19,13 @@ its own cursor in ``interval`` apply mode, so a point posted through any
 replica converges into every replica's model state — the front door can
 round-robin /ingest like any other POST.
 
+With a ``sharding`` block plus a ``shards`` assignment list (sharded
+fleets — ``serving/sharding.py``), the replica subsets its forecaster,
+history sidecar, and WAL follow-set to the owned shards before warmup:
+resident series drop to ~S*owned/num_shards, only the owned
+``wal_dir/shard-<k>/`` namespaces are replayed, and the backlog replay
+happens BEFORE ``/readyz`` flips (the supervisor's hand-off gate).
+
 Boot order is the contract the supervisor routes on: bind the port with
 ``/readyz`` at 503 first, warm the bucket ladder, THEN flip ready — a
 replica never receives traffic while it is still compiling.  The shared
@@ -91,6 +98,45 @@ def main(argv=None) -> None:
     configure_tracing(TraceConfig.from_conf(tracing_conf))
 
     forecaster = load_forecaster(conf["artifact_dir"])
+
+    # -- series partition (serving/sharding.py) -----------------------------
+    # The supervisor hands each replica its shard assignment at spawn; the
+    # replica subsets params/keys/scales to those shards BEFORE warmup, so
+    # resident memory and every forecast/update is ~S*owned/num_shards.
+    shards = conf.get("shards")
+    sharding_cfg = None
+    shard_metrics = None
+    owned_idx = None
+    if conf.get("sharding") and shards is not None:
+        from distributed_forecasting_tpu.serving.predictor import (
+            BatchForecaster,
+        )
+        from distributed_forecasting_tpu.serving.sharding import (
+            ShardingConfig,
+            ShardMetrics,
+            subset_for_shards,
+        )
+
+        cfg = ShardingConfig.from_conf(conf["sharding"])
+        if isinstance(forecaster, BatchForecaster):
+            forecaster, owned_idx = subset_for_shards(
+                forecaster, shards, cfg.num_shards)
+            sharding_cfg = cfg
+            shard_metrics = ShardMetrics()
+            shard_metrics.observe_assignment(
+                forecaster.keys, shards, cfg.num_shards)
+            logger.info(
+                "serving shards %s of %d: %d resident series",
+                sorted(int(s) for s in shards), cfg.num_shards,
+                int(forecaster.keys.shape[0]))
+        else:
+            # composite artifacts (ensemble/bucketed) don't subset yet;
+            # serve the full set rather than refuse to boot — the front
+            # door's routing is still correct, just not memory-partitioned
+            logger.warning(
+                "%s cannot subset to a shard assignment; serving the "
+                "full series set", type(forecaster).__name__)
+
     if mesh_devices > 1:
         enable_mesh = getattr(forecaster, "enable_mesh", None)
         if enable_mesh is None:
@@ -139,11 +185,46 @@ def main(argv=None) -> None:
             # interval — sync mode would only freshen the replica that
             # happened to receive the POST
             ingest_conf["apply_mode"] = "interval"
+        # training-history sidecar (tasks/serve.py writes it next to the
+        # artifact): enables full refits; a sharded replica loads only its
+        # shards' rows — the shard "state sidecar" half of hand-off
+        history_y = history_mask = None
+        for cand in (
+            os.path.join(conf["artifact_dir"], "history.npz"),
+            os.path.join(conf["artifact_dir"], "forecaster", "history.npz"),
+        ):
+            if os.path.exists(cand):
+                import numpy as np
+
+                blob = np.load(cand)
+                history_y, history_mask = blob["y"], blob["mask"]
+                if owned_idx is not None:
+                    history_y = history_y[owned_idx]
+                    history_mask = history_mask[owned_idx]
+                break
+        wal_factory = None
+        if sharding_cfg is not None:
+            from distributed_forecasting_tpu.serving.sharding import (
+                ShardedWAL,
+            )
+
+            def wal_factory(wal_dir, max_segment_bytes):
+                # per-shard namespaces under the SHARED wal_dir: this
+                # replica appends anywhere (durability) but follows —
+                # and therefore applies — only its owned shards
+                return ShardedWAL(
+                    wal_dir, shards, sharding_cfg.num_shards,
+                    max_segment_bytes=max_segment_bytes,
+                    on_read=shard_metrics.note_wal_read)
+
         ingest = build_ingest_runtime(
             ingest_conf,
             forecaster,
+            history_y=history_y,
+            history_mask=history_mask,
             quality=quality,
             default_wal_dir=os.path.join(conf["artifact_dir"], "ingest_wal"),
+            wal_factory=wal_factory,
         )
         if ingest is not None:
             logger.info("streaming ingest: shared WAL at %s (%s mode)",
@@ -157,6 +238,7 @@ def main(argv=None) -> None:
         ready=False,  # warm first; the supervisor routes on /readyz
         quality=quality,
         ingest=ingest,
+        extra_metrics=shard_metrics,
     )
     sizes = conf.get("warmup_sizes")
     if sizes:
@@ -168,6 +250,13 @@ def main(argv=None) -> None:
         logger.info(
             "warmed %d bucket(s) (%d AOT store hit(s), %d miss(es))",
             n, stats["hits"], stats["misses"])
+    if ingest is not None:
+        # hand-off gate: replay the WAL backlog (a sharded replica: its
+        # shards' logs) BEFORE /readyz flips, so a restarted owner never
+        # serves forecasts that predate writes the fleet already accepted
+        replay = ingest.poll_apply()
+        if replay.get("accepted"):
+            logger.info("replayed WAL backlog before ready: %s", replay)
     srv.mark_ready()
     logger.info("replica ready on %s:%d", conf.get("host", "127.0.0.1"),
                 int(conf["port"]))

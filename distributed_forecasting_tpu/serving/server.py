@@ -211,6 +211,8 @@ class _Handler(BaseHTTPRequestHandler):
                 text += self.server.quality.render_metrics()
             if self.server.ingest is not None:
                 text += self.server.ingest.render_metrics()
+            if self.server.extra_metrics is not None:
+                text += self.server.extra_metrics.render()
             body = text.encode()
             self.send_response(200)
             self.send_header(
@@ -546,6 +548,7 @@ class ForecastServer(ThreadingHTTPServer):
         batching: Optional[BatchingConfig] = None,
         quality=None,
         ingest=None,
+        extra_metrics=None,
     ):
         super().__init__(addr, _Handler)
         self.forecaster = forecaster
@@ -553,6 +556,10 @@ class ForecastServer(ThreadingHTTPServer):
         self.logger = get_logger("ForecastServer")
         self.metrics = ServingMetrics()
         self.batching = batching
+        # extra exposition appended to GET /metrics — any object with a
+        # ``render() -> str`` (sharded replicas attach their per-shard
+        # registry here; see serving/sharding.ShardMetrics)
+        self.extra_metrics = extra_metrics
         # the wired quality stack (monitoring/quality.QualityRuntime) —
         # owns the scrape + SLO loops, started here so every construction
         # path (serve, start_server, tests) gets the same lifecycle; the
@@ -668,13 +675,15 @@ def start_server(
     ready: bool = True,
     quality=None,
     ingest=None,
+    extra_metrics=None,
 ) -> ForecastServer:
     """Start serving on a background thread; returns the server (its
     ``server_address[1]`` is the bound port — port=0 picks a free one).
     ``ready=False`` starts with /readyz at 503 until ``mark_ready()`` —
     for launchers that warm the compile ladder against the live server."""
     srv = ForecastServer((host, port), forecaster, model_version, batching,
-                         quality=quality, ingest=ingest)
+                         quality=quality, ingest=ingest,
+                         extra_metrics=extra_metrics)
     if ready:
         srv.mark_ready()
     t = threading.Thread(target=srv.serve_forever, daemon=True)

@@ -25,6 +25,13 @@ Conf: the same ``serving:`` block ``dftpu-serve`` reads, plus::
         retry_window_s: 10       # front-door budget to find a ready replica
         mesh_devices: 0          # >1: each replica shards predict over a
                                  # device mesh of this size
+      sharding:                  # series partition (serving/sharding.py)
+        enabled: false
+        num_shards: 8            # fixed key->shard partition count
+        replication: 2           # owners per shard on the consistent ring
+        vnodes: 64               # virtual ring points per replica
+        quota_rps: 0             # per-tenant admitted rows/s (0 = off)
+        quota_burst: 0           # token-bucket size (0 = 2 * quota_rps)
 
 A top-level ``monitoring:`` block (see ``tasks/serve.py``) flows through to
 every replica: each builds its own quality monitor + store (port-suffixed
@@ -50,6 +57,7 @@ from distributed_forecasting_tpu.serving.fleet import (
     FleetConfig,
     start_fleet,
 )
+from distributed_forecasting_tpu.serving.sharding import ShardingConfig
 from distributed_forecasting_tpu.tasks.common import Task
 
 
@@ -63,6 +71,9 @@ class FleetTask(Task):
             fleet = dataclasses.replace(fleet, enabled=True)
         # fail on a batching typo in milliseconds, before artifact resolution
         BatchingConfig.from_conf(conf.get("batching"))
+        # strict parse: a typo'd sharding key fails here, not as a fleet
+        # that silently serves unpartitioned
+        sharding = ShardingConfig.from_conf(conf.get("sharding"))
         name = conf.get("model_name", "ForecastingBatchModel")
         stage = conf.get("stage")
         version = self.registry.latest_version(name, stage=stage)
@@ -103,6 +114,7 @@ class FleetTask(Task):
             front_host=conf.get("host", "0.0.0.0"),
             front_port=int(conf.get("port", 8080)),
             env_extra=env_extra,
+            sharding=sharding if sharding.enabled else None,
         )
         self.logger.info(
             "fleet of %d replica(s) serving %s v%s behind %s:%d",

@@ -27,6 +27,15 @@ sustained QPS per replica for 1 vs N replicas, plus failed-request counts
 and an aggregated-/metrics presence check — the JSON the CI fleet smoke
 step and BENCH trajectory tracking consume (``--json-out``).
 
+**Sharded mode** (PR 12 acceptance, ``--fleet 2,4 --sharded``): at each
+replica count, boots the artifact as a broadcast round-robin fleet AND as
+a series-partitioned routed fleet (``serving/sharding.py``), compares
+latency percentiles, and verifies the partition: routed responses
+byte-identical to broadcast (single-shard and a >= 3-shard scatter),
+per-replica resident series ~ S * owned / num_shards, streamed ingest
+applied only by shard owners, and (``--kill-one``) zero failed requests
+after a SIGKILL-triggered hand-off.
+
 Run (CPU backend is fine — dispatch overhead and fleet mechanics exist on
 every backend):
 
@@ -54,8 +63,12 @@ import urllib.request
 
 
 def _call(port: int, payload: dict) -> bytes:
+    return _post(port, "/invocations", payload)
+
+
+def _post(port: int, path: str, payload: dict) -> bytes:
     req = urllib.request.Request(
-        f"http://127.0.0.1:{port}/invocations",
+        f"http://127.0.0.1:{port}{path}",
         data=json.dumps(payload).encode(),
         headers={"Content-Type": "application/json"},
     )
@@ -338,6 +351,241 @@ def run_fleet_scaling(args, counts) -> dict:
     return out
 
 
+def _resident_series(sup) -> dict:
+    """port -> dftpu_shard_resident_series from each replica's OWN /metrics
+    (the front door's merged view can't show per-replica residency)."""
+    out = {}
+    for rep in sup.describe():
+        text = _metrics(rep["port"])
+        m = re.search(r"dftpu_shard_resident_series ([0-9.]+)", text)
+        out[rep["port"]] = int(float(m.group(1))) if m else None
+    return out
+
+
+def _ingest_counts(sup) -> dict:
+    """port -> {shard: points} parsed from dftpu_shard_ingest_points_total
+    on each replica — the owner-only apply evidence."""
+    out = {}
+    for rep in sup.describe():
+        text = _metrics(rep["port"])
+        out[rep["port"]] = {
+            int(shard): int(float(v))
+            for shard, v in re.findall(
+                r'dftpu_shard_ingest_points_total\{shard="(\d+)"\} '
+                r'([0-9.]+)', text)
+        }
+    return out
+
+
+def run_sharded_bench(args, counts) -> dict:
+    """Round-robin vs series-routed fleets at each replica count.
+
+    Boots the SAME artifact twice per count — once as a classic broadcast
+    fleet (every replica holds all S series, the front door round-robins)
+    and once series-partitioned (``serving/sharding.py``: each replica
+    subsets to its shards, the front door routes/scatter-gathers) — and
+    reports latency percentiles for both, plus the partition evidence the
+    CI smoke gates on: routed responses byte-identical to round-robin
+    ones (single-shard AND a scatter spanning >= 3 shards), per-replica
+    resident series ~ S * owned / num_shards, and streamed ingest applied
+    ONLY by owning replicas (``dftpu_shard_*`` on each replica's own
+    /metrics).  ``--kill-one`` SIGKILLs a routed replica and re-drives the
+    load after the supervisor's hand-off (WAL replay before /readyz),
+    gating on zero failed requests post-rebalance.
+    """
+    from distributed_forecasting_tpu.serving.fleet import (
+        FleetConfig,
+        start_fleet,
+    )
+    from distributed_forecasting_tpu.serving.sharding import (
+        ShardingConfig,
+        shard_of_key,
+    )
+
+    fc = _fit_forecaster(args)
+    S = fc.n_series
+    K = min(args.clients, S)
+    payloads = _payloads(fc, args.horizon, K)
+    all_keys = [tuple(int(v) for v in k) for k in fc.keys]
+    scatter_payload = {
+        "inputs": [dict(zip(fc.key_names, k)) for k in all_keys],
+        "horizon": args.horizon,
+    }
+    n_scatter_shards = len(
+        {shard_of_key(k, args.num_shards) for k in all_keys})
+    sharding = ShardingConfig(
+        enabled=True, num_shards=args.num_shards, replication=1)
+
+    workdir = tempfile.mkdtemp(prefix="dftpu-sharded-bench-")
+    artifact_dir = os.path.join(workdir, "forecaster")
+    fc.save(artifact_dir)
+    env_extra = {"DFTPU_COMPILE_CACHE": os.environ.get(
+        "DFTPU_COMPILE_CACHE", os.path.join(workdir, "compile_cache"))}
+    serving_conf = {
+        "warmup_sizes": [1],
+        "warmup_horizon": args.horizon,
+        # streamed writes are part of the evidence: the sharded fleet's
+        # replicas follow only their wal_dir/shard-<k>/ namespaces
+        "ingest": {"enabled": True},
+    }
+
+    def boot(count, shard_cfg, wal_tag):
+        cfg = FleetConfig(
+            enabled=True, replicas=count, health_poll_interval_s=0.2,
+            ready_timeout_s=args.fleet_ready_timeout)
+        sup, front = start_fleet(
+            cfg,
+            # distinct artifact copies per leg would be wasteful; distinct
+            # WAL roots are required (the broadcast and routed fleets must
+            # not replay each other's writes)
+            artifact_dir=artifact_dir,
+            serving_conf={
+                **serving_conf,
+                "ingest": {"enabled": True,
+                           "wal_dir": os.path.join(
+                               workdir, f"wal-{wal_tag}-{count}")},
+            },
+            front_host="127.0.0.1",
+            front_port=0,
+            env_extra=env_extra,
+            wait=False,
+            sharding=shard_cfg,
+        )
+        if not sup.wait_ready(min_ready=count,
+                              timeout=args.fleet_ready_timeout):
+            front.shutdown()
+            sup.stop()
+            raise RuntimeError(
+                f"only {sup.ready_count()}/{count} replicas ready "
+                f"({wal_tag} leg)")
+        return sup, front
+
+    def drive(front):
+        port = front.server_address[1]
+        for p in payloads:          # untimed sweep: compile-on-first-use
+            _call(port, p)          # stays out of the percentiles
+        scatter_body = _call(port, scatter_payload)
+        closed = closed_loop(
+            lambda p: _call(port, p), payloads, args.requests)
+        bodies = closed.pop("_bodies")
+        return closed, bodies, scatter_body
+
+    comparison = []
+    gate_errors = []
+    for count in counts:
+        point = {"replicas": count, "num_shards": args.num_shards}
+
+        sup, front = boot(count, None, "rr")
+        try:
+            rr, rr_bodies, rr_scatter = drive(front)
+        finally:
+            front.shutdown()
+            sup.stop()
+        point["round_robin"] = rr
+
+        sup, front = boot(count, sharding, "routed")
+        try:
+            routed, routed_bodies, routed_scatter = drive(front)
+            point["routed"] = routed
+            point["routed_identical"] = routed_bodies == rr_bodies
+            point["scatter_identical"] = routed_scatter == rr_scatter
+            point["scatter_shards"] = n_scatter_shards
+
+            resident = _resident_series(sup)
+            point["resident_series"] = {
+                str(p): v for p, v in resident.items()}
+            vals = [v for v in resident.values() if v is not None]
+            point["resident_partitioned"] = (
+                len(vals) == count and sum(vals) == S and max(vals) < S)
+
+            # streamed ingest: one point per series through the front
+            # door, then owner-only apply evidence off replica metrics
+            day = int(fc.day1) + 1
+            ack = json.loads(_post(
+                front.server_address[1], "/ingest",
+                {"points": [dict(zip(fc.key_names, k), d=day, y=1.0)
+                            for k in all_keys]}))
+            owned = {r["port"]: set(r["shards"]) for r in sup.describe()}
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline:
+                counts_by_port = _ingest_counts(sup)
+                applied = sum(sum(c.values())
+                              for c in counts_by_port.values())
+                if applied >= ack.get("written", 0):
+                    break
+                time.sleep(0.25)
+            owner_only = all(
+                set(shards) <= owned[port]
+                for port, shards in counts_by_port.items())
+            point["ingest"] = {
+                "written": ack.get("written"),
+                "applied": applied,
+                "owner_only": owner_only,
+                "per_replica": {str(p): {str(s): n for s, n in c.items()}
+                                for p, c in counts_by_port.items()},
+            }
+
+            if args.kill_one:
+                sup.kill_replica(0)
+                converged = sup.wait_ready(
+                    min_ready=count, timeout=args.fleet_ready_timeout)
+                after, _, _ = drive(front)
+                front_text = _metrics(front.server_address[1])
+                m = re.search(r"dftpu_shard_rebalance_total ([0-9.]+)",
+                              front_text)
+                point["rebalance"] = {
+                    "converged": bool(converged),
+                    "rebalance_total": int(float(m.group(1))) if m else 0,
+                    "after_restart": after,
+                }
+                if not converged:
+                    gate_errors.append(
+                        f"{count} replicas: fleet never reconverged after "
+                        f"kill")
+                if after["failed_requests"]:
+                    gate_errors.append(
+                        f"{count} replicas: {after['failed_requests']} "
+                        f"failed request(s) after rebalance")
+        finally:
+            front.shutdown()
+            sup.stop()
+
+        for leg in ("round_robin", "routed"):
+            if point[leg]["failed_requests"]:
+                gate_errors.append(
+                    f"{count} replicas: {point[leg]['failed_requests']} "
+                    f"failed request(s) on the {leg} leg")
+        if not point["routed_identical"]:
+            gate_errors.append(
+                f"{count} replicas: routed single-series responses differ "
+                f"from round-robin")
+        if not point["scatter_identical"]:
+            gate_errors.append(
+                f"{count} replicas: scatter-gather response differs from "
+                f"broadcast")
+        if not point["resident_partitioned"]:
+            gate_errors.append(
+                f"{count} replicas: resident series not partitioned "
+                f"({point['resident_series']})")
+        if not point["ingest"]["owner_only"]:
+            gate_errors.append(
+                f"{count} replicas: a non-owner applied ingest points")
+        comparison.append(point)
+
+    return {
+        "bench": "serving_sharded_fleet",
+        "model": args.model,
+        "series": S,
+        "num_shards": args.num_shards,
+        "clients": K,
+        "requests_per_client": args.requests,
+        "horizon": args.horizon,
+        "scatter_spans_shards": n_scatter_shards,
+        "comparison": comparison,
+        "gate_errors": gate_errors,
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--clients", type=int, default=16)
@@ -354,6 +602,18 @@ def main() -> None:
                     help="comma list of replica counts (e.g. 1,2): run the "
                          "fleet scaling bench through the front door "
                          "instead of the micro-batching comparison")
+    ap.add_argument("--sharded", action="store_true",
+                    help="with --fleet: compare round-robin vs series-"
+                         "routed fleets at each replica count and verify "
+                         "the partition (byte-identical responses, "
+                         "resident-series split, owner-only ingest)")
+    ap.add_argument("--num-shards", type=int, default=4,
+                    help="shard count for --sharded (keys partition by "
+                         "stable hash mod this)")
+    ap.add_argument("--kill-one", action="store_true",
+                    help="with --sharded: SIGKILL a replica, wait for the "
+                         "hand-off to reconverge, and gate on zero failed "
+                         "requests after the rebalance")
     ap.add_argument("--fleet-mesh-devices", type=int, default=0,
                     help="shard each replica's predict over a mesh of this "
                          "size (>1; replicas force host devices to match)")
@@ -379,6 +639,16 @@ def main() -> None:
 
     if args.fleet:
         counts = [int(x) for x in args.fleet.split(",") if x.strip()]
+        if args.sharded:
+            out = run_sharded_bench(args, counts)
+            line = json.dumps(out)
+            print(line)
+            if args.json_out:
+                with open(args.json_out, "w") as f:
+                    f.write(line + "\n")
+            if out["gate_errors"]:
+                sys.exit("; ".join(out["gate_errors"]))
+            return
         out = run_fleet_scaling(args, counts)
         line = json.dumps(out)
         print(line)

@@ -953,3 +953,100 @@ def test_wal_append_snapshot_then_write_negative(tmp_path):
     """)
     found = _lint(tmp_path, "serving/wal.py")
     assert "blocking-under-lock" not in _rules(found)
+
+
+# ---------------------------------------------------------------------------
+# ISSUE-12 fixtures: the serving.sharding conf block + the routing table
+# ---------------------------------------------------------------------------
+
+def test_sharding_conf_block_drift_positive_and_negative(tmp_path):
+    # mirrors conf/tasks/serve_config.yml's serving.sharding block: a typo'd
+    # replication key is spellable from YAML but no ShardingConfig field or
+    # string lookup consumes it -> drift; every real key lands on a field
+    _write(tmp_path, "conf/serve.yml", """
+        serving:
+          sharding:
+            enabled: true
+            num_shards: 4
+            replicaton: 2
+            vnodes: 64
+    """)
+    _write(tmp_path, "src/sharding_cfg.py", """
+        import dataclasses
+
+        @dataclasses.dataclass(frozen=True)
+        class ShardingConfig:
+            enabled: bool = False
+            num_shards: int = 8
+            replication: int = 1
+            vnodes: int = 64
+
+            @classmethod
+            def from_conf(cls, conf):
+                block = conf.get("serving", {}).get("sharding", {})
+                known = {f.name for f in dataclasses.fields(cls)}
+                return cls(**{k: v for k, v in block.items() if k in known})
+    """)
+    found = _lint(tmp_path, "src/sharding_cfg.py")
+    assert [f.rule for f in found] == ["config-drift"]
+    assert "replicaton" in found[0].message
+    assert found[0].path == "conf/serve.yml"
+
+    # fixing the typo makes the block clean
+    _write(tmp_path, "conf/serve.yml", """
+        serving:
+          sharding:
+            enabled: true
+            num_shards: 4
+            replication: 2
+            vnodes: 64
+    """)
+    assert _lint(tmp_path, "src/sharding_cfg.py") == []
+
+
+def test_ring_read_under_rebalance_positive(tmp_path):
+    # the race the front door must avoid: rebalance() rewrites the
+    # shard->replica table under the lock while lookup() reads it bare —
+    # a request routed mid-rebalance can observe a half-built table
+    _write(tmp_path, "serving/router.py", """
+        import threading
+
+        class Router:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._assignments = {}
+
+            def rebalance(self, table):
+                with self._lock:
+                    self._assignments = dict(table)
+
+            def lookup(self, shard):
+                return self._assignments.get(shard, [])
+    """)
+    found = _lint(tmp_path, "serving/router.py")
+    assert "unlocked-shared-state" in _rules(found)
+    assert any("lookup" in f.message for f in found)
+
+
+def test_ring_snapshot_under_lock_negative(tmp_path):
+    # the shape serving/fleet.py actually uses: copy the table under the
+    # lock, resolve replicas from the snapshot outside it
+    _write(tmp_path, "serving/router.py", """
+        import threading
+
+        class Router:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._assignments = {}
+
+            def rebalance(self, table):
+                with self._lock:
+                    self._assignments = dict(table)
+
+            def lookup(self, shard):
+                with self._lock:
+                    table = dict(self._assignments)
+                return table.get(shard, [])
+    """)
+    found = _lint(tmp_path, "serving/router.py")
+    assert "unlocked-shared-state" not in _rules(found)

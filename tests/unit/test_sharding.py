@@ -1,0 +1,807 @@
+"""Series-partitioned fleet (serving/sharding.py + fleet wiring).
+
+Three layers of coverage, mirroring the subsystem's structure:
+
+* pure-function layer — ring determinism and stability (adding a replica
+  remaps ~1/N of the keys, never reshuffles), scatter merge order and
+  partial-failure semantics, config validation, token-bucket admission
+  with a hand-driven clock;
+* state layer — forecaster subsetting partitions the key set exactly,
+  per-shard WAL namespaces isolate what a replica follows (tenant A's
+  ingest is never applied by a non-owner), and a new owner replaying the
+  shard WAL loses zero pending writes (the hand-off contract);
+* fleet layer — in-process fake replicas behind the real FrontDoor:
+  routed single-shard dispatch, scatter-gather spanning >= 3 shards,
+  unowned-shard vs no-ready-replica 503s, quota 429s, and
+  restart/resize rebalance bookkeeping.
+
+The routed-vs-broadcast BYTE-identity guarantee over real forecasters
+(all 7 families) rides the coalescing contract: per-series forecasts are
+independent of batch composition, so a shard subset's predict is bitwise
+equal to the full artifact's rows for the same keys.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributed_forecasting_tpu.serving.fleet import (
+    FleetConfig,
+    start_fleet,
+)
+from distributed_forecasting_tpu.serving.sharding import (
+    HashRing,
+    RoutePlan,
+    ShardedWAL,
+    ShardingConfig,
+    TokenBucket,
+    compute_assignments,
+    merge_ingest_responses,
+    merge_invocation_responses,
+    plan_request,
+    shard_of_key,
+    subset_for_shards,
+)
+
+from tests.unit.test_fleet import _FakeProc, _front_call
+
+KEY_NAMES = ("store", "item")
+
+
+# -- config -------------------------------------------------------------------
+
+def test_sharding_config_defaults_and_from_conf():
+    cfg = ShardingConfig.from_conf(None)
+    assert not cfg.enabled and cfg.num_shards == 8 and cfg.replication == 1
+    cfg = ShardingConfig.from_conf(
+        {"enabled": True, "num_shards": "16", "replication": 2,
+         "vnodes": 32, "quota_rps": 100, "quota_burst": 0})
+    assert cfg.enabled and cfg.num_shards == 16 and cfg.vnodes == 32
+    assert cfg.quota_rps == 100.0
+
+
+def test_sharding_config_rejects_unknown_keys():
+    with pytest.raises(ValueError, match="num_shard"):
+        ShardingConfig.from_conf({"num_shard": 4})
+
+
+@pytest.mark.parametrize("bad", [
+    {"num_shards": 0},
+    {"replication": 0},
+    {"vnodes": 0},
+    {"quota_rps": -1.0},
+    {"quota_burst": -1.0},
+])
+def test_sharding_config_validates(bad):
+    with pytest.raises(ValueError):
+        ShardingConfig(**bad)
+
+
+# -- ring determinism + stability ---------------------------------------------
+
+def test_key_to_shard_is_deterministic_and_spread():
+    shards = [shard_of_key((s, i), 8) for s in range(16) for i in range(16)]
+    assert shards == [shard_of_key((s, i), 8)
+                      for s in range(16) for i in range(16)]
+    counts = np.bincount(shards, minlength=8)
+    assert counts.min() > 0  # every shard gets keys at 256 keys / 8 shards
+
+
+def test_assignments_deterministic():
+    cfg = ShardingConfig(num_shards=32, replication=2, vnodes=64)
+    a = compute_assignments(cfg, range(4))
+    b = compute_assignments(cfg, range(4))
+    assert a == b
+    assert all(len(owners) == 2 and len(set(owners)) == 2
+               for owners in a.values())
+
+
+def test_ring_add_replica_remaps_bounded_fraction():
+    """The consistent-hash property the subsystem exists for: growing the
+    fleet N -> N+1 moves ~1/(N+1) of the shards (and therefore keys), not
+    a full reshuffle.  2/(N+1) is a generous bound for vnodes=64."""
+    cfg = ShardingConfig(num_shards=256, replication=1, vnodes=64)
+    n = 8
+    before = compute_assignments(cfg, range(n))
+    after = compute_assignments(cfg, range(n + 1))
+    moved = sum(1 for k in before if before[k][0] != after[k][0])
+    assert 0 < moved / cfg.num_shards < 2.0 / (n + 1)
+    # keys only move INTO the new replica, never between survivors
+    assert all(after[k][0] == n for k in before
+               if before[k][0] != after[k][0])
+
+
+def test_ring_lookup_n_distinct_and_capped():
+    ring = HashRing([0, 1, 2], vnodes=16)
+    owners = ring.lookup_n("shard:7", 2)
+    assert len(owners) == 2 and len(set(owners)) == 2
+    # replication beyond the node count caps at the node count
+    assert len(ring.lookup_n("shard:7", 9)) == 3
+
+
+# -- request planning + scatter merge ----------------------------------------
+
+def _inputs(keys):
+    return [dict(zip(KEY_NAMES, k)) for k in keys]
+
+
+def test_plan_request_groups_by_shard_in_order():
+    keys = [(s, i) for s in range(4) for i in range(2)]
+    body = {"inputs": _inputs(keys), "horizon": 5}
+    plan = plan_request("/invocations", body, KEY_NAMES, 4)
+    assert plan is not None and plan.field == "inputs"
+    assert plan.key_order == keys
+    for shard, items in plan.shard_items.items():
+        for item in items:
+            assert shard_of_key((item["store"], item["item"]), 4) == shard
+    sub = plan.sub_body(body, plan.shards[0])
+    assert sub["horizon"] == 5  # shared fields ride along
+    assert sub["inputs"] == plan.shard_items[plan.shards[0]]
+
+
+def test_plan_request_unplannable_bodies_return_none():
+    assert plan_request("/invocations", {"inputs": []}, KEY_NAMES, 4) is None
+    assert plan_request("/invocations", {"horizon": 5}, KEY_NAMES, 4) is None
+    assert plan_request("/nope", {"inputs": _inputs([(1, 1)])},
+                        KEY_NAMES, 4) is None
+    # one keyless item makes the whole body unroutable (the replica's own
+    # parser shapes the 400, not the router)
+    assert plan_request(
+        "/invocations", {"inputs": [{"store": 1, "item": 2}, {"store": 3}]},
+        KEY_NAMES, 4) is None
+
+
+def _fake_shard_response(plan: RoutePlan, shard: int, tag: str):
+    preds = [dict(zip(KEY_NAMES, k), yhat=f"{tag}-{k}")
+             for k in plan.shard_keys[shard]]
+    return 200, json.dumps(
+        {"predictions": preds, "n_series": len(preds)}).encode()
+
+
+def test_merge_invocations_preserves_request_key_order():
+    keys = [(s, i) for s in range(4) for i in range(2)]
+    plan = plan_request("/invocations", {"inputs": _inputs(keys)},
+                        KEY_NAMES, 4)
+    assert len(plan.shards) >= 3  # the scatter-gather regime
+    responses = {k: _fake_shard_response(plan, k, "ok")
+                 for k in plan.shards}
+    status, merged = merge_invocation_responses(plan, KEY_NAMES, responses)
+    assert status == 200 and "errors" not in merged
+    assert [(r["store"], r["item"]) for r in merged["predictions"]] == keys
+    assert merged["n_series"] == len(keys)
+
+
+def test_merge_invocations_partial_failure_is_per_key_not_5xx():
+    keys = [(s, i) for s in range(4) for i in range(2)]
+    plan = plan_request("/invocations", {"inputs": _inputs(keys)},
+                        KEY_NAMES, 4)
+    dead = plan.shards[0]
+    responses = {k: _fake_shard_response(plan, k, "ok")
+                 for k in plan.shards if k != dead}
+    responses[dead] = (503, json.dumps({"error": "boom"}).encode())
+    status, merged = merge_invocation_responses(plan, KEY_NAMES, responses)
+    assert status == 200  # the other tenants' forecasts still ship
+    live_keys = [k for k in keys if shard_of_key(k, 4) != dead]
+    assert [(r["store"], r["item"]) for r in merged["predictions"]] \
+        == live_keys
+    errs = merged["errors"]
+    assert {(e["store"], e["item"]) for e in errs} \
+        == {k for k in keys if shard_of_key(k, 4) == dead}
+    assert all(e["shard"] == dead and e["status"] == 503
+               and e["error"] == "boom" for e in errs)
+    assert merged["n_failed_series"] == len(errs)
+
+
+def test_merge_invocations_all_shards_failed_is_503():
+    plan = plan_request("/invocations", {"inputs": _inputs([(0, 0), (1, 0)])},
+                        KEY_NAMES, 64)
+    responses = {k: (503, b'{"error": "down"}') for k in plan.shards}
+    status, merged = merge_invocation_responses(plan, KEY_NAMES, responses)
+    assert status == 503 and merged["predictions"] == []
+
+
+def test_merge_ingest_sums_numeric_acks():
+    keys = [(s, 0) for s in range(8)]
+    points = [dict(zip(KEY_NAMES, k), d=10, y=1.0) for k in keys]
+    plan = plan_request("/ingest", {"points": points}, KEY_NAMES, 4)
+    responses = {}
+    for shard in plan.shards:
+        n = len(plan.shard_items[shard])
+        responses[shard] = (200, json.dumps(
+            {"written": n, "unknown_series": 0, "malformed": 0,
+             "applied": {"accepted": n}}).encode())
+    dead = plan.shards[-1]
+    n_dead = len(plan.shard_items[dead])
+    responses[dead] = (503, b'{"error": "down"}')
+    status, merged = merge_ingest_responses(plan, responses)
+    assert status == 200
+    assert merged["written"] == len(keys) - n_dead
+    assert merged["applied"]["accepted"] == len(keys) - n_dead
+    assert merged["errors"][0]["shard"] == dead
+    assert merged["errors"][0]["points"] == n_dead
+
+
+# -- token-bucket admission ---------------------------------------------------
+
+def test_token_bucket_admits_refills_and_isolates_tenants():
+    now = [0.0]
+    bucket = TokenBucket(rate=2.0, burst=4.0, time_fn=lambda: now[0])
+    assert bucket.allow("a", 4)       # full burst
+    assert not bucket.allow("a", 1)   # drained
+    assert bucket.allow("b", 4)       # tenants are independent buckets
+    now[0] = 1.0                      # 1s at 2 rows/s -> 2 tokens back
+    assert bucket.allow("a", 2)
+    assert not bucket.allow("a", 1)
+    now[0] = 100.0                    # refill clamps at burst
+    assert bucket.allow("a", 4)
+    assert not bucket.allow("a", 1)
+
+
+def test_token_bucket_default_burst_and_validation():
+    assert TokenBucket(rate=5.0).burst == 10.0
+    with pytest.raises(ValueError):
+        TokenBucket(rate=0.0)
+
+
+# -- forecaster subsetting ----------------------------------------------------
+
+_FIT_CACHE = {}
+
+
+def _tiny_forecaster(family="theta"):
+    """One fitted 8-series artifact per family, cached for the module —
+    every test re-subsets from the same fit, mirroring how a fleet's
+    replicas all load the same registered artifact."""
+    if family in _FIT_CACHE:
+        return _FIT_CACHE[family]
+    from distributed_forecasting_tpu.data import (
+        synthetic_store_item_sales,
+        tensorize,
+    )
+    from distributed_forecasting_tpu.engine import fit_forecast
+    from distributed_forecasting_tpu.models.base import get_model
+    from distributed_forecasting_tpu.serving import BatchForecaster
+
+    df = synthetic_store_item_sales(
+        n_stores=4, n_items=2, n_days=40, seed=7)
+    batch = tensorize(df)
+    cfg = get_model(family).config_cls()
+    params, _ = fit_forecast(batch, model=family, config=cfg, horizon=4)
+    fc = BatchForecaster.from_fit(batch, params, family, cfg)
+    fc.interval_scale = np.linspace(
+        0.5, 1.5, fc.keys.shape[0]).astype(np.float32)
+    _FIT_CACHE[family] = fc
+    return fc
+
+
+def test_subset_for_shards_partitions_exactly():
+    fc = _tiny_forecaster()
+    num_shards = 4
+    seen = []
+    for shard in range(num_shards):
+        sub, idx = subset_for_shards(fc, [shard], num_shards)
+        assert sub.keys.shape[0] == len(idx)
+        assert np.array_equal(sub.keys, np.asarray(fc.keys)[idx])
+        assert np.allclose(sub.interval_scale, fc.interval_scale[idx])
+        assert sub.day0 == fc.day0 and sub.day1 == fc.day1
+        for k in sub.keys.tolist():
+            assert shard_of_key(k, num_shards) == shard
+        seen.extend(idx.tolist())
+    # the shards tile the key set: every series in exactly one shard
+    assert sorted(seen) == list(range(fc.keys.shape[0]))
+
+
+# -- per-shard WAL isolation + hand-off ---------------------------------------
+
+def _wal_rows(keys, day=35, y=42.0):
+    return [{"k": list(k), "d": day, "y": y} for k in keys]
+
+
+def test_sharded_wal_routes_appends_and_follows_owned_only(tmp_path):
+    num_shards = 4
+    keys = [(s, i) for s in range(8) for i in range(2)]
+    by_shard = {}
+    for k in keys:
+        by_shard.setdefault(shard_of_key(k, num_shards), []).append(k)
+    owned = sorted(by_shard)[:2]
+    foreign = [s for s in sorted(by_shard) if s not in owned]
+    reads = []
+    wal = ShardedWAL(str(tmp_path), owned, num_shards,
+                     on_read=lambda s, n: reads.append((s, n)))
+    assert wal.append(_wal_rows(keys)) == len(keys)
+    # every shard's rows landed in ITS namespace, owned or not (appends
+    # are durable anywhere; only the follow-set is restricted)
+    for shard, skeys in by_shard.items():
+        seg_dir = tmp_path / f"shard-{shard}"
+        assert seg_dir.is_dir()
+        lines = [json.loads(line)
+                 for seg in sorted(seg_dir.glob("seg-*.jsonl"))
+                 for line in seg.read_text().splitlines()]
+        assert {tuple(r["k"]) for r in lines} == set(skeys)
+    records, cursor = wal.read_new(None)
+    got = {tuple(r["k"]) for r in records}
+    assert got == {k for s in owned for k in by_shard[s]}
+    assert not any(tuple(k) in got for s in foreign for k in by_shard[s])
+    assert sorted(s for s, _ in reads) == owned
+    # cursor advances: a second read sees nothing
+    again, cursor2 = wal.read_new(cursor)
+    assert again == [] and cursor2 == cursor
+    st = wal.stats()
+    assert st["segments"] == len(owned) and st["bytes"] > 0
+
+
+def test_ingest_applies_only_on_owning_replica(tmp_path):
+    """Tenant A's ingest is never applied by a non-owner: two subset
+    replicas share one wal_dir; a point for a shard owned by replica 0
+    reaches replica 0's model state and leaves replica 1's untouched."""
+    from distributed_forecasting_tpu.serving.ingest import (
+        build_ingest_runtime,
+    )
+    from distributed_forecasting_tpu.serving.sharding import ShardMetrics
+
+    num_shards = 4
+    fc = _tiny_forecaster("theta")
+    # split the shards that actually hold resident series between the two
+    # replicas, so both sides of the isolation assertion are non-vacuous
+    populated = sorted({shard_of_key(k, num_shards)
+                        for k in fc.keys.tolist()})
+    assert len(populated) >= 2
+    assign = {0: populated[:len(populated) // 2],
+              1: populated[len(populated) // 2:]}
+    runtimes = {}
+    metrics = {}
+    for ridx, shards in assign.items():
+        sub, _ = subset_for_shards(fc, shards, num_shards)
+        sm = ShardMetrics()
+        runtimes[ridx] = build_ingest_runtime(
+            {"enabled": True, "apply_mode": "sync", "time_bucket": 8},
+            sub,
+            default_wal_dir=str(tmp_path / "wal"),
+            wal_factory=lambda wal_dir, max_seg, s=shards, m=sm: ShardedWAL(
+                wal_dir, s, num_shards, max_segment_bytes=max_seg,
+                on_read=m.note_wal_read),
+        )
+        metrics[ridx] = sm
+    key = next(tuple(k) for k in fc.keys.tolist()
+               if shard_of_key(k, num_shards) in assign[0])
+    day = int(fc.day1) + 1
+    point = dict(zip(fc.key_names, key), d=day, y=123.0)
+    out = runtimes[0].submit([point])
+    assert out["written"] == 1 and out["applied"]["accepted"] == 1
+    # the owner's frontier advanced; the non-owner read NOTHING
+    assert runtimes[0].forecaster.day1 >= day
+    other = runtimes[1].poll_apply()
+    assert other["accepted"] == 0
+    assert runtimes[1].forecaster.day1 == fc.day1
+    shard = shard_of_key(key, num_shards)
+    assert metrics[0].ingest_points.value(shard=str(shard)) == 1
+    assert f'dftpu_shard_ingest_points_total{{shard="{shard}"}} 1' \
+        in metrics[0].render()
+    assert metrics[1].ingest_points.snapshot() == {}
+    # a non-resident key is filtered before the WAL (unknown on a subset)
+    foreign_key = next(tuple(k) for k in fc.keys.tolist()
+                       if shard_of_key(k, num_shards) in assign[1])
+    out = runtimes[0].submit(
+        [dict(zip(fc.key_names, foreign_key), d=day, y=1.0)])
+    assert out["written"] == 0 and out["unknown_series"] == 1
+
+
+def test_handoff_replay_loses_zero_pending_writes(tmp_path):
+    """The rebalance hand-off contract: a NEW owner building over the
+    shard WAL replays every write the old owner accepted but had not
+    applied — nothing pending is lost across the ownership change."""
+    from distributed_forecasting_tpu.serving.ingest import (
+        build_ingest_runtime,
+    )
+
+    num_shards = 4
+    fc = _tiny_forecaster("theta")
+    populated = sorted({shard_of_key(k, num_shards)
+                        for k in fc.keys.tolist()})
+    shards = populated[:2]
+
+    def build(forecaster):
+        return build_ingest_runtime(
+            {"enabled": True, "apply_mode": "interval", "time_bucket": 8},
+            forecaster,
+            default_wal_dir=str(tmp_path / "wal"),
+            wal_factory=lambda d, m: ShardedWAL(
+                d, shards, num_shards, max_segment_bytes=m),
+        )
+
+    sub_old, _ = subset_for_shards(fc, shards, num_shards)
+    old_owner = build(sub_old)
+    keys = [tuple(k) for k in sub_old.keys.tolist()]
+    day = int(fc.day1) + 1
+    points = [dict(zip(fc.key_names, k), d=day, y=50.0 + j)
+              for j, k in enumerate(keys)]
+    out = old_owner.submit(points)  # interval mode: WAL'd, NOT applied
+    assert out["written"] == len(keys)
+    assert old_owner.forecaster.day1 == fc.day1  # still pending
+
+    # old owner dies here; the new owner boots from the artifact + WAL
+    sub_new, _ = subset_for_shards(fc, shards, num_shards)
+    new_owner = build(sub_new)
+    replay = new_owner.poll_apply()  # what replica.py runs before ready
+    assert replay["accepted"] == len(keys)  # zero lost
+    assert new_owner.forecaster.day1 >= day
+
+
+# -- fleet-level routing over in-process fake replicas ------------------------
+
+def _make_routing_fake(port):
+    """A fake sharded replica: /readyz, /schema, /metrics, and POSTs that
+    echo which port served which keys (enough to prove routing without a
+    model).  ``srv.fail`` turns POSTs into 500s."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def _send(self, code, payload, ctype="application/json"):
+            body = payload if isinstance(payload, bytes) \
+                else json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/readyz":
+                self._send(200 if self.server.ready else 503,
+                           {"ready": self.server.ready})
+            elif self.path == "/schema":
+                self._send(200, {"key_names": list(KEY_NAMES)})
+            elif self.path == "/metrics":
+                self._send(
+                    200,
+                    ("# TYPE serving_requests_total counter\n"
+                     f"serving_requests_total {self.server.hits}\n"
+                     ).encode(),
+                    "text/plain")
+            else:
+                self._send(404, {})
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", "0"))
+            req = json.loads(self.rfile.read(n) or b"{}")
+            self.server.hits += 1
+            if self.server.fail:
+                self._send(500, {"error": "injected failure"})
+                return
+            me = self.server.server_address[1]
+            if self.path == "/ingest":
+                self.server.ingested.extend(
+                    (r["store"], r["item"]) for r in req.get("points", []))
+                self._send(200, {"written": len(req.get("points", []))})
+                return
+            seen = []
+            preds = []
+            for item in req.get("inputs", []):
+                k = (item["store"], item["item"])
+                if k in seen:
+                    continue
+                seen.append(k)
+                preds.append({"store": k[0], "item": k[1], "port": me})
+            self._send(200, {"predictions": preds, "n_series": len(seen)})
+
+    srv = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    srv.daemon_threads = True
+    srv.ready = True
+    srv.fail = False
+    srv.hits = 0
+    srv.ingested = []
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv
+
+
+@pytest.fixture
+def sharded_fake_fleet():
+    """(supervisor, front, procs, scfg) — 2 fakes x 4 shards, routed."""
+    cfg = FleetConfig(
+        enabled=True, replicas=2, health_poll_interval_s=0.05,
+        probe_timeout_s=1.0, restart_backoff_s=0.05,
+        restart_backoff_max_s=0.4, drain_timeout_s=2.0, retry_window_s=2.0)
+    scfg = ShardingConfig(enabled=True, num_shards=4, replication=1,
+                          vnodes=32)
+    procs = {}
+    spawn_shards = []
+
+    def spawn(index, port, shards=None):
+        spawn_shards.append((index, tuple(shards or ())))
+        proc = _FakeProc(_make_routing_fake(port))
+        procs[index] = proc
+        return proc
+
+    sup, front = start_fleet(cfg, spawn_fn=spawn, wait=False,
+                             sharding=scfg, key_names=KEY_NAMES)
+    assert sup.wait_ready(min_ready=2, timeout=10.0)
+    sup.spawn_shards = spawn_shards
+    try:
+        yield sup, front, procs, scfg
+    finally:
+        front.shutdown()
+        sup.stop()
+
+
+def _keys_on_shard(sup, scfg, shard, n=1):
+    out = []
+    for s in range(64):
+        for i in range(4):
+            if shard_of_key((s, i), scfg.num_shards) == shard:
+                out.append((s, i))
+                if len(out) == n:
+                    return out
+    raise AssertionError("no keys found for shard")
+
+
+def test_spawn_receives_disjoint_covering_assignment(sharded_fake_fleet):
+    sup, front, procs, scfg = sharded_fake_fleet
+    owned = [set(shards) for _, shards in sup.spawn_shards]
+    assert set().union(*owned) == set(range(scfg.num_shards))
+    assert not (owned[0] & owned[1])  # replication=1: a partition
+    assert sup.assignments().keys() == set(range(scfg.num_shards))
+
+
+def test_single_shard_request_routes_to_owner(sharded_fake_fleet):
+    sup, front, procs, scfg = sharded_fake_fleet
+    assign = sup.assignments()
+    ports = {r["index"]: r["port"] for r in sup.describe()}
+    for shard in range(scfg.num_shards):
+        key = _keys_on_shard(sup, scfg, shard)[0]
+        body = json.dumps(
+            {"inputs": [dict(zip(KEY_NAMES, key))], "horizon": 3}).encode()
+        status, headers, payload = _front_call(
+            front, "POST", "/invocations", body)
+        assert status == 200
+        assert int(headers["X-Fleet-Shard"]) == shard
+        owner_port = ports[assign[shard][0]]
+        assert int(headers["X-Fleet-Replica"]) == owner_port
+        assert json.loads(payload)["predictions"][0]["port"] == owner_port
+    metrics = sup.render_metrics()
+    assert f"dftpu_shard_routed_total {scfg.num_shards}" in metrics
+    assert "dftpu_shard_scatter_total 0" in metrics
+
+
+def test_scatter_gather_spans_shards_and_merges_in_order(sharded_fake_fleet):
+    sup, front, procs, scfg = sharded_fake_fleet
+    keys = []
+    for shard in range(scfg.num_shards):
+        keys.extend(_keys_on_shard(sup, scfg, shard, n=2))
+    order = sorted(keys)  # any fixed request order, interleaving shards
+    body = json.dumps({"inputs": _inputs(order), "horizon": 3}).encode()
+    status, headers, payload = _front_call(front, "POST", "/invocations", body)
+    assert status == 200
+    assert int(headers["X-Fleet-Scatter"]) == scfg.num_shards >= 3
+    merged = json.loads(payload)
+    assert [(r["store"], r["item"]) for r in merged["predictions"]] == order
+    assert merged["n_series"] == len(order)
+    # every record came from its shard's owner, not round-robin
+    assign = sup.assignments()
+    ports = {r["index"]: r["port"] for r in sup.describe()}
+    for rec in merged["predictions"]:
+        shard = shard_of_key((rec["store"], rec["item"]), scfg.num_shards)
+        assert rec["port"] == ports[assign[shard][0]]
+    assert "dftpu_shard_scatter_total 1" in sup.render_metrics()
+
+
+def test_scatter_partial_failure_degrades_per_key(sharded_fake_fleet):
+    sup, front, procs, scfg = sharded_fake_fleet
+    victim_idx = 0
+    procs[victim_idx].server.fail = True
+    with sup._lock:
+        dead_shards = set(sup._replicas[victim_idx].shards)
+    keys = [k for shard in range(scfg.num_shards)
+            for k in _keys_on_shard(sup, scfg, shard)]
+    body = json.dumps({"inputs": _inputs(keys), "horizon": 3}).encode()
+    status, _, payload = _front_call(front, "POST", "/invocations", body)
+    assert status == 200  # partial failure is NOT a whole-request 5xx
+    merged = json.loads(payload)
+    ok_keys = [k for k in keys
+               if shard_of_key(k, scfg.num_shards) not in dead_shards]
+    assert [(r["store"], r["item"]) for r in merged["predictions"]] == ok_keys
+    assert {(e["store"], e["item"]) for e in merged["errors"]} \
+        == {k for k in keys
+            if shard_of_key(k, scfg.num_shards) in dead_shards}
+    assert all(e["error"] == "injected failure" for e in merged["errors"])
+
+
+def test_routed_ingest_reaches_only_owners(sharded_fake_fleet):
+    sup, front, procs, scfg = sharded_fake_fleet
+    keys = [k for shard in range(scfg.num_shards)
+            for k in _keys_on_shard(sup, scfg, shard)]
+    points = [dict(zip(KEY_NAMES, k), d=10, y=1.0) for k in keys]
+    status, _, payload = _front_call(
+        front, "POST", "/ingest", json.dumps({"points": points}).encode())
+    assert status == 200
+    assert json.loads(payload)["written"] == len(keys)
+    assign = sup.assignments()
+    with sup._lock:
+        owned = {r.index: set(r.shards) for r in sup._replicas}
+    for ridx, proc in procs.items():
+        got = set(proc.server.ingested)
+        expect = {tuple(k) for k in keys
+                  if assign[shard_of_key(k, scfg.num_shards)][0] == ridx}
+        assert got == expect
+        assert all(shard_of_key(k, scfg.num_shards) in owned[ridx]
+                   for k in got)
+
+
+def test_unowned_shard_503_is_distinct_from_unrouted(sharded_fake_fleet):
+    sup, front, procs, scfg = sharded_fake_fleet
+    key = _keys_on_shard(sup, scfg, 0)[0]
+    with sup._lock:
+        sup._assignments[0] = []  # rebalance in flight: shard 0 orphaned
+    body = json.dumps({"inputs": [dict(zip(KEY_NAMES, key))]}).encode()
+    status, headers, payload = _front_call(front, "POST", "/invocations", body)
+    assert status == 503
+    assert headers.get("Retry-After") == "1"
+    assert json.loads(payload)["error"] == "shard has no owner"
+    metrics = sup.render_metrics()
+    assert "fleet_unowned_shard_total 1" in metrics
+    assert "fleet_unrouted_total 0" in metrics  # NOT the no-replica path
+
+
+def test_quota_429_per_tenant(sharded_fake_fleet):
+    sup, front, procs, scfg = sharded_fake_fleet
+    now = [0.0]
+    sup.quota = TokenBucket(rate=0.001, burst=2.0, time_fn=lambda: now[0])
+    tenant_a = [(7, 0), (7, 1)]   # same first key column = same tenant
+    body = json.dumps({"inputs": _inputs(tenant_a)}).encode()
+    assert _front_call(front, "POST", "/invocations", body)[0] == 200
+    status, headers, payload = _front_call(front, "POST", "/invocations", body)
+    assert status == 429
+    assert headers.get("Retry-After") == "1"
+    assert json.loads(payload)["tenant"] == "7"
+    # another tenant is admitted: buckets are per series prefix
+    other = json.dumps({"inputs": _inputs([(8, 0)])}).encode()
+    assert _front_call(front, "POST", "/invocations", other)[0] == 200
+    assert "dftpu_shard_quota_rejected_total 1" in sup.render_metrics()
+
+
+def test_unroutable_post_falls_back_to_round_robin(sharded_fake_fleet):
+    sup, front, procs, scfg = sharded_fake_fleet
+    # no key columns at all: planner bails, round-robin still answers
+    status, _, _ = _front_call(
+        front, "POST", "/invocations", json.dumps({"horizon": 3}).encode())
+    assert status == 200
+    assert "dftpu_shard_unrouted_total 1" in sup.render_metrics()
+
+
+def test_restart_respawns_with_same_shards_and_counts_rebalance(
+        sharded_fake_fleet):
+    sup, front, procs, scfg = sharded_fake_fleet
+    before = dict(sup.spawn_shards)
+    procs[0].crash()
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        if sup.ready_count() == 2 and len(sup.spawn_shards) >= 3:
+            break
+        time.sleep(0.05)
+    respawns = sup.spawn_shards[2:]
+    assert respawns and respawns[0] == (0, before[0])  # same assignment
+    rebalances = [line for line in sup.render_metrics().splitlines()
+                  if line.startswith("dftpu_shard_rebalance_total ")]
+    assert rebalances and float(rebalances[0].split()[1]) >= 1
+
+
+def test_resize_rebalances_with_bounded_movement(sharded_fake_fleet):
+    sup, front, procs, scfg = sharded_fake_fleet
+    before = sup.assignments()
+    sup.resize(3)
+    after = sup.assignments()
+    assert sup.size == 3
+    # still a disjoint cover of all shards
+    with sup._lock:
+        owned = [set(r.shards) for r in sup._replicas]
+    assert set().union(*owned) == set(range(scfg.num_shards))
+    assert sum(len(o) for o in owned) == scfg.num_shards
+    # movement is INTO the new replica only (consistent-hash property)
+    moved = [k for k in before if before[k] != after[k]]
+    assert all(after[k][0] == 2 for k in moved)
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline and sup.ready_count() < 3:
+        time.sleep(0.05)
+    assert sup.ready_count() == 3
+    # routed traffic still lands on owners after the rebalance
+    key = _keys_on_shard(sup, scfg, 0)[0]
+    body = json.dumps({"inputs": [dict(zip(KEY_NAMES, key))]}).encode()
+    status, headers, _ = _front_call(front, "POST", "/invocations", body)
+    assert status == 200
+    ports = {r["index"]: r["port"] for r in sup.describe()}
+    assert int(headers["X-Fleet-Replica"]) == ports[after[0][0]]
+
+
+# -- routed vs broadcast BYTE-identity over real forecasters ------------------
+
+# theta (filter-state family, the streaming path) and prophet (curve
+# family) anchor tier-1; the other five ride the CI unit step's slow set
+_FAMILIES = [
+    "theta",
+    "prophet",
+    pytest.param("arima", marks=pytest.mark.slow),
+    pytest.param("croston", marks=pytest.mark.slow),
+    pytest.param("curve", marks=pytest.mark.slow),
+    pytest.param("holt_winters", marks=pytest.mark.slow),
+    pytest.param("prophet_ar", marks=pytest.mark.slow),
+]
+
+
+@pytest.mark.parametrize("family", _FAMILIES)
+def test_routed_sharded_fleet_serves_byte_identical_forecasts(family):
+    """The acceptance bar: a sharded fleet of REAL subset replicas answers
+    byte-for-byte what one unsharded replica answers — for a single-shard
+    routed request AND a scatter-gather spanning >= 3 shards."""
+    from distributed_forecasting_tpu.serving.server import start_server
+
+    fc = _tiny_forecaster(family)
+    num_shards = 4
+    full = start_server(fc, port=0)
+    servers = [full]
+    cfg = FleetConfig(
+        enabled=True, replicas=2, health_poll_interval_s=0.05,
+        probe_timeout_s=2.0, drain_timeout_s=2.0, retry_window_s=5.0)
+    scfg = ShardingConfig(enabled=True, num_shards=num_shards,
+                          replication=1, vnodes=32)
+
+    def spawn(index, port, shards=None):
+        sub, _ = subset_for_shards(fc, shards, num_shards)
+        srv = start_server(sub, port=port)
+        servers.append(srv)
+        return _FakeProc(srv)
+
+    sup, front = start_fleet(cfg, spawn_fn=spawn, wait=False,
+                             sharding=scfg, key_names=fc.key_names)
+    try:
+        assert sup.wait_ready(min_ready=2, timeout=30.0)
+        keys = [tuple(int(v) for v in k) for k in fc.keys.tolist()]
+        shards_hit = {shard_of_key(k, num_shards) for k in keys}
+        assert len(shards_hit) >= 3  # the scatter regime, per acceptance
+        requests = [
+            # single series -> single-shard routed fast path
+            {"inputs": [dict(zip(fc.key_names, keys[0]))], "horizon": 4},
+            # full key set in a scrambled order -> scatter-gather
+            {"inputs": _inputs_named(fc.key_names, keys[::-1]), "horizon": 4},
+            # subset with include_history exercises merged history rows
+            {"inputs": _inputs_named(fc.key_names, keys[::3]), "horizon": 3,
+             "include_history": True},
+        ]
+        for req in requests:
+            body = json.dumps(req).encode()
+            status_u, _, payload_u = _srv_call(full, body)
+            status_s, _, payload_s = _front_call(
+                front, "POST", "/invocations", body)
+            assert status_u == status_s == 200
+            assert payload_s == payload_u, (
+                f"{family}: routed response differs from unsharded "
+                f"({len(payload_s)} vs {len(payload_u)} bytes)")
+    finally:
+        front.shutdown()
+        sup.stop()
+        for srv in servers:
+            srv.shutdown()
+            srv.server_close()
+
+
+def _inputs_named(key_names, keys):
+    return [dict(zip(key_names, k)) for k in keys]
+
+
+def _srv_call(srv, body):
+    import http.client
+
+    host, port = srv.server_address
+    conn = http.client.HTTPConnection(host, port, timeout=30)
+    try:
+        conn.request("POST", "/invocations", body=body,
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return resp.status, dict(resp.getheaders()), resp.read()
+    finally:
+        conn.close()
