@@ -35,6 +35,15 @@ from distributed_forecasting_tpu.engine.compile_cache import (
     cache_stats,
     configure_compile_cache,
 )
+from distributed_forecasting_tpu.engine.windowed import (
+    WindowedConfig,
+    WindowedSeriesStateStore,
+    configure_windowed,
+    plan_windows,
+    should_window,
+    windowed_config,
+    windowed_fit_forecast,
+)
 from distributed_forecasting_tpu.engine.executor import (
     ExperimentHandle,
     PipelineConfig,
@@ -82,4 +91,11 @@ __all__ = [
     "BlendResult",
     "blend_weights",
     "fit_forecast_blend",
+    "WindowedConfig",
+    "WindowedSeriesStateStore",
+    "configure_windowed",
+    "plan_windows",
+    "should_window",
+    "windowed_config",
+    "windowed_fit_forecast",
 ]

@@ -288,6 +288,20 @@ def cross_validate(
     """
     config, key, xreg = _cv_entry(batch, model, config, key, xreg,
                                   "cross_validate")
+    if model == "arima":
+        from distributed_forecasting_tpu.engine.windowed import should_window
+
+        if should_window(batch.n_time):
+            # every cutoff would re-run the sequential whole-series fit the
+            # windowed threshold exists to avoid — O(cuts * T) serial scan
+            # steps.  Fail loudly instead of silently burning hours.
+            raise ValueError(
+                f"cross_validate on {batch.n_time} periods crosses the "
+                f"engine.windowed auto-activation threshold; rolling-origin "
+                f"CV re-fits the full sequential path per cutoff and is not "
+                f"supported in the windowed regime — CV on a subsampled "
+                f"history, or disable engine.windowed"
+            )
     cuts = cutoff_indices(batch.n_time, cv)
     mase_m = metrics_ops.seasonal_naive_lag(getattr(batch, "freq", "D"))
     if return_frame:

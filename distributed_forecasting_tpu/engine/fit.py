@@ -282,6 +282,23 @@ def fit_forecast(
     fns = get_model(model)
     validate_grid_cadence(model, batch)
     config = config if config is not None else fns.config_cls()
+    if (model == "arima" and xreg is None
+            and getattr(config, "method", None) == "hr"):
+        # ultra-long auto-activation (engine.windowed conf block): above
+        # the configured T threshold the sequential Kalman scan's serial
+        # depth dominates wall time, and the DARIMA split-and-combine path
+        # fits all windows in one batched dispatch instead.  Result grid
+        # covers tail window + horizon (docs/windowed.md).
+        from distributed_forecasting_tpu.engine.windowed import (
+            should_window,
+            windowed_fit_forecast,
+        )
+
+        if should_window(batch.n_time):
+            return windowed_fit_forecast(
+                batch, model=model, config=config, horizon=horizon,
+                key=key, min_points=min_points,
+            )
     if key is None:
         key = jax.random.PRNGKey(0)
     validate_changepoint_days(config, batch.day)

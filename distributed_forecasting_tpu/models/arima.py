@@ -263,24 +263,22 @@ def _effective_r(config: ArimaConfig) -> int:
     return max(p_eff, q_eff + 1, 1)
 
 
-def _hannan_rissanen(z, m, ar_lags, ma_lags, p_eff: int, q_eff: int, K: int,
-                     ridge: float = 1e-4):
-    """Closed-form batched (S)ARMA estimation (Hannan-Rissanen).
+def _hr_regression(z, m, ar_lags, ma_lags, K: int, ridge: float = 1e-4):
+    """The Hannan-Rissanen regression core, exposed as sufficient statistics.
 
-    The TPU-first fit: where the 'mle' path runs fit_steps sequential Adam
-    iterations of a T-step Kalman scan (serial depth fit_steps x T), this is
-    three batched linear-algebra steps, all MXU-shaped:
+    z/m: centered differenced series + validity mask, (S, T) — S being any
+    batch axis (whole series, or flattened series x windows for the
+    DARIMA split-and-combine path, engine/windowed.py).  Returns
 
-      1. long-AR(K) by Yule-Walker on masked pairwise autocorrelations —
-         one (S, K, K) Toeplitz solve;
-      2. innovations e_t = z_t - sum_i a_i z_{t-i} from K static lag shifts;
-      3. regression of z_t on the AR lag set + innovation lag set — one
-         (S, F, F) ridge solve.  Seasonal (SARMA) terms are just more lags
-         in the sets (``_lag_sets``), at zero extra structure;
-
-    followed by a PACF-clip projection into the stationary/invertible
-    region.  Returns dense polynomials (phi (S, p_eff), theta (S, q_eff))
-    with the non-lag positions zero.
+      coef  (S, F): regression coefficients over the lag-set feature basis
+                    ``ar_lags + ma_lags`` (RAW — no PACF projection);
+      gram  (S, F, F): the ridged normal matrix X'X — the observed
+                    information (up to sigma2), which is exactly the
+                    inverse-covariance weight the DARIMA WLS combine needs
+                    (arXiv 2007.09577 eq. 10: Sigma_k^{-1} ∝ X_k'X_k);
+      n_valid (S,): rows with every lag feature observed;
+      sigma2  (S,): residual variance of the regression — the per-window
+                    noise scale that divides the gram into a precision.
     """
     S, T = z.shape
     zm = z * m
@@ -302,7 +300,9 @@ def _hannan_rissanen(z, m, ar_lags, ma_lags, p_eff: int, q_eff: int, K: int,
 
     F = len(ar_lags) + len(ma_lags)
     if F == 0:
-        return jnp.zeros((S, 0)), jnp.zeros((S, 0))
+        zero_s = jnp.zeros((S,))
+        return (jnp.zeros((S, 0)), jnp.zeros((S, 0, 0)), zero_s + 1.0,
+                jnp.maximum(g0, _EPS))
     feats = [_lag(zm, i) for i in ar_lags] + [_lag(e, j) for j in ma_lags]
     valid = m
     for i in ar_lags:
@@ -316,8 +316,18 @@ def _hannan_rissanen(z, m, ar_lags, ma_lags, p_eff: int, q_eff: int, K: int,
     G = G + (ridge * g0 * n_valid)[:, None, None] * jnp.eye(F)[None]
     b = jnp.einsum("stf,st->sf", X, zv, optimize=True)
     coef = solve_dense(G, b)
+    resid = zv - jnp.einsum("stf,sf->st", X, coef, optimize=True) * valid
+    sigma2 = jnp.maximum(
+        jnp.sum(resid * resid, axis=1) / n_valid, _EPS)
+    return coef, G, n_valid, sigma2
 
-    # scatter the lag-set coefficients into dense polynomials
+
+def coef_to_poly(coef, ar_lags, ma_lags, p_eff: int, q_eff: int):
+    """Scatter lag-set regression coefficients (S, F) into dense stabilized
+    (phi (S, p_eff), theta (S, q_eff)) polynomials — the shared tail of the
+    HR fit, reused verbatim by the windowed WLS-combine path so combined
+    coefficients land in the exact same stationary/invertible region."""
+    S = coef.shape[0]
     nar = len(ar_lags)
     phi = jnp.zeros((S, p_eff))
     for col, lag in enumerate(ar_lags):
@@ -331,6 +341,33 @@ def _hannan_rissanen(z, m, ar_lags, ma_lags, p_eff: int, q_eff: int, K: int,
     if q_eff:
         theta = jax.vmap(_stabilize)(theta)
     return phi, theta
+
+
+def _hannan_rissanen(z, m, ar_lags, ma_lags, p_eff: int, q_eff: int, K: int,
+                     ridge: float = 1e-4):
+    """Closed-form batched (S)ARMA estimation (Hannan-Rissanen).
+
+    The TPU-first fit: where the 'mle' path runs fit_steps sequential Adam
+    iterations of a T-step Kalman scan (serial depth fit_steps x T), this is
+    three batched linear-algebra steps, all MXU-shaped:
+
+      1. long-AR(K) by Yule-Walker on masked pairwise autocorrelations —
+         one (S, K, K) Toeplitz solve;
+      2. innovations e_t = z_t - sum_i a_i z_{t-i} from K static lag shifts;
+      3. regression of z_t on the AR lag set + innovation lag set — one
+         (S, F, F) ridge solve.  Seasonal (SARMA) terms are just more lags
+         in the sets (``_lag_sets``), at zero extra structure;
+
+    followed by a PACF-clip projection into the stationary/invertible
+    region.  Returns dense polynomials (phi (S, p_eff), theta (S, q_eff))
+    with the non-lag positions zero.
+    """
+    S = z.shape[0]
+    F = len(ar_lags) + len(ma_lags)
+    if F == 0:
+        return jnp.zeros((S, 0)), jnp.zeros((S, 0))
+    coef, _G, _n, _s2 = _hr_regression(z, m, ar_lags, ma_lags, K, ridge)
+    return coef_to_poly(coef, ar_lags, ma_lags, p_eff, q_eff)
 
 
 def _difference(y, mask, d):
@@ -396,6 +433,16 @@ def fit(y, mask, day, config: ArimaConfig) -> ArimaParams:
     else:
         raise ValueError(f"unknown ARIMA fit method {config.method!r}; 'hr' or 'mle'")
 
+    return _finalize(y, mask, day, config, phi, theta, mean, zc, zmask)
+
+
+def _finalize(y, mask, day, config: ArimaConfig, phi, theta, mean, zc, zmask):
+    """Post-estimation tail of ``fit``: one Kalman pass for sigma2 / final
+    states / one-step fitted path, then d=1 integration.  Shared by the
+    whole-series fit above and the windowed path (engine/windowed.py), which
+    runs it over the TAIL window only with externally-combined phi/theta."""
+    d = config.d
+    r = _effective_r(config)
     if config.kalman == "pscan":
         from distributed_forecasting_tpu.ops.pkalman import parallel_kalman_filter
 
@@ -458,6 +505,52 @@ def fit(y, mask, day, config: ArimaConfig) -> ArimaParams:
         day0=day[0].astype(jnp.float32),
         t_fit_end=day[-1].astype(jnp.float32),
     )
+
+
+@partial(jax.jit, static_argnames=("config",))
+def window_stats(y, mask, config: ArimaConfig):
+    """Per-window HR sufficient statistics for the DARIMA split-and-combine
+    path (arXiv 2007.09577).  y/mask (B, W) are RAW windows — B is the
+    flattened series x windows axis — differencing happens inside, exactly
+    as in ``fit``.  Returns a dict of
+
+      coef (B, F), gram (B, F, F), n_valid (B,), sigma2 (B,): the HR
+        regression's sufficient statistics (see ``_hr_regression``);
+      mean (B,), n_obs (B,): per-window differenced-space mean + count, so
+        the combine can reconstruct the precision-weighted global mean.
+
+    Every array is O(F^2) per window — the (B, W) data stays on device and
+    only these small statistics flow into the combine solve.
+    """
+    if config.method != "hr":
+        raise ValueError(
+            "windowed fitting requires ArimaConfig.method='hr' — the MLE "
+            "path has no closed-form sufficient statistics to combine"
+        )
+    ar_lags, ma_lags, p_eff, q_eff = _lag_sets(config)
+    z, zmask = _difference(y, mask, config.d)
+    n_obs = jnp.maximum(zmask.sum(axis=1), 1.0)
+    mean = (z * zmask).sum(axis=1) / n_obs
+    zc = (z - mean[:, None]) * zmask
+    K = max(config.hr_ar_order, p_eff + q_eff + config.m)
+    coef, gram, n_valid, sigma2 = _hr_regression(zc, zmask, ar_lags, ma_lags, K)
+    return {
+        "coef": coef, "gram": gram, "n_valid": n_valid, "sigma2": sigma2,
+        "mean": mean, "n_obs": n_obs,
+    }
+
+
+@partial(jax.jit, static_argnames=("config",))
+def params_from_estimates(y, mask, day, config: ArimaConfig, phi, theta, mean):
+    """Build full ``ArimaParams`` from externally-estimated coefficients by
+    running only the post-estimation Kalman/integration tail over (y, mask,
+    day).  The windowed path calls this on the TAIL window with the
+    WLS-combined phi/theta/mean: the resulting params are anchored at the
+    tail (day0 = tail start), so ``forecast`` routes through the existing
+    predictor unchanged and never scans the full T axis."""
+    z, zmask = _difference(y, mask, config.d)
+    zc = (z - mean[:, None]) * zmask
+    return _finalize(y, mask, day, config, phi, theta, mean, zc, zmask)
 
 
 @partial(jax.jit, static_argnames=("config", "_r"))

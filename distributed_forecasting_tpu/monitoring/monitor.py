@@ -985,6 +985,11 @@ class IngestMetrics:
         self.refits_total = self.registry.counter(
             "dftpu_ingest_refits_total",
             "background full refits completed and swapped in")
+        self.tail_window_refits_total = self.registry.counter(
+            "dftpu_ingest_tail_window_refits_total",
+            "windowed refits that re-fit only the tail window, reusing "
+            "frozen per-window stats for the untouched prefix "
+            "(engine.windowed streaming path)")
         self.wal_bytes = self.registry.gauge(
             "dftpu_ingest_wal_bytes",
             "total bytes across WAL segments (shared in fleet mode: "

@@ -1,0 +1,118 @@
+"""WLS combine for window-parallel (DARIMA) fitting.
+
+*Distributed ARIMA Models for Ultra-long Time Series* (arXiv 2007.09577)
+estimates one global model from K independent sub-series fits by weighted
+least squares with inverse-covariance weights: each window k contributes
+its coefficient estimate beta_k and the precision Sigma_k^{-1} of that
+estimate, and the combined estimator is the closed form
+
+    beta = (sum_k Sigma_k^{-1})^{-1} sum_k Sigma_k^{-1} beta_k.
+
+For the Hannan-Rissanen regression the precision is available for free:
+Sigma_k^{-1} = X_k'X_k / sigma2_k — the ridged normal matrix and residual
+variance that ``models/arima._hr_regression`` already computes.  So the
+combine is one (S, F, F) batched solve over statistics that are O(F^2)
+per window; the (S*K, W) window data never leaves the fit dispatch.
+
+This module owns the dispatch discipline (mirroring ``ops/update.py``):
+the combine runs under a ``windowed.combine`` span with the standard
+``device_annotation``, keyed ``windowed_combine:<model>`` in the AOT
+executable store so its cost lands in ``/debug/cost`` and the perf
+sentinel's program registry.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from distributed_forecasting_tpu.engine.compile_cache import aot_call
+from distributed_forecasting_tpu.monitoring.trace import (
+    device_annotation,
+    get_tracer,
+)
+from distributed_forecasting_tpu.ops.solve import solve_dense
+
+_EPS = 1e-6
+
+
+@partial(jax.jit, static_argnames=("n_windows",))
+def wls_combine(stats: dict, n_windows: int) -> dict:
+    """Combine per-window HR sufficient statistics into one estimate per
+    series.
+
+    ``stats`` is the dict returned by ``models/arima.window_stats`` with
+    every leaf flat over the series x windows axis: coef (S*K, F), gram
+    (S*K, F, F), n_valid/sigma2/mean/n_obs (S*K,).  Windows of one series
+    are CONTIGUOUS (series-major), matching ``engine/windowed.plan``.
+
+    Returns per-series ``{"coef": (S, F), "mean": (S,), "sigma2": (S,)}``:
+    the WLS-combined regression coefficients, the precision-weighted global
+    mean of the differenced series, and the observation-pooled residual
+    variance (diagnostic — the tail finalize recomputes sigma2 from its
+    own Kalman pass).
+    """
+    coef = stats["coef"]
+    B, F = coef.shape
+    if B % n_windows:
+        raise ValueError(
+            f"flat window axis {B} is not a multiple of n_windows={n_windows}"
+        )
+    S = B // n_windows
+
+    def grp(x):
+        return x.reshape((S, n_windows) + x.shape[1:])
+
+    sigma2 = jnp.maximum(grp(stats["sigma2"]), _EPS)   # (S, K)
+    n_obs = grp(stats["n_obs"])                        # (S, K)
+    n_valid = grp(stats["n_valid"])                    # (S, K)
+    mean_k = grp(stats["mean"])                        # (S, K)
+
+    # precision-weighted global mean (scalar WLS over the window means)
+    w_mean = n_obs / sigma2
+    mean = jnp.sum(w_mean * mean_k, axis=1) / jnp.maximum(
+        jnp.sum(w_mean, axis=1), _EPS
+    )
+    # observation-pooled residual variance
+    pooled = jnp.sum(n_valid * sigma2, axis=1) / jnp.maximum(
+        jnp.sum(n_valid, axis=1), 1.0
+    )
+
+    if F == 0:
+        return {"coef": jnp.zeros((S, 0)), "mean": mean, "sigma2": pooled}
+
+    gram = grp(stats["gram"])                          # (S, K, F, F)
+    coef_k = grp(coef)                                 # (S, K, F)
+    prec = gram / sigma2[..., None, None]              # Sigma_k^{-1}
+    A = jnp.sum(prec, axis=1)                          # (S, F, F)
+    b = jnp.einsum("skfg,skg->sf", prec, coef_k, optimize=True)
+    # each gram already carries the HR ridge, so A is a sum of SPD terms;
+    # solve_dense routes to the backend-stable LU (ops/solve.py)
+    comb = solve_dense(A, b)
+    return {"coef": comb, "mean": mean, "sigma2": pooled}
+
+
+def combine_estimates(model: str, stats: dict, n_windows: int) -> dict:
+    """One batched WLS combine through the AOT executable store.
+
+    Keyed ``windowed_combine:<model>`` so the compile-time cost capture
+    rooflines it in ``/debug/cost`` alongside the window-fit dispatch.
+    """
+    entry = f"windowed_combine:{model}"
+    tracer = get_tracer()
+    B = int(stats["coef"].shape[0])
+    with tracer.span(
+        "windowed.combine",
+        model=model,
+        rows=B,
+        n_windows=int(n_windows),
+    ):
+        with device_annotation(entry):
+            return aot_call(
+                entry,
+                wls_combine,
+                args=(stats,),
+                static_kwargs={"n_windows": int(n_windows)},
+            )

@@ -11,9 +11,12 @@ Three solvers exist for that shape, and the roofline says which wins:
   refit ALWAYS runs here regardless of how candidates were scored.
 - ``pscan``: associative parallel prefix over affine maps
   (ops/pscan.py).  O(log T) depth at O(d) extra FLOPs — a win only on an
-  accelerator with idle lanes AND very long series.  Measured 50-100x
-  SLOWER than scan on CPU (BENCH_r05, re-confirmed by the bench.py
-  kernel probe), so the heuristic never picks it off-TPU.
+  accelerator with idle lanes AND very long series.  Measured x153
+  SLOWER than scan on CPU (bench.py kernel probe, r07, at S=8 T=2048
+  12 lanes; BENCH_r05 first put it at 50-100x), so the heuristic never
+  picks it off-TPU.  In the windowed regime (engine/windowed.py) the
+  per-dispatch time axis is the window length, not the raw history
+  length, so ultra-long T never reaches pscan's long-series tier.
 - ``pallas``: a hand-fused Pallas TPU kernel for the candidate-SCORING
   pass only (:func:`hw_score`).  It keeps the (level, trend, season)
   carry in VMEM registers across the whole time loop instead of
@@ -54,6 +57,28 @@ def _pallas_available() -> bool:
         return False
 
 
+def _effective_scan_time(n_time: int) -> int:
+    """Time axis a single dispatch will actually scan at history length T.
+
+    Above the windowed auto-activation threshold the fit runs as batched
+    windows of length W (engine/windowed.py), so the serial depth any
+    solver sees is W — that is the length the pscan tier must judge.
+    Falls back to the raw T when the windowed engine is unavailable or
+    inactive.
+    """
+    try:
+        from distributed_forecasting_tpu.engine.windowed import (
+            should_window,
+            windowed_config,
+        )
+    except Exception:  # pragma: no cover - engine always importable in-tree
+        return n_time
+    cfg = windowed_config()
+    if should_window(n_time, cfg):
+        return cfg.window_len
+    return n_time
+
+
 def select_filter(backend: str, n_series: int, n_time: int,
                   lanes: int = 1) -> str:
     """Pick the time-recurrence solver for a (backend, S, T, lanes) shape.
@@ -63,13 +88,23 @@ def select_filter(backend: str, n_series: int, n_time: int,
     below MXU saturation, TPU only).  On TPU everything else takes the
     fused pallas scoring kernel — the state-in-VMEM fusion wins across
     the short-T regime where pscan's prefix tree never amortizes.  Off
-    TPU the answer is always ``'scan'``: pscan is 50-100x slower on CPU
-    (BENCH_r05 + bench.py kernel probe) and the pallas kernel would run
-    in interpret mode, which is an emulator, not an optimization.
+    TPU the answer is always ``'scan'``: pscan measured x153 slower on
+    CPU (bench.py kernel probe, r07; BENCH_r05 first put it at 50-100x)
+    and the pallas kernel would run in interpret mode, which is an
+    emulator, not an optimization.
+
+    Windowed tier: when the history is long enough that the windowed
+    estimator auto-activates (engine/windowed.py), the time axis any
+    single dispatch actually scans is the window length W, not the raw
+    T — the long series arrives as ceil(T/stride) batched windows.  The
+    pscan tier is therefore evaluated at that effective length, so
+    'auto' never picks pscan for a T that windowing will split below
+    ``_PSCAN_MIN_TIME`` anyway.
     """
     if backend != "tpu":
         return "scan"
-    if prefer_pscan(backend, n_series, n_time, lanes=lanes):
+    if prefer_pscan(backend, n_series, _effective_scan_time(n_time),
+                    lanes=lanes):
         return "pscan"
     if _pallas_available():
         return "pallas"

@@ -216,16 +216,20 @@ def prefer_pscan(backend: str, n_series: int, n_time: int,
     per-series parallelism (e.g. grid-search candidates) vmapped alongside.
 
     The prefix trades O(T d^2) FLOPs for O(T d^3) at O(log T) depth — a win
-    only where depth, not FLOPs, bounds wall time.  BENCH_r05 measured
-    pscan at x0.01-0.02 of scan throughput on CPU in BOTH the short-T and
-    long-T regimes (a CPU has no idle lanes for the extra matmul factor);
-    the bench.py kernel probe re-confirms it every round (r07: x153
-    slower at S=8, T=2048, 12 lanes), so anything but an accelerator
-    always scans.  On TPU the prefix needs long series (serial depth
-    dominating) AND few enough total batch lanes that the MXU is not
-    already saturated by the series axis.  This is one tier of
-    ``ops/fused_scan.select_filter``, which adds the fused-pallas tier
-    above it — callers picking a solver should go through that.
+    only where depth, not FLOPs, bounds wall time.  On CPU it loses in
+    BOTH the short-T and long-T regimes (a CPU has no idle lanes for the
+    extra matmul factor): BENCH_r05 first measured x0.01-0.02 of scan
+    throughput, and the bench.py kernel probe re-measures every round —
+    r07 pinned it at x153 slower (S=8, T=2048, 12 lanes), i.e. ~x0.007
+    throughput, worse than the original estimate.  So anything but an
+    accelerator always scans.  On TPU the prefix needs long series
+    (serial depth dominating) AND few enough total batch lanes that the
+    MXU is not already saturated by the series axis.  Note the windowed
+    estimator (engine/windowed.py) caps the per-dispatch time axis at
+    the window length for ultra-long histories; callers should pass the
+    length actually scanned, as ``ops/fused_scan.select_filter`` does.
+    This is one tier of ``select_filter``, which adds the fused-pallas
+    tier above it — callers picking a solver should go through that.
     """
     if backend != "tpu":
         return False

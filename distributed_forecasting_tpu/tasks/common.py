@@ -131,6 +131,30 @@ class Task(ABC):
             )
 
             configure_precision(PrecisionConfig.from_conf(pr))
+        # Window-parallel fitting for ultra-long series (engine/windowed.py,
+        # DARIMA split-and-combine) — armed here so fit_forecast's
+        # auto-activation sees it before any fit in launch():
+        #
+        #     engine:
+        #       windowed:
+        #         enabled: false
+        #         window_len: 8192         # W, periods per window
+        #         overlap: 256             # shared periods between windows
+        #         min_windows: 4           # auto-activates at W*min_windows
+        eng = self.conf.get("engine") if isinstance(self.conf, dict) else None
+        if eng is not None:
+            known_eng = {"windowed"}
+            unknown_eng = set(eng) - known_eng
+            if unknown_eng:
+                raise ValueError(
+                    f"unknown engine conf key(s) {sorted(unknown_eng)}; "
+                    f"valid: {sorted(known_eng)}")
+            if eng.get("windowed") is not None:
+                from distributed_forecasting_tpu.engine.windowed import (
+                    configure_windowed,
+                )
+
+                configure_windowed(eng["windowed"])
 
     # lazy infra handles ----------------------------------------------------
     @property

@@ -109,6 +109,8 @@ def collect(workdir: str, reps: int = 20, expect_warm: bool = False) -> Dict:
     params, _ = fit_forecast(batch, model="prophet", config=cfg, horizon=30)
     fc = BatchForecaster.from_fit(batch, params, "prophet", cfg)
 
+    windowed = _windowed_section(workdir)
+
     req = pd.DataFrame({"store": [1, 1, 2], "item": [1, 2, 3]})
     out = fc.predict(req, horizon=30)  # warmup: compile or store-load
     samples = []
@@ -156,8 +158,50 @@ def collect(workdir: str, reps: int = 20, expect_warm: bool = False) -> Dict:
             "max": round(samples[-1] * 1e3, 3),
         },
         "throughput_rows_per_s": round(rows_per_dispatch / p50, 1),
+        "windowed": windowed,
         "output_sha256": hashlib.sha256(
             out.to_csv(index=False).encode()).hexdigest(),
+    }
+
+
+def _windowed_section(workdir: str) -> Dict:
+    """Exercise the DARIMA windowed-fit entrypoints through the AOT cache.
+
+    A miniature ultra-long fit (the real regime is T~10^5-10^6; this is a
+    cost fingerprint, not a perf number) drives ``windowed_fit:arima``,
+    ``windowed_combine:arima``, and ``windowed_finalize:arima`` so their
+    compiled-program costs land in the same per-entry registry the diff
+    side gates — a change that silently fattens the window-stats kernel or
+    the WLS solve fails CI exactly like any serving-path program would.
+    The forecast sha gives the cold-vs-warm output-identity check for the
+    windowed path (:func:`diff_records`' ``windowed_output_hash``)."""
+    import numpy as np
+
+    from distributed_forecasting_tpu.data import (
+        synthetic_store_item_sales,
+        tensorize,
+    )
+    from distributed_forecasting_tpu.engine.windowed import (
+        WindowedConfig,
+        windowed_fit_forecast,
+    )
+    from distributed_forecasting_tpu.models.arima import ArimaConfig
+
+    wcfg = WindowedConfig(enabled=True, window_len=256, overlap=32,
+                          min_windows=2)
+    df = synthetic_store_item_sales(
+        n_stores=2, n_items=3, n_days=1024, seed=7)
+    batch = tensorize(df)
+    _, res = windowed_fit_forecast(
+        batch, model="arima", config=ArimaConfig(), horizon=30,
+        wconfig=wcfg)
+    return {
+        "workload": {"n_series": batch.n_series, "n_days": batch.n_time,
+                     "window_len": wcfg.window_len, "overlap": wcfg.overlap,
+                     "horizon": 30},
+        "all_ok": bool(res.ok.all()),
+        "output_sha256": hashlib.sha256(
+            np.asarray(res.yhat, np.float32).tobytes()).hexdigest(),
     }
 
 
@@ -352,6 +396,22 @@ def diff_records(baseline: Dict, current: Dict,
                 "output_hash", "ok",
                 f"cold and warm runs served byte-identical frames "
                 f"({(a or '?')[:12]})"))
+        wa = (cold.get("windowed") or {}).get("output_sha256")
+        wb = (current.get("windowed") or {}).get("output_sha256")
+        if wa and wb and wa != wb:
+            findings.append(_finding(
+                "windowed_output_hash", "fail",
+                f"cold-run windowed forecast {wa[:12]} != warm-run "
+                f"{wb[:12]}: the AOT cache changed what the windowed "
+                f"estimator serves"))
+        elif wa or wb:
+            findings.append(_finding(
+                "windowed_output_hash",
+                "ok" if (wa and wb) else "warn",
+                f"windowed forecasts byte-identical cold vs warm "
+                f"({(wb or wa or '?')[:12]})" if (wa and wb) else
+                "windowed section present in only one record (older "
+                "perf_report on the other side?); hash check skipped"))
     return findings
 
 

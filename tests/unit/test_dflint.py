@@ -547,9 +547,98 @@ def test_config_drift_monitoring_cost_block_clean(tmp_path):
     assert _lint(tmp_path, "monitoring/cost.py") == []
 
 
-# ---------------------------------------------------------------------------
-# host-reuse-after-donation
-# ---------------------------------------------------------------------------
+def test_config_drift_engine_windowed_block(tmp_path):
+    # the engine.windowed conf block (conf/tasks/train_config.yml): its
+    # keys are WindowedConfig dataclass fields, so a typo'd key
+    # (windw_len) is drift while the real spelling passes
+    _write(tmp_path, "conf/train.yml", """
+        engine:
+          windowed:
+            enabled: true
+            windw_len: 8192
+            overlap: 256
+            min_windows: 4
+    """)
+    _write(tmp_path, "engine/windowed.py", """
+        import dataclasses
+
+        @dataclasses.dataclass(frozen=True)
+        class WindowedConfig:
+            enabled: bool = False
+            window_len: int = 8192
+            overlap: int = 256
+            min_windows: int = 4
+
+            @classmethod
+            def from_conf(cls, conf):
+                return cls(**(conf or {}))
+
+        def build(conf):
+            return WindowedConfig.from_conf(
+                (conf.get("engine") or {}).get("windowed"))
+    """)
+    found = _lint(tmp_path, "engine/windowed.py")
+    assert [f.rule for f in found] == ["config-drift"]
+    assert "windw_len" in found[0].message
+    assert found[0].path == "conf/train.yml"
+
+
+def test_config_drift_engine_windowed_block_clean(tmp_path):
+    _write(tmp_path, "conf/train.yml", """
+        engine:
+          windowed:
+            enabled: false
+            window_len: 8192
+            overlap: 256
+            min_windows: 4
+    """)
+    _write(tmp_path, "engine/windowed.py", """
+        import dataclasses
+
+        @dataclasses.dataclass(frozen=True)
+        class WindowedConfig:
+            enabled: bool = False
+            window_len: int = 8192
+            overlap: int = 256
+            min_windows: int = 4
+
+            @classmethod
+            def from_conf(cls, conf):
+                return cls(**(conf or {}))
+
+        def build(conf):
+            return WindowedConfig.from_conf(
+                (conf.get("engine") or {}).get("windowed"))
+    """)
+    assert _lint(tmp_path, "engine/windowed.py") == []
+
+
+def test_host_sync_windowed_combine_path(tmp_path):
+    # the WLS combine (ops/combine.py) is a hot dispatch between the
+    # window-fit and finalize entrypoints: a host pull of the combined
+    # coefficients inside the jitted solve serializes the whole windowed
+    # pipeline and must be flagged; the same solve returning its arrays
+    # stays quiet
+    _write(tmp_path, "ops/combine.py", """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def wls_combine_leaky(gram, coef):
+            prec = jnp.sum(gram, axis=1)
+            b = jnp.einsum("skfg,skg->sf", gram, coef)
+            comb = jnp.linalg.solve(prec, b)
+            return float(comb[0, 0])
+
+        @jax.jit
+        def wls_combine(gram, coef):
+            prec = jnp.sum(gram, axis=1)
+            b = jnp.einsum("skfg,skg->sf", gram, coef)
+            return jnp.linalg.solve(prec, b)
+    """)
+    found = _lint(tmp_path, "ops/combine.py")
+    assert [f.rule for f in found] == ["host-sync-in-hot-path"]
+    assert "wls_combine_leaky" in found[0].message or found[0].line
 
 def test_donation_reuse_positive_aot_call(tmp_path):
     _write(tmp_path, "engine/upd.py", """
