@@ -41,6 +41,12 @@ _HOST_CALLS = {
     "jax.device_get": "pulls the value to host",
     "numpy.asarray": "materializes a device array on host",
     "numpy.array": "materializes a device array on host",
+    # a failpoint site inside traced code would run at TRACE time (once
+    # per compile, never per call) AND takes the registry lock + PRNG on
+    # host — fault sites belong on the host-side orchestration path only
+    "distributed_forecasting_tpu.monitoring.failpoints.failpoint":
+        "evaluates a host-side failpoint registry (lock + PRNG) at trace "
+        "time",
 }
 
 _HOST_METHODS = ("item", "tolist")

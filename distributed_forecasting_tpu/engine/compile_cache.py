@@ -61,6 +61,10 @@ from typing import Any, Callable, Dict, Optional
 
 import jax
 
+from distributed_forecasting_tpu.monitoring.failpoints import (
+    failpoint,
+    failpoint_data,
+)
 from distributed_forecasting_tpu.monitoring.monitor import MetricsRegistry
 from distributed_forecasting_tpu.monitoring.trace import get_tracer
 from distributed_forecasting_tpu.utils import get_logger
@@ -100,7 +104,8 @@ _compile_seconds = _registry.histogram(
 # label-value escaping
 _entry_requests = _registry.labeled_counter(
     "compile_cache_entry_requests_total", ("entry", "outcome"),
-    "AOT store lookups per entry point, by outcome (memo | hit | miss)")
+    "AOT store lookups per entry point, by outcome "
+    "(memo | hit | miss | error)")
 
 
 def metrics_registry() -> MetricsRegistry:
@@ -332,11 +337,16 @@ class AOTStore:
 
         t0 = time.perf_counter()
         try:
+            # inside the try so injected faults exercise the same
+            # discard-and-fall-through path a real corrupt entry does
+            failpoint("aot.load")
             with open(path, "rb") as f:
                 record = pickle.load(f)
             if record.get("format") != _FORMAT_VERSION:
                 raise ValueError(f"store format {record.get('format')!r}")
-            payload = record["payload"]
+            # data site: "corrupt"/"corrupt truncate" mangle the payload
+            # right where bit rot would land, upstream of the sha check
+            payload = failpoint_data("aot.load.payload", record["payload"])
             if hashlib.sha256(payload).hexdigest() != record["sha256"]:
                 raise ValueError("payload checksum mismatch")
             compiled = serialize_executable.deserialize_and_load(
@@ -378,6 +388,7 @@ class AOTStore:
         from jax.experimental import serialize_executable
 
         try:
+            failpoint("aot.store")
             payload, in_tree, out_tree = serialize_executable.serialize(
                 compiled)
             record = {
@@ -470,6 +481,11 @@ class AOTStore:
                 span.set_attribute("outcome", "memo")
                 _entry_requests.inc(entry=entry, outcome="memo")
                 return compiled
+            # an entry that EXISTED but failed to load (corruption, version
+            # skew) is an "error" outcome, not a plain miss — the distinct
+            # label is what lets an operator see silent bit rot in a store
+            # that still ends up serving every request via recompile
+            present = self._find(key) is not None
             with tracer.span("aot.load", entry=entry):
                 compiled = self.load(key)
             if compiled is not None:
@@ -478,8 +494,9 @@ class AOTStore:
                 _entry_requests.inc(entry=entry, outcome="hit")
             else:
                 _misses.inc()
-                span.set_attribute("outcome", "miss")
-                _entry_requests.inc(entry=entry, outcome="miss")
+                outcome = "error" if present else "miss"
+                span.set_attribute("outcome", outcome)
+                _entry_requests.inc(entry=entry, outcome=outcome)
                 t0 = time.perf_counter()
                 with tracer.span("aot.compile", entry=entry):
                     result = compile_fn()

@@ -46,6 +46,7 @@ import numpy as np
 
 from distributed_forecasting_tpu.engine.compile_cache import donated_variant
 from distributed_forecasting_tpu.models.base import get_model
+from distributed_forecasting_tpu.monitoring.failpoints import failpoint
 from distributed_forecasting_tpu.monitoring.trace import get_tracer
 from distributed_forecasting_tpu.ops.update import apply_update, column_bucket
 from distributed_forecasting_tpu.utils import get_logger
@@ -249,6 +250,10 @@ class SeriesStateStore:
                 self._aux = aux2
                 self._day_cur = max_day
                 self._applied_since_refit += n_points
+            # fault site between the store's commit and the forecaster's:
+            # a crash HERE is the worst apply-path moment (store advanced,
+            # serving snapshot not yet swapped) — what WAL replay must heal
+            failpoint("state.swap")
             self._fc.swap_state(params=params2, day1=max_day)
             if self.metrics is not None:
                 self.metrics.update_seconds.observe(time.monotonic() - t0)
@@ -330,6 +335,9 @@ class SeriesStateStore:
 
     def _install_refit(self, state) -> None:
         """Replay-and-swap under ``_apply_gate`` (caller holds it)."""
+        # fault site before any mutation: an injected failure leaves the
+        # last-good state fully installed, the invariant chaos asserts
+        failpoint("refit.install")
         day_snap = int(state["day_snap"])
         params = state["params"]
         t_snap = day_snap - self.day0 + 1

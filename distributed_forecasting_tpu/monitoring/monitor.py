@@ -1017,3 +1017,11 @@ class IngestMetrics:
         self.refit_seconds = self.registry.histogram(
             "dftpu_ingest_refit_seconds", _STAGE_BUCKETS,
             "wall seconds per background full refit (fit + replay + swap)")
+        self.ingest_shutdown_stuck_total = self.registry.counter(
+            "dftpu_ingest_shutdown_stuck_total",
+            "shutdowns where the WAL follower thread outlived its join "
+            "timeout and was leaked (daemon) instead of drained")
+        self.refit_shutdown_stuck_total = self.registry.counter(
+            "dftpu_refit_shutdown_stuck_total",
+            "shutdowns where the refit scheduler thread outlived its join "
+            "timeout and was leaked (daemon) instead of drained")

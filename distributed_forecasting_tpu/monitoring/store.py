@@ -47,6 +47,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
+from distributed_forecasting_tpu.monitoring.failpoints import failpoint
 from distributed_forecasting_tpu.utils import get_logger
 
 _SEG_RE = re.compile(r"^seg-(\d{8})\.jsonl$")
@@ -87,6 +88,7 @@ def read_segments_from(
     are single ``O_APPEND`` writes of whole lines, reads consume whole
     lines, and the pair is torn-line tolerant end to end.
     """
+    failpoint("wal.read")
     new_cursor = dict(cursor or {})
     lines: List[str] = []
     for idx in segment_indices(directory):
@@ -207,6 +209,7 @@ class TimeSeriesStore:
                 self._seg_bytes = 0
             path = self._seg_path(self._seg)
             self._seg_bytes += len(payload)
+        failpoint("store.append")
         fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
         try:
             os.write(fd, payload)

@@ -95,6 +95,10 @@ class ServingMetrics:
             "requests that exceeded request_timeout_s (HTTP 503)")
         self.errors = r.counter(
             "serving_errors_total", "requests that failed with HTTP 500")
+        self.deadline_shed = r.counter(
+            "serving_deadline_shed_total",
+            "requests shed before dispatch because their X-Deadline-Ms "
+            "budget was already exhausted (HTTP 503)")
         self.dispatches = r.counter(
             "serving_dispatches_total",
             "forecaster predict calls (coalesced device dispatches)")

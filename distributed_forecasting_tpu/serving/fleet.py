@@ -41,8 +41,16 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, List, Optional
 
+from distributed_forecasting_tpu.monitoring.failpoints import failpoint
 from distributed_forecasting_tpu.monitoring.monitor import MetricsRegistry
 from distributed_forecasting_tpu.monitoring.trace import get_tracer
+from distributed_forecasting_tpu.serving.resilience import (
+    CircuitBreaker,
+    LatencyReservoir,
+    ResilienceConfig,
+    deadline_from_headers,
+    remaining_ms,
+)
 from distributed_forecasting_tpu.serving.sharding import (
     ShardingConfig,
     TokenBucket,
@@ -440,9 +448,19 @@ class FleetSupervisor:
 
     def __init__(self, config: FleetConfig, spawn_fn: SpawnFn,
                  sharding: Optional[ShardingConfig] = None,
-                 key_names: Optional[tuple] = None):
+                 key_names: Optional[tuple] = None,
+                 resilience: Optional[ResilienceConfig] = None,
+                 request_timeout_s: Optional[float] = None):
         self._config = config
         self._spawn = spawn_fn
+        self.resilience = resilience or ResilienceConfig()
+        # satellite of the deadline work: every forwarded leg gets an
+        # explicit timeout bounded by the replica's own request timeout
+        # (plus slack for transport), so a hung socket can no longer pin
+        # a front-door worker for the full proxy_timeout_s
+        self.request_timeout_s = request_timeout_s
+        self._breakers: dict = {}       # port -> CircuitBreaker, under _lock
+        self.leg_latency = LatencyReservoir()
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._poll_thread: Optional[threading.Thread] = None
@@ -511,6 +529,27 @@ class FleetSupervisor:
             "dftpu_shard_quota_rejected_total",
             "requests rejected 429 by per-tenant admission at the front "
             "door")
+        self._g_breaker = self.registry.labeled_gauge(
+            "dftpu_fleet_breaker_state", ("port",),
+            "per-replica circuit breaker state "
+            "(0 closed / 1 open / 2 half-open)")
+        self._c_breaker_open = self.registry.counter(
+            "dftpu_fleet_breaker_skipped_total",
+            "forward attempts skipped because the replica's breaker was "
+            "open")
+        self._c_deadline_exhausted = self.registry.counter(
+            "dftpu_fleet_deadline_exhausted_total",
+            "requests shed at the front door with their deadline budget "
+            "spent (HTTP 503)")
+        self._c_hedges = self.registry.counter(
+            "dftpu_fleet_hedges_total",
+            "duplicate scatter legs fired after the hedge delay")
+        self._c_hedge_wins = self.registry.counter(
+            "dftpu_fleet_hedge_wins_total",
+            "scatter legs where the hedged duplicate answered first")
+        self._c_hedge_cancelled = self.registry.counter(
+            "dftpu_fleet_hedge_cancelled_total",
+            "losing duplicate legs discarded after first-response-wins")
         self._g_total.set(config.replicas)
 
     # -- introspection (snapshot under lock, return plain data) -------------
@@ -592,6 +631,90 @@ class FleetSupervisor:
             for r in self._replicas:
                 if r.port == port:
                     r.ready = False
+
+    # -- circuit breakers + deadline budgets ---------------------------------
+    def breaker_for(self, port: int) -> Optional[CircuitBreaker]:
+        """The port's breaker (created lazily), or None when disabled."""
+        res = self.resilience
+        if res.breaker_failures < 1:
+            return None
+        with self._lock:
+            br = self._breakers.get(port)
+            if br is None:
+                br = CircuitBreaker(
+                    res.breaker_failures, res.breaker_open_s,
+                    slow_s=res.breaker_slow_s)
+                self._breakers[port] = br
+        return br
+
+    def breaker_allow(self, port: int) -> bool:
+        """Routing gate: False ejects the port from this attempt.  Every
+        True MUST be followed by breaker_success/breaker_failure, or a
+        half-open probe slot stays claimed forever."""
+        br = self.breaker_for(port)
+        if br is None:
+            return True
+        ok = br.allow()
+        if not ok:
+            self._c_breaker_open.inc()
+        self._g_breaker.set(br.state, port=str(port))
+        return ok
+
+    def breaker_success(self, port: int, elapsed_s: float) -> None:
+        self.leg_latency.observe(elapsed_s)
+        br = self.breaker_for(port)
+        if br is not None:
+            br.record_success(elapsed_s)
+            self._g_breaker.set(br.state, port=str(port))
+
+    def breaker_failure(self, port: int) -> None:
+        br = self.breaker_for(port)
+        if br is not None:
+            br.record_failure()
+            self._g_breaker.set(br.state, port=str(port))
+
+    def request_deadline(self, headers) -> Optional[float]:
+        """Monotonic deadline for an incoming request (header or conf
+        default), or None when unbounded."""
+        return deadline_from_headers(
+            headers, self.resilience.default_deadline_ms)
+
+    def leg_timeout_s(self, deadline: Optional[float] = None) -> float:
+        """Socket timeout for one forwarded leg: the proxy cap, tightened
+        by the replica's own request timeout (+5s transport slack — we
+        wait for the replica's 503, not for a hung socket) and by the
+        request's remaining deadline budget."""
+        leg = self._config.proxy_timeout_s
+        if self.request_timeout_s is not None:
+            leg = min(leg, self.request_timeout_s + 5.0)
+        rem = remaining_ms(deadline)
+        if rem is not None:
+            leg = min(leg, max(
+                rem / 1000.0,
+                self.resilience.min_leg_timeout_ms / 1000.0))
+        return leg
+
+    def hedge_delay_s(self) -> float:
+        """How long a scatter leg may stay silent before its duplicate
+        fires: the conf's fixed delay, or the observed leg p95."""
+        res = self.resilience
+        if res.hedge_delay_ms > 0:
+            return res.hedge_delay_ms / 1000.0
+        floor = res.hedge_min_delay_ms / 1000.0
+        p95 = self.leg_latency.p95()
+        return max(p95, floor) if p95 is not None else floor
+
+    def note_deadline_exhausted(self) -> None:
+        self._c_deadline_exhausted.inc()
+
+    def note_hedge(self) -> None:
+        self._c_hedges.inc()
+
+    def note_hedge_win(self) -> None:
+        self._c_hedge_wins.inc()
+
+    def note_hedge_cancelled(self) -> None:
+        self._c_hedge_cancelled.inc()
 
     def note_retry(self) -> None:
         self._c_retries.inc()
@@ -844,6 +967,12 @@ class FleetSupervisor:
 
 # -- the front door ----------------------------------------------------------
 
+class _DeadlineExhausted(Exception):
+    """A request's deadline budget ran out inside the front door — the
+    routing loops raise it so every caller converges on one distinct 503
+    (shed, not "no ready replica")."""
+
+
 class _FrontDoorHandler(BaseHTTPRequestHandler):
     server_version = "dftpu-fleet/1.0"
 
@@ -876,15 +1005,32 @@ class _FrontDoorHandler(BaseHTTPRequestHandler):
             self._metrics()
         else:
             # /health, /schema, ... answer the same on any replica
-            self._proxy("GET", None)
+            self._proxy("GET", None, sup.request_deadline(self.headers))
+
+    def _send_deadline_shed(self) -> None:
+        self.server.supervisor.note_deadline_exhausted()
+        self._send_json(
+            503,
+            {"error": "deadline budget exhausted",
+             "detail": "the request's X-Deadline-Ms budget ran out before "
+                       "a replica answered; retry with a larger budget"},
+            extra_headers=(("Retry-After", "1"),))
 
     def do_POST(self):
+        sup = self.server.supervisor
+        deadline = sup.request_deadline(self.headers)
+        rem = remaining_ms(deadline)
+        if rem is not None and rem <= 0:
+            # shed before reading the body: exhausted work gets its
+            # terminal status immediately instead of a doomed forward
+            self._send_deadline_shed()
+            return
         length = int(self.headers.get("Content-Length", "0"))
         body = self.rfile.read(length)
-        if self.server.supervisor.sharding is not None:
-            if self._routed_post(body):
+        if sup.sharding is not None:
+            if self._routed_post(body, deadline):
                 return
-        self._proxy("POST", body)
+        self._proxy("POST", body, deadline)
 
     def _metrics(self) -> None:
         sup = self.server.supervisor
@@ -905,12 +1051,23 @@ class _FrontDoorHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
-    def _forward(self, host: int, port: int, method: str, body):
+    def _forward(self, host: int, port: int, method: str, body,
+                 deadline: Optional[float] = None):
+        sup = self.server.supervisor
+        # fault site for EVERY front-door -> replica leg: an injected
+        # OSError takes the callers' report-failure-and-retry path, an
+        # injected sleep models a hung socket against the leg timeout
+        failpoint("fleet.forward")
         conn = http.client.HTTPConnection(
-            host, port, timeout=self.server.supervisor.config.proxy_timeout_s)
+            host, port, timeout=sup.leg_timeout_s(deadline))
         try:
             headers = {"Content-Type": self.headers.get(
                 "Content-Type", "application/json")} if body is not None else {}
+            rem = remaining_ms(deadline)
+            if rem is not None:
+                # the remaining budget travels downstream; a replica that
+                # receives <= 0 sheds before dispatch (serving/server.py)
+                headers["X-Deadline-Ms"] = str(int(rem))
             conn.request(method, self.path, body=body, headers=headers)
             resp = conn.getresponse()
             return resp.status, resp.getheader(
@@ -950,33 +1107,48 @@ class _FrontDoorHandler(BaseHTTPRequestHandler):
                 return names
         return None
 
-    def _forward_with_retry(self, ports_fn, method: str, body):
+    def _forward_with_retry(self, ports_fn, method: str, body,
+                            deadline: Optional[float] = None):
         """Retry-on-next-port over ``ports_fn()`` until the retry window
-        closes.  Returns ``(status, ctype, payload, port)`` or ``None`` —
-        unlike :meth:`_proxy` it never writes the response itself, so
-        scatter threads can call it concurrently."""
+        (or the request's deadline budget) closes.  Returns ``(status,
+        ctype, payload, port)`` or ``None`` — unlike :meth:`_proxy` it
+        never writes the response itself, so scatter threads can call it
+        concurrently.  Raises :class:`_DeadlineExhausted` when the budget
+        runs out with no response."""
         sup = self.server.supervisor
         cfg = sup.config
-        deadline = time.monotonic() + cfg.retry_window_s
+        window = time.monotonic() + cfg.retry_window_s
         attempts = 0
         while True:
             for port in ports_fn():
+                rem = remaining_ms(deadline)
+                if rem is not None and rem <= 0:
+                    raise _DeadlineExhausted()
+                if not sup.breaker_allow(port):
+                    continue
                 attempts += 1
                 if attempts > 1:
                     sup.note_retry()
+                t0 = time.monotonic()
                 try:
                     status, ctype, payload = self._forward(
-                        cfg.replica_host, port, method, body)
+                        cfg.replica_host, port, method, body,
+                        deadline=deadline)
                 except (OSError, http.client.HTTPException):
+                    sup.breaker_failure(port)
                     sup.report_failure(port)
                     continue
+                sup.breaker_success(port, time.monotonic() - t0)
                 return status, ctype, payload, port
-            if time.monotonic() >= deadline:
+            rem = remaining_ms(deadline)
+            if rem is not None and rem <= 0:
+                raise _DeadlineExhausted()
+            if time.monotonic() >= window:
                 return None
             # no ready owner right now; wait for the poll loop's hand-off
             time.sleep(0.05)
 
-    def _routed_post(self, body) -> bool:
+    def _routed_post(self, body, deadline: Optional[float] = None) -> bool:
         """Shard-route a POST.  Returns True when the request was fully
         handled here; False falls back to round-robin ``_proxy`` (body not
         shard-plannable: unknown path, missing key columns, non-JSON)."""
@@ -1012,10 +1184,11 @@ class _FrontDoorHandler(BaseHTTPRequestHandler):
                         extra_headers=(("Retry-After", "1"),))
                     return True
         if len(plan.shards) == 1:
-            return self._routed_single(plan, body)
-        return self._scatter(plan, parsed, tid)
+            return self._routed_single(plan, body, deadline)
+        return self._scatter(plan, parsed, tid, deadline)
 
-    def _routed_single(self, plan, body) -> bool:
+    def _routed_single(self, plan, body,
+                       deadline: Optional[float] = None) -> bool:
         """Single-shard fast path: the original body forwards VERBATIM to
         an owning replica, so the client sees that replica's exact bytes —
         the round-robin path's contract, now shard-aware."""
@@ -1030,8 +1203,13 @@ class _FrontDoorHandler(BaseHTTPRequestHandler):
                            "replica; retry after rebalance"},
                 extra_headers=(("Retry-After", "1"),))
             return True
-        res = self._forward_with_retry(
-            lambda: sup.owner_rotation(shard), "POST", body)
+        try:
+            res = self._forward_with_retry(
+                lambda: sup.owner_rotation(shard), "POST", body,
+                deadline=deadline)
+        except _DeadlineExhausted:
+            self._send_deadline_shed()
+            return True
         if res is None:
             sup.note_unrouted()
             self._send_json(
@@ -1050,7 +1228,68 @@ class _FrontDoorHandler(BaseHTTPRequestHandler):
         self.wfile.write(payload)
         return True
 
-    def _scatter(self, plan, parsed: dict, tid) -> bool:
+    def _hedged_forward(self, ports_fn, method: str, body,
+                        deadline: Optional[float] = None):
+        """First-response-wins over a primary leg and (after the hedge
+        delay) a duplicate to the next owner.  Same return contract as
+        :meth:`_forward_with_retry`, which is also the fallback when
+        hedging is off, fewer than two owners are up, or both legs die.
+        The losing duplicate is counted and discarded, never awaited —
+        its thread still reports its breaker outcome when it lands."""
+        sup = self.server.supervisor
+        cfg = sup.config
+        if not sup.resilience.hedge_enabled:
+            return self._forward_with_retry(ports_fn, method, body,
+                                            deadline=deadline)
+        ports = ports_fn()
+        if len(ports) < 2:
+            return self._forward_with_retry(ports_fn, method, body,
+                                            deadline=deadline)
+        done = threading.Event()
+        lock = threading.Lock()
+        winner: list = []
+
+        def leg(port: int, is_hedge: bool):
+            t0 = time.monotonic()
+            try:
+                status, ctype, payload = self._forward(
+                    cfg.replica_host, port, method, body, deadline=deadline)
+            except (OSError, http.client.HTTPException):
+                sup.breaker_failure(port)
+                sup.report_failure(port)
+                return
+            sup.breaker_success(port, time.monotonic() - t0)
+            with lock:
+                if winner:
+                    # the race is over: this duplicate's answer is
+                    # discarded (the replica already did the work; predict
+                    # is idempotent, so discarding is safe)
+                    sup.note_hedge_cancelled()
+                    return
+                winner.append((status, ctype, payload, port, is_hedge))
+            done.set()
+
+        threading.Thread(
+            target=leg, args=(ports[0], False), daemon=True).start()
+        if not done.wait(sup.hedge_delay_s()):
+            sup.note_hedge()
+            threading.Thread(
+                target=leg, args=(ports[1], True), daemon=True).start()
+        done.wait(sup.leg_timeout_s(deadline))
+        with lock:
+            res = winner[0] if winner else None
+        if res is None:
+            # both legs failed or are still hung: the classic retry loop
+            # owns the remaining window (and the deadline bookkeeping)
+            return self._forward_with_retry(ports_fn, method, body,
+                                            deadline=deadline)
+        status, ctype, payload, port, is_hedge = res
+        if is_hedge:
+            sup.note_hedge_win()
+        return status, ctype, payload, port
+
+    def _scatter(self, plan, parsed: dict, tid,
+                 deadline: Optional[float] = None) -> bool:
         """Fan a multi-shard request out to one owner per shard and merge.
 
         A failed shard degrades to per-key ``errors`` entries in the merged
@@ -1065,8 +1304,14 @@ class _FrontDoorHandler(BaseHTTPRequestHandler):
                 return 503, json.dumps(
                     {"error": "shard has no owner"}).encode()
             sub = json.dumps(plan.sub_body(parsed, shard)).encode()
-            res = self._forward_with_retry(
-                lambda: sup.owner_rotation(shard), "POST", sub)
+            try:
+                res = self._hedged_forward(
+                    lambda: sup.owner_rotation(shard), "POST", sub,
+                    deadline=deadline)
+            except _DeadlineExhausted:
+                sup.note_deadline_exhausted()
+                return 503, json.dumps(
+                    {"error": "deadline budget exhausted"}).encode()
             if res is None:
                 sup.note_unrouted()
                 return 503, json.dumps(
@@ -1099,7 +1344,8 @@ class _FrontDoorHandler(BaseHTTPRequestHandler):
         self._send_json(status, merged, extra_headers=tuple(headers))
         return True
 
-    def _proxy(self, method: str, body) -> None:
+    def _proxy(self, method: str, body,
+               deadline: Optional[float] = None) -> None:
         """Round-robin with retry-on-next-replica.
 
         Connection-level failures (refused/reset/timeout before a response
@@ -1107,24 +1353,37 @@ class _FrontDoorHandler(BaseHTTPRequestHandler):
         idempotent, so the request replays on the next ready replica and
         the client never sees the crash.  Application-level responses —
         including a replica's own 4xx/5xx — pass through untouched.
+        Replicas with an open circuit breaker are skipped exactly like
+        not-ready ones, and a spent deadline budget ends the loop with a
+        distinct 503 instead of more doomed attempts.
         """
         sup = self.server.supervisor
         cfg = sup.config
-        deadline = time.monotonic() + cfg.retry_window_s
+        window = time.monotonic() + cfg.retry_window_s
         attempts = 0
         last_err: Optional[str] = None
         while True:
             for port in sup.rotation():
+                rem = remaining_ms(deadline)
+                if rem is not None and rem <= 0:
+                    self._send_deadline_shed()
+                    return
+                if not sup.breaker_allow(port):
+                    continue
                 attempts += 1
                 if attempts > 1:
                     sup.note_retry()
+                t0 = time.monotonic()
                 try:
                     status, ctype, payload = self._forward(
-                        cfg.replica_host, port, method, body)
+                        cfg.replica_host, port, method, body,
+                        deadline=deadline)
                 except (OSError, http.client.HTTPException) as e:
+                    sup.breaker_failure(port)
                     sup.report_failure(port)
                     last_err = f"{type(e).__name__}: {e}"
                     continue
+                sup.breaker_success(port, time.monotonic() - t0)
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(payload)))
@@ -1132,7 +1391,11 @@ class _FrontDoorHandler(BaseHTTPRequestHandler):
                 self.end_headers()
                 self.wfile.write(payload)
                 return
-            if time.monotonic() >= deadline:
+            rem = remaining_ms(deadline)
+            if rem is not None and rem <= 0:
+                self._send_deadline_shed()
+                return
+            if time.monotonic() >= window:
                 break
             # no ready replica right now (all crashed or mid-restart):
             # wait for the supervisor's poll loop to bring one back
@@ -1168,6 +1431,7 @@ def start_fleet(
     wait: bool = True,
     sharding: Optional[ShardingConfig] = None,
     key_names: Optional[tuple] = None,
+    resilience: Optional[ResilienceConfig] = None,
 ):
     """Boot the whole subsystem: supervisor + replicas + front door.
 
@@ -1176,10 +1440,23 @@ def start_fleet(
     stop with ``front.shutdown(); supervisor.stop()``.  With ``sharding``
     the front door routes by series key instead of round-robinning
     (``key_names`` pre-seeds the routing schema; omitted, it is discovered
-    from a replica's ``/schema``).
+    from a replica's ``/schema``).  ``resilience`` arms the degradation
+    layer (deadline budgets, breakers, hedging) and — when its
+    ``failpoints`` spec is non-empty — the front door's OWN failpoint
+    registry (replica children arm via the ``DFTPU_FAILPOINTS`` env var
+    that tasks/fleet.py sets from the same conf block).
     """
     if sharding is not None and not sharding.enabled:
         sharding = None
+    if resilience is not None and resilience.failpoints:
+        from distributed_forecasting_tpu.monitoring import failpoints as _fp
+        _fp.configure(resilience.failpoints, seed=resilience.failpoint_seed)
+    # the replica's own request timeout bounds each forwarded leg
+    # (satellite: a hung replica socket must not pin a front-door worker)
+    request_timeout_s = None
+    batching = (serving_conf or {}).get("batching") or {}
+    if batching.get("request_timeout_s") is not None:
+        request_timeout_s = float(batching["request_timeout_s"])
     if spawn_fn is None:
         if artifact_dir is None:
             raise ValueError(
@@ -1189,7 +1466,9 @@ def start_fleet(
             config, artifact_dir, serving_conf, env_extra=env_extra,
             sharding=sharding)
     supervisor = FleetSupervisor(config, spawn_fn, sharding=sharding,
-                                 key_names=key_names)
+                                 key_names=key_names,
+                                 resilience=resilience,
+                                 request_timeout_s=request_timeout_s)
     supervisor.start()
     if wait and not supervisor.wait_ready(min_ready=1):
         supervisor.stop()

@@ -43,6 +43,7 @@ import pandas as pd
 
 from distributed_forecasting_tpu.data.tensorize import period_ordinals
 from distributed_forecasting_tpu.engine.state_store import SeriesStateStore
+from distributed_forecasting_tpu.monitoring.failpoints import failpoint
 from distributed_forecasting_tpu.monitoring.monitor import IngestMetrics
 from distributed_forecasting_tpu.monitoring.store import (
     read_segments_from,
@@ -51,6 +52,10 @@ from distributed_forecasting_tpu.monitoring.store import (
 )
 from distributed_forecasting_tpu.monitoring.trace import get_tracer
 from distributed_forecasting_tpu.utils import get_logger
+
+# How long stop() waits for the WAL follower before declaring the drain
+# stuck (module-level so tests can shrink it without a 10s wall stall).
+_JOIN_TIMEOUT_S = 10.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -128,13 +133,40 @@ class WriteAheadLog:
         os.makedirs(self.directory, exist_ok=True)
         idxs = segment_indices(self.directory)
         seg = idxs[-1] if idxs else 0
-        try:
-            seg_bytes = os.path.getsize(segment_path(self.directory, seg))
-        except OSError:
-            seg_bytes = 0
+        seg_bytes = self._seal_torn_tail(segment_path(self.directory, seg))
         self._lock = threading.Lock()  # segment-cursor bookkeeping ONLY
         self._seg = seg
         self._seg_bytes = seg_bytes
+
+    @staticmethod
+    def _seal_torn_tail(path: str) -> int:
+        """Recovery hygiene: if the live segment ends mid-line (the writer
+        was SIGKILLed inside its ``os.write``), append a newline BEFORE
+        this process's first append.  Without the seal, the new writer's
+        first line would glue onto the torn fragment into one undecodable
+        line and an acked batch would silently vanish on replay; with it,
+        the fragment becomes its own skippable junk line.  Returns the
+        segment's size (post-seal), the append cursor's starting point."""
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            return 0
+        if size == 0:
+            return 0
+        try:
+            with open(path, "rb") as f:
+                f.seek(size - 1)
+                last = f.read(1)
+            if last != b"\n":
+                fd = os.open(path, os.O_WRONLY | os.O_APPEND)
+                try:
+                    os.write(fd, b"\n")
+                finally:
+                    os.close(fd)
+                size += 1
+        except OSError:
+            pass  # read-only media etc.: appends will fail loudly anyway
+        return size
 
     def append(self, records: List[Dict]) -> int:
         """Append record dicts as JSONL; one ``os.write``, outside the
@@ -144,15 +176,22 @@ class WriteAheadLog:
         payload = "".join(
             json.dumps(r, separators=(",", ":")) + "\n" for r in records
         ).encode()
+        rolled = False
         with self._lock:
             if self._seg_bytes >= self.max_segment_bytes:
                 self._seg += 1
                 self._seg_bytes = 0
+                rolled = True
             seg = self._seg
             path = segment_path(self.directory, seg)
             self._seg_bytes += len(payload)
         written = 0
         try:
+            # fault sites live inside the try: an injected OSError takes
+            # the same cursor-compensation path a real ENOSPC/EIO does
+            if rolled:
+                failpoint("wal.roll")
+            failpoint("wal.append.enospc")
             fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
             try:
                 while written < len(payload):
@@ -175,6 +214,8 @@ class WriteAheadLog:
         """(decoded records past ``cursor``, advanced cursor).  Lines that
         fail to decode (foreign writers, disk corruption) are skipped —
         the log must stay replayable end to end."""
+        # the "wal.read" fault site lives in read_segments_from (shared
+        # with the quality store's follower) — no second site here
         lines, cursor = read_segments_from(self.directory, cursor)
         records = []
         for line in lines:
@@ -370,11 +411,23 @@ class IngestRuntime:
         if self.refit is not None:
             self.refit.stop()
         self._stop.set()
-        if self._thread is not None:
+        thread = self._thread
+        if thread is not None:
             # NOT under _poll_gate: the follower takes the gate inside
             # poll_apply, so joining while holding it would deadlock
-            self._thread.join(timeout=10.0)
-            self._thread = None  # dflint: disable=unlocked-shared-state — lifecycle field touched only by the owning thread
+            thread.join(timeout=_JOIN_TIMEOUT_S)
+            if thread.is_alive():
+                # the poll is wedged (hung disk, stuck device dispatch):
+                # the daemon thread leaks past this shutdown and may still
+                # mutate state while teardown proceeds — say so loudly
+                # instead of pretending the drain succeeded
+                self.metrics.ingest_shutdown_stuck_total.inc()
+                self.logger.error(
+                    "WAL follower thread still alive after %.0fs join; "
+                    "leaking it (daemon) — shutdown is NOT clean",
+                    _JOIN_TIMEOUT_S)
+            else:
+                self._thread = None  # dflint: disable=unlocked-shared-state — lifecycle field touched only by the owning thread
 
     # -- exposition ----------------------------------------------------------
     def render_metrics(self) -> str:

@@ -33,7 +33,12 @@ from distributed_forecasting_tpu.engine.executor import (
     PipelineConfig,
     TrainingExecutor,
 )
+from distributed_forecasting_tpu.monitoring.failpoints import failpoint
 from distributed_forecasting_tpu.utils import get_logger
+
+# stop()'s drain patience before declaring the scheduler thread stuck
+# (module-level so tests can shrink it without a 10s wall stall).
+_JOIN_TIMEOUT_S = 10.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -150,6 +155,9 @@ class RefitScheduler:
                 return None
             self._submitting = True
         try:
+            # inside the claim/release window: an injected failure exercises
+            # the same finally-path a real refit_stages() error would
+            failpoint("refit.submit")
             prep, dispatch, complete = self.store.refit_stages()
             handle = self._executor.submit(
                 f"refit:{trigger}", prep, dispatch, complete)
@@ -195,9 +203,21 @@ class RefitScheduler:
 
     def stop(self) -> None:
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=10.0)
-            self._thread = None  # dflint: disable=unlocked-shared-state — lifecycle field touched only by the owning thread
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=_JOIN_TIMEOUT_S)
+            if thread.is_alive():
+                # a refit dispatch is wedged under _run: the daemon thread
+                # leaks past this shutdown — surface it instead of
+                # pretending the drain succeeded
+                if self.metrics is not None:
+                    self.metrics.refit_shutdown_stuck_total.inc()
+                self.logger.error(
+                    "refit scheduler thread still alive after %.0fs join; "
+                    "leaking it (daemon) — shutdown is NOT clean",
+                    _JOIN_TIMEOUT_S)
+            else:
+                self._thread = None  # dflint: disable=unlocked-shared-state — lifecycle field touched only by the owning thread
         self._executor.close()
 
     def snapshot(self) -> Dict:

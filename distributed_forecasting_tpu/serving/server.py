@@ -288,6 +288,24 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(404, {"error": f"no route {parsed.path}"})
 
     def do_POST(self):
+        # deadline shed (serving/resilience): work whose X-Deadline-Ms
+        # budget is already spent gets its terminal 503 BEFORE parsing or
+        # dispatch — the client stopped waiting, so device time spent on
+        # it would be pure waste.  The front door forwards the remaining
+        # budget; direct clients can send the header themselves.
+        raw_budget = (self.headers.get("X-Deadline-Ms") or "").strip()
+        if raw_budget:
+            try:
+                budget_ms = float(raw_budget)
+            except ValueError:
+                budget_ms = None  # hostile/garbage header: ignore
+            if budget_ms is not None and budget_ms <= 0:
+                self.server.metrics.deadline_shed.inc()
+                self._send(
+                    503,
+                    {"error": "deadline budget exhausted before dispatch"},
+                    extra_headers=(("Retry-After", "1"),))
+                return
         if self.path == "/observe":
             self._observe()
             return

@@ -55,6 +55,7 @@ import time
 from bisect import bisect_right
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from distributed_forecasting_tpu.monitoring.failpoints import failpoint
 from distributed_forecasting_tpu.monitoring.monitor import MetricsRegistry
 from distributed_forecasting_tpu.serving.ingest import WriteAheadLog
 
@@ -278,6 +279,10 @@ class ShardedWAL:
             by_shard.setdefault(shard, []).append(rec)
         written = 0
         for shard, rows in sorted(by_shard.items()):
+            # per-shard-leg site: a mid-loop fault models one shard's disk
+            # failing while the earlier shards already acked their rows —
+            # exactly the partial-append case replay has to reconcile
+            failpoint("wal.shard.append")
             written += self._wal(shard).append(rows)
         return written
 

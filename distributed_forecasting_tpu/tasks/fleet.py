@@ -57,6 +57,7 @@ from distributed_forecasting_tpu.serving.fleet import (
     FleetConfig,
     start_fleet,
 )
+from distributed_forecasting_tpu.serving.resilience import ResilienceConfig
 from distributed_forecasting_tpu.serving.sharding import ShardingConfig
 from distributed_forecasting_tpu.tasks.common import Task
 
@@ -74,6 +75,8 @@ class FleetTask(Task):
         # strict parse: a typo'd sharding key fails here, not as a fleet
         # that silently serves unpartitioned
         sharding = ShardingConfig.from_conf(conf.get("sharding"))
+        # degradation layer + failpoint activation, same strict discipline
+        resilience = ResilienceConfig.from_conf(conf.get("resilience"))
         name = conf.get("model_name", "ForecastingBatchModel")
         stage = conf.get("stage")
         version = self.registry.latest_version(name, stage=stage)
@@ -106,6 +109,12 @@ class FleetTask(Task):
             # every replica shares the task's AOT store: the first warmup
             # compiles, the other N-1 (and every restart) deserialize
             env_extra["DFTPU_COMPILE_CACHE"] = cc.directory
+        if resilience.failpoints:
+            # replica children arm their failpoint registries at import
+            # from the environment — one conf stanza drives the whole tree
+            env_extra["DFTPU_FAILPOINTS"] = resilience.failpoints
+            env_extra["DFTPU_FAILPOINTS_SEED"] = str(
+                resilience.failpoint_seed)
 
         supervisor, front = start_fleet(
             fleet,
@@ -115,6 +124,7 @@ class FleetTask(Task):
             front_port=int(conf.get("port", 8080)),
             env_extra=env_extra,
             sharding=sharding if sharding.enabled else None,
+            resilience=resilience,
         )
         self.logger.info(
             "fleet of %d replica(s) serving %s v%s behind %s:%d",

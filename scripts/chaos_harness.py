@@ -1,0 +1,496 @@
+"""Chaos harness: injected faults -> asserted invariants, reproducibly.
+
+Five scenarios over the failpoint registry (``monitoring/failpoints.py``)
+and the degradation layer (``serving/resilience.py``), each a pure
+function returning a result dict and raising AssertionError on a broken
+invariant:
+
+  wal_kill9_replay      SIGKILL a WAL writer mid-stream (a subprocess
+                        child self-arms ``kill9`` after N acked appends);
+                        replay must contain EVERY acked batch, and a
+                        torn final line must not eat the next writer's
+                        first append (the torn-tail seal).
+  wal_enospc            seeded probabilistic ENOSPC on the append path;
+                        replay == exactly the acked set, and the segment
+                        cursor matches the bytes actually on disk.
+  aot_corrupt_warm_boot a corrupted AOT store entry on warm boot: the
+                        request is served via recompile, the recovered
+                        output is byte-identical to the unfaulted
+                        control, and the outcome="error" label fires.
+  slow_replica_brownout a replica answering 200s too slowly: the slow-
+                        call breaker ejects it, every request still gets
+                        a terminal status, traffic converges on the
+                        healthy replica.
+  breaker_trip_recover  a hung replica trips its breaker OPEN; after the
+                        replica revives, the half-open probe recloses it
+                        within ``breaker_open_s`` + one request.
+
+Every scenario is deterministic from its seed — a failing run replays
+bit-for-bit.  CI runs the three fast scenarios as the chaos smoke::
+
+    python scripts/chaos_harness.py \
+        --scenarios wal_kill9_replay,aot_corrupt_warm_boot,breaker_trip_recover
+
+Exit code 1 on any broken invariant; ``--out`` writes the result JSON.
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from distributed_forecasting_tpu.monitoring import failpoints as fp  # noqa: E402
+from distributed_forecasting_tpu.serving.ingest import (  # noqa: E402
+    WriteAheadLog,
+    segment_indices,
+    segment_path,
+)
+
+
+# ---------------------------------------------------------------------------
+# scenario 1: kill -9 mid-WAL-append
+# ---------------------------------------------------------------------------
+
+_CHILD_WRITER = r"""
+import json, sys
+from distributed_forecasting_tpu.monitoring import failpoints as fp
+from distributed_forecasting_tpu.serving.ingest import WriteAheadLog
+
+wal_dir, kill_after = sys.argv[1], int(sys.argv[2])
+wal = WriteAheadLog(wal_dir, max_segment_bytes=4096)
+batch = 0
+while True:
+    if batch == kill_after:
+        # self-arm: the NEXT append evaluation SIGKILLs this process —
+        # no atexit, no flush, exactly the crash the WAL must survive
+        fp.configure("wal.append.enospc=kill9")
+    wal.append([{"batch": batch, "fill": "x" * 64}])
+    # the append returned: this batch is ACKED (parent reads the line)
+    print(f"ACK {batch}", flush=True)
+    batch += 1
+"""
+
+
+def wal_kill9_replay(workdir: str, seed: int = 0) -> dict:
+    wal_dir = os.path.join(workdir, "wal_kill9")
+    kill_after = 25
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _CHILD_WRITER, wal_dir, str(kill_after)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    out, err = proc.communicate(timeout=120)
+    acked = [int(line.split()[1]) for line in out.splitlines()
+             if line.startswith("ACK ")]
+    assert proc.returncode == -9, (
+        f"child exited {proc.returncode}, wanted SIGKILL (-9); "
+        f"stderr: {err[-500:]}")
+    assert len(acked) == kill_after, (acked, kill_after)
+
+    # replay through a FRESH log handle, the post-crash boot path
+    records, _ = WriteAheadLog(wal_dir).read_new()
+    replayed = {r["batch"] for r in records if "batch" in r}
+    lost = sorted(set(acked) - replayed)
+    assert not lost, f"acked batches lost on replay: {lost}"
+
+    # torn-tail invariant: a fragment without a trailing newline (the
+    # writer died inside os.write) must not glue onto the NEXT writer's
+    # first line — the seal turns it into its own skippable junk line
+    live = segment_path(wal_dir, segment_indices(wal_dir)[-1])
+    with open(live, "ab") as f:
+        f.write(b'{"batch": 999999, "torn": tr')  # no newline
+    wal2 = WriteAheadLog(wal_dir)  # seals the tail at open
+    wal2.append([{"batch": 1000000}])
+    records, _ = WriteAheadLog(wal_dir).read_new()
+    replayed2 = {r["batch"] for r in records if "batch" in r}
+    assert 1000000 in replayed2, "append after torn tail lost on replay"
+    assert replayed <= replayed2, "reopen lost previously replayable rows"
+    return {"acked": len(acked), "replayed": len(replayed),
+            "child_returncode": proc.returncode}
+
+
+# ---------------------------------------------------------------------------
+# scenario 2: ENOSPC mid-segment
+# ---------------------------------------------------------------------------
+
+def wal_enospc(workdir: str, seed: int = 0) -> dict:
+    wal_dir = os.path.join(workdir, "wal_enospc")
+    wal = WriteAheadLog(wal_dir, max_segment_bytes=2048)
+    fp.configure("wal.append.enospc=raise OSError:0.3", seed=seed)
+    acked, failed = [], []
+    try:
+        for i in range(200):
+            try:
+                wal.append([{"i": i, "fill": "y" * 32}])
+                acked.append(i)
+            except OSError:
+                failed.append(i)
+    finally:
+        fp.deactivate()
+    assert failed, "p=0.3 over 200 appends fired nothing — seed plumbing?"
+    assert acked, "every append failed at p=0.3 — seed plumbing?"
+
+    # zero acked loss, zero ghost rows: replay is EXACTLY the acked set
+    records, _ = WriteAheadLog(wal_dir).read_new()
+    replayed = {r["i"] for r in records if "i" in r}
+    assert replayed == set(acked), {
+        "lost": sorted(set(acked) - replayed),
+        "ghosts": sorted(replayed - set(acked))}
+
+    # cursor compensation: the in-memory segment cursor must match the
+    # bytes actually on disk, or roll decisions drift forever after the
+    # first failed append
+    live = segment_path(wal_dir, wal._seg)
+    disk = os.path.getsize(live) if os.path.exists(live) else 0
+    assert wal._seg_bytes == disk, (wal._seg_bytes, disk)
+    return {"acked": len(acked), "failed": len(failed),
+            "fired": fp.fired("wal.append.enospc"), "segments": wal._seg + 1}
+
+
+# ---------------------------------------------------------------------------
+# scenario 3: corrupted AOT entry on warm boot
+# ---------------------------------------------------------------------------
+
+def aot_corrupt_warm_boot(workdir: str, seed: int = 0) -> dict:
+    # jax stays out of the module import so the WAL/fleet scenarios run
+    # without initializing a backend
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_forecasting_tpu.engine import compile_cache as cc
+
+    cache_dir = os.path.join(workdir, "aot_chaos")
+
+    @jax.jit
+    def scoring(x):
+        # plain (unjitted) callables bypass the AOT store by design —
+        # the store holds serialized compiled executables only
+        return x * 2.0 + jnp.sin(x)
+
+    def call():
+        x = jnp.linspace(-2.0, 2.0, 128, dtype=jnp.float32)
+        return np.asarray(cc.aot_call(
+            "chaos_toy", scoring, args=(x,), static_kwargs={},
+            dynamic_kwargs={})).tobytes()
+
+    def boot():
+        cc.configure_compile_cache(cc.CompileCacheConfig(
+            enabled=True, directory=cache_dir))
+
+    try:
+        boot()
+        control = call()           # cold: compile + store
+        boot()
+        assert call() == control, "unfaulted warm boot diverged from cold"
+
+        # the fault: one flipped byte mid-payload, surfaced on the next
+        # warm boot.  sha256 catches it, the entry is discarded, the
+        # request is served via recompile
+        fp.configure("aot.load.payload=corrupt:1", seed=seed)
+        boot()
+        s0 = cc.cache_stats()
+        recovered = call()
+        s1 = cc.cache_stats()
+        assert recovered == control, (
+            "post-recovery forecast diverged from the unfaulted control")
+        assert s1["errors"] == s0["errors"] + 1, (s0, s1)
+        assert fp.fired("aot.load.payload") == 1
+        render = cc.metrics_registry().render_prometheus()
+        assert 'outcome="error"' in render, "error outcome label missing"
+
+        # recovery re-stored a good entry: the next clean warm boot hits
+        fp.deactivate()
+        boot()
+        s2 = cc.cache_stats()
+        assert call() == control
+        s3 = cc.cache_stats()
+        assert s3["hits"] == s2["hits"] + 1, (s2, s3)
+    finally:
+        fp.deactivate()
+        cc.configure_compile_cache(cc.CompileCacheConfig(enabled=False))
+    return {"errors_counted": 1, "recovered_identical": True}
+
+
+# ---------------------------------------------------------------------------
+# fake-replica scaffolding for the fleet scenarios (the test_fleet.py
+# idiom: in-process HTTP servers behind Popen-compatible handles)
+# ---------------------------------------------------------------------------
+
+def _make_fake_replica(port, delay_s=0.0):
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def _send(self, code, body):
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/readyz":
+                self._send(200, b'{"ready": true}')
+            else:
+                self._send(404, b"{}")
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", "0"))
+            self.rfile.read(n)
+            if self.server.delay_s:
+                time.sleep(self.server.delay_s)
+            self.server.hits += 1
+            self._send(200, json.dumps(
+                {"port": self.server.server_address[1]}).encode())
+
+    srv = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    srv.daemon_threads = True
+    srv.delay_s = delay_s
+    srv.hits = 0
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+class _FakeProc:
+    def __init__(self, server):
+        self.server = server
+        self._returncode = None
+
+    def _close(self):
+        if self.server is not None:
+            self.server.shutdown()
+            self.server.server_close()
+            self.server = None
+
+    def poll(self):
+        return self._returncode
+
+    def hang_up(self):
+        self._close()
+
+    def terminate(self):
+        self._close()
+        if self._returncode is None:
+            self._returncode = -15
+
+    kill = terminate
+
+    def wait(self, timeout=None):
+        return self._returncode
+
+
+def _front_post(front, headers=None, timeout=10.0):
+    host, port = front.server_address
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request("POST", "/invocations", body=b"{}",
+                     headers={"Content-Type": "application/json",
+                              **(headers or {})})
+        resp = conn.getresponse()
+        return resp.status, dict(resp.getheaders()), resp.read()
+    finally:
+        conn.close()
+
+
+def _boot_fake_fleet(resilience, delays=(0.0, 0.0)):
+    from distributed_forecasting_tpu.serving.fleet import (
+        FleetConfig,
+        start_fleet,
+    )
+
+    cfg = FleetConfig(
+        enabled=True, replicas=len(delays), health_poll_interval_s=60.0,
+        restart_backoff_s=0.05, restart_backoff_max_s=0.4,
+        drain_timeout_s=1.0, retry_window_s=3.0, proxy_timeout_s=10.0)
+    procs = {}
+
+    def spawn(index, port):
+        proc = _FakeProc(_make_fake_replica(port, delay_s=delays[index]))
+        procs[index] = proc
+        return proc
+
+    sup, front = start_fleet(cfg, spawn_fn=spawn, wait=False,
+                             resilience=resilience)
+    sup.poll_once()
+    assert sup.ready_count() == len(delays), "fake replicas not ready"
+    return sup, front, procs
+
+
+# ---------------------------------------------------------------------------
+# scenario 4: slow-replica brownout
+# ---------------------------------------------------------------------------
+
+def slow_replica_brownout(workdir: str, seed: int = 0) -> dict:
+    from distributed_forecasting_tpu.serving.resilience import (
+        OPEN,
+        ResilienceConfig,
+    )
+
+    res = ResilienceConfig(breaker_failures=2, breaker_slow_s=0.1,
+                           breaker_open_s=60.0)
+    # replica 0 answers correct 200s, just 0.4s late: ready stays True,
+    # only the slow-call breaker can eject it
+    sup, front, procs = _boot_fake_fleet(res, delays=(0.4, 0.0))
+    try:
+        statuses = []
+        for _ in range(8):
+            status, headers, _ = _front_post(front)
+            statuses.append((status, int(headers.get("X-Fleet-Replica", 0))))
+        # invariant: no request without a terminal status
+        assert all(s == 200 for s, _ in statuses), statuses
+        slow_port = procs[0].server.server_address[1]
+        fast_port = procs[1].server.server_address[1]
+        br = sup.breaker_for(slow_port)
+        assert br is not None and br.state == OPEN, (
+            f"slow-call breaker never opened: state="
+            f"{None if br is None else br.state}, statuses={statuses}")
+        # once open, traffic converges on the healthy replica
+        tail = [p for _, p in statuses[-3:]]
+        assert all(p == fast_port for p in tail), statuses
+        metrics = sup.render_metrics()
+        assert (f'dftpu_fleet_breaker_state{{port="{slow_port}"}} 1'
+                in metrics), metrics
+        return {"statuses": statuses, "slow_port": slow_port,
+                "breaker_state": br.state}
+    finally:
+        front.shutdown()
+        sup.stop()
+
+
+# ---------------------------------------------------------------------------
+# scenario 5: breaker trips on a hung replica, recloses after revival
+# ---------------------------------------------------------------------------
+
+def breaker_trip_recover(workdir: str, seed: int = 0) -> dict:
+    from distributed_forecasting_tpu.serving.resilience import (
+        CLOSED,
+        OPEN,
+        ResilienceConfig,
+    )
+
+    open_s = 1.0
+    res = ResilienceConfig(breaker_failures=1, breaker_open_s=open_s)
+    sup, front, procs = _boot_fake_fleet(res)
+    try:
+        dead_port, live_port = sup.all_ports()
+        procs[0].hang_up()
+        # the trip: first request routed at the hung replica fails the
+        # connection, opens its breaker, and retries invisibly
+        for _ in range(4):
+            status, _, _ = _front_post(front)
+            assert status == 200
+        assert sup.breaker_for(dead_port).state == OPEN
+
+        # revive the replica on the SAME port and let a health sweep flip
+        # ready back (report_failure cleared it on the conn failure)
+        procs[0].server = _make_fake_replica(dead_port)
+        sup.poll_once()
+        assert sup.ready_count() == 2
+
+        # reclose bound: open_s elapses, the next request routed at the
+        # port is the half-open probe, and its success recloses the
+        # breaker — within open_s + one rotation of requests
+        t0 = time.monotonic()
+        deadline = t0 + open_s + 5.0
+        while time.monotonic() < deadline:
+            status, _, _ = _front_post(front)
+            assert status == 200
+            if sup.breaker_for(dead_port).state == CLOSED:
+                break
+            time.sleep(0.05)
+        reclose_s = time.monotonic() - t0
+        assert sup.breaker_for(dead_port).state == CLOSED, (
+            f"breaker never reclosed within {reclose_s:.1f}s")
+        # both replicas back in rotation
+        ports = set()
+        for _ in range(6):
+            status, headers, _ = _front_post(front)
+            assert status == 200
+            ports.add(int(headers["X-Fleet-Replica"]))
+        assert ports == {dead_port, live_port}, ports
+        return {"reclose_s": round(reclose_s, 3), "open_s": open_s}
+    finally:
+        front.shutdown()
+        sup.stop()
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+SCENARIOS = {
+    "wal_kill9_replay": wal_kill9_replay,
+    "wal_enospc": wal_enospc,
+    "aot_corrupt_warm_boot": aot_corrupt_warm_boot,
+    "slow_replica_brownout": slow_replica_brownout,
+    "breaker_trip_recover": breaker_trip_recover,
+}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--scenarios", default=",".join(SCENARIOS),
+                    help="comma-separated subset (default: all)")
+    ap.add_argument("--workdir", default=None,
+                    help="scratch directory (default: a fresh tempdir)")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="failpoint PRNG seed — a failing run replays "
+                         "bit-for-bit from it")
+    ap.add_argument("--out", default=None, help="write result JSON here")
+    args = ap.parse_args(argv)
+
+    names = [s.strip() for s in args.scenarios.split(",") if s.strip()]
+    unknown = [n for n in names if n not in SCENARIOS]
+    if unknown:
+        ap.error(f"unknown scenario(s) {unknown}; "
+                 f"valid: {', '.join(SCENARIOS)}")
+    workdir = args.workdir or tempfile.mkdtemp(prefix="chaos_harness_")
+    os.makedirs(workdir, exist_ok=True)
+
+    results, failures = {}, []
+    for name in names:
+        t0 = time.monotonic()
+        try:
+            detail = SCENARIOS[name](workdir, seed=args.seed)
+            results[name] = {"ok": True, "seconds":
+                             round(time.monotonic() - t0, 2),
+                             "detail": detail}
+            print(f"[chaos] {name}: OK "
+                  f"({results[name]['seconds']}s)", flush=True)
+        except Exception as exc:  # a broken invariant IS the signal
+            results[name] = {"ok": False, "seconds":
+                             round(time.monotonic() - t0, 2),
+                             "error": f"{type(exc).__name__}: {exc}"}
+            failures.append(name)
+            print(f"[chaos] {name}: FAILED — {exc}", flush=True)
+        finally:
+            fp.deactivate()  # no scenario leaks armed sites into the next
+
+    summary = {"seed": args.seed, "workdir": workdir,
+               "scenarios": results, "failures": failures}
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(summary, f, indent=2)
+    print(json.dumps({k: v["ok"] for k, v in results.items()}))
+    if failures:
+        print(f"[chaos] {len(failures)} scenario(s) failed: "
+              f"{', '.join(failures)} (replay with --seed {args.seed})",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

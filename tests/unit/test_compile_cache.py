@@ -313,3 +313,119 @@ def test_concurrent_writers_never_tear_the_store(cache_dir):
     files = os.listdir(store_dir)
     assert [f for f in files if f.endswith(".aot")], files
     assert not [f for f in files if f.endswith(".tmp")], "temp files leaked"
+
+
+# ---------------------------------------------------------------------------
+# ISSUE-14 satellite: injected AOT-store faults via the failpoint registry —
+# the same corruption paths as test_corrupted_entry_falls_through, but
+# driven through monitoring/failpoints.py, plus the outcome="error" label
+# and the warm-boot-after-recovery guarantee
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def failpoints():
+    from distributed_forecasting_tpu.monitoring import failpoints as fp
+
+    fp.deactivate()
+    try:
+        yield fp
+    finally:
+        fp.deactivate()
+
+
+def _toy_call(x):
+    return cc.aot_call("toy", _toy, args=(x,), static_kwargs={"scale": 2.0},
+                       dynamic_kwargs={"y": None})
+
+
+def _toy_error_count():
+    # the metrics registry is process-global, so earlier tests in this file
+    # have already banked toy errors — assert deltas, not absolutes
+    render = cc.metrics_registry().render_prometheus()
+    for line in render.splitlines():
+        if 'entry="toy",outcome="error"}' in line:
+            return int(float(line.rsplit(" ", 1)[1]))
+    return 0
+
+
+def test_failpoint_sha_mismatch_recompiles_and_labels_error(
+        cache_dir, failpoints):
+    _enable(cache_dir)
+    x = jnp.arange(32, dtype=jnp.float32)
+    reference = np.asarray(_toy_call(x)).tobytes()
+    assert len(_aot_entries(cache_dir)) == 1
+
+    # warm boot with a byte flipped mid-payload: the sha256 check fires,
+    # the entry is discarded, the request is served via recompile
+    failpoints.configure("aot.load.payload=corrupt:1")
+    _enable(cache_dir)
+    s0 = cc.cache_stats()
+    e0 = _toy_error_count()
+    out = _toy_call(x)
+    s1 = cc.cache_stats()
+    assert np.asarray(out).tobytes() == reference
+    assert s1["errors"] == s0["errors"] + 1
+    assert s1["misses"] == s0["misses"] + 1
+    assert failpoints.fired("aot.load.payload") == 1
+    # entry EXISTED but failed to load -> outcome="error", not "miss"
+    assert _toy_error_count() == e0 + 1
+
+    # recovery re-stored the entry; a clean warm boot loads it again
+    failpoints.deactivate()
+    _enable(cache_dir)
+    s2 = cc.cache_stats()
+    out = _toy_call(x)
+    s3 = cc.cache_stats()
+    assert np.asarray(out).tobytes() == reference
+    assert s3["hits"] == s2["hits"] + 1
+    assert s3["errors"] == s2["errors"]
+
+
+def test_failpoint_truncated_entry_recompiles(cache_dir, failpoints):
+    _enable(cache_dir)
+    x = jnp.linspace(-1.0, 1.0, 16, dtype=jnp.float32)
+    reference = np.asarray(_toy_call(x)).tobytes()
+
+    # the torn-write fault: half the payload gone; sha catches it upstream
+    # of the deserializer, load() discards, the call recompiles
+    failpoints.configure("aot.load.payload=corrupt truncate:1")
+    _enable(cache_dir)
+    s0 = cc.cache_stats()
+    out = _toy_call(x)
+    s1 = cc.cache_stats()
+    assert np.asarray(out).tobytes() == reference
+    assert s1["errors"] == s0["errors"] + 1
+    assert len(_aot_entries(cache_dir)) == 1  # re-stored after recompile
+
+
+def test_failpoint_unreadable_entry_falls_through(cache_dir, failpoints):
+    _enable(cache_dir)
+    x = jnp.ones((8,), jnp.float32)
+    reference = np.asarray(_toy_call(x)).tobytes()
+
+    # an I/O-level fault (EIO on open/read) takes the same discard path
+    # as corruption: served via recompile, never raised to the caller
+    failpoints.configure("aot.load=raise OSError:1")
+    _enable(cache_dir)
+    s0 = cc.cache_stats()
+    out = _toy_call(x)
+    s1 = cc.cache_stats()
+    assert np.asarray(out).tobytes() == reference
+    assert s1["errors"] == s0["errors"] + 1
+
+
+def test_failpoint_store_failure_is_nonfatal(cache_dir, failpoints):
+    # ENOSPC while persisting a fresh compile: the call still answers (the
+    # executable is live in the memo), only the on-disk entry is missing
+    failpoints.configure("aot.store=raise OSError:1")
+    _enable(cache_dir)
+    x = jnp.arange(8, dtype=jnp.float32)
+    reference = np.asarray(_toy_call(x)).tobytes()
+    assert _aot_entries(cache_dir) == []  # nothing persisted
+
+    # with the fault cleared, the next cold boot compiles AND stores
+    failpoints.deactivate()
+    _enable(cache_dir)
+    out = _toy_call(x)
+    assert np.asarray(out).tobytes() == reference
+    assert len(_aot_entries(cache_dir)) == 1

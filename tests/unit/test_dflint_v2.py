@@ -1050,3 +1050,97 @@ def test_ring_snapshot_under_lock_negative(tmp_path):
     """)
     found = _lint(tmp_path, "serving/router.py")
     assert "unlocked-shared-state" not in _rules(found)
+
+
+# ---------------------------------------------------------------------------
+# ISSUE-14 fixtures: the serving.resilience conf block + failpoint sites
+# must stay out of jit-traced code
+# ---------------------------------------------------------------------------
+
+def test_resilience_conf_block_drift_positive_and_negative(tmp_path):
+    # mirrors conf/tasks/serve_config.yml's serving.resilience block: a
+    # typo'd breaker key is spellable from YAML but no ResilienceConfig
+    # field consumes it -> drift (the breaker silently stays off); every
+    # real key lands on a field
+    _write(tmp_path, "conf/serve.yml", """
+        serving:
+          resilience:
+            failpoints: ""
+            failpoint_seed: 0
+            default_deadline_ms: 0
+            breaker_failues: 3
+            breaker_open_s: 5
+            hedge_enabled: false
+    """)
+    _write(tmp_path, "src/resilience_cfg.py", """
+        import dataclasses
+
+        @dataclasses.dataclass(frozen=True)
+        class ResilienceConfig:
+            failpoints: str = ""
+            failpoint_seed: int = 0
+            default_deadline_ms: float = 0.0
+            breaker_failures: int = 0
+            breaker_open_s: float = 5.0
+            hedge_enabled: bool = False
+
+            @classmethod
+            def from_conf(cls, conf):
+                block = conf.get("serving", {}).get("resilience", {})
+                known = {f.name for f in dataclasses.fields(cls)}
+                return cls(**{k: v for k, v in block.items() if k in known})
+    """)
+    found = _lint(tmp_path, "src/resilience_cfg.py")
+    assert [f.rule for f in found] == ["config-drift"]
+    assert "breaker_failues" in found[0].message
+    assert found[0].path == "conf/serve.yml"
+
+    # fixing the typo makes the block clean
+    _write(tmp_path, "conf/serve.yml", """
+        serving:
+          resilience:
+            failpoints: ""
+            failpoint_seed: 0
+            default_deadline_ms: 0
+            breaker_failures: 3
+            breaker_open_s: 5
+            hedge_enabled: false
+    """)
+    assert _lint(tmp_path, "src/resilience_cfg.py") == []
+
+
+def test_failpoint_site_in_traced_code_positive(tmp_path):
+    # a failpoint inside a jit-traced function runs at TRACE time (once
+    # per compile, never per call) and takes the registry lock + PRNG on
+    # host — the host-sync rule must flag it in the hot dirs
+    _write(tmp_path, "ops/kernel.py", """
+        import jax
+        from distributed_forecasting_tpu.monitoring.failpoints import failpoint
+
+        @jax.jit
+        def step(x):
+            failpoint("ops.step")
+            return x * 2
+    """)
+    found = _lint(tmp_path, "ops/kernel.py")
+    assert _rules(found) == ["host-sync-in-hot-path"]
+    assert "failpoint" in found[0].message
+
+
+def test_failpoint_on_host_orchestration_path_negative(tmp_path):
+    # where fault sites actually live: host-side orchestration code that
+    # CALLS the compiled program — never traced, so never flagged, even
+    # in a hot dir
+    _write(tmp_path, "ops/driver.py", """
+        import jax
+        from distributed_forecasting_tpu.monitoring.failpoints import failpoint
+
+        @jax.jit
+        def step(x):
+            return x * 2
+
+        def dispatch(x):
+            failpoint("ops.dispatch")
+            return step(x)
+    """)
+    assert _lint(tmp_path, "ops/driver.py") == []

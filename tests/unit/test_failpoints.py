@@ -1,0 +1,227 @@
+"""Failpoint registry (monitoring/failpoints.py): spec parsing, the
+count/prob modifiers, seeded determinism, corrupt modes at data sites,
+and the two activation routes (configure() and the environment).
+
+Everything here is host-side stdlib — no jax, no device."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from distributed_forecasting_tpu.monitoring import failpoints as fp
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    fp.deactivate()
+    yield
+    fp.deactivate()
+
+
+# -- spec parsing -------------------------------------------------------------
+
+def test_configure_counts_sites_and_is_active():
+    n = fp.configure("a.b=raise; c.d=sleep 5:0.5:3")
+    assert n == 2
+    assert fp.is_active() and fp.is_active("a.b") and fp.is_active("c.d")
+    assert not fp.is_active("nope")
+
+
+def test_empty_spec_deactivates():
+    fp.configure("a.b=raise")
+    assert fp.is_active()
+    assert fp.configure("") == 0
+    assert not fp.is_active()
+    fp.configure("a.b=raise")
+    fp.configure(None)
+    assert not fp.is_active()
+
+
+def test_newlines_are_term_separators():
+    assert fp.configure("a.b=raise\nc.d=sleep 1") == 2
+
+
+@pytest.mark.parametrize("bad", [
+    "noequals",                      # not name=action
+    "a.b=",                          # empty action
+    "a.b=explode",                   # unknown action
+    "a.b=raise NoSuchExc",           # unknown exception name
+    "a.b=raise OSError:1.5",         # prob outside (0, 1]
+    "a.b=raise OSError:0",           # count 0
+    "a.b=sleep",                     # sleep without milliseconds
+    "a.b=corrupt sideways",          # bad corrupt mode
+])
+def test_bad_specs_fail_at_configure_time(bad):
+    with pytest.raises(ValueError):
+        fp.configure(bad)
+    # a failed configure never leaves the registry half-armed
+    assert not fp.is_active()
+
+
+# -- actions ------------------------------------------------------------------
+
+def test_raise_default_and_named_exception():
+    fp.configure("a.b=raise")
+    with pytest.raises(fp.FailpointError, match="a.b"):
+        fp.failpoint("a.b")
+    fp.configure("a.b=raise OSError")
+    with pytest.raises(OSError):
+        fp.failpoint("a.b")
+
+
+def test_unarmed_site_is_a_noop_even_while_active():
+    fp.configure("a.b=raise")
+    fp.failpoint("other.site")  # must not raise
+    assert fp.fired("other.site") == 0
+
+
+def test_sleep_blocks_roughly_the_requested_ms():
+    import time
+    fp.configure("a.b=sleep 30")
+    t0 = time.monotonic()
+    fp.failpoint("a.b")
+    assert time.monotonic() - t0 >= 0.025
+
+
+def test_disabled_fast_path_is_free_of_side_effects():
+    fp.failpoint("a.b")
+    assert fp.failpoint_data("a.b", b"payload") == b"payload"
+    assert fp.snapshot() == {}
+
+
+# -- count / prob modifiers ---------------------------------------------------
+
+def test_count_caps_total_firings_then_disarms():
+    fp.configure("a.b=raise OSError:2")
+    for _ in range(2):
+        with pytest.raises(OSError):
+            fp.failpoint("a.b")
+    fp.failpoint("a.b")  # third evaluation: disarmed, no-op
+    assert fp.fired("a.b") == 2
+
+
+def test_count_x_suffix_spelling():
+    fp.configure("a.b=raise:1x")
+    with pytest.raises(fp.FailpointError):
+        fp.failpoint("a.b")
+    fp.failpoint("a.b")
+    assert fp.fired("a.b") == 1
+
+
+def test_prob_one_point_zero_always_fires():
+    # ``1`` alone is a count; ``1.0`` is "always" — the documented wart
+    fp.configure("a.b=raise:1.0")
+    for _ in range(3):
+        with pytest.raises(fp.FailpointError):
+            fp.failpoint("a.b")
+    assert fp.fired("a.b") == 3
+
+
+def _firing_pattern(spec, seed, evals=200):
+    fp.configure(spec, seed=seed)
+    pattern = []
+    for _ in range(evals):
+        try:
+            fp.failpoint("a.b")
+            pattern.append(0)
+        except fp.FailpointError:
+            pattern.append(1)
+    return pattern
+
+
+def test_probabilistic_firing_is_seed_deterministic():
+    first = _firing_pattern("a.b=raise:0.3", seed=42)
+    again = _firing_pattern("a.b=raise:0.3", seed=42)
+    assert first == again
+    # roughly-binomial sanity: p=0.3 over 200 draws lands well inside
+    assert 20 <= sum(first) <= 120
+
+
+def test_fired_and_snapshot_track_per_site():
+    fp.configure("a.b=sleep 0; c.d=sleep 0")
+    fp.failpoint("a.b")
+    fp.failpoint("a.b")
+    fp.failpoint("c.d")
+    assert fp.fired("a.b") == 2 and fp.fired("c.d") == 1
+    assert fp.snapshot() == {"a.b": 2, "c.d": 1}
+    fp.configure("a.b=sleep 0")  # re-configure resets counters
+    assert fp.snapshot() == {}
+
+
+# -- data sites ---------------------------------------------------------------
+
+def test_corrupt_flip_changes_one_middle_byte():
+    fp.configure("a.b=corrupt")
+    data = bytes(range(16))
+    out = fp.failpoint_data("a.b", data)
+    assert len(out) == len(data) and out != data
+    diffs = [i for i, (x, y) in enumerate(zip(data, out)) if x != y]
+    assert diffs == [8]
+
+
+def test_corrupt_truncate_drops_the_tail():
+    fp.configure("a.b=corrupt truncate")
+    data = b"x" * 64
+    out = fp.failpoint_data("a.b", data)
+    assert 0 < len(out) < len(data)
+
+
+def test_corrupt_at_plain_site_is_ignored():
+    fp.configure("a.b=corrupt")
+    fp.failpoint("a.b")  # nothing to corrupt: must not raise
+    assert fp.fired("a.b") == 1
+
+
+def test_raise_still_works_at_data_sites():
+    fp.configure("a.b=raise OSError")
+    with pytest.raises(OSError):
+        fp.failpoint_data("a.b", b"payload")
+
+
+def test_corrupt_empty_payload_passthrough():
+    fp.configure("a.b=corrupt")
+    assert fp.failpoint_data("a.b", b"") == b""
+
+
+# -- environment activation ---------------------------------------------------
+
+def test_configure_from_env_arms_and_respects_seed(monkeypatch):
+    monkeypatch.setenv("DFTPU_FAILPOINTS", "a.b=raise:0.3")
+    monkeypatch.setenv("DFTPU_FAILPOINTS_SEED", "7")
+    assert fp.configure_from_env() == 1
+    env_pattern = []
+    for _ in range(50):
+        try:
+            fp.failpoint("a.b")
+            env_pattern.append(0)
+        except fp.FailpointError:
+            env_pattern.append(1)
+    assert env_pattern == _firing_pattern("a.b=raise:0.3", seed=7, evals=50)
+
+
+def test_empty_env_does_not_clobber_in_process_configure(monkeypatch):
+    fp.configure("a.b=raise")
+    monkeypatch.delenv("DFTPU_FAILPOINTS", raising=False)
+    assert fp.configure_from_env() == 0
+    assert fp.is_active("a.b")
+
+
+def test_child_process_arms_at_import(tmp_path):
+    # the replica-subprocess route: a fresh interpreter with the env var
+    # set fires the site with no configure() call anywhere
+    code = (
+        "from distributed_forecasting_tpu.monitoring import failpoints as fp\n"
+        "assert fp.is_active('a.b')\n"
+        "try:\n"
+        "    fp.failpoint('a.b')\n"
+        "except OSError:\n"
+        "    print('FIRED')\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        env={**os.environ, "DFTPU_FAILPOINTS": "a.b=raise OSError",
+             "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr
+    assert "FIRED" in proc.stdout

@@ -1,0 +1,463 @@
+"""Degradation layer (serving/resilience.py) + its fleet integration:
+the strict conf block, the breaker state machine in simulated time, the
+latency reservoir, deadline-budget parsing/derivation, and front-door
+behavior over in-process fake replicas (breaker ejection + gauge,
+deadline shed, budget forwarding).  The shutdown-stuck satellite rides
+along: a wedged follower/scheduler join must count and log, not hang.
+"""
+
+import json
+import logging
+import threading
+import time
+
+import pytest
+
+from distributed_forecasting_tpu.serving import ingest as ingest_mod
+from distributed_forecasting_tpu.serving import refit as refit_mod
+from distributed_forecasting_tpu.serving.fleet import (
+    FleetConfig,
+    FleetSupervisor,
+    start_fleet,
+)
+from distributed_forecasting_tpu.serving.resilience import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    CircuitBreaker,
+    LatencyReservoir,
+    ResilienceConfig,
+    deadline_from_headers,
+    parse_deadline_header,
+    remaining_ms,
+    state_name,
+)
+
+from test_fleet import _FakeProc, _front_call, _make_fake_replica
+
+
+# -- config -------------------------------------------------------------------
+
+def test_resilience_config_defaults_are_all_off():
+    cfg = ResilienceConfig.from_conf(None)
+    assert cfg.failpoints == ""
+    assert cfg.default_deadline_ms == 0.0
+    assert cfg.breaker_failures == 0
+    assert not cfg.hedge_enabled
+
+
+def test_resilience_config_rejects_unknown_keys():
+    with pytest.raises(ValueError, match="breaker_failues"):
+        ResilienceConfig.from_conf({"breaker_failues": 3})
+
+
+def test_resilience_config_scalar_casts():
+    cfg = ResilienceConfig.from_conf({
+        "breaker_failures": "3", "breaker_open_s": "2.5",
+        "default_deadline_ms": 800, "hedge_enabled": True})
+    assert cfg.breaker_failures == 3
+    assert cfg.breaker_open_s == 2.5
+    assert cfg.default_deadline_ms == 800.0
+    assert cfg.hedge_enabled is True
+
+
+@pytest.mark.parametrize("bad", [
+    {"default_deadline_ms": -1},
+    {"min_leg_timeout_ms": 0},
+    {"breaker_failures": -1},
+    {"breaker_slow_s": -0.5},
+    {"breaker_open_s": 0},
+    {"hedge_delay_ms": -1},
+    {"hedge_min_delay_ms": 0},
+])
+def test_resilience_config_validates(bad):
+    with pytest.raises(ValueError):
+        ResilienceConfig(**bad)
+
+
+# -- circuit breaker (simulated time) -----------------------------------------
+
+class _Clock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+
+def test_breaker_full_cycle_closed_open_halfopen_closed():
+    clock = _Clock()
+    br = CircuitBreaker(failures=2, open_s=5.0, time_fn=clock)
+    assert br.state == CLOSED and br.allow()
+    br.record_failure()
+    assert br.state == CLOSED  # one failure is not a trip
+    br.record_failure()
+    assert br.state == OPEN
+    assert not br.allow()
+    clock.now = 4.9
+    assert not br.allow()
+    clock.now = 5.1
+    assert br.allow()          # the half-open probe
+    assert br.state == HALF_OPEN
+    assert not br.allow()      # a second caller is refused while probing
+    br.record_success(elapsed_s=0.01)
+    assert br.state == CLOSED and br.allow()
+
+
+def test_breaker_failed_probe_reopens_with_restarted_timer():
+    clock = _Clock()
+    br = CircuitBreaker(failures=1, open_s=5.0, time_fn=clock)
+    br.record_failure()
+    clock.now = 6.0
+    assert br.allow()
+    br.record_failure()        # the probe failed
+    assert br.state == OPEN
+    clock.now = 10.0           # 4s after the reopen: still open
+    assert not br.allow()
+    clock.now = 11.5
+    assert br.allow()
+
+
+def test_breaker_success_resets_consecutive_count():
+    br = CircuitBreaker(failures=2, open_s=5.0, time_fn=_Clock())
+    br.record_failure()
+    br.record_success()
+    br.record_failure()
+    assert br.state == CLOSED  # never two CONSECUTIVE failures
+
+
+def test_breaker_slow_success_counts_as_failure():
+    br = CircuitBreaker(failures=1, open_s=5.0, slow_s=0.1,
+                        time_fn=_Clock())
+    br.record_success(elapsed_s=0.5)
+    assert br.state == OPEN
+
+
+def test_breaker_rejects_zero_failures():
+    with pytest.raises(ValueError):
+        CircuitBreaker(failures=0, open_s=5.0)
+
+
+def test_state_name_encoding():
+    assert state_name(CLOSED) == "closed"
+    assert state_name(OPEN) == "open"
+    assert state_name(HALF_OPEN) == "half_open"
+    assert state_name(99) == "unknown"
+
+
+# -- latency reservoir --------------------------------------------------------
+
+def test_reservoir_p95_and_ring_overwrite():
+    res = LatencyReservoir(capacity=100)
+    assert res.p95() is None
+    for i in range(100):
+        res.observe(i / 1000.0)
+    assert res.p95() == pytest.approx(0.095)
+    # overwriting the ring with a faster fleet drags the p95 down
+    for _ in range(100):
+        res.observe(0.001)
+    assert res.p95() == pytest.approx(0.001)
+
+
+# -- deadline budgets ---------------------------------------------------------
+
+def test_parse_deadline_header_garbage_is_absent():
+    assert parse_deadline_header(None) is None
+    assert parse_deadline_header("not-a-number") is None
+    assert parse_deadline_header(" 250.5 ") == 250.5
+
+
+def test_deadline_from_headers_header_wins_over_default():
+    now = time.monotonic()
+    d = deadline_from_headers({"X-Deadline-Ms": "500"}, default_ms=60000)
+    assert now + 0.3 < d < now + 0.7
+    d = deadline_from_headers({}, default_ms=60000)
+    assert d > now + 50
+    assert deadline_from_headers({}, default_ms=0) is None
+
+
+def test_remaining_ms_none_is_unbounded():
+    assert remaining_ms(None) is None
+    assert remaining_ms(time.monotonic() - 1.0) < 0
+
+
+# -- supervisor derivations (no fleet boot needed) ----------------------------
+
+def _bare_supervisor(resilience=None, request_timeout_s=None):
+    cfg = FleetConfig(enabled=True, replicas=2)
+    return FleetSupervisor(cfg, lambda i, p: None, resilience=resilience,
+                           request_timeout_s=request_timeout_s)
+
+
+def test_leg_timeout_tightens_from_request_timeout_and_budget():
+    sup = _bare_supervisor(request_timeout_s=30.0)
+    # no deadline: proxy cap (120) tightened to request_timeout + 5 slack
+    assert sup.leg_timeout_s(None) == pytest.approx(35.0)
+    # a 2s budget tightens further
+    t = sup.leg_timeout_s(time.monotonic() + 2.0)
+    assert 1.5 < t < 2.1
+    # an exhausted budget floors at min_leg_timeout_ms, never 0/negative
+    t = sup.leg_timeout_s(time.monotonic() - 1.0)
+    assert t == pytest.approx(0.05, abs=0.01)
+
+
+def test_hedge_delay_fixed_p95_and_floor():
+    sup = _bare_supervisor(resilience=ResilienceConfig(
+        hedge_enabled=True, hedge_delay_ms=75.0))
+    assert sup.hedge_delay_s() == pytest.approx(0.075)
+    sup = _bare_supervisor(resilience=ResilienceConfig(
+        hedge_enabled=True, hedge_min_delay_ms=10.0))
+    assert sup.hedge_delay_s() == pytest.approx(0.010)  # empty reservoir
+    for _ in range(50):
+        sup.leg_latency.observe(0.200)
+    assert sup.hedge_delay_s() == pytest.approx(0.200)
+
+
+def test_breaker_for_disabled_and_lazy_creation():
+    sup = _bare_supervisor()  # breaker_failures=0: disabled
+    assert sup.breaker_for(1234) is None
+    assert sup.breaker_allow(1234)  # disabled gate always admits
+    sup = _bare_supervisor(resilience=ResilienceConfig(breaker_failures=2))
+    br = sup.breaker_for(1234)
+    assert br is not None and sup.breaker_for(1234) is br
+
+
+# -- fleet integration over fake replicas -------------------------------------
+
+def _resilient_fleet(resilience, request_timeout_s=None):
+    cfg = FleetConfig(
+        enabled=True, replicas=2, health_poll_interval_s=60.0,
+        restart_backoff_s=0.05, restart_backoff_max_s=0.4,
+        drain_timeout_s=1.0, retry_window_s=2.0)
+    procs = {}
+
+    def spawn(index, port):
+        proc = _FakeProc(_make_fake_replica(port))
+        procs[index] = proc
+        return proc
+
+    serving_conf = None
+    if request_timeout_s is not None:
+        serving_conf = {"batching": {"request_timeout_s": request_timeout_s}}
+    sup, front = start_fleet(cfg, spawn_fn=spawn, wait=False,
+                             serving_conf=serving_conf,
+                             resilience=resilience)
+    sup.poll_once()
+    assert sup.ready_count() == 2
+    return sup, front, procs
+
+
+def test_breaker_trips_on_hung_replica_and_exports_state():
+    sup, front, procs = _resilient_fleet(
+        ResilienceConfig(breaker_failures=1, breaker_open_s=60.0))
+    try:
+        procs[0].hang_up()
+        dead, live = sup.all_ports()
+        for _ in range(4):
+            status, headers, _ = _front_call(front)
+            assert status == 200
+            assert int(headers["X-Fleet-Replica"]) == live
+        assert sup.breaker_for(dead).state == OPEN
+        assert sup.breaker_for(live).state == CLOSED
+        metrics = sup.render_metrics()
+        assert f'dftpu_fleet_breaker_state{{port="{dead}"}} 1' in metrics
+        assert f'dftpu_fleet_breaker_state{{port="{live}"}} 0' in metrics
+    finally:
+        front.shutdown()
+        sup.stop()
+
+
+def test_open_breaker_ejects_port_without_a_connection_attempt():
+    sup, front, procs = _resilient_fleet(
+        ResilienceConfig(breaker_failures=1, breaker_open_s=60.0))
+    try:
+        # trip port A's breaker directly: routing must skip it while the
+        # replica itself still answers (ready stays True — the breaker is
+        # the only thing ejecting it)
+        skip, keep = sup.all_ports()
+        sup.breaker_failure(skip)
+        assert sup.breaker_for(skip).state == OPEN
+        for _ in range(4):
+            status, headers, _ = _front_call(front)
+            assert status == 200
+            assert int(headers["X-Fleet-Replica"]) == keep
+        assert "dftpu_fleet_breaker_skipped_total" in sup.render_metrics()
+    finally:
+        front.shutdown()
+        sup.stop()
+
+
+def test_exhausted_deadline_sheds_503_before_forwarding():
+    sup, front, procs = _resilient_fleet(ResilienceConfig())
+    try:
+        host, port = front.server_address
+        import http.client
+        conn = http.client.HTTPConnection(host, port, timeout=10)
+        try:
+            conn.request("POST", "/invocations", body=b"{}",
+                         headers={"Content-Type": "application/json",
+                                  "X-Deadline-Ms": "0"})
+            resp = conn.getresponse()
+            body = resp.read()
+            assert resp.status == 503
+            assert b"deadline" in body
+            assert resp.getheader("Retry-After") == "1"
+        finally:
+            conn.close()
+        # no replica saw the request
+        assert all(p.server.hits == 0 for p in procs.values())
+        assert "dftpu_fleet_deadline_exhausted_total 1" in sup.render_metrics()
+    finally:
+        front.shutdown()
+        sup.stop()
+
+
+def test_remaining_budget_is_forwarded_downstream():
+    sup, front, procs = _resilient_fleet(ResilienceConfig())
+    try:
+        seen = []
+        for proc in procs.values():
+            srv = proc.server
+            orig = srv.RequestHandlerClass.do_POST
+
+            def do_POST(handler, _orig=orig):
+                seen.append(handler.headers.get("X-Deadline-Ms"))
+                _orig(handler)
+
+            srv.RequestHandlerClass.do_POST = do_POST
+        status, _, _ = _front_call(front)
+        assert status == 200
+        assert seen == [None]  # no header, no default: nothing forwarded
+        seen.clear()
+
+        host, port = front.server_address
+        import http.client
+        conn = http.client.HTTPConnection(host, port, timeout=10)
+        try:
+            conn.request("POST", "/invocations", body=b"{}",
+                         headers={"Content-Type": "application/json",
+                                  "X-Deadline-Ms": "5000"})
+            assert conn.getresponse().status == 200
+        finally:
+            conn.close()
+        (forwarded,) = seen
+        assert forwarded is not None
+        assert 0 < int(forwarded) <= 5000  # shrank in transit, never grew
+    finally:
+        front.shutdown()
+        sup.stop()
+
+
+def test_default_deadline_applies_without_header():
+    sup, front, procs = _resilient_fleet(
+        ResilienceConfig(default_deadline_ms=5000.0))
+    try:
+        seen = []
+        for proc in procs.values():
+            srv = proc.server
+            orig = srv.RequestHandlerClass.do_POST
+
+            def do_POST(handler, _orig=orig):
+                seen.append(handler.headers.get("X-Deadline-Ms"))
+                _orig(handler)
+
+            srv.RequestHandlerClass.do_POST = do_POST
+        status, _, _ = _front_call(front)
+        assert status == 200
+        (forwarded,) = seen
+        assert forwarded is not None and 0 < int(forwarded) <= 5000
+    finally:
+        front.shutdown()
+        sup.stop()
+
+
+# -- shutdown-stuck satellite -------------------------------------------------
+
+def _wedged_thread():
+    release = threading.Event()
+    t = threading.Thread(target=release.wait, daemon=True)
+    t.start()
+    return t, release
+
+
+def test_ingest_stop_counts_wedged_follower(monkeypatch):
+    from distributed_forecasting_tpu.monitoring.monitor import IngestMetrics
+
+    monkeypatch.setattr(ingest_mod, "_JOIN_TIMEOUT_S", 0.05)
+    rt = ingest_mod.IngestRuntime.__new__(ingest_mod.IngestRuntime)
+    rt.refit = None
+    rt._stop = threading.Event()
+    rt.metrics = IngestMetrics()
+    rt.logger = logging.getLogger("test-ingest-stop")
+    thread, release = _wedged_thread()
+    rt._thread = thread
+    try:
+        t0 = time.monotonic()
+        rt.stop()
+        assert time.monotonic() - t0 < 2.0  # bounded, not a hang
+        assert rt.metrics.ingest_shutdown_stuck_total.value == 1
+        assert rt._thread is thread  # NOT cleared: the leak stays visible
+    finally:
+        release.set()
+        thread.join(timeout=2.0)
+    # a clean join leaves the counter untouched and clears the handle
+    rt2 = ingest_mod.IngestRuntime.__new__(ingest_mod.IngestRuntime)
+    rt2.refit = None
+    rt2._stop = threading.Event()
+    rt2.metrics = IngestMetrics()
+    rt2.logger = rt.logger
+    done = threading.Thread(target=lambda: None, daemon=True)
+    done.start()
+    done.join()
+    rt2._thread = done
+    rt2.stop()
+    assert rt2.metrics.ingest_shutdown_stuck_total.value == 0
+    assert rt2._thread is None
+
+
+def test_refit_stop_counts_wedged_scheduler(monkeypatch):
+    from distributed_forecasting_tpu.monitoring.monitor import IngestMetrics
+
+    monkeypatch.setattr(refit_mod, "_JOIN_TIMEOUT_S", 0.05)
+
+    class _Executor:
+        closed = False
+
+        def close(self):
+            self.closed = True
+
+    sched = refit_mod.RefitScheduler.__new__(refit_mod.RefitScheduler)
+    sched._stop = threading.Event()
+    sched.metrics = IngestMetrics()
+    sched.logger = logging.getLogger("test-refit-stop")
+    sched._executor = _Executor()
+    thread, release = _wedged_thread()
+    sched._thread = thread
+    try:
+        sched.stop()
+        assert sched.metrics.refit_shutdown_stuck_total.value == 1
+        assert sched._executor.closed  # teardown still proceeds
+    finally:
+        release.set()
+        thread.join(timeout=2.0)
+
+
+def test_refit_stop_tolerates_none_metrics(monkeypatch):
+    monkeypatch.setattr(refit_mod, "_JOIN_TIMEOUT_S", 0.05)
+
+    class _Executor:
+        def close(self):
+            pass
+
+    sched = refit_mod.RefitScheduler.__new__(refit_mod.RefitScheduler)
+    sched._stop = threading.Event()
+    sched.metrics = None
+    sched.logger = logging.getLogger("test-refit-stop-none")
+    sched._executor = _Executor()
+    thread, release = _wedged_thread()
+    sched._thread = thread
+    try:
+        sched.stop()  # must not AttributeError on metrics=None
+    finally:
+        release.set()
+        thread.join(timeout=2.0)
