@@ -13,7 +13,7 @@ columns are ordinary design-matrix features under jit.
 
 from __future__ import annotations
 
-from typing import Dict, Iterable, List, Tuple
+from typing import Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
 import pandas as pd
@@ -78,3 +78,76 @@ def us_holiday_spec_for_range(
     return holiday_spec(
         us_federal_holidays(range(y0, y1 + 1)), lower_window, upper_window
     )
+
+
+_NAMED_CALENDARS = ("US", "none")
+
+
+def merge_calendars(
+    base: Dict[str, Iterable], custom: Dict[str, Iterable]
+) -> Dict[str, List[pd.Timestamp]]:
+    """Base calendar + tenant-supplied custom events, with validation.
+
+    ``custom`` is a plain ``{name: [dates]}`` spec dict (YAML-friendly:
+    values may be date strings).  A custom name colliding with a base
+    holiday is an ERROR, not a silent union — "christmas" meaning one
+    tenant's promo window and the federal date at once would produce an
+    indicator column nobody can interpret; rename the custom event.
+    Unparseable dates fail loudly for the same reason a typo'd conf key
+    does.
+    """
+    overlap = sorted(set(base) & set(custom))
+    if overlap:
+        raise ValueError(
+            f"custom holiday name(s) {overlap} collide with the base "
+            f"calendar; rename the custom event(s)")
+    out: Dict[str, List[pd.Timestamp]] = {
+        name: [pd.Timestamp(ts) for ts in days]
+        for name, days in base.items()
+    }
+    for name, days in custom.items():
+        if not str(name).strip():
+            raise ValueError("custom holiday names must be non-empty")
+        if isinstance(days, (str, bytes)) or not hasattr(days, "__iter__"):
+            days = [days]
+        try:
+            parsed = [pd.Timestamp(ts) for ts in days]
+        except (TypeError, ValueError) as e:
+            raise ValueError(
+                f"custom holiday {name!r} has unparseable date(s): {e}"
+            ) from e
+        if not parsed:
+            raise ValueError(f"custom holiday {name!r} has no dates")
+        out[str(name)] = parsed
+    return out
+
+
+def holiday_spec_for_range(
+    start,
+    end,
+    calendar: str = "US",
+    custom: Optional[Dict[str, Iterable]] = None,
+    lower_window: int = 0,
+    upper_window: int = 0,
+) -> Tuple[Tuple[str, Tuple[int, ...]], ...]:
+    """Named calendar + optional custom events -> static spec over
+    [start, end].
+
+    ``calendar`` picks the algorithmic base ("US" federal, or "none" for
+    custom-only tenants); ``custom`` merges tenant events through
+    :func:`merge_calendars` (overlapping names raise).  This is the
+    resolver both the training pipeline's ``holidays:`` conf and
+    autoprep's ``engine.autoprep.holiday_*`` knobs go through.
+    """
+    name = str(calendar)
+    if name.upper() == "US":
+        y0, y1 = pd.Timestamp(start).year, pd.Timestamp(end).year
+        base = us_federal_holidays(range(y0, y1 + 1))
+    elif name.lower() == "none":
+        base = {}
+    else:
+        raise ValueError(
+            f"unknown holiday calendar {calendar!r}; "
+            f"valid: {_NAMED_CALENDARS}")
+    merged = merge_calendars(base, custom or {})
+    return holiday_spec(merged, lower_window, upper_window)

@@ -12,6 +12,14 @@ from distributed_forecasting_tpu.engine.calibrate import (
     conformal_interval_scale,
 )
 from distributed_forecasting_tpu.engine.season import detect_season_length
+from distributed_forecasting_tpu.engine.autoprep import (
+    AutoprepConfig,
+    PrepReport,
+    PrepResult,
+    autoprep_batch,
+    autoprep_config,
+    configure_autoprep,
+)
 from distributed_forecasting_tpu.engine.order import select_arima_order
 from distributed_forecasting_tpu.engine.blend import (
     BlendResult,
@@ -87,6 +95,12 @@ __all__ = [
     "apply_interval_scale",
     "conformal_interval_scale",
     "detect_season_length",
+    "AutoprepConfig",
+    "PrepReport",
+    "PrepResult",
+    "autoprep_batch",
+    "autoprep_config",
+    "configure_autoprep",
     "select_arima_order",
     "BlendResult",
     "blend_weights",

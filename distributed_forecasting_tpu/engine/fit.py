@@ -258,6 +258,33 @@ def _fit_forecast_impl(y, mask, day, key, model, config, horizon, min_points,
     return params, yhat, lo, hi, ok, day_all
 
 
+def _apply_autoprep(batch: SeriesBatch, autoprep) -> SeriesBatch:
+    """Auto-mode prep shared by the fit entrypoints: when the process-wide
+    ``engine.autoprep`` block is armed (or a config is forced), run the
+    CLEANING stages over the batch — config-shaping stages (season
+    detection, holiday regressors) stay off here because they feed model
+    configs, which the training pipeline owns.  ``autoprep=False`` skips
+    entirely (the pipeline passes this after prepping once)."""
+    if autoprep is False:
+        return batch
+    from distributed_forecasting_tpu.engine.autoprep import (
+        AutoprepConfig,
+        autoprep_batch,
+        autoprep_config,
+    )
+
+    apcfg = autoprep if isinstance(autoprep, AutoprepConfig) \
+        else autoprep_config()
+    if not apcfg.enabled:
+        return batch
+    # the fit sees the repaired tensor, the stored history is untouched
+    apcfg = dataclasses.replace(apcfg, season_detect=False,
+                                holiday_regressors=False)
+    if not apcfg.any_stage:
+        return batch
+    return autoprep_batch(batch, apcfg).batch
+
+
 def fit_forecast(
     batch: SeriesBatch,
     model: str = "prophet",
@@ -266,6 +293,7 @@ def fit_forecast(
     key: Optional[jax.Array] = None,
     min_points: int = DEFAULT_MIN_POINTS,
     xreg=None,
+    autoprep=None,
 ) -> Tuple[object, ForecastResult]:
     """Fit every series and forecast ``horizon`` days past the end of history.
 
@@ -278,10 +306,19 @@ def fit_forecast(
     (S, T+horizon, R) per-series (see ``data.tensorize.tensorize_regressors``
     to build them from long-format rows).  Requires a model registered with
     ``supports_xreg`` and ``config.n_regressors == R``.
+
+    ``autoprep``: ``None`` auto-applies the process-wide ``engine.autoprep``
+    CLEANING stages (zero-run masking, outlier repair, level-shift
+    alignment — config-shaping stages like season/holiday selection stay
+    off here; the training pipeline owns those) when that block is armed;
+    ``False`` skips prep (the pipeline passes this after prepping once);
+    an :class:`~distributed_forecasting_tpu.engine.autoprep.AutoprepConfig`
+    forces one.
     """
     fns = get_model(model)
     validate_grid_cadence(model, batch)
     config = config if config is not None else fns.config_cls()
+    batch = _apply_autoprep(batch, autoprep)
     if (model == "arima" and xreg is None
             and getattr(config, "method", None) == "hr"):
         # ultra-long auto-activation (engine.windowed conf block): above
@@ -360,6 +397,7 @@ def fit_forecast_chunked(
     min_points: int = DEFAULT_MIN_POINTS,
     dispatch: str = "scan",
     xreg=None,
+    autoprep=None,
 ) -> Tuple[object, ForecastResult]:
     """Memory-bounded fit for very large batches (the 50k-series regime).
 
@@ -376,11 +414,14 @@ def fit_forecast_chunked(
     """
     if dispatch not in ("scan", "loop"):
         raise ValueError(f"unknown dispatch {dispatch!r}; 'scan' or 'loop'")
+    # prep ONCE on the full batch (the scan path never reaches
+    # fit_forecast, and per-chunk prep would re-bucket the series axis)
+    batch = _apply_autoprep(batch, autoprep)
     S = batch.n_series
     if S <= chunk_size:
         return fit_forecast(
             batch, model=model, config=config, horizon=horizon, key=key,
-            min_points=min_points, xreg=xreg,
+            min_points=min_points, xreg=xreg, autoprep=False,
         )
     fns = get_model(model)
     config = config if config is not None else fns.config_cls()
@@ -442,6 +483,7 @@ def fit_forecast_chunked(
             sub, model=model, config=config, horizon=horizon,
             key=jax.random.fold_in(key, c), min_points=min_points,
             xreg=xreg_padded[sl] if xreg_padded is not None else xreg,
+            autoprep=False,
         )
         params_list.append(p)
         yhat.append(r.yhat)
@@ -474,6 +516,7 @@ def fit_forecast_bucketed(
     min_points: int = DEFAULT_MIN_POINTS,
     max_buckets: int = 4,
     xreg=None,
+    autoprep=None,
 ):
     """Fit a RAGGED batch in span buckets (SURVEY.md §7.1 bucketed padding).
 
@@ -495,6 +538,9 @@ def fit_forecast_bucketed(
 
     if key is None:
         key = jax.random.PRNGKey(0)
+    # prep ONCE on the shared grid, before span bucketing — repairs on a
+    # bucket's trimmed grid would see truncated interpolation neighborhoods
+    batch = _apply_autoprep(batch, autoprep)
     buckets = bucket_by_span(batch, max_buckets=max_buckets)
     # double-buffered device placement: bucket i+1's transfer is issued
     # while bucket i fits (depth from the pipeline: conf block; device_put
@@ -524,7 +570,7 @@ def fit_forecast_bucketed(
         p, r = fit_forecast(
             sub, model=model, config=config, horizon=horizon,
             key=jax.random.fold_in(key, i), min_points=min_points,
-            xreg=xr,
+            xreg=xr, autoprep=False,
         )
         L_all = int(r.yhat.shape[1])
         lead = T_all - L_all

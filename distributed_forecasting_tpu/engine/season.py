@@ -52,9 +52,16 @@ import numpy as np
 _MIN_LAG = 2
 
 
-@partial(jax.jit, static_argnames=("max_lag",))
-def _acf_scores(y, mask, max_lag: int):
-    """(max_lag+1,) batch-mean masked ACF of diff(y) at lags 0..max_lag.
+def acf_scores_per_series(y, mask, max_lag: int):
+    """Per-series masked ACF of diff(y): ``(r (S, max_lag+1), nonempty
+    (S,) bool)``.
+
+    Plain traceable array code (no jit of its own): ``acf_scores_impl``
+    reduces it to the batch mean for standalone detection, and
+    ``engine/autoprep``'s fused prep program inlines it with a
+    padding-aware mean so seasonality scoring rides the same single
+    dispatch as the cleaning stages (zero-padded filler rows must not
+    dilute the batch score under the comb gate).
 
     The differenced values are winsorized at 6 robust sigmas (MAD) per
     series before correlating: a few percent of promo/glitch spike days
@@ -89,7 +96,18 @@ def _acf_scores(y, mask, max_lag: int):
     acov = num / jnp.maximum(cnt, 1.0)            # (S, max_lag+1)
     a0 = acov[:, :1]
     r = jnp.where(a0 > 1e-12, acov / jnp.maximum(a0, 1e-12), 0.0)
+    return r, jnp.sum(mask, axis=1) > 0
+
+
+def acf_scores_impl(y, mask, max_lag: int):
+    """(max_lag+1,) batch-mean masked ACF of diff(y) at lags 0..max_lag —
+    every series counted in the mean, matching the original batch-level
+    detection semantics (a flat/invalid series contributes its zero row)."""
+    r, _ = acf_scores_per_series(y, mask, max_lag)
     return jnp.mean(r, axis=0)
+
+
+_acf_scores = partial(jax.jit, static_argnames=("max_lag",))(acf_scores_impl)
 
 
 def detect_season_length(
@@ -108,10 +126,28 @@ def detect_season_length(
     module docstring for the selection rationale.
     """
     T = batch.n_time
-    max_lag = int(min(max_lag, max(T // 3, _MIN_LAG)))
+    max_lag = clamp_max_lag(max_lag, T)
     if max_lag < 4:
         return int(default)
     raw = np.asarray(_acf_scores(batch.y, batch.mask, max_lag))
+    return select_period(raw, max_lag, default=default, min_score=min_score)
+
+
+def clamp_max_lag(max_lag: int, n_time: int) -> int:
+    """Shared lag-window clamp: candidates need >= 2 comb teeth in range,
+    so the scan never exceeds T/3 periods."""
+    return int(min(max_lag, max(n_time // 3, _MIN_LAG)))
+
+
+def select_period(raw: np.ndarray, max_lag: int, default: int = 7,
+                  min_score: float = 0.1) -> int:
+    """Host-side period selection over a precomputed (max_lag+1,) ACF
+    score vector — the second half of :func:`detect_season_length`, split
+    out so ``engine/autoprep`` can feed it the ACF its fused prep program
+    already computed (same gate, same matched filter, one dispatch)."""
+    if max_lag < 4 or raw.shape[0] < max_lag + 1:
+        return int(default)
+    raw = np.asarray(raw[: max_lag + 1], dtype=np.float64)
 
     # Harmonic comb score per candidate period m (pitch-detection style):
     # mean ACF at the first <=3 multiples of m MINUS mean at the anti-phase

@@ -1,0 +1,215 @@
+"""Batched data-cleaning kernels for the fused autoprep program.
+
+ARIMA_PLUS's core usability claim is that cleaning — dead-zero stretches,
+holiday effects, level shifts, spike outliers, seasonality — happens
+*inside* the model pipeline, declared and inspectable, not as ad-hoc
+pandas scripts upstream.  These are the device-side pieces: every function
+here is pure jnp over the dense ``(S, T)`` batch layout, shape-static, and
+composed by ``engine/autoprep._autoprep_impl`` into ONE jitted dispatch
+per batch (the same AOT-store discipline as the fit entrypoints).
+
+Kernel notes (why each avoids the obvious per-series loop):
+
+* zero-run lengths use the cummax-of-index trick — ``t - cummax(t where
+  not-zero)`` gives the forward run length at every cell in one scan, the
+  flipped pass gives the backward half, so run masking is O(T) with no
+  data-dependent shapes;
+* the outlier neighborhood mean is a cumsum-differenced box window that
+  EXCLUDES the center cell — a spike must not launder itself into its own
+  baseline — and the residual scale is the per-series MAD
+  (``ops/solve.masked_mad_scale``), so one promo week cannot inflate the
+  threshold that should catch it;
+* repair gathers the nearest valid, non-repaired neighbors on both sides
+  (cummax index scans again) and linearly interpolates; edge cells with a
+  single-sided neighbor take that value, isolated cells keep the original;
+* the CUSUM changepoint is the classic max-|cumsum| statistic with a
+  robust (MAD-of-differences) sigma and a two-sample mean-shift z-score —
+  everything reduces along the time axis, so S series cost one pass.
+
+Nothing here mutates the stored history: repair/masking produce NEW
+tensors plus per-point bool maps; the caller decides what feeds the fit
+and records the rest (``PrepReport``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from distributed_forecasting_tpu.ops.solve import masked_mad_scale
+
+_EPS = 1e-9
+
+
+# -- zero-run masking --------------------------------------------------------
+
+def zero_run_lengths(y: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """(S, T) total length of the observed-zero run each cell sits in.
+
+    A cell counts as "zero" when it is observed (mask > 0) and exactly 0 —
+    tensorize's encoding for both true zero demand and silently dead
+    feeds.  Cells outside any zero run get 0.
+    """
+    S, T = y.shape
+    z = (mask > 0) & (y == 0.0)
+    idx = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (S, T))
+    # forward run length ending at t: distance to the last non-zero cell
+    last_nz = lax.cummax(jnp.where(z, jnp.int32(-1), idx), axis=1)
+    fwd = idx - last_nz
+    # backward run length starting at t: same scan on the flipped series
+    zf = z[:, ::-1]
+    next_nz = lax.cummax(jnp.where(zf, jnp.int32(-1), idx), axis=1)
+    bwd = (idx - next_nz)[:, ::-1]
+    return jnp.where(z, fwd + bwd - 1, 0)
+
+
+def mask_zero_runs(y, mask, min_run: int):
+    """Drop observed-zero runs of >= ``min_run`` cells from the mask.
+
+    Returns ``(mask_clean, dropped)`` — ``dropped`` is the (S, T) bool map
+    of cells that were observed but are now masked out.  Long dead-zero
+    stretches are store closures / feed outages, not demand: leaving them
+    observed biases level and seasonal estimates toward zero; short zero
+    runs (true intermittent demand) stay untouched.
+    """
+    runs = zero_run_lengths(y, mask)
+    dropped = runs >= min_run
+    return jnp.where(dropped, 0.0, mask), dropped
+
+
+# -- MAD outlier scoring + interpolation repair ------------------------------
+
+def _box_window_sums(v: jnp.ndarray, window: int):
+    """Inclusive box window [t-window, t+window] sums along axis 1 via
+    cumsum differences — one scan regardless of window size."""
+    S, T = v.shape
+    cs = jnp.concatenate(
+        [jnp.zeros((S, 1), v.dtype), jnp.cumsum(v, axis=1)], axis=1)
+    t = jnp.arange(T)
+    a = jnp.clip(t - window, 0, T)
+    b = jnp.clip(t + window + 1, 0, T)
+    return cs[:, b] - cs[:, a]
+
+
+def mad_outlier_scores(y, mask, window: int):
+    """Robust per-point spike scores: ``(score (S,T), scale (S,))``.
+
+    The baseline at t is the mean of observed neighbors in a +-window box
+    EXCLUDING t itself; the residual against that baseline is scaled by
+    the per-series MAD of all such residuals.  Cells without any observed
+    neighbor (or whole series whose MAD is 0 — constants can't have
+    spikes) score 0.
+    """
+    vm = y * mask
+    nb_sum = _box_window_sums(vm, window) - vm
+    nb_cnt = _box_window_sums(mask, window) - mask
+    has_nb = nb_cnt > 0
+    nb_mean = nb_sum / jnp.maximum(nb_cnt, 1.0)
+    r = jnp.where(has_nb, y - nb_mean, 0.0)
+    valid = mask * has_nb.astype(mask.dtype)
+    scale = masked_mad_scale(r, valid)
+    score = jnp.abs(r) / jnp.maximum(scale, _EPS)[:, None]
+    score = jnp.where((valid > 0) & (scale[:, None] > 0), score, 0.0)
+    return score, scale
+
+
+def interpolate_repair(y, mask, repair: jnp.ndarray):
+    """Replace flagged cells by linear interpolation between the nearest
+    valid NON-flagged observed neighbors.
+
+    Returns ``(y_repaired, repaired)`` — ``repaired`` is the (S, T) bool
+    map of cells whose value actually changed source (both may be smaller
+    than ``repair`` where no anchor neighbor exists: an isolated series
+    of flagged cells keeps its original values rather than inventing
+    data).  The input ``y`` is never modified in place; callers keep the
+    original tensor as the stored history.
+    """
+    S, T = y.shape
+    good = (mask > 0) & ~repair
+    idx = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (S, T))
+    prev_i = lax.cummax(jnp.where(good, idx, jnp.int32(-1)), axis=1)
+    next_rev = lax.cummax(jnp.where(good[:, ::-1], idx, jnp.int32(-1)),
+                          axis=1)[:, ::-1]
+    next_i = jnp.where(next_rev >= 0, (T - 1) - next_rev, jnp.int32(T))
+    has_prev = prev_i >= 0
+    has_next = next_i < T
+    rows = jnp.arange(S)[:, None]
+    v_prev = y[rows, jnp.clip(prev_i, 0, T - 1)]
+    v_next = y[rows, jnp.clip(next_i, 0, T - 1)]
+    span = jnp.maximum((next_i - prev_i).astype(y.dtype), 1.0)
+    w_next = (idx - prev_i).astype(y.dtype) / span
+    interp = v_prev * (1.0 - w_next) + v_next * w_next
+    filled = jnp.where(
+        has_prev & has_next, interp,
+        jnp.where(has_prev, v_prev, jnp.where(has_next, v_next, y)))
+    repaired = repair & (has_prev | has_next) & (mask > 0)
+    return jnp.where(repaired, filled, y), repaired
+
+
+# -- CUSUM level-shift detection ---------------------------------------------
+
+def cusum_level_shift(y, mask, threshold: float):
+    """Single most-significant level shift per series.
+
+    Returns ``(cp_index (S,) int32, shift (S,), score (S,))`` where
+    ``cp_index`` is the last cell of the pre-shift segment (-1 when no
+    shift clears ``threshold``), ``shift`` is mean(after) - mean(before),
+    and ``score`` is the two-sample mean-shift z using a robust sigma
+    (MAD of first differences / sqrt(2) — immune to the shift itself,
+    which a global residual sigma is not).
+    """
+    m = mask
+    S, T = y.shape
+    n_tot = jnp.sum(m, axis=1)
+    tot = jnp.sum(y * m, axis=1)
+    mu = tot / jnp.maximum(n_tot, 1.0)
+    dev = jnp.cumsum((y - mu[:, None]) * m, axis=1)
+    n_left = jnp.cumsum(m, axis=1)
+    s_left = jnp.cumsum(y * m, axis=1)
+    n_right = n_tot[:, None] - n_left
+    # candidate split t needs real mass on BOTH sides; the last column
+    # (n_right = 0) and leading unobserved cells are excluded by scoring
+    valid = (n_left >= 2.0) & (n_right >= 2.0)
+    stat = jnp.where(valid, jnp.abs(dev), -jnp.inf)
+    cp = jnp.argmax(stat, axis=1).astype(jnp.int32)
+    rows = jnp.arange(S)
+    nl = jnp.maximum(n_left[rows, cp], 1.0)
+    nr = jnp.maximum(n_right[rows, cp], 1.0)
+    mean_l = s_left[rows, cp] / nl
+    mean_r = (tot - s_left[rows, cp]) / nr
+    shift = mean_r - mean_l
+    dy = y[:, 1:] - y[:, :-1]
+    dm = m[:, 1:] * m[:, :-1]
+    sigma = masked_mad_scale(dy, dm) / jnp.sqrt(2.0)
+    se = jnp.maximum(sigma, _EPS) * jnp.sqrt(1.0 / nl + 1.0 / nr)
+    score = jnp.abs(shift) / se
+    found = valid[rows, cp] & (score >= threshold) & (sigma > 0)
+    return (jnp.where(found, cp, jnp.int32(-1)),
+            jnp.where(found, shift, 0.0),
+            jnp.where(found, score, 0.0))
+
+
+def align_level_shift(y, mask, cp_index, shift):
+    """Re-level the PRE-shift segment onto the post-shift level: cells at
+    or before ``cp_index`` get ``+ shift``.  Series with ``cp_index < 0``
+    pass through untouched.  This feeds the FIT tensor only — the stored
+    history keeps the raw values (the report records the alignment)."""
+    del mask  # alignment applies to the whole grid; masked cells are inert
+    t = jnp.arange(y.shape[1], dtype=jnp.int32)[None, :]
+    pre = (t <= cp_index[:, None]) & (cp_index[:, None] >= 0)
+    return jnp.where(pre, y + shift[:, None], y)
+
+
+# -- holiday indicators ------------------------------------------------------
+
+def holiday_indicators(day_grid: jnp.ndarray,
+                       holiday_days: jnp.ndarray) -> jnp.ndarray:
+    """(G,) day ordinals x (R, D) padded per-holiday day lists -> (G, R)
+    0/1 indicator matrix (the design-matrix columns holiday regressors
+    become).  ``holiday_days`` pads ragged occurrence lists with -1, which
+    never matches a real epoch-day ordinal on the served grids."""
+    if holiday_days.size == 0:
+        return jnp.zeros((day_grid.shape[0], holiday_days.shape[0]),
+                         jnp.float32)
+    hit = day_grid[:, None, None] == holiday_days[None, :, :]
+    return jnp.any(hit, axis=-1).astype(jnp.float32)

@@ -28,6 +28,7 @@ import time
 from typing import Any, Dict, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import pandas as pd
 
@@ -201,24 +202,21 @@ def _resolve_holidays_conf(
     epoch = pd.Timestamp("1970-01-01")
     start = epoch + pd.Timedelta(days=int(batch.day[0]))
     end = epoch + pd.Timedelta(days=int(batch.day[-1]) + horizon)
-    cal: Dict[str, Any] = {}
     name = spec.get("calendar")
-    if name:
-        if str(name).upper() != "US":
-            raise ValueError(
-                f"unknown holiday calendar {name!r}; supported: 'US' "
-                f"(plus custom date lists via the 'custom' key)"
-            )
-        cal.update(H.us_federal_holidays(range(start.year, end.year + 1)))
-    for event, dates in (spec.get("custom") or {}).items():
-        cal[str(event)] = [pd.Timestamp(d) for d in dates]
-    if not cal:
+    custom = spec.get("custom") or {}
+    if not name and not custom:
         raise ValueError(
             "holidays conf resolved to an empty calendar: give 'calendar: "
             "US', a 'custom' dates dict, or both"
         )
     out = dict(model_conf)
-    out["holidays"] = H.holiday_spec(cal, lower, upper)
+    # the shared resolver validates the calendar name AND rejects custom
+    # names that collide with base holidays (a tenant's "christmas" promo
+    # silently replacing the federal date was exactly the ambiguity the
+    # old dict-update merge allowed)
+    out["holidays"] = H.holiday_spec_for_range(
+        start, end, calendar=(name or "none"), custom=custom,
+        lower_window=lower, upper_window=upper)
     return out
 
 
@@ -421,10 +419,50 @@ class TrainingPipeline:
                 df = self.catalog.read_table(source_table)
             with timer.phase("tensorize"):
                 batch = tensorize(df, key_cols=key_cols, freq=freq)
+            # fused data prep BEFORE config resolution: the fit sees the
+            # cleaned tensor, and a detected season feeds the config the
+            # same way season_length: auto would (but from the repaired
+            # series — a 30-sigma spike no longer poisons the ACF)
+            mconf = model_conf
+            prep_report = None
+            prep_xreg = None
+            prep_frames = None
+            from distributed_forecasting_tpu.engine.autoprep import (
+                autoprep_config,
+            )
+
+            apcfg = autoprep_config()
+            if apcfg.enabled and apcfg.any_stage:
+                from distributed_forecasting_tpu.engine.autoprep import (
+                    autoprep_batch,
+                )
+
+                with timer.phase("autoprep"):
+                    prep_res = autoprep_batch(batch, apcfg, horizon=horizon)
+                prep_report = prep_res.report
+                prep_xreg = prep_res.xreg
+                if prep_report is not None:
+                    # materialize the artifact frames against the RAW batch
+                    # before it is swapped for the cleaned tensor —
+                    # repairs_frame's y_raw column is the original value
+                    prep_frames = {
+                        "prep_report.parquet":
+                            prep_report.to_frame(batch),
+                        "prep_repairs.parquet":
+                            prep_report.repairs_frame(batch),
+                    }
+                batch = prep_res.batch
+                if (prep_res.season_length is not None
+                        and (mconf or {}).get("season_length") == "auto"):
+                    mconf = dict(mconf)
+                    mconf["season_length"] = int(prep_res.season_length)
+                self.logger.info(
+                    "autoprep: %s", prep_report.summary()
+                    if prep_report else "{}")
             # config AFTER tensorize: a named holiday calendar resolves over
             # the batch's actual date range (+horizon)
             config = _config_from_conf(
-                model, _resolve_model_conf(model, model_conf, batch, horizon,
+                model, _resolve_model_conf(model, mconf, batch, horizon,
                                            cv_conf)
             )
             if (model_conf or {}).get("season_length") == "auto":
@@ -446,6 +484,28 @@ class TrainingPipeline:
                     xreg, config = _load_regressors(
                         self.catalog, regressors, batch, horizon, config
                     )
+            if prep_xreg is not None:
+                # autoprep holiday indicator columns join the regressor
+                # tensor exactly like conf-driven covariates (shared
+                # calendar: (T+H, Rh)) — names stamped into the config so
+                # the artifact records what the fit saw
+                import dataclasses as _dc
+
+                hnames = tuple(prep_report.holiday_names)
+                if xreg is None:
+                    xreg = prep_xreg
+                elif xreg.ndim == 3:
+                    hx = jnp.broadcast_to(
+                        prep_xreg[None],
+                        (xreg.shape[0],) + prep_xreg.shape)
+                    xreg = jnp.concatenate([xreg, hx], axis=-1)
+                else:
+                    xreg = jnp.concatenate([xreg, prep_xreg], axis=-1)
+                config = _dc.replace(
+                    config,
+                    n_regressors=int(config.n_regressors) + len(hnames),
+                    regressor_names=tuple(config.regressor_names) + hnames,
+                )
             self.logger.info(
                 "fine-grained fit: %d series x %d days, model=%s%s",
                 batch.n_series, batch.n_time, model,
@@ -453,7 +513,8 @@ class TrainingPipeline:
                 else "",
             )
             return {"timer": timer, "batch": batch, "config": config,
-                    "xreg": xreg}
+                    "xreg": xreg, "prep_report": prep_report,
+                    "prep_frames": prep_frames}
 
         def dispatch(state: Dict[str, Any]) -> Dict[str, Any]:
             timer, batch = state["timer"], state["batch"]
@@ -496,11 +557,13 @@ class TrainingPipeline:
                         buckets, result = fit_forecast_bucketed(
                             batch, model=model, config=config,
                             horizon=horizon, key=key, xreg=xreg,
+                            autoprep=False,  # prep() already cleaned
                         )
                     else:
                         params, result = fit_forecast(
                             batch, model=model, config=config,
                             horizon=horizon, key=key, xreg=xreg,
+                            autoprep=False,  # prep() already cleaned
                         )
             state.update(t_start=t_start, cv=cv, cv_metrics=cv_metrics,
                          cv_frame=cv_frame, buckets=buckets, params=params,
@@ -610,6 +673,17 @@ class TrainingPipeline:
                     cov_c = np.asarray(cv_metrics["_coverage_calibrated"])
                     series_table["coverage_calibrated"] = cov_c
                     agg["val_coverage_calibrated"] = float(np.mean(cov_c[ok])) if ok.any() else float("nan")
+                prep_report = state.get("prep_report")
+                if prep_report is not None:
+                    # what autoprep did, per batch (metrics), per series
+                    # (prep_report) and per repaired point (prep_repairs) —
+                    # the inspectability contract: repairs exist in the fit
+                    # tensor and in these artifacts, never in stored history
+                    agg.update(prep_report.summary())
+                    for name, frame in (state.get("prep_frames")
+                                        or {}).items():
+                        if len(frame):
+                            run.log_table(name, frame)
                 run.log_metrics(agg)
                 run.log_table("series_metrics.parquet", series_table)
                 if cv_artifact and run_cross_validation:

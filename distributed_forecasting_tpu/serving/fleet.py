@@ -55,6 +55,7 @@ from distributed_forecasting_tpu.serving.sharding import (
     ShardingConfig,
     TokenBucket,
     compute_assignments,
+    merge_detect_responses,
     merge_ingest_responses,
     merge_invocation_responses,
     plan_request,
@@ -413,6 +414,9 @@ def default_spawn_fn(
             # shared verbatim — replicas converge by following one log
             # (the replica defaults apply_mode to "interval" in a fleet)
             "ingest": serving_conf.get("ingest"),
+            # anomaly scoring conf: each replica scores its own shards'
+            # points; the front door scatter-gathers /detect_anomalies
+            "anomaly": serving_conf.get("anomaly"),
             # series partition: the child subsets its forecaster/WAL to
             # these shards and follows only their wal_dir/shard-<k>/ logs
             "sharding": (None if sharding is None
@@ -1333,8 +1337,13 @@ class _FrontDoorHandler(BaseHTTPRequestHandler):
             for t in threads:
                 t.join()
         sup.note_scatter()
+        # /ingest and /detect_anomalies share the "points" field, so the
+        # merge dispatches on the path, not the plan's field name
         if plan.field == "inputs":
             status, merged = merge_invocation_responses(
+                plan, self._schema_key_names() or (), responses)
+        elif self.path == "/detect_anomalies":
+            status, merged = merge_detect_responses(
                 plan, self._schema_key_names() or (), responses)
         else:
             status, merged = merge_ingest_responses(plan, responses)

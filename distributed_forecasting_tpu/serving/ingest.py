@@ -254,6 +254,11 @@ class IngestRuntime:
         self.wal = wal
         self.metrics = metrics if metrics is not None else IngestMetrics()
         self.refit = refit_scheduler
+        # optional streaming anomaly leg (serving/anomaly.AnomalyScorer),
+        # late-bound by ForecastServer when serving.anomaly.stream_scoring
+        # is on: validated batches score against the CURRENT bands before
+        # the sync apply moves the frontier
+        self.anomaly = None
         self.logger = get_logger("IngestRuntime")
         self.key_names = tuple(forecaster.key_names)
         self._key_index = {
@@ -346,6 +351,15 @@ class IngestRuntime:
             self.metrics.unknown_series_total.inc(unknown)
         if out_of_range:
             self.metrics.out_of_range_total.inc(out_of_range)
+        if rows and self.anomaly is not None:
+            # streaming anomaly leg: score the batch against the bands as
+            # they stand BEFORE this batch applies (a point must not
+            # vouch for itself).  The WAL append above is already
+            # durable, so a scoring failure must never fail the ingest.
+            try:
+                out["anomalies"] = self.anomaly.score_ingest(rows)
+            except Exception:  # noqa: BLE001
+                self.logger.exception("ingest anomaly scoring failed")
         if rows and self.config.apply_mode == "sync":
             out["applied"] = self.poll_apply()
         return out

@@ -229,6 +229,24 @@ def main(argv=None) -> None:
         if ingest is not None:
             logger.info("streaming ingest: shared WAL at %s (%s mode)",
                         ingest.wal.directory, ingest.config.apply_mode)
+    anomaly = None
+    if conf.get("anomaly"):
+        from distributed_forecasting_tpu.serving.anomaly import (
+            build_anomaly_runtime,
+        )
+
+        # per-replica stream directory for the same reason as the quality
+        # store: segment cursors are per-process state
+        anomaly = build_anomaly_runtime(
+            conf["anomaly"],
+            forecaster,
+            default_store_dir=os.path.join(
+                conf["artifact_dir"], "anomaly_stream",
+                f"replica-{int(conf['port'])}"),
+        )
+        if anomaly is not None:
+            logger.info("anomaly scoring on: threshold=%.3f",
+                        anomaly.threshold)
     srv = start_server(
         forecaster,
         host=conf.get("host", "127.0.0.1"),
@@ -238,6 +256,7 @@ def main(argv=None) -> None:
         ready=False,  # warm first; the supervisor routes on /readyz
         quality=quality,
         ingest=ingest,
+        anomaly=anomaly,
         extra_metrics=shard_metrics,
     )
     sizes = conf.get("warmup_sizes")

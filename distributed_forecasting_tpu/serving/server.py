@@ -211,8 +211,15 @@ class _Handler(BaseHTTPRequestHandler):
                 text += self.server.quality.render_metrics()
             if self.server.ingest is not None:
                 text += self.server.ingest.render_metrics()
+            if self.server.anomaly is not None:
+                text += self.server.anomaly.render_metrics()
             if self.server.extra_metrics is not None:
                 text += self.server.extra_metrics.render()
+            from distributed_forecasting_tpu.data.quality import (
+                render_data_quality_metrics,
+            )
+
+            text += render_data_quality_metrics()
             body = text.encode()
             self.send_response(200)
             self.send_header(
@@ -311,6 +318,9 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if self.path == "/ingest":
             self._ingest()
+            return
+        if self.path == "/detect_anomalies":
+            self._detect_anomalies()
             return
         if self.path not in ("/invocations", "/predict"):
             self._send(404, {"error": f"no route {self.path}"})
@@ -506,6 +516,74 @@ class _Handler(BaseHTTPRequestHandler):
             self.server.logger.exception("observe failed")
             self._send(500, {"error": f"{type(e).__name__}: {e}"})
 
+    def _detect_anomalies(self):
+        """POST /detect_anomalies: score actuals against the served bands.
+
+        Body: ``{"points": [{<key cols>, "ds": "...", "y": ...}, ...],
+        "threshold": 4.0, "on_missing": "skip"|"raise"}``.  One batched
+        predict per request (through the coalescer when batching is on),
+        per-point ``anomaly_score`` + ``is_anomaly`` back in request
+        order.  503 when no anomaly runtime is configured
+        (``serving.anomaly`` conf block).
+        """
+        anomaly = self.server.anomaly
+        if anomaly is None:
+            self._send(503, {"error": "anomaly detection not enabled "
+                                      "(serving.anomaly conf block)"})
+            return
+        tracer = get_tracer()
+        self._trace_id = _safe_trace_id(self.headers.get("X-Trace-Id"))
+        try:
+            with tracer.root_span(
+                "http.request", trace_id=self._trace_id,
+                method="POST", path="/detect_anomalies",
+            ) as root:
+                self._trace_id = root.trace_id or self._trace_id
+                length = int(self.headers.get("Content-Length", "0"))
+                req = json.loads(self.rfile.read(length) or b"{}")
+                if not isinstance(req, dict):
+                    self._send(400, {"error": "body must be a JSON object "
+                                              "with 'points'"})
+                    return
+                points = req.get("points")
+                if not points or not isinstance(points, list):
+                    self._send(400, {"error": "body needs a non-empty "
+                                              "'points' list"})
+                    return
+                if len(points) > anomaly.config.max_points_per_request:
+                    self._send(400, {
+                        "error": f"request has {len(points)} points; "
+                                 f"max_points_per_request="
+                                 f"{anomaly.config.max_points_per_request}"})
+                    return
+                threshold = req.get("threshold")
+                if threshold is not None:
+                    threshold = float(threshold)
+                    if not threshold > 0:
+                        self._send(400, {"error": "threshold must be > 0"})
+                        return
+                out = anomaly.score(
+                    pd.DataFrame(points),
+                    on_missing=req.get("on_missing", "skip"),
+                    threshold=threshold)
+                root.set_attribute("points", len(points))
+                root.set_attribute("flagged", out["n_flagged"])
+                self._send(200, out)
+                root.set_attribute("status", self._status)
+        except UnknownSeriesError as e:
+            self._send(404, {"error": str(e)})
+        except QueueFullError as e:
+            self._send(429, {"error": str(e)},
+                       extra_headers=(("Retry-After", "1"),))
+        except (TimeoutError, _FutureTimeoutError) as e:
+            self._send(503, {"error": f"request timed out: {e}" if str(e)
+                             else "request timed out"})
+        except (ValueError, TypeError, KeyError, json.JSONDecodeError) as e:
+            self._send(400, {"error": f"{type(e).__name__}: {e}"})
+        except Exception as e:  # noqa: BLE001 — scorer must not die mid-request
+            self.server.logger.exception("detect_anomalies failed")
+            self._send(500, {"error": f"{type(e).__name__}: {e}"})
+
     def _ingest(self):
         """POST /ingest: new observations into the streaming WAL.
 
@@ -567,6 +645,7 @@ class ForecastServer(ThreadingHTTPServer):
         quality=None,
         ingest=None,
         extra_metrics=None,
+        anomaly=None,
     ):
         super().__init__(addr, _Handler)
         self.forecaster = forecaster
@@ -596,6 +675,23 @@ class ForecastServer(ThreadingHTTPServer):
                 "streaming ingest on: wal_dir=%s apply_mode=%s refit=%s",
                 ingest.wal.directory, ingest.config.apply_mode,
                 "on" if ingest.refit is not None else "off")
+        # the anomaly scorer (serving/anomaly.AnomalyScorer): detection
+        # batches ride the SAME coalescing dispatch as forecast traffic,
+        # so /detect_anomalies under load shares device batches with
+        # /invocations instead of competing with them
+        self.anomaly = anomaly
+        if anomaly is not None:
+            anomaly.bind_execute(self.execute)
+            if ingest is not None and anomaly.config.stream_scoring:
+                # streaming leg: every validated /ingest batch is scored
+                # against the current bands (serving/ingest.py hooks this
+                # BEFORE the sync apply — a point must not vouch for
+                # itself)
+                ingest.anomaly = anomaly
+            self.logger.info(
+                "anomaly detection on: threshold=%.3f stream_scoring=%s",
+                anomaly.threshold,
+                anomaly.config.stream_scoring and ingest is not None)
         # readiness is an Event, not a guarded flag: it is set exactly once
         # after warmup and cleared at shutdown, and /readyz polls it
         self._ready = threading.Event()
@@ -694,6 +790,7 @@ def start_server(
     quality=None,
     ingest=None,
     extra_metrics=None,
+    anomaly=None,
 ) -> ForecastServer:
     """Start serving on a background thread; returns the server (its
     ``server_address[1]`` is the bound port — port=0 picks a free one).
@@ -701,7 +798,7 @@ def start_server(
     for launchers that warm the compile ladder against the live server."""
     srv = ForecastServer((host, port), forecaster, model_version, batching,
                          quality=quality, ingest=ingest,
-                         extra_metrics=extra_metrics)
+                         extra_metrics=extra_metrics, anomaly=anomaly)
     if ready:
         srv.mark_ready()
     t = threading.Thread(target=srv.serve_forever, daemon=True)
@@ -717,9 +814,10 @@ def serve(
     batching: Optional[BatchingConfig] = None,
     quality=None,
     ingest=None,
+    anomaly=None,
 ) -> None:
     srv = ForecastServer((host, port), forecaster, model_version, batching,
-                         quality=quality, ingest=ingest)
+                         quality=quality, ingest=ingest, anomaly=anomaly)
     srv.mark_ready()
     srv.logger.info("serving on %s:%d", host, port)
     srv.serve_forever()

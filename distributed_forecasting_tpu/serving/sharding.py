@@ -395,6 +395,7 @@ _ROUTED_FIELDS = {
     "/predict": "inputs",
     "/ingest": "points",
     "/observe": "observations",
+    "/detect_anomalies": "points",
 }
 
 
@@ -485,6 +486,67 @@ def merge_invocation_responses(
     if errors:
         merged["errors"] = errors
         merged["n_failed_series"] = len(errors)
+    if not any(status == 200 for status, _ in responses.values()):
+        return 503, merged
+    return 200, merged
+
+
+def merge_detect_responses(
+    plan: RoutePlan,
+    key_names: Sequence[str],
+    responses: Dict[int, Tuple[int, bytes]],
+) -> Tuple[int, Dict]:
+    """Scatter-gather merge for ``/detect_anomalies``.
+
+    Same shape as :func:`merge_invocation_responses`: successful shards'
+    per-point results regroup by key tuple in the ORIGINAL request key
+    order (scores are per-series computations, independent of batch
+    composition), summary counts sum, and a failed shard degrades to
+    per-key ``errors`` entries while the other shards' verdicts still
+    ship.  Status: 200 unless EVERY shard failed (503, retryable)."""
+    by_key: Dict[Tuple, List] = {}
+    totals = {"n_scored": 0, "n_flagged": 0, "n_skipped": 0}
+    threshold = None
+    errors: List[Dict] = []
+    key_names = list(key_names)
+    for shard, (status, payload) in sorted(responses.items()):
+        if status == 200:
+            try:
+                parsed = json.loads(payload)
+            except ValueError:
+                parsed = None
+            if not isinstance(parsed, dict):
+                status, parsed = 502, {"error": "unparseable shard response"}
+            else:
+                for k in totals:
+                    totals[k] += int(parsed.get(k, 0))
+                if threshold is None:
+                    threshold = parsed.get("threshold")
+                for rec in parsed.get("results", []):
+                    try:
+                        key = tuple(int(rec[n]) for n in key_names)
+                    except (KeyError, TypeError, ValueError):
+                        continue
+                    by_key.setdefault(key, []).append(rec)
+                continue
+        try:
+            detail = json.loads(payload).get("error", "")
+        except (ValueError, AttributeError):
+            detail = ""
+        for key in plan.shard_keys.get(shard, []):
+            entry = dict(zip(key_names, (int(v) for v in key)))
+            entry["error"] = detail or f"shard {shard} unavailable"
+            entry["status"] = int(status)
+            entry["shard"] = int(shard)
+            errors.append(entry)
+    results: List = []
+    for key in plan.key_order:
+        results.extend(by_key.get(key, []))
+    merged: Dict = {"results": results, **totals}
+    if threshold is not None:
+        merged["threshold"] = threshold
+    if errors:
+        merged["errors"] = errors
     if not any(status == 200 for status, _ in responses.values()):
         return 503, merged
     return 200, merged

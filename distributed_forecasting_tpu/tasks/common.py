@@ -141,9 +141,16 @@ class Task(ABC):
         #         window_len: 8192         # W, periods per window
         #         overlap: 256             # shared periods between windows
         #         min_windows: 4           # auto-activates at W*min_windows
+        # Fused automatic data prep (engine/autoprep.py) rides the same
+        # block:
+        #
+        #     engine:
+        #       autoprep:
+        #         enabled: false           # arms the fused pre-fit program
+        #         (stage gates + thresholds: docs/autoprep.md)
         eng = self.conf.get("engine") if isinstance(self.conf, dict) else None
         if eng is not None:
-            known_eng = {"windowed"}
+            known_eng = {"windowed", "autoprep"}
             unknown_eng = set(eng) - known_eng
             if unknown_eng:
                 raise ValueError(
@@ -155,6 +162,12 @@ class Task(ABC):
                 )
 
                 configure_windowed(eng["windowed"])
+            if eng.get("autoprep") is not None:
+                from distributed_forecasting_tpu.engine.autoprep import (
+                    configure_autoprep,
+                )
+
+                configure_autoprep(eng["autoprep"])
 
     # lazy infra handles ----------------------------------------------------
     @property

@@ -47,6 +47,15 @@ Conf::
           max_staleness_s: 3600      # incremental-only unless the artifact
           check_interval_s: 5        # dir carries history.npz)
           drift_coverage_tol: 0.15
+      anomaly:                # optional anomaly scoring (serving/anomaly.py)
+        enabled: true         # default false: POST /detect_anomalies -> 503
+        threshold: 0.0        # sigma-score flag cutoff; 0 -> the artifact's
+                              # calibrated interval z-width
+        max_horizon: 365      # points further past the fit end are skipped
+        max_points_per_request: 10000
+        stream_scoring: true  # score every accepted /ingest batch too
+        stream_store_dir: null  # flagged-point JSONL stream
+                              # (default <env.root>/anomaly_stream)
     compile_cache:            # optional persistent compile cache + AOT
       enabled: true           # store (engine/compile_cache): warmup loads
       directory: null         # serialized bucket programs from disk
@@ -123,6 +132,21 @@ class ServeTask(Task):
                 quality.slo is not None)
         ingest = self._build_ingest(conf.get("ingest"), forecaster,
                                     version, quality, env)
+        from distributed_forecasting_tpu.serving.anomaly import (
+            build_anomaly_runtime,
+        )
+
+        anomaly = build_anomaly_runtime(
+            conf.get("anomaly"),
+            forecaster,
+            default_store_dir=os.path.join(
+                env.get("root", "./dftpu_store"), "anomaly_stream"),
+        )
+        if anomaly is not None:
+            self.logger.info(
+                "anomaly detection on: threshold=%.3f stream=%s",
+                anomaly.threshold,
+                anomaly.config.stream_scoring and ingest is not None)
         sizes = conf.get("warmup_sizes")
         if sizes:
             import time
@@ -158,6 +182,7 @@ class ServeTask(Task):
             batching=batching,
             quality=quality,
             ingest=ingest,
+            anomaly=anomaly,
         )
 
     def _build_ingest(self, ingest_conf, forecaster, version, quality, env):

@@ -1,0 +1,235 @@
+"""CI smoke: serve -> plant outliers -> /detect_anomalies precision/recall.
+
+The end-to-end demo of the on-device anomaly detection layer
+(``serving/anomaly.py`` + the ``/detect_anomalies`` endpoint and the
+``/ingest`` streaming leg) on the REAL fleet path:
+
+  1. fit a small multi-series theta model (streaming-capable family) and
+     save the artifact with its training-history sidecar;
+  2. boot a 1-replica fleet (``serving/fleet.py``) with ``anomaly:`` and
+     ``ingest:`` conf blocks flowing through the spawner;
+  3. build next-day actuals from the model's OWN served bands: point
+     outliers planted tens of sigmas off on half the series, a 3-day
+     level shift on one series, and on-band clean points everywhere
+     else;
+  4. POST them to the FRONT DOOR's ``/detect_anomalies`` and gate on
+     precision/recall against the planted truth (the separation is
+     deterministic — the default gate is exact);
+  5. POST the same day-1 points to ``/ingest`` and require the streaming
+     leg's ``anomalies`` ack summary to agree, the ``dftpu_anomaly_*``
+     families to show on both the replica and fleet ``/metrics``, and
+     the flagged points to land on the replica's JSONL anomaly stream
+     from BOTH sources.
+
+Run::
+
+    python scripts/anomaly_smoke.py --workdir /tmp/anomaly_smoke
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import http.client
+import json
+import os
+import shutil
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _post(host: str, port: int, path: str, payload: dict,
+          timeout: float = 60.0) -> tuple:
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request("POST", path, json.dumps(payload),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read() or b"{}")
+    finally:
+        conn.close()
+
+
+def _get(host: str, port: int, path: str, timeout: float = 10.0) -> tuple:
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, resp.read().decode()
+    finally:
+        conn.close()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--workdir", default="/tmp/anomaly_smoke")
+    ap.add_argument("--series", type=int, default=8,
+                    help="synthetic series count (stores x items)")
+    ap.add_argument("--days", type=int, default=200)
+    ap.add_argument("--planted-sigma", type=float, default=40.0,
+                    help="severity of planted point outliers, in band sigmas")
+    ap.add_argument("--min-precision", type=float, default=1.0)
+    ap.add_argument("--min-recall", type=float, default=1.0)
+    args = ap.parse_args()
+
+    import numpy as np
+    import pandas as pd
+
+    from distributed_forecasting_tpu.data import (
+        synthetic_store_item_sales,
+        tensorize,
+    )
+    from distributed_forecasting_tpu.engine import fit_forecast
+    from distributed_forecasting_tpu.models import ThetaConfig
+    from distributed_forecasting_tpu.serving import BatchForecaster
+    from distributed_forecasting_tpu.serving.fleet import (
+        FleetConfig,
+        start_fleet,
+    )
+
+    if os.path.exists(args.workdir):
+        shutil.rmtree(args.workdir)
+    os.makedirs(args.workdir)
+
+    # 1. fit + save the artifact (theta: the /ingest leg needs a streaming
+    # update kernel) with the history sidecar the replica's ingest loads
+    df = synthetic_store_item_sales(
+        n_stores=2, n_items=max(args.series // 2, 1),
+        n_days=args.days, seed=13)
+    batch = tensorize(df)
+    cfg = ThetaConfig()
+    params, _ = fit_forecast(batch, model="theta", config=cfg, horizon=30)
+    fc = BatchForecaster.from_fit(batch, params, "theta", cfg)
+    artifact_dir = os.path.join(args.workdir, "artifact")
+    fc.save(artifact_dir)
+    np.savez(os.path.join(artifact_dir, "history.npz"),
+             y=np.asarray(batch.y), mask=np.asarray(batch.mask))
+
+    # 3. actuals derived from the model's own served bands, so the planted
+    # severities are exact by construction whatever the fit did
+    keys = pd.DataFrame(np.asarray(fc.keys), columns=["store", "item"])
+    pred = fc.predict(keys, horizon=3)
+    z95 = 1.959964
+    points, truth = [], []
+    for i, (store, item) in enumerate(keys.itertuples(index=False)):
+        rows = pred[(pred["store"] == store) & (pred["item"] == item)]
+        r1 = rows.iloc[0]
+        sigma1 = max(float(r1["yhat_upper"] - r1["yhat"]) / z95, 1e-9)
+        planted = i % 2 == 0
+        y1 = float(r1["yhat"]) + (args.planted_sigma * sigma1 if planted
+                                  else 0.2 * sigma1)
+        points.append({"store": int(store), "item": int(item),
+                       "ds": str(pd.Timestamp(r1["ds"]).date()), "y": y1})
+        truth.append(planted)
+        if i == 1:
+            # a 3-day level shift on one otherwise-clean series: every day
+            # of the shifted regime must flag on its own band
+            for h in range(3):
+                rh = rows.iloc[h]
+                sig = max(float(rh["yhat_upper"] - rh["yhat"]) / z95, 1e-9)
+                points.append({"store": int(store), "item": int(item),
+                               "ds": str(pd.Timestamp(rh["ds"]).date()),
+                               "y": float(rh["yhat"]) + 12.0 * sig})
+                truth.append(True)
+
+    # 2. one-replica fleet with the anomaly + ingest blocks flowing through
+    fleet = FleetConfig(enabled=True, replicas=1, ready_timeout_s=600)
+    supervisor, front = start_fleet(
+        fleet,
+        artifact_dir=artifact_dir,
+        serving_conf={"warmup_sizes": [args.series], "warmup_horizon": 30,
+                      "anomaly": {"enabled": True},
+                      "ingest": {"enabled": True}},
+        front_host="127.0.0.1",
+        front_port=0,
+    )
+    front_port = front.server_address[1]
+    replica_port = supervisor.all_ports()[0]
+    failures = []
+    try:
+        # 4. detection through the front door, gated on the planted truth
+        status, det = _post("127.0.0.1", front_port, "/detect_anomalies",
+                            {"points": points})
+        print("detect:", status, json.dumps(
+            {k: det.get(k) for k in
+             ("n_scored", "n_flagged", "n_skipped", "threshold")}))
+        if status != 200:
+            failures.append(f"/detect_anomalies failed: {status} {det}")
+            results = []
+        else:
+            results = det.get("results", [])
+        if len(results) != len(points):
+            failures.append(f"expected {len(points)} verdicts, "
+                            f"got {len(results)}")
+        flags = [bool(r.get("is_anomaly")) for r in results]
+        tp = sum(1 for f, t in zip(flags, truth) if f and t)
+        fp = sum(1 for f, t in zip(flags, truth) if f and not t)
+        fn = sum(1 for f, t in zip(flags, truth) if not f and t)
+        precision = tp / max(tp + fp, 1)
+        recall = tp / max(tp + fn, 1)
+        print(f"planted={sum(truth)} tp={tp} fp={fp} fn={fn} "
+              f"precision={precision:.3f} recall={recall:.3f}")
+        if precision < args.min_precision:
+            failures.append(f"precision {precision:.3f} < "
+                            f"{args.min_precision}")
+        if recall < args.min_recall:
+            failures.append(f"recall {recall:.3f} < {args.min_recall}")
+
+        # 5a. streaming leg: the same day-1 points through /ingest must
+        # score BEFORE the state update applies and agree on the count
+        day1 = [p for p in points
+                if p["ds"] == points[0]["ds"]]
+        day1_truth = [t for p, t in zip(points, truth)
+                      if p["ds"] == points[0]["ds"]]
+        status, ack = _post("127.0.0.1", front_port, "/ingest",
+                            {"points": day1})
+        anoms = (ack or {}).get("anomalies") or {}
+        print("ingest:", status, json.dumps(anoms))
+        if status != 200:
+            failures.append(f"/ingest failed: {status} {ack}")
+        elif anoms.get("flagged") != sum(day1_truth):
+            failures.append(
+                f"streaming leg flagged {anoms.get('flagged')} of "
+                f"{len(day1)}; planted {sum(day1_truth)}")
+
+        # 5b. metrics exposition on both the replica and the front door
+        _, replica_metrics = _get("127.0.0.1", replica_port, "/metrics")
+        _, fleet_metrics = _get("127.0.0.1", front_port, "/metrics")
+        for needle in ("dftpu_anomaly_requests_total",
+                       "dftpu_anomaly_flagged_total",
+                       "dftpu_anomaly_threshold"):
+            if needle not in replica_metrics:
+                failures.append(f"{needle} missing from replica /metrics")
+            if needle not in fleet_metrics:
+                failures.append(f"{needle} missing from fleet /metrics")
+    finally:
+        front.shutdown()
+        supervisor.stop()
+
+    # 5c. flagged points persisted on the replica's JSONL anomaly stream,
+    # from both serving legs
+    rows = []
+    for seg in glob.glob(os.path.join(
+            artifact_dir, "anomaly_stream", "replica-*", "*.jsonl")):
+        with open(seg) as fh:
+            rows.extend(json.loads(ln) for ln in fh if ln.strip())
+    sources = {(r.get("labels") or {}).get("source") for r in rows}
+    print(f"anomaly stream: {len(rows)} rows, sources={sorted(sources)}")
+    if len(rows) < sum(truth):
+        failures.append(f"anomaly stream has {len(rows)} rows; expected "
+                        f">= {sum(truth)} flagged points")
+    if not {"endpoint", "ingest"} <= sources:
+        failures.append(f"anomaly stream sources {sorted(sources)} missing "
+                        "a serving leg")
+
+    if failures:
+        for f in failures:
+            print("FAIL:", f, file=sys.stderr)
+        sys.exit(1)
+    print("anomaly smoke ok")
+
+
+if __name__ == "__main__":
+    main()

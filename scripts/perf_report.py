@@ -110,6 +110,7 @@ def collect(workdir: str, reps: int = 20, expect_warm: bool = False) -> Dict:
     fc = BatchForecaster.from_fit(batch, params, "prophet", cfg)
 
     windowed = _windowed_section(workdir)
+    autoprep = _autoprep_section()
 
     req = pd.DataFrame({"store": [1, 1, 2], "item": [1, 2, 3]})
     out = fc.predict(req, horizon=30)  # warmup: compile or store-load
@@ -159,6 +160,7 @@ def collect(workdir: str, reps: int = 20, expect_warm: bool = False) -> Dict:
         },
         "throughput_rows_per_s": round(rows_per_dispatch / p50, 1),
         "windowed": windowed,
+        "autoprep": autoprep,
         "output_sha256": hashlib.sha256(
             out.to_csv(index=False).encode()).hexdigest(),
     }
@@ -202,6 +204,49 @@ def _windowed_section(workdir: str) -> Dict:
         "all_ok": bool(res.ok.all()),
         "output_sha256": hashlib.sha256(
             np.asarray(res.yhat, np.float32).tobytes()).hexdigest(),
+    }
+
+
+def _autoprep_section() -> Dict:
+    """Exercise the fused pre-fit cleaning program through the AOT cache.
+
+    One ``autoprep_batch`` over a deterministically-contaminated batch
+    drives the ``autoprep:<Sb>x<T>`` entry so its compiled-program costs
+    land in the per-entry registry the diff side gates, and the
+    ``--expect-warm`` pass proves a restarted process deserializes it
+    instead of recompiling.  The repaired-tensor sha gives the
+    cold-vs-warm output-identity check for the cleaning path."""
+    import dataclasses
+
+    import numpy as np
+
+    from distributed_forecasting_tpu.data import (
+        synthetic_store_item_sales,
+        tensorize,
+    )
+    from distributed_forecasting_tpu.engine.autoprep import (
+        AutoprepConfig,
+        autoprep_batch,
+    )
+
+    df = synthetic_store_item_sales(n_stores=2, n_items=3, n_days=400, seed=7)
+    batch = tensorize(df)
+    y = np.asarray(batch.y).copy()
+    level = float(np.nanmean(np.where(np.asarray(batch.mask) > 0, y, np.nan)))
+    for s in range(batch.n_series):
+        y[s, 50 + 40 * s % 300] += 12.0 * level * (1 if s % 2 else -1)
+    import jax.numpy as jnp
+
+    dirty = dataclasses.replace(batch, y=jnp.asarray(y))
+    cfg = AutoprepConfig(enabled=True, outlier_threshold=6.0)
+    res = autoprep_batch(dirty, cfg)
+    summary = res.report.summary() if res.report is not None else {}
+    return {
+        "workload": {"n_series": batch.n_series, "n_days": batch.n_time,
+                     "planted_outliers": batch.n_series},
+        "repaired_points": int(summary.get("prep_repaired_points", 0)),
+        "output_sha256": hashlib.sha256(
+            np.asarray(res.batch.y, np.float32).tobytes()).hexdigest(),
     }
 
 
@@ -411,6 +456,22 @@ def diff_records(baseline: Dict, current: Dict,
                 f"windowed forecasts byte-identical cold vs warm "
                 f"({(wb or wa or '?')[:12]})" if (wa and wb) else
                 "windowed section present in only one record (older "
+                "perf_report on the other side?); hash check skipped"))
+        pa = (cold.get("autoprep") or {}).get("output_sha256")
+        pb = (current.get("autoprep") or {}).get("output_sha256")
+        if pa and pb and pa != pb:
+            findings.append(_finding(
+                "autoprep_output_hash", "fail",
+                f"cold-run repaired tensor {pa[:12]} != warm-run "
+                f"{pb[:12]}: the AOT cache changed what the fused "
+                f"cleaning program produces"))
+        elif pa or pb:
+            findings.append(_finding(
+                "autoprep_output_hash",
+                "ok" if (pa and pb) else "warn",
+                f"repaired tensors byte-identical cold vs warm "
+                f"({(pb or pa or '?')[:12]})" if (pa and pb) else
+                "autoprep section present in only one record (older "
                 "perf_report on the other side?); hash check skipped"))
     return findings
 

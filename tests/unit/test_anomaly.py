@@ -1,0 +1,331 @@
+"""On-device anomaly detection (serving/anomaly.py + the endpoint wiring).
+
+The ISSUE-15 serving acceptance gates: ``POST /detect_anomalies`` flags
+planted outliers and leaves clean actuals unflagged, the ``/ingest``
+streaming leg agrees with the endpoint on the same points, flagged points
+land on the JSONL anomaly stream, and the sharded front door returns the
+same verdicts as an unsharded server.
+"""
+
+import glob
+import json
+import os
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from distributed_forecasting_tpu.serving import BatchForecaster, start_server
+from distributed_forecasting_tpu.serving.anomaly import (
+    AnomalyConfig,
+    AnomalyScorer,
+    build_anomaly_runtime,
+)
+
+
+@pytest.fixture(scope="module")
+def forecaster():
+    """A fitted theta artifact (streaming-capable family, so the same
+    fixture serves the /ingest leg)."""
+    from distributed_forecasting_tpu.data import (
+        synthetic_store_item_sales,
+        tensorize,
+    )
+    from distributed_forecasting_tpu.engine import fit_forecast
+    from distributed_forecasting_tpu.models import ThetaConfig
+
+    df = synthetic_store_item_sales(
+        n_stores=2, n_items=2, n_days=200, seed=9)
+    batch = tensorize(df)
+    cfg = ThetaConfig()
+    params, _ = fit_forecast(batch, model="theta", config=cfg, horizon=30)
+    return BatchForecaster.from_fit(batch, params, "theta", cfg)
+
+
+@pytest.fixture()
+def server(forecaster, tmp_path):
+    from distributed_forecasting_tpu.serving.ingest import (
+        build_ingest_runtime,
+    )
+
+    anomaly = build_anomaly_runtime(
+        {"enabled": True}, forecaster,
+        default_store_dir=str(tmp_path / "anomaly_stream"))
+    ingest = build_ingest_runtime(
+        {"enabled": True, "apply_mode": "sync"}, forecaster,
+        default_wal_dir=str(tmp_path / "wal"))
+    srv = start_server(forecaster, model_version="1",
+                       anomaly=anomaly, ingest=ingest)
+    yield srv, anomaly, str(tmp_path / "anomaly_stream")
+    srv.shutdown()
+
+
+def _call(srv, path, payload=None):
+    url = f"http://127.0.0.1:{srv.server_address[1]}{path}"
+    if payload is None:
+        req = urllib.request.Request(url)
+    else:
+        req = urllib.request.Request(
+            url, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        body = r.read()
+        try:
+            return r.status, json.loads(body)
+        except json.JSONDecodeError:
+            return r.status, body.decode()
+
+
+def _next_day_points(fc, planted_sigma=50.0):
+    """(points, expected_flags): one wildly-off and one on-band actual for
+    the first series, dated the first day past history."""
+    pred = fc.predict(pd.DataFrame({"store": [1], "item": [1]}), horizon=3)
+    ds = str(pd.Timestamp(pred["ds"].iloc[0]).date())
+    yhat = float(pred["yhat"].iloc[0])
+    hi = float(pred["yhat_upper"].iloc[0])
+    off = yhat + planted_sigma * max(hi - yhat, 1.0)
+    return ([{"store": 1, "item": 1, "ds": ds, "y": off},
+             {"store": 1, "item": 1, "ds": ds, "y": yhat}],
+            [True, False])
+
+
+# -- config -------------------------------------------------------------------
+
+def test_config_rejects_unknown_keys_and_bad_values():
+    with pytest.raises(ValueError, match="treshold"):
+        AnomalyConfig.from_conf({"treshold": 3})
+    for bad in ({"threshold": -1}, {"max_horizon": 0},
+                {"max_points_per_request": 0}):
+        with pytest.raises(ValueError):
+            AnomalyConfig.from_conf(bad)
+
+
+def test_build_runtime_gates(forecaster, tmp_path):
+    assert build_anomaly_runtime(None, forecaster) is None
+    assert build_anomaly_runtime({"enabled": False}, forecaster) is None
+    scorer = build_anomaly_runtime({"enabled": True}, forecaster)
+    assert scorer is not None and scorer.store is None
+    scorer = build_anomaly_runtime(
+        {"enabled": True}, forecaster,
+        default_store_dir=str(tmp_path / "s"))
+    assert scorer.store is not None
+    # default severity is the served band's z
+    assert scorer.threshold == pytest.approx(scorer._z_w)
+    # explicit severity wins
+    scorer = build_anomaly_runtime(
+        {"enabled": True, "threshold": 4.5}, forecaster)
+    assert scorer.threshold == 4.5
+
+
+# -- scorer -------------------------------------------------------------------
+
+def test_scorer_flags_planted_not_clean(forecaster):
+    scorer = AnomalyScorer(forecaster)
+    points, expected = _next_day_points(forecaster)
+    out = scorer.score(pd.DataFrame(points))
+    assert out["n_scored"] == 2 and out["n_flagged"] == 1
+    assert [r["is_anomaly"] for r in out["results"]] == expected
+    assert out["results"][0]["anomaly_score"] > out["threshold"]
+    assert out["results"][1]["anomaly_score"] <= out["threshold"]
+    # request order survives scoring
+    assert out["results"][0]["y"] == pytest.approx(points[0]["y"])
+
+
+def test_scorer_threshold_override(forecaster):
+    scorer = AnomalyScorer(forecaster)
+    points, _ = _next_day_points(forecaster)
+    clean = [points[1] | {"y": points[1]["y"] + 1.0}]
+    assert scorer.score(pd.DataFrame(clean))["n_flagged"] == 0
+    out = scorer.score(pd.DataFrame(clean), threshold=1e-6)
+    assert out["n_flagged"] == 1 and out["threshold"] == 1e-6
+
+
+def test_scorer_skips_unknown_and_beyond_horizon(forecaster):
+    scorer = AnomalyScorer(
+        forecaster, config=AnomalyConfig(enabled=True, max_horizon=5))
+    points, _ = _next_day_points(forecaster)
+    far = dict(points[1])
+    far["ds"] = str((pd.Timestamp(points[1]["ds"])
+                     + pd.Timedelta(days=400)).date())
+    unknown = dict(points[1], store=99)
+    out = scorer.score(pd.DataFrame([points[0], far, unknown]))
+    assert out["n_scored"] == 1
+    assert out["n_skipped"] == 2
+    with pytest.raises(ValueError, match="missing column"):
+        scorer.score(pd.DataFrame([{"store": 1, "item": 1, "ds": "2020-01-01"}]))
+    with pytest.raises(ValueError, match="'ds'"):
+        scorer.score(pd.DataFrame([{"store": 1, "item": 1, "y": 1.0}]))
+
+
+# -- endpoint + streaming leg -------------------------------------------------
+
+def test_endpoint_flags_planted_points(server, forecaster):
+    srv, _, _ = server
+    points, expected = _next_day_points(forecaster)
+    code, out = _call(srv, "/detect_anomalies", {"points": points})
+    assert code == 200
+    assert [r["is_anomaly"] for r in out["results"]] == expected
+    assert out["n_flagged"] == 1 and out["threshold"] > 0
+
+
+def test_endpoint_error_paths(server):
+    srv, _, _ = server
+    for bad in ({}, {"points": []}, {"points": "x"},
+                {"points": [{"store": 1}]},
+                {"points": [{"store": 1, "item": 1,
+                             "ds": "2020-01-01", "y": 1}],
+                 "threshold": -2}):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _call(srv, "/detect_anomalies", bad)
+        assert e.value.code == 400, bad
+
+
+def test_endpoint_503_when_disarmed(forecaster):
+    srv = start_server(forecaster, model_version="1")
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _call(srv, "/detect_anomalies",
+                  {"points": [{"store": 1, "item": 1,
+                               "ds": "2020-01-01", "y": 1}]})
+        assert e.value.code == 503
+    finally:
+        srv.shutdown()
+
+
+def test_ingest_streaming_leg_agrees_with_endpoint(server, forecaster):
+    """The acceptance gate: both legs flag the same planted point."""
+    srv, anomaly, stream_dir = server
+    points, expected = _next_day_points(forecaster)
+    code, det = _call(srv, "/detect_anomalies", {"points": points})
+    assert code == 200
+    code, ing = _call(srv, "/ingest", {"points": points})
+    assert code == 200 and "anomalies" in ing
+    # same points, same verdicts: the streaming summary counts what the
+    # endpoint flagged
+    assert ing["anomalies"]["flagged"] == det["n_flagged"] == 1
+    assert ing["anomalies"]["scored"] == det["n_scored"]
+    assert ing["anomalies"]["threshold"] == det["threshold"]
+
+    # counters split by leg
+    snap = anomaly.registry.snapshot()
+    assert snap["dftpu_anomaly_flagged_total"] == 1
+    assert snap["dftpu_anomaly_stream_flagged_total"] == 1
+
+    # flagged points landed on the JSONL stream from BOTH legs
+    rows = [json.loads(line)
+            for p in glob.glob(os.path.join(stream_dir, "*.jsonl"))
+            for line in open(p) if line.strip()]
+    assert all(r["name"] == "dftpu_anomaly_point" for r in rows)
+    assert {r["labels"]["source"] for r in rows} == {"endpoint", "ingest"}
+
+
+def test_metrics_exposes_anomaly_families(server, forecaster):
+    srv, _, _ = server
+    points, _ = _next_day_points(forecaster)
+    _call(srv, "/detect_anomalies", {"points": points})
+    code, text = _call(srv, "/metrics")
+    assert code == 200
+    assert "dftpu_anomaly_requests_total 1" in text
+    assert "dftpu_anomaly_threshold" in text
+
+
+# -- sharded front door -------------------------------------------------------
+
+def test_sharded_front_door_agrees_with_unsharded(forecaster):
+    """/detect_anomalies through the PR-12 front door: real subset
+    replicas each score their own shards, and the merged response carries
+    the same verdicts as one unsharded server."""
+    from distributed_forecasting_tpu.serving.fleet import (
+        FleetConfig,
+        start_fleet,
+    )
+    from distributed_forecasting_tpu.serving.sharding import (
+        ShardingConfig,
+        shard_of_key,
+        subset_for_shards,
+    )
+    from tests.unit.test_fleet import _FakeProc, _front_call
+
+    fc = forecaster
+    num_shards = 4
+    full = start_server(
+        fc, anomaly=build_anomaly_runtime({"enabled": True}, fc))
+    servers = [full]
+    cfg = FleetConfig(
+        enabled=True, replicas=2, health_poll_interval_s=0.05,
+        probe_timeout_s=2.0, drain_timeout_s=2.0, retry_window_s=5.0)
+    scfg = ShardingConfig(enabled=True, num_shards=num_shards,
+                          replication=1, vnodes=32)
+
+    def spawn(index, port, shards=None):
+        sub, _ = subset_for_shards(fc, shards, num_shards)
+        srv = start_server(
+            sub, port=port,
+            anomaly=build_anomaly_runtime({"enabled": True}, sub))
+        servers.append(srv)
+        return _FakeProc(srv)
+
+    sup, front = start_fleet(cfg, spawn_fn=spawn, wait=False,
+                             sharding=scfg, key_names=fc.key_names)
+    try:
+        assert sup.wait_ready(min_ready=2, timeout=30.0)
+        keys = [tuple(int(v) for v in k) for k in fc.keys.tolist()]
+        assert len({shard_of_key(k, num_shards) for k in keys}) >= 2
+        pred = fc.predict(
+            pd.DataFrame([dict(zip(fc.key_names, k)) for k in keys]),
+            horizon=2)
+        day0 = pred.groupby(list(fc.key_names), observed=True).first()
+        points = []
+        for i, k in enumerate(keys):   # one point per key: order-stable
+            row = day0.loc[k]
+            y = float(row["yhat"])
+            if i % 2 == 0:             # plant outliers on alternating keys
+                y += 60.0 * max(float(row["yhat_upper"]) - y, 1.0)
+            points.append(dict(zip(fc.key_names, k),
+                               ds=str(pd.Timestamp(row["ds"]).date()), y=y))
+        body = json.dumps({"points": points}).encode()
+
+        host, port = full.server_address
+        req = urllib.request.Request(
+            f"http://{host}:{port}/detect_anomalies", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            unsharded = json.loads(r.read())
+        status, _, payload = _front_call(
+            front, "POST", "/detect_anomalies", body)
+        assert status == 200
+        sharded = json.loads(payload)
+
+        assert sharded["n_scored"] == unsharded["n_scored"] == len(keys)
+        assert sharded["n_flagged"] == unsharded["n_flagged"]
+        assert sharded["threshold"] == unsharded["threshold"]
+        flags_s = {(r["store"], r["item"]): r["is_anomaly"]
+                   for r in sharded["results"]}
+        flags_u = {(r["store"], r["item"]): r["is_anomaly"]
+                   for r in unsharded["results"]}
+        assert flags_s == flags_u
+        planted = {k: (i % 2 == 0) for i, k in enumerate(keys)}
+        assert flags_s == planted
+    finally:
+        front.shutdown()
+        sup.stop()
+        for srv in servers:
+            srv.shutdown()
+            srv.server_close()
+
+
+def test_shipped_conf_block_parses():
+    """The committed serve_config.yml anomaly block must parse through the
+    strict loader — the config-drift guard in executable form."""
+    import pathlib
+
+    import yaml
+
+    repo = pathlib.Path(__file__).resolve().parents[2]
+    with open(repo / "conf" / "tasks" / "serve_config.yml") as fh:
+        conf = yaml.safe_load(fh)
+    cfg = AnomalyConfig.from_conf(conf["serving"]["anomaly"])
+    assert not cfg.enabled  # shipped off by default
+    assert cfg.stream_scoring

@@ -1,0 +1,299 @@
+"""Fused automatic data-prep (engine/autoprep + ops/clean).
+
+Covers the ISSUE-15 acceptance gates that are unit-testable: the no-op
+short-circuit is byte-identical by construction, repairs are recorded per
+point and never touch the stored history, the fused program lands in the
+AOT store under ``autoprep:<bucket>``, and a repaired fit beats an
+unrepaired fit on contaminated synthetic data.
+"""
+
+import glob
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from distributed_forecasting_tpu.data import synthetic_store_item_sales, tensorize
+from distributed_forecasting_tpu.engine.autoprep import (
+    AutoprepConfig,
+    autoprep_batch,
+    autoprep_config,
+    configure_autoprep,
+)
+from distributed_forecasting_tpu.ops import clean
+
+jnp = pytest.importorskip("jax.numpy")
+
+
+def _batch(n_days=220, n_stores=2, n_items=2, seed=3):
+    df = synthetic_store_item_sales(
+        n_stores=n_stores, n_items=n_items, n_days=n_days, seed=seed)
+    return tensorize(df)
+
+
+def _contaminate(batch, spikes=((0, 40), (1, 100), (2, 160)), scale=12.0):
+    """Plant large point outliers; returns (dirty batch, clean y)."""
+    y = np.asarray(batch.y).copy()
+    level = np.nanmean(np.where(np.asarray(batch.mask) > 0, y, np.nan))
+    for s, t in spikes:
+        y[s, t] += scale * level * (1 if (s + t) % 2 else -1)
+    import dataclasses
+
+    return dataclasses.replace(batch, y=jnp.asarray(y)), np.asarray(batch.y)
+
+
+# -- config strictness --------------------------------------------------------
+
+def test_config_rejects_unknown_keys():
+    with pytest.raises(ValueError, match="outlier_treshold"):
+        AutoprepConfig.from_conf({"outlier_treshold": 5})
+
+
+@pytest.mark.parametrize("bad", [
+    {"zero_run_min": 1},
+    {"outlier_threshold": 0},
+    {"changepoint_threshold": -1},
+    {"outlier_window": 0},
+    {"season_max_lag": 3},
+    {"holiday_lower_window": -1},
+])
+def test_config_validates_ranges(bad):
+    with pytest.raises(ValueError):
+        AutoprepConfig.from_conf(bad)
+
+
+def test_configure_installs_process_config():
+    old = autoprep_config()
+    try:
+        cfg = configure_autoprep({"enabled": True, "outlier_threshold": 4.0})
+        assert autoprep_config() is cfg
+        assert cfg.outlier_threshold == 4.0
+    finally:
+        configure_autoprep(old)
+
+
+# -- no-op byte identity ------------------------------------------------------
+
+def test_disabled_returns_input_batch_object():
+    batch = _batch()
+    res = autoprep_batch(batch, AutoprepConfig(enabled=False))
+    assert res.batch is batch
+    assert res.report is None and res.xreg is None
+
+
+def test_all_gates_off_returns_input_batch_object():
+    batch = _batch()
+    cfg = AutoprepConfig(
+        enabled=True, zero_run_mask=False, outlier_repair=False,
+        changepoints=False, holiday_regressors=False, season_detect=False)
+    assert not cfg.any_stage
+    res = autoprep_batch(batch, cfg)
+    # byte-identity is structural: the very same arrays, no device work
+    assert res.batch is batch
+
+
+# -- outlier repair -----------------------------------------------------------
+
+def test_outlier_repair_flags_and_repairs_planted_spikes():
+    batch = _batch()
+    dirty, clean_y = _contaminate(batch)
+    cfg = AutoprepConfig(enabled=True, zero_run_mask=False,
+                         changepoints=False, outlier_threshold=6.0)
+    res = autoprep_batch(dirty, cfg)
+    rep = res.report
+    assert rep is not None
+    for s, t in ((0, 40), (1, 100), (2, 160)):
+        assert rep.repaired[s, t], f"spike at ({s},{t}) not repaired"
+        # the repair interpolates toward the clean neighborhood, so the
+        # repaired value is far closer to the uncontaminated truth
+        fixed = float(np.asarray(res.batch.y)[s, t])
+        dirty_v = float(np.asarray(dirty.y)[s, t])
+        assert abs(fixed - clean_y[s, t]) < 0.2 * abs(dirty_v - clean_y[s, t])
+    # the stored history is never mutated
+    assert np.array_equal(np.asarray(dirty.y)[0], np.asarray(dirty.y)[0])
+    assert float(np.asarray(dirty.y)[0, 40]) != float(
+        np.asarray(res.batch.y)[0, 40])
+    # clean points stay untouched bit-for-bit
+    untouched = ~rep.repaired
+    assert np.array_equal(np.asarray(res.batch.y)[untouched],
+                          np.asarray(dirty.y)[untouched])
+
+
+def test_repairs_frame_records_raw_and_repaired():
+    batch = _batch()
+    dirty, _ = _contaminate(batch, spikes=((0, 50),))
+    cfg = AutoprepConfig(enabled=True, zero_run_mask=False,
+                         changepoints=False)
+    res = autoprep_batch(dirty, cfg)
+    frame = res.report.repairs_frame(dirty)
+    assert {"store", "item", "ds", "y_raw", "y_repaired",
+            "outlier_score"} <= set(frame.columns)
+    planted = frame[frame["ds"] == batch.dates()[50]]
+    assert len(planted) >= 1
+    row = planted.iloc[0]
+    assert row["y_raw"] == pytest.approx(float(np.asarray(dirty.y)[0, 50]))
+    assert row["y_raw"] != row["y_repaired"]
+    assert row["outlier_score"] > cfg.outlier_threshold
+
+
+# -- zero-run masking ---------------------------------------------------------
+
+def test_zero_run_masking_drops_long_runs_keeps_short():
+    batch = _batch()
+    import dataclasses
+
+    y = np.asarray(batch.y).copy()
+    y[0, 30:60] = 0.0     # 30-day dead stretch: a feed outage
+    y[1, 80:84] = 0.0     # 4-day zero run: ordinary intermittency
+    dirty = dataclasses.replace(batch, y=jnp.asarray(y))
+    cfg = AutoprepConfig(enabled=True, outlier_repair=False,
+                         changepoints=False, zero_run_min=14)
+    res = autoprep_batch(dirty, cfg)
+    mask = np.asarray(res.batch.mask)
+    assert (mask[0, 30:60] == 0).all()
+    assert (mask[1, 80:84] > 0).all()
+    assert res.report.summary()["prep_masked_zero_cells"] == 30
+
+
+# -- changepoints -------------------------------------------------------------
+
+def test_cusum_finds_planted_level_shift():
+    batch = _batch(n_days=200)
+    import dataclasses
+
+    y = np.asarray(batch.y).copy()
+    y[0, 120:] += 8.0 * max(float(np.std(y[0])), 1.0)
+    dirty = dataclasses.replace(batch, y=jnp.asarray(y))
+    cfg = AutoprepConfig(enabled=True, zero_run_mask=False,
+                         outlier_repair=False,
+                         changepoint_threshold=8.0)
+    rep = autoprep_batch(dirty, cfg).report
+    assert rep.cp_index[0] == pytest.approx(120, abs=3)
+    assert rep.cp_shift[0] > 0
+    assert rep.cp_score[0] > cfg.changepoint_threshold
+
+
+def test_align_level_shifts_relevels_pre_segment():
+    batch = _batch(n_days=200)
+    import dataclasses
+
+    y = np.asarray(batch.y).copy()
+    shift = 8.0 * max(float(np.std(y[0])), 1.0)
+    y[0, 120:] += shift
+    dirty = dataclasses.replace(batch, y=jnp.asarray(y))
+    cfg = AutoprepConfig(enabled=True, zero_run_mask=False,
+                         outlier_repair=False, align_level_shifts=True)
+    res = autoprep_batch(dirty, cfg)
+    pre_mean_before = float(np.asarray(dirty.y)[0, :120].mean())
+    pre_mean_after = float(np.asarray(res.batch.y)[0, :120].mean())
+    assert pre_mean_after == pytest.approx(pre_mean_before + shift, rel=0.1)
+
+
+# -- seasonality + holidays through the fused program -------------------------
+
+def test_fused_season_detection_finds_weekly_period():
+    rng = np.random.default_rng(0)
+    t = np.arange(400)
+    rows = []
+    for item in (1, 2):
+        y = 50 + 10 * np.sin(2 * np.pi * t / 7 + item) + rng.normal(size=400)
+        rows.append(pd.DataFrame(
+            {"date": pd.date_range("2020-01-01", periods=400), "store": 1,
+             "item": item, "sales": y}))
+    batch = tensorize(pd.concat(rows, ignore_index=True))
+    cfg = AutoprepConfig(enabled=True, zero_run_mask=False,
+                         outlier_repair=False, changepoints=False,
+                         season_detect=True)
+    res = autoprep_batch(batch, cfg)
+    assert res.season_length == 7
+    assert res.report.summary()["prep_season_length"] == 7
+
+
+def test_holiday_regressors_cover_history_and_horizon():
+    batch = _batch(n_days=400)
+    cfg = AutoprepConfig(enabled=True, zero_run_mask=False,
+                         outlier_repair=False, changepoints=False,
+                         holiday_regressors=True)
+    res = autoprep_batch(batch, cfg, horizon=30)
+    assert res.xreg is not None
+    T = batch.n_time
+    assert res.xreg.shape[0] == T + 30
+    assert res.xreg.shape[1] == len(res.report.holiday_names)
+    x = np.asarray(res.xreg)
+    assert set(np.unique(x)) <= {0.0, 1.0}
+    # July 4 falls inside a 400-day grid from the synthetic start; at
+    # least one indicator column fires somewhere
+    assert x.sum() > 0
+
+
+# -- AOT store ----------------------------------------------------------------
+
+def test_fused_program_lands_in_aot_store(tmp_path):
+    from distributed_forecasting_tpu.engine import compile_cache as cc
+
+    directory = str(tmp_path / "cc")
+    cc.configure_compile_cache(cc.CompileCacheConfig(
+        enabled=True, directory=directory, aot_store=True))
+    try:
+        batch = _batch()
+        cfg = AutoprepConfig(enabled=True, zero_run_mask=False,
+                             changepoints=False)
+        autoprep_batch(batch, cfg)
+        entries = glob.glob(os.path.join(directory, "aot", "*.aot"))
+        S = batch.n_series
+        Sb = 1 << max(S - 1, 0).bit_length()
+        tag = f"autoprep_{Sb}x{batch.n_time}"  # ':' slugs to '_' on disk
+        assert any(tag in os.path.basename(p) for p in entries), entries
+        # warm-process path: a fresh store over the same directory (new
+        # empty memo, as a restarted process would see) must LOAD the
+        # serialized program, not recompile it
+        cc.configure_compile_cache(cc.CompileCacheConfig(
+            enabled=True, directory=directory, aot_store=True))
+        s0 = cc.cache_stats()
+        autoprep_batch(batch, cfg)
+        s1 = cc.cache_stats()
+        assert s1["hits"] == s0["hits"] + 1
+        assert s1["misses"] == s0["misses"]
+    finally:
+        cc.configure_compile_cache(cc.CompileCacheConfig(enabled=False))
+
+
+# -- the acceptance gate: repaired fit >= unrepaired on contaminated data -----
+
+def test_repaired_fit_beats_unrepaired_on_contaminated_data():
+    from distributed_forecasting_tpu.engine import fit_forecast
+    from distributed_forecasting_tpu.models import CurveModelConfig
+
+    batch = _batch(n_days=260, seed=11)
+    spikes = tuple((s, t) for s in range(batch.n_series)
+                   for t in (40, 90, 150, 200))
+    dirty, clean_y = _contaminate(batch, spikes=spikes, scale=15.0)
+    cfg = CurveModelConfig()
+    prep = AutoprepConfig(enabled=True, zero_run_mask=False,
+                          changepoints=False, outlier_threshold=6.0)
+
+    _, raw = fit_forecast(dirty, model="prophet", config=cfg, horizon=14,
+                          autoprep=False)
+    _, fixed = fit_forecast(dirty, model="prophet", config=cfg, horizon=14,
+                            autoprep=prep)
+    T = batch.n_time
+    mask = np.asarray(batch.mask) > 0
+    err_raw = np.abs(np.asarray(raw.yhat)[:, :T] - clean_y)[mask].mean()
+    err_fixed = np.abs(np.asarray(fixed.yhat)[:, :T] - clean_y)[mask].mean()
+    assert err_fixed <= err_raw
+
+
+def test_shipped_conf_block_parses():
+    """The committed train_config.yml autoprep block must parse through the
+    strict loader — the config-drift guard in executable form."""
+    import pathlib
+
+    import yaml
+
+    repo = pathlib.Path(__file__).resolve().parents[2]
+    with open(repo / "conf" / "tasks" / "train_config.yml") as fh:
+        conf = yaml.safe_load(fh)
+    cfg = AutoprepConfig.from_conf(conf["engine"]["autoprep"])
+    assert not cfg.enabled  # shipped off by default
+    assert cfg.zero_run_mask and cfg.outlier_repair and cfg.changepoints

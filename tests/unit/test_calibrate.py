@@ -355,3 +355,132 @@ def test_allocated_path_rejects_calibrate_flag(tmp_path, monkeypatch):
     }
     with pytest.raises(ValueError, match="allocated"):
         TrainTask(init_conf=conf).launch()
+
+
+# -- conformal edge cases the anomaly scorer depends on (ISSUE 15) ------------
+
+def _paths(residual_ratio, masks=None, C=2, T=12, half=5.0):
+    """Build (y, yhat, hi, eval_masks) CV-path tensors where every valid
+    point's |residual| / half-band equals its series' entry in
+    ``residual_ratio``."""
+    ratio = np.asarray(residual_ratio, dtype=np.float32)
+    S = ratio.shape[0]
+    yhat = np.full((C, S, T), 50.0, np.float32)
+    hi = yhat + half
+    y = yhat[0] + ratio[:, None] * half
+    if masks is None:
+        masks = np.ones((C, S, T), np.float32)
+    return (jnp.asarray(y), jnp.asarray(yhat), jnp.asarray(hi),
+            jnp.asarray(masks))
+
+
+def test_zero_residual_series_scale_is_zero_and_finite():
+    """A series the model fits EXACTLY (y == yhat on every CV point) gets
+    a zero conformal scale — the mathematically correct answer (its CV
+    evidence says the band can collapse), and critically not NaN/inf:
+    the serving stack multiplies bands by this array."""
+    from distributed_forecasting_tpu.engine.calibrate import (
+        conformal_scale_from_paths,
+    )
+
+    y, yhat, hi, masks = _paths([0.0, 0.0, 0.0])
+    q = np.asarray(conformal_scale_from_paths(y, yhat, hi, masks,
+                                              min_points=1))
+    assert np.isfinite(q).all()
+    assert (q == 0.0).all(), q
+    # applying it collapses to the point path without producing NaN
+    yh, lo2, hi2 = apply_interval_scale(
+        yhat[0], yhat[0] - 5.0, hi[0], jnp.asarray(q))
+    assert np.isfinite(np.asarray(lo2)).all()
+    np.testing.assert_allclose(np.asarray(lo2), np.asarray(yh))
+    np.testing.assert_allclose(np.asarray(hi2), np.asarray(yh))
+
+
+def test_single_point_series_takes_pooled_scale():
+    """A series with ONE valid calibration point cannot support its own
+    rank quantile (k > n-1 clips to that single residual); it must take
+    the pooled quantile across the batch instead."""
+    from distributed_forecasting_tpu.engine.calibrate import (
+        conformal_scale_from_paths,
+    )
+
+    C, T = 2, 12
+    masks = np.ones((C, 3, T), np.float32)
+    masks[:, 0, :] = 0.0
+    masks[0, 0, 0] = 1.0          # series 0: exactly one CV point
+    y, yhat, hi, masks = _paths([3.0, 1.0, 1.0], masks=masks)
+    q = np.asarray(conformal_scale_from_paths(y, yhat, hi, masks,
+                                              min_points=30))
+    assert np.isfinite(q).all()
+    # every series is thin vs min_points=30? no: series 1/2 have C*T=24
+    # points each — also < 30, so ALL take the pooled quantile: one value
+    assert len(set(np.round(q, 6))) == 1, q
+    # pooled 95% rank over {3.0 x1, 1.0 x48}: ceil(50*.95)-1 = 47 of 49
+    # sorted values -> 1.0 (NOT the thin series' own 3.0 residual, which
+    # a per-series k > n-1 clip would have returned)
+    assert q[0] == pytest.approx(1.0)
+
+
+def test_no_calibration_data_is_identity_scale():
+    from distributed_forecasting_tpu.engine.calibrate import (
+        conformal_scale_from_paths,
+    )
+
+    y, yhat, hi, _ = _paths([1.0, 2.0])
+    masks = jnp.zeros((2, 2, 12), jnp.float32)
+    q = np.asarray(conformal_scale_from_paths(y, yhat, hi, masks))
+    np.testing.assert_allclose(q, 1.0)
+
+
+def test_interval_scale_survives_refit_swap():
+    """The PR-9 streaming contract: a background full refit swaps fresh
+    params in but leaves the conformal interval_scale exactly as fit-time
+    calibration set it (re-calibration needs a CV pass, out of streaming
+    scope) — the anomaly scorer's severity must not silently change when
+    a refit lands."""
+    from distributed_forecasting_tpu.data import (
+        synthetic_store_item_sales,
+    )
+    from distributed_forecasting_tpu.engine.state_store import (
+        SeriesStateStore,
+    )
+    from distributed_forecasting_tpu.models import ThetaConfig
+    from distributed_forecasting_tpu.models.base import get_model
+    from distributed_forecasting_tpu.serving import BatchForecaster
+    from distributed_forecasting_tpu.serving.refit import (
+        RefitConfig,
+        RefitScheduler,
+    )
+
+    df = synthetic_store_item_sales(n_stores=2, n_items=2, n_days=120,
+                                    seed=21)
+    batch = tensorize(df)
+    cfg = ThetaConfig()
+    params = get_model("theta").fit(batch.y, batch.mask, batch.day, cfg)
+    scale = np.asarray([1.5, 0.9, 2.0, 1.1], dtype=np.float32)
+    fc = BatchForecaster.from_fit(batch, params, "theta", cfg,
+                                  interval_scale=scale.copy())
+    store = SeriesStateStore(fc, time_bucket=16,
+                             history_y=np.asarray(batch.y),
+                             history_mask=np.asarray(batch.mask))
+    store.ingest([(0, int(fc.day1) + 1, 75.0)])
+    store.apply_pending()
+    sched = RefitScheduler(store, RefitConfig(
+        enabled=True, max_applied_points=10**9, max_staleness_s=1e9,
+        check_interval_s=60))
+    try:
+        assert sched.maybe_refit(force=True) == "forced"
+        sched.wait(timeout=300)
+        assert sched.snapshot()["refits_done"] == 1
+    finally:
+        sched.stop()
+    np.testing.assert_array_equal(fc.interval_scale, scale)
+    # and the served bands still reflect it: scaled vs a scale-free twin
+    fc_plain = BatchForecaster.from_fit(batch, params, "theta", cfg)
+    fc_plain.swap_state(params=fc.params, day1=int(fc.day1))
+    req = pd.DataFrame(fc.keys[:1], columns=list(fc.key_names))
+    cal = fc.predict(req, horizon=3)
+    raw = fc_plain.predict(req, horizon=3)
+    half_cal = (cal["yhat_upper"] - cal["yhat"]).to_numpy()
+    half_raw = (raw["yhat_upper"] - raw["yhat"]).to_numpy()
+    np.testing.assert_allclose(half_cal, scale[0] * half_raw, rtol=1e-5)

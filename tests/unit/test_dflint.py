@@ -873,3 +873,99 @@ def test_analysis_package_never_imports_accelerator_stack():
     proc = subprocess.run([sys.executable, "-c", code], env=env,
                           cwd=str(REPO))
     assert proc.returncode == 0
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 15: engine.autoprep conf block + the fused clean program
+# ---------------------------------------------------------------------------
+
+_AUTOPREP_MODULE = """
+    import dataclasses
+
+    @dataclasses.dataclass(frozen=True)
+    class AutoprepConfig:
+        enabled: bool = False
+        zero_run_mask: bool = True
+        zero_run_min: int = 14
+        outlier_repair: bool = True
+        outlier_threshold: float = 6.0
+        changepoints: bool = True
+
+        @classmethod
+        def from_conf(cls, conf):
+            return cls(**(conf or {}))
+
+    def build(conf):
+        return AutoprepConfig.from_conf(
+            (conf.get("engine") or {}).get("autoprep"))
+"""
+
+
+def test_config_drift_engine_autoprep_block(tmp_path):
+    # engine.autoprep keys are AutoprepConfig dataclass fields: the typo'd
+    # outlier_treshold is drift; tasks/common.py would raise at runtime,
+    # but the lint catches it before a training run burns device time
+    _write(tmp_path, "conf/train.yml", """
+        engine:
+          autoprep:
+            enabled: true
+            zero_run_mask: true
+            outlier_treshold: 6.0
+            changepoints: true
+    """)
+    _write(tmp_path, "engine/autoprep.py", _AUTOPREP_MODULE)
+    found = _lint(tmp_path, "engine/autoprep.py")
+    assert [f.rule for f in found] == ["config-drift"]
+    assert "outlier_treshold" in found[0].message
+    assert found[0].path == "conf/train.yml"
+
+
+def test_config_drift_engine_autoprep_block_clean(tmp_path):
+    _write(tmp_path, "conf/train.yml", """
+        engine:
+          autoprep:
+            enabled: true
+            zero_run_mask: true
+            outlier_threshold: 6.0
+            changepoints: true
+    """)
+    _write(tmp_path, "engine/autoprep.py", _AUTOPREP_MODULE)
+    assert _lint(tmp_path, "engine/autoprep.py") == []
+
+
+def test_host_sync_fused_clean_program_stays_quiet(tmp_path):
+    # the fused prep program is ONE dispatch on the pre-fit hot path: it
+    # returns device arrays for the caller to slice on the host AFTER the
+    # dispatch.  The sanctioned shape (no float()/np.asarray() inside the
+    # jitted body) must stay quiet; a host pull of the repair count inside
+    # the program would serialize every training batch and must flag.
+    _write(tmp_path, "ops/cleanprog.py", """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def fused_prep(y, mask, threshold):
+            med = jnp.median(y, axis=1, keepdims=True)
+            mad = jnp.median(jnp.abs(y - med), axis=1, keepdims=True)
+            score = jnp.abs(y - med) / jnp.maximum(1.4826 * mad, 1e-9)
+            repaired = score > threshold
+            y_clean = jnp.where(repaired, med, y)
+            return y_clean, mask, repaired
+    """)
+    assert _lint(tmp_path, "ops/cleanprog.py") == []
+    _write(tmp_path, "ops/cleanleak.py", """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def fused_prep_leaky(y, mask, threshold):
+            med = jnp.median(y, axis=1, keepdims=True)
+            mad = jnp.median(jnp.abs(y - med), axis=1, keepdims=True)
+            score = jnp.abs(y - med) / jnp.maximum(1.4826 * mad, 1e-9)
+            repaired = score > threshold
+            n_repaired = int(repaired.sum())
+            y_clean = jnp.where(repaired, med, y)
+            return y_clean, mask, n_repaired
+    """)
+    found = _lint(tmp_path, "ops/cleanleak.py")
+    assert [f.rule for f in found] == ["host-sync-in-hot-path"]
