@@ -11,6 +11,11 @@ from distributed_forecasting_tpu.serving.ensemble import (
     BlendedForecaster,
     MultiModelForecaster,
 )
+from distributed_forecasting_tpu.serving.forecast_cache import (
+    CacheConfig,
+    ForecastCache,
+    build_forecast_cache,
+)
 from distributed_forecasting_tpu.serving.fleet import (
     FleetConfig,
     FleetSupervisor,
@@ -32,8 +37,10 @@ __all__ = [
     "BucketedForecaster",
     "MultiModelForecaster",
     "BlendedForecaster",
+    "CacheConfig",
     "FleetConfig",
     "FleetSupervisor",
+    "ForecastCache",
     "ForecastServer",
     "FrontDoorServer",
     "QueueFullError",
@@ -41,6 +48,7 @@ __all__ = [
     "ServingMetrics",
     "ShuttingDownError",
     "aggregate_prometheus",
+    "build_forecast_cache",
     "load_forecaster",
     "resolve_from_registry",
     "serve",

@@ -203,6 +203,10 @@ _GAUGE_MAX_MERGE = frozenset({
     # of a shard reports the SAME resident count for that shard's label,
     # so summing would multiply series by the replication factor
     "dftpu_shard_series",
+    # forecast-cache staleness headline: the fleet's oldest materialized
+    # frame anywhere — summing per-replica ages would fabricate an age no
+    # frame has (the hit/miss/invalidation counters still SUM)
+    "dftpu_cache_entry_age_seconds",
 })
 
 #: per-replica capacity watermarks (host RSS, device bytes in use) —
@@ -417,6 +421,11 @@ def default_spawn_fn(
             # anomaly scoring conf: each replica scores its own shards'
             # points; the front door scatter-gathers /detect_anomalies
             "anomaly": serving_conf.get("anomaly"),
+            # materialized forecast cache: each replica caches exactly its
+            # owned series' frames and invalidates on its OWN state installs
+            # (WAL apply/refit) — no cross-replica fan-out needed because a
+            # shard's writes only ever land at its owners
+            "cache": serving_conf.get("cache"),
             # series partition: the child subsets its forecaster/WAL to
             # these shards and follows only their wal_dir/shard-<k>/ logs
             "sharding": (None if sharding is None
@@ -799,6 +808,10 @@ class FleetSupervisor:
         now = time.monotonic()
         to_restart = []
         with self._lock:
+            if self._stop.is_set():
+                # a sweep that straddled stop() must not write back its
+                # pre-stop observations (or respawn a draining replica)
+                return
             for rep, alive, ready in observed:
                 if alive:
                     rep.ready = ready
@@ -944,10 +957,13 @@ class FleetSupervisor:
         with self._lock:
             thread = self._poll_thread
             procs = [r.proc for r in self._replicas]
-            for r in self._replicas:
-                r.ready = False
         if thread is not None:
             thread.join(timeout=5.0)
+        with self._lock:
+            # cleared AFTER the join: a health sweep in flight when _stop
+            # was set can no longer resurrect a pre-stop ready=True
+            for r in self._replicas:
+                r.ready = False
         self._g_ready.set(0)
         for proc in procs:
             if proc is not None and proc.poll() is None:

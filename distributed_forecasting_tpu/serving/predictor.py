@@ -212,6 +212,15 @@ class BatchForecaster:
         # pre-update day1 with post-update params or vice versa.  Held only
         # for the reference swap/snapshot, never across device work or I/O.
         self._state_lock = threading.Lock()
+        # generation-numbered state epochs: every swap_state bumps this
+        # counter under _state_lock, so a consumer that tags derived data
+        # (the materialized forecast cache) with the generation it read can
+        # later tell "still the state I computed from" apart from "a writer
+        # installed something newer" without comparing pytrees.  Listeners
+        # registered via register_state_listener are invoked AFTER the swap,
+        # outside the lock (they may predict / take their own locks).
+        self._state_gen = 0
+        self._state_listeners: list = []
         # time-grid bucket (engine/state_store sets this when streaming is
         # attached): the forecast grid end is padded up to the next multiple
         # of this many days so the per-apply day1 advance reuses O(T/B)
@@ -368,10 +377,19 @@ class BatchForecaster:
             raise ValueError(
                 f"on_missing must be 'raise' or 'skip', got {on_missing!r}"
             )
-        req = request[list(self.key_names)].drop_duplicates().astype(np.int64)
+        # hot path for every read (dispatch AND cache hit): plain numpy
+        # column pulls + a first-occurrence dedup set — semantically the
+        # old drop_duplicates().astype(int64).itertuples() pipeline, minus
+        # ~1ms of pandas machinery per request
+        cols = [np.asarray(request[name].to_numpy()) for name in self.key_names]
+        n = len(request)
         idx = []
-        for row in req.itertuples(index=False):
-            key = tuple(row)
+        seen = set()
+        for i in range(n):
+            key = tuple(int(c[i]) for c in cols)
+            if key in seen:
+                continue
+            seen.add(key)
             if key in self._index:
                 idx.append(self._index[key])
             elif on_missing == "raise":
@@ -388,17 +406,58 @@ class BatchForecaster:
         same pytree structure as the current params; ``day1`` advances the
         last-observed day the forecast grid ends at.  Concurrent predicts
         either see the whole old state or the whole new one, never a mix
-        (:meth:`_state_snapshot`)."""
+        (:meth:`_state_snapshot`).
+
+        Every install bumps the state generation and then notifies the
+        registered listeners OUTSIDE the lock — ALL serving write paths
+        (streaming apply, full-refit install, windowed tail-refit, the
+        day1-only grid advance, autoprep re-levels riding a refit) funnel
+        through this one method, which is what makes it the single
+        invalidation choke point the forecast cache hangs off.
+        """
         with self._state_lock:
             if params is not None:
                 self.params = params
             if day1 is not None:
                 self.day1 = int(day1)
+            self._state_gen += 1
+            listeners = tuple(self._state_listeners)
+        for fn in listeners:
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — a cache hiccup must not fail the write
+                import logging
+
+                logging.getLogger("BatchForecaster").exception(
+                    "state listener failed (state swap itself committed)")
+
+    def register_state_listener(self, fn) -> None:
+        """Subscribe ``fn()`` to state installs (see :meth:`swap_state`).
+
+        Called after every committed swap, outside ``_state_lock``, on the
+        WRITER's thread — listeners may predict, persist, or take their own
+        locks, but must never raise expectations back into the writer."""
+        with self._state_lock:
+            self._state_listeners.append(fn)
+
+    def state_generation(self) -> int:
+        """Monotonic install counter — the epoch number derived-data caches
+        tag their frames with (0 until the first :meth:`swap_state`)."""
+        with self._state_lock:
+            return self._state_gen
 
     def _state_snapshot(self):
         """(params, day1) as one consistent unit; see :meth:`swap_state`."""
         with self._state_lock:
             return self.params, self.day1
+
+    def _state_snapshot_versioned(self):
+        """(params, day1, generation) as one consistent unit — the cache's
+        read form: the returned generation is exactly the epoch the pair
+        belongs to, so derived frames can be tagged without a race between
+        snapshotting state and reading the counter."""
+        with self._state_lock:
+            return self.params, self.day1, self._state_gen
 
     def gather_params(self, sidx: np.ndarray, params=None):
         """Row-gather the requested series out of the param pytree.

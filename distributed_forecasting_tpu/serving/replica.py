@@ -12,7 +12,11 @@ blocks (same shapes as the ``serving:`` conf), ``model_version``,
 — ``BatchForecaster.enable_mesh``), an optional ``monitoring`` block
 (quality/store/SLO — ``monitoring/quality.py``; the replica suffixes the
 store directory with its port so replicas never share an append cursor),
-and an optional ``ingest`` block (``serving/ingest.py``).  Unlike the
+an optional ``cache`` block (``serving/forecast_cache.py`` — the replica
+suffixes the persistence directory with its port: a sharded replica's
+materialized frames cover only its owned series and must never be adopted
+by a sibling), and an optional ``ingest`` block (``serving/ingest.py``).
+Unlike the
 quality store, the ingest WAL directory is deliberately SHARED across the
 fleet: each replica appends O_APPEND whole lines and follows the log with
 its own cursor in ``interval`` apply mode, so a point posted through any
@@ -247,6 +251,25 @@ def main(argv=None) -> None:
         if anomaly is not None:
             logger.info("anomaly scoring on: threshold=%.3f",
                         anomaly.threshold)
+    cache = None
+    if conf.get("cache"):
+        from distributed_forecasting_tpu.serving.forecast_cache import (
+            build_forecast_cache,
+        )
+
+        # per-replica mmap directory for the same reason as the quality
+        # store: a sharded replica's frames cover only its owned series,
+        # and two replicas must never adopt each other's persisted payloads
+        cache = build_forecast_cache(
+            conf["cache"],
+            forecaster,
+            default_mmap_dir=os.path.join(
+                conf["artifact_dir"], "forecast_cache",
+                f"replica-{int(conf['port'])}"),
+        )
+        if cache is not None:
+            logger.info("forecast cache on: %d persisted frame(s) adopted",
+                        int(cache.metrics.loads.value))
     srv = start_server(
         forecaster,
         host=conf.get("host", "127.0.0.1"),
@@ -258,6 +281,7 @@ def main(argv=None) -> None:
         ingest=ingest,
         anomaly=anomaly,
         extra_metrics=shard_metrics,
+        cache=cache,
     )
     sizes = conf.get("warmup_sizes")
     if sizes:

@@ -213,6 +213,8 @@ class _Handler(BaseHTTPRequestHandler):
                 text += self.server.ingest.render_metrics()
             if self.server.anomaly is not None:
                 text += self.server.anomaly.render_metrics()
+            if self.server.cache is not None:
+                text += self.server.cache.render_metrics()
             if self.server.extra_metrics is not None:
                 text += self.server.extra_metrics.render()
             from distributed_forecasting_tpu.data.quality import (
@@ -646,6 +648,7 @@ class ForecastServer(ThreadingHTTPServer):
         ingest=None,
         extra_metrics=None,
         anomaly=None,
+        cache=None,
     ):
         super().__init__(addr, _Handler)
         self.forecaster = forecaster
@@ -692,6 +695,18 @@ class ForecastServer(ThreadingHTTPServer):
                 "anomaly detection on: threshold=%.3f stream_scoring=%s",
                 anomaly.threshold,
                 anomaly.config.stream_scoring and ingest is not None)
+        # the materialized forecast cache (serving/forecast_cache) — reads
+        # become row gathers from a current-epoch frame, with misses and
+        # exotic requests falling through to the batcher/direct dispatch;
+        # the cache subscribed itself to swap_state at construction, so no
+        # lifecycle work is needed here beyond exposition
+        self.cache = cache
+        if cache is not None:
+            self.logger.info(
+                "forecast cache on: max_horizons=%d quantile_sets=%d "
+                "mmap_dir=%s max_bytes=%d",
+                cache.config.max_horizons, len(cache.config.quantile_sets),
+                cache.config.mmap_dir, cache.config.max_bytes)
         # readiness is an Event, not a guarded flag: it is set exactly once
         # after warmup and cleared at shutdown, and /readyz polls it
         self._ready = threading.Event()
@@ -717,7 +732,21 @@ class ForecastServer(ThreadingHTTPServer):
         """Run one parsed /invocations request — through the coalescer when
         batching is on, as a direct forecaster call otherwise (both paths
         feed the same dispatch/batch-size metrics, so /metrics tells the
-        coalescing story in either mode)."""
+        coalescing story in either mode).  The materialized cache gets
+        first refusal: a current-epoch hit is a row gather (no dispatch,
+        no batch metrics — it genuinely wasn't one); a None is a miss or
+        an inadmissible request and takes the dispatch path below."""
+        if self.cache is not None:
+            cached = self.cache.lookup(
+                frame,
+                horizon=horizon,
+                include_history=include_history,
+                quantiles=quantiles,
+                on_missing=on_missing,
+                xreg=xreg,
+            )
+            if cached is not None:
+                return cached
         if self.batcher is not None:
             fut = self.batcher.submit(
                 frame,
@@ -791,6 +820,7 @@ def start_server(
     ingest=None,
     extra_metrics=None,
     anomaly=None,
+    cache=None,
 ) -> ForecastServer:
     """Start serving on a background thread; returns the server (its
     ``server_address[1]`` is the bound port — port=0 picks a free one).
@@ -798,7 +828,8 @@ def start_server(
     for launchers that warm the compile ladder against the live server."""
     srv = ForecastServer((host, port), forecaster, model_version, batching,
                          quality=quality, ingest=ingest,
-                         extra_metrics=extra_metrics, anomaly=anomaly)
+                         extra_metrics=extra_metrics, anomaly=anomaly,
+                         cache=cache)
     if ready:
         srv.mark_ready()
     t = threading.Thread(target=srv.serve_forever, daemon=True)
@@ -815,9 +846,11 @@ def serve(
     quality=None,
     ingest=None,
     anomaly=None,
+    cache=None,
 ) -> None:
     srv = ForecastServer((host, port), forecaster, model_version, batching,
-                         quality=quality, ingest=ingest, anomaly=anomaly)
+                         quality=quality, ingest=ingest, anomaly=anomaly,
+                         cache=cache)
     srv.mark_ready()
     srv.logger.info("serving on %s:%d", host, port)
     srv.serve_forever()

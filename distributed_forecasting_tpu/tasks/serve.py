@@ -47,6 +47,15 @@ Conf::
           max_staleness_s: 3600      # incremental-only unless the artifact
           check_interval_s: 5        # dir carries history.npz)
           drift_coverage_tol: 0.15
+      cache:                  # optional materialized forecast cache
+        enabled: true         # (serving/forecast_cache.py) default false:
+        max_horizons: 4       # every read dispatches.  Distinct horizons
+                              # admitted before further ones dispatch-only
+        quantile_sets: [[0.1, 0.5, 0.9]]  # quantile reads served cached
+        mmap_dir: null        # persistence dir (default
+                              # <artifact_dir>/forecast_cache when serving
+                              # a fleet; null = in-memory only here)
+        max_bytes: 268435456  # resident budget; oldest frames evicted
       anomaly:                # optional anomaly scoring (serving/anomaly.py)
         enabled: true         # default false: POST /detect_anomalies -> 503
         threshold: 0.0        # sigma-score flag cutoff; 0 -> the artifact's
@@ -101,6 +110,10 @@ from distributed_forecasting_tpu.monitoring.trace import (
     configure_tracing,
 )
 from distributed_forecasting_tpu.serving.batcher import BatchingConfig
+from distributed_forecasting_tpu.serving.forecast_cache import (
+    CacheConfig,
+    build_forecast_cache,
+)
 from distributed_forecasting_tpu.serving.server import resolve_from_registry, serve
 from distributed_forecasting_tpu.tasks.common import Task
 
@@ -115,6 +128,7 @@ class ServeTask(Task):
         # resolution
         batching = BatchingConfig.from_conf(conf.get("batching"))
         tracing = TraceConfig.from_conf(conf.get("tracing"))
+        CacheConfig.from_conf(conf.get("cache"))  # fail-fast on typos
         configure_tracing(tracing)
         forecaster, version = resolve_from_registry(self.registry, name, stage=stage)
         env = self.conf.get("env", {})
@@ -174,6 +188,11 @@ class ServeTask(Task):
             conf.get("host", "0.0.0.0"), conf.get("port", 8080),
             "on" if batching.enabled else "off",
         )
+        cache = build_forecast_cache(conf.get("cache"), forecaster)
+        if cache is not None:
+            self.logger.info(
+                "forecast cache on: max_horizons=%d quantile_sets=%d",
+                cache.config.max_horizons, len(cache.config.quantile_sets))
         serve(
             forecaster,
             host=conf.get("host", "0.0.0.0"),
@@ -183,6 +202,7 @@ class ServeTask(Task):
             quality=quality,
             ingest=ingest,
             anomaly=anomaly,
+            cache=cache,
         )
 
     def _build_ingest(self, ingest_conf, forecaster, version, quality, env):

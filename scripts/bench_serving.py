@@ -586,6 +586,138 @@ def run_sharded_bench(args, counts) -> dict:
     }
 
 
+def run_read_mix(args) -> dict:
+    """Read-heavy open-loop bench (ISSUE #16 acceptance): the SAME fitted
+    artifact behind two live servers — dispatch-per-read vs the
+    materialized forecast cache — plus a replica-level (no-HTTP) latency
+    comparison, since the < 0.5ms acceptance bar is AT the replica where
+    the row gather happens, not through a socket.
+
+    ``--read-mix R`` sets the read fraction: the remaining (1-R) of
+    replica-level operations are state installs (swap_state at an
+    unchanged frontier — a generation bump with a bit-identical rebuild),
+    so the identity gate runs WHILE invalidation churns: every cached
+    read during the mix must still equal the reference dispatch frame.
+    """
+    import pandas as pd
+
+    from distributed_forecasting_tpu.serving import start_server
+    from distributed_forecasting_tpu.serving.forecast_cache import (
+        build_forecast_cache,
+    )
+
+    fc = _fit_forecaster(args)
+    K = min(args.clients, fc.n_series)
+    payloads = _payloads(fc, args.horizon, K)
+    fc.warmup(horizon=args.horizon, sizes=[1])
+    read_frac = min(max(args.read_mix, 0.0), 1.0)
+
+    # -- replica level: cache lookup vs direct dispatch, writes interleaved
+    cache = build_forecast_cache(
+        {"enabled": True, "max_horizons": 1}, fc)
+    frames = [pd.DataFrame([fc.keys[i % fc.n_series]],
+                           columns=fc.key_names) for i in range(K)]
+    reference = fc.predict(frames[0], horizon=args.horizon)
+    ref_csv = reference.to_csv(index=False)
+    assert cache.lookup(frames[0], args.horizon, False, None,
+                        "raise", None) is not None  # materialize once
+    hits_before = int(cache.metrics.hits.value)
+
+    n_ops = max(args.requests * args.clients, 200)
+    every = int(round(1.0 / (1.0 - read_frac))) if read_frac < 1.0 else 0
+    cached_stats, dispatch_stats = LatencyStats(), LatencyStats()
+    identity_failures = 0
+    writes = 0
+    for i in range(n_ops):
+        if every and i and i % every == 0:
+            fc.swap_state(day1=fc.day1)  # install at the same frontier:
+            writes += 1                  # epoch bump, identical state
+        frame = frames[i % K]
+        t0 = time.perf_counter()
+        hit = cache.lookup(frame, args.horizon, False, None, "raise", None)
+        cached_stats.observe(time.perf_counter() - t0)
+        if i % K == 0:
+            # the identity gate rides the mix: any torn/stale frame a
+            # raced invalidation could expose shows up as a csv diff
+            got = hit if hit is not None else fc.predict(
+                frame, horizon=args.horizon)
+            if got.to_csv(index=False) != ref_csv:
+                identity_failures += 1
+    for i in range(max(n_ops // 10, 50)):
+        frame = frames[i % K]
+        t0 = time.perf_counter()
+        fc.predict(frame, horizon=args.horizon)
+        dispatch_stats.observe(time.perf_counter() - t0)
+    hits = int(cache.metrics.hits.value) - hits_before
+    replica_level = {
+        "ops": n_ops,
+        "writes_interleaved": writes,
+        "cached_read": cached_stats.summary(),
+        "dispatch_read": dispatch_stats.summary(),
+        "speedup_p50": round(
+            dispatch_stats.percentile(0.5)
+            / max(cached_stats.percentile(0.5), 1e-9), 1),
+        "hit_rate": round(hits / n_ops, 4),
+        "identity_failures": identity_failures,
+    }
+
+    # -- HTTP level: one replica per leg, closed loop + open loop ----------
+    def leg(with_cache):
+        leg_cache = build_forecast_cache(
+            {"enabled": True, "max_horizons": 1}, fc) if with_cache else None
+        srv = start_server(fc, cache=leg_cache)
+        port = srv.server_address[1]
+        for p in payloads:      # untimed: compile/materialize on first use
+            _call(port, p)
+        closed = closed_loop(lambda p: _call(port, p), payloads,
+                             args.requests)
+        bodies = closed.pop("_bodies")
+        rate = args.open_loop_qps or max(
+            1.0, 0.7 * closed["throughput_rps"])
+        n_open = max(10, int(math.ceil(rate * args.open_loop_duration)))
+        opened = open_loop(lambda p: _call(port, p), payloads, rate, n_open)
+        hit_rate = None
+        if leg_cache is not None:
+            total = (leg_cache.metrics.hits.value
+                     + sum(leg_cache.metrics.misses.snapshot().values()))
+            hit_rate = round(leg_cache.metrics.hits.value / max(total, 1), 4)
+        srv.shutdown()
+        srv.server_close()
+        return {"closed_loop": closed, "open_loop": opened,
+                "hit_rate": hit_rate}, bodies
+
+    dispatch_leg, dispatch_bodies = leg(with_cache=False)
+    cached_leg, cached_bodies = leg(with_cache=True)
+    byte_identical = dispatch_bodies == cached_bodies
+
+    out = {
+        "bench": "serving_read_mix",
+        "model": args.model,
+        "clients": K,
+        "requests_per_client": args.requests,
+        "series": fc.n_series,
+        "horizon": args.horizon,
+        "read_fraction": read_frac,
+        "replica_level": replica_level,
+        "dispatch": dispatch_leg,
+        "cached": cached_leg,
+        # the two headline fields the BENCH trajectory tracks; qps is the
+        # replica's own read capacity (1/p50 of a cache hit) — the HTTP
+        # legs above measure the whole stack, where Python's http.server
+        # and JSON serialization dominate once reads are sub-millisecond
+        "cache_hit_p50_ms": replica_level["cached_read"]["p50_ms"],
+        "qps_per_replica": round(
+            1000.0 / max(replica_level["cached_read"]["p50_ms"], 1e-6), 1),
+        "qps_per_replica_http": cached_leg["open_loop"]["achieved_rps"],
+        "qps_speedup": replica_level["speedup_p50"],
+        "qps_speedup_http": round(
+            cached_leg["closed_loop"]["throughput_rps"]
+            / max(dispatch_leg["closed_loop"]["throughput_rps"], 1e-9), 1),
+        "byte_identical": bool(byte_identical),
+    }
+    return out
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--clients", type=int, default=16)
@@ -614,6 +746,12 @@ def main() -> None:
                     help="with --sharded: SIGKILL a replica, wait for the "
                          "hand-off to reconverge, and gate on zero failed "
                          "requests after the rebalance")
+    ap.add_argument("--read-mix", type=float, nargs="?", const=0.95,
+                    default=None, metavar="FRACTION",
+                    help="read-heavy bench: cached vs dispatch-per-read; "
+                         "the value is the read fraction (default 0.95), "
+                         "the rest are interleaved state installs that "
+                         "churn invalidation under the identity gate")
     ap.add_argument("--fleet-mesh-devices", type=int, default=0,
                     help="shard each replica's predict over a mesh of this "
                          "size (>1; replicas force host devices to match)")
@@ -636,6 +774,20 @@ def main() -> None:
     sys.path.insert(
         0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     import distributed_forecasting_tpu  # noqa: F401  (platform override first)
+
+    if args.read_mix is not None:
+        out = run_read_mix(args)
+        line = json.dumps(out)
+        print(line)
+        if args.json_out:
+            with open(args.json_out, "w") as f:
+                f.write(line + "\n")
+        if not out["byte_identical"]:
+            sys.exit("cached responses diverged from dispatch responses")
+        if out["replica_level"]["identity_failures"]:
+            sys.exit(f"{out['replica_level']['identity_failures']} cached "
+                     f"read(s) diverged under invalidation churn")
+        return
 
     if args.fleet:
         counts = [int(x) for x in args.fleet.split(",") if x.strip()]

@@ -1,0 +1,174 @@
+"""CI smoke: materialized forecast cache on a 2-replica sharded fleet.
+
+The end-to-end gate for ``serving/forecast_cache.py`` on the REAL fleet
+path (docs/serving.md "Materialized forecast cache"):
+
+  1. fit a small multi-series model and save the artifact;
+  2. boot the same 2-replica series-sharded fleet TWICE — once with the
+     ``serving.cache`` block enabled, once without — and drive an
+     identical request sequence through the front door: per-series
+     requests repeated (the second pass must be cache hits), plus a
+     full-catalog scatter request spanning every shard;
+  3. the gate: every response body from the cached fleet byte-identical
+     to the uncached fleet's, ``dftpu_cache_hits_total`` NONZERO on the
+     front door's aggregated ``/metrics`` (the reads actually came out of
+     the materialized frames, not silently out of dispatch), and the
+     ``dftpu_cache_entry_age_seconds`` gauge present with its TYPE line
+     (the max-merge fleet semantics of docs/observability.md).
+
+Run::
+
+    JAX_PLATFORMS=cpu python scripts/cache_smoke.py --workdir /tmp/cache_smoke
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import os
+import re
+import shutil
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _post(port: int, payload: dict, timeout: float = 60.0):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("POST", "/invocations", json.dumps(payload),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+def _metrics(port: int, timeout: float = 10.0) -> str:
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("GET", "/metrics")
+        return conn.getresponse().read().decode()
+    finally:
+        conn.close()
+
+
+def _counter(text: str, name: str) -> float:
+    total = 0.0
+    for line in text.splitlines():
+        m = re.match(rf"{name}(?:{{[^}}]*}})? ([0-9.e+-]+)$", line)
+        if m:
+            total += float(m.group(1))
+    return total
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--workdir", default="/tmp/cache_smoke")
+    ap.add_argument("--series", type=int, default=8,
+                    help="synthetic series count (2 stores x series/2 items)")
+    ap.add_argument("--days", type=int, default=120)
+    ap.add_argument("--horizon", type=int, default=7)
+    ap.add_argument("--num-shards", type=int, default=4)
+    ap.add_argument("--ready-timeout", type=float, default=600.0)
+    args = ap.parse_args()
+
+    from distributed_forecasting_tpu.data import (
+        synthetic_store_item_sales,
+        tensorize,
+    )
+    from distributed_forecasting_tpu.models import ThetaConfig
+    from distributed_forecasting_tpu.models.base import get_model
+    from distributed_forecasting_tpu.serving import BatchForecaster
+    from distributed_forecasting_tpu.serving.fleet import (
+        FleetConfig,
+        start_fleet,
+    )
+    from distributed_forecasting_tpu.serving.sharding import ShardingConfig
+
+    if os.path.exists(args.workdir):
+        shutil.rmtree(args.workdir)
+    os.makedirs(args.workdir)
+
+    df = synthetic_store_item_sales(
+        n_stores=2, n_items=max(args.series // 2, 1),
+        n_days=args.days, seed=7)
+    batch = tensorize(df)
+    cfg = ThetaConfig()
+    params = get_model("theta").fit(batch.y, batch.mask, batch.day, cfg)
+    fc = BatchForecaster.from_fit(batch, params, "theta", cfg)
+    artifact_dir = os.path.join(args.workdir, "artifact")
+    fc.save(artifact_dir)
+
+    keys = [tuple(int(v) for v in k) for k in fc.keys]
+    payloads = [{"inputs": [dict(zip(fc.key_names, k))],
+                 "horizon": args.horizon} for k in keys]
+    payloads.append({"inputs": [dict(zip(fc.key_names, k)) for k in keys],
+                     "horizon": args.horizon})  # scatter: spans every shard
+    sharding = ShardingConfig(enabled=True, num_shards=args.num_shards,
+                              replication=1)
+
+    def leg(tag, cache_conf):
+        serving_conf = {"warmup_sizes": [1], "warmup_horizon": args.horizon}
+        if cache_conf:
+            serving_conf["cache"] = cache_conf
+        sup, front = start_fleet(
+            FleetConfig(enabled=True, replicas=2,
+                        ready_timeout_s=args.ready_timeout),
+            artifact_dir=artifact_dir,
+            serving_conf=serving_conf,
+            front_host="127.0.0.1",
+            front_port=0,
+            env_extra={"DFTPU_COMPILE_CACHE": os.environ.get(
+                "DFTPU_COMPILE_CACHE",
+                os.path.join(args.workdir, "compile_cache"))},
+            sharding=sharding,
+        )
+        port = front.server_address[1]
+        bodies = []
+        try:
+            for p in payloads:      # pass 1: cold (materialize per shard)
+                _post(port, p)
+            for p in payloads:      # pass 2+3: must be cache hits
+                for _ in range(2):
+                    status, body = _post(port, p)
+                    assert status == 200, (tag, status, body[:200])
+                    bodies.append(body)
+            metrics = _metrics(port)
+        finally:
+            front.shutdown()
+            sup.stop()
+        return bodies, metrics
+
+    cached_bodies, cached_metrics = leg(
+        "cached", {"enabled": True, "max_horizons": 1})
+    plain_bodies, _ = leg("uncached", None)
+
+    failures = []
+    if cached_bodies != plain_bodies:
+        diverged = sum(a != b for a, b in zip(cached_bodies, plain_bodies))
+        failures.append(
+            f"{diverged}/{len(plain_bodies)} responses from the cached "
+            f"fleet differ from the uncached fleet's bytes")
+    hits = _counter(cached_metrics, "dftpu_cache_hits_total")
+    if hits <= 0:
+        failures.append(
+            "dftpu_cache_hits_total is 0 on the fleet exposition — every "
+            "read fell through to dispatch")
+    if "# TYPE dftpu_cache_entry_age_seconds gauge" not in cached_metrics:
+        failures.append(
+            "dftpu_cache_entry_age_seconds TYPE line missing from the "
+            "aggregated fleet /metrics")
+
+    if failures:
+        for f in failures:
+            print("FAIL:", f, file=sys.stderr)
+        sys.exit(1)
+    print(f"cache smoke ok: {len(cached_bodies)} byte-identical responses, "
+          f"{int(hits)} fleet-wide cache hits")
+
+
+if __name__ == "__main__":
+    main()

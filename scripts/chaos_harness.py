@@ -1,6 +1,6 @@
 """Chaos harness: injected faults -> asserted invariants, reproducibly.
 
-Five scenarios over the failpoint registry (``monitoring/failpoints.py``)
+Six scenarios over the failpoint registry (``monitoring/failpoints.py``)
 and the degradation layer (``serving/resilience.py``), each a pure
 function returning a result dict and raising AssertionError on a broken
 invariant:
@@ -24,6 +24,12 @@ invariant:
   breaker_trip_recover  a hung replica trips its breaker OPEN; after the
                         replica revives, the half-open probe recloses it
                         within ``breaker_open_s`` + one request.
+  cache_kill9_mid_persist
+                        SIGKILL a replica inside the forecast-cache
+                        persist window (``cache.persist=kill9``); a fresh
+                        boot adopts only cleanly committed frames,
+                        discards a torn payload via the sha256 digest,
+                        and serves byte-identical forecasts either way.
 
 Every scenario is deterministic from its seed — a failing run replays
 bit-for-bit.  CI runs the three fast scenarios as the chaos smoke::
@@ -427,6 +433,138 @@ def breaker_trip_recover(workdir: str, seed: int = 0) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# scenario 6: kill -9 mid-forecast-cache-persist
+# ---------------------------------------------------------------------------
+
+_CACHE_CHILD = r"""
+import sys
+
+import pandas as pd
+
+from distributed_forecasting_tpu.data import (
+    synthetic_store_item_sales,
+    tensorize,
+)
+from distributed_forecasting_tpu.models import ThetaConfig
+from distributed_forecasting_tpu.models.base import get_model
+from distributed_forecasting_tpu.monitoring import failpoints as fp
+from distributed_forecasting_tpu.serving import BatchForecaster
+from distributed_forecasting_tpu.serving.forecast_cache import (
+    build_forecast_cache,
+)
+
+mmap_dir, seed = sys.argv[1], int(sys.argv[2])
+df = synthetic_store_item_sales(n_stores=2, n_items=2, n_days=120, seed=seed)
+batch = tensorize(df)
+cfg = ThetaConfig()
+params = get_model("theta").fit(batch.y, batch.mask, batch.day, cfg)
+fc = BatchForecaster.from_fit(batch, params, "theta", cfg)
+cache = build_forecast_cache({"enabled": True, "mmap_dir": mmap_dir}, fc)
+req = pd.DataFrame(fc.keys, columns=fc.key_names)
+frame = cache.lookup(req, 14, False, None, "raise", None)
+assert frame is not None
+# the parent checks its own dispatch against this — the cross-process
+# bitwise-determinism gate the recovery assertions rest on
+print("REF " + frame.to_csv(index=False).encode().hex(), flush=True)
+print("PERSISTED " + str(int(cache.metrics.persists.value)), flush=True)
+# self-arm: the NEXT persist evaluation SIGKILLs this process inside the
+# durable-write window — after the rebuilt frame went live in memory,
+# before any byte of the commit record lands
+fp.configure("cache.persist=kill9")
+fc.swap_state(day1=fc.day1)  # epoch bump -> eager rebuild -> persist
+print("SURVIVED", flush=True)
+"""
+
+
+def cache_kill9_mid_persist(workdir: str, seed: int = 0) -> dict:
+    """SIGKILL a replica inside the forecast-cache persist window; a fresh
+    boot must adopt only cleanly committed frames, discard a torn payload,
+    and serve byte-identical forecasts either way (dispatch fall-through
+    covers whatever the disk lost)."""
+    import pandas as pd
+
+    from distributed_forecasting_tpu.data import (
+        synthetic_store_item_sales,
+        tensorize,
+    )
+    from distributed_forecasting_tpu.models import ThetaConfig
+    from distributed_forecasting_tpu.models.base import get_model
+    from distributed_forecasting_tpu.serving import BatchForecaster
+    from distributed_forecasting_tpu.serving.forecast_cache import (
+        build_forecast_cache,
+    )
+
+    mmap_dir = os.path.join(workdir, "cache_kill9")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _CACHE_CHILD, mmap_dir, str(seed)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    out, err = proc.communicate(timeout=300)
+    assert proc.returncode == -9, (
+        f"child exited {proc.returncode}, wanted SIGKILL (-9); "
+        f"stderr: {err[-500:]}")
+    assert "SURVIVED" not in out, "kill9 failpoint never fired"
+    ref_csv = next(bytes.fromhex(line.split()[1]).decode()
+                   for line in out.splitlines() if line.startswith("REF "))
+    persists = next(int(line.split()[1])
+                    for line in out.splitlines()
+                    if line.startswith("PERSISTED "))
+    assert persists >= 1, "first-epoch persist never landed before the kill"
+
+    # meta-last commit protocol: the crash window can leave a payload with
+    # no meta, never a meta with no (valid) payload
+    names = set(os.listdir(mmap_dir))
+    for name in names:
+        if name.endswith(".meta.json"):
+            assert name[:-len(".meta.json")] + ".npy" in names, name
+    # plant the other crash shape by hand — an orphan payload (died between
+    # the payload rename and the meta write); the loader must ignore it
+    with open(os.path.join(mmap_dir, "h99.npy"), "wb") as f:
+        f.write(b"orphan payload, no commit record")
+
+    df = synthetic_store_item_sales(n_stores=2, n_items=2, n_days=120,
+                                    seed=seed)
+    batch = tensorize(df)
+    cfg = ThetaConfig()
+    params = get_model("theta").fit(batch.y, batch.mask, batch.day, cfg)
+    fc = BatchForecaster.from_fit(batch, params, "theta", cfg)
+    req = pd.DataFrame(fc.keys, columns=fc.key_names)
+    dispatched = fc.predict(req, horizon=14)
+    assert dispatched.to_csv(index=False) == ref_csv, (
+        "cross-process dispatch determinism broke — recovery assertions "
+        "below would be meaningless")
+
+    # clean recovery: the child's committed first-epoch frame is adopted
+    # (same state -> same fingerprint) and serves byte-identically
+    boot_a = build_forecast_cache({"enabled": True, "mmap_dir": mmap_dir}, fc)
+    loads_a = int(boot_a.metrics.loads.value)
+    load_errors_a = int(boot_a.metrics.load_errors.value)
+    assert loads_a == 1 and load_errors_a == 0, (loads_a, load_errors_a)
+    got = boot_a.lookup(req, 14, False, None, "raise", None)
+    assert got is not None and got.to_csv(index=False) == ref_csv
+
+    # torn recovery: flip one payload byte (a torn write that still got its
+    # commit record); the digest check discards it and the read falls
+    # through to a fresh dispatch — byte-identical either way
+    ppath = os.path.join(mmap_dir, "h14.npy")
+    blob = bytearray(open(ppath, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    with open(ppath, "wb") as f:
+        f.write(bytes(blob))
+    boot_b = build_forecast_cache({"enabled": True, "mmap_dir": mmap_dir}, fc)
+    assert int(boot_b.metrics.loads.value) == 0
+    assert int(boot_b.metrics.load_errors.value) == 1
+    assert not os.path.exists(ppath), "torn payload not discarded"
+    got = boot_b.lookup(req, 14, False, None, "raise", None)
+    if got is None:  # miss while the inline rebuild gate was busy
+        got = fc.predict(req, horizon=14)
+    assert got.to_csv(index=False) == ref_csv
+    return {"child_returncode": proc.returncode,
+            "adopted_clean": loads_a, "discarded_torn": 1,
+            "recovered_identical": True}
+
+
+# ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
 
@@ -436,6 +574,7 @@ SCENARIOS = {
     "aot_corrupt_warm_boot": aot_corrupt_warm_boot,
     "slow_replica_brownout": slow_replica_brownout,
     "breaker_trip_recover": breaker_trip_recover,
+    "cache_kill9_mid_persist": cache_kill9_mid_persist,
 }
 
 

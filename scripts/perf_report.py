@@ -16,7 +16,9 @@ prophet batch through the AOT compile cache, then time repeated
 ``BatchForecaster.predict`` dispatches.  The record carries the backend
 fingerprint, the per-entry compiled-program cost registry
 (``monitoring/cost.py``), AOT-store outcome counters, warm-dispatch latency
-quantiles, and a sha256 of the served frame.
+quantiles, a sha256 of the served frame, and a materialized-forecast-cache
+section (hit rate, cache-read p50, sha of a cache-hit frame) the diff side
+holds byte-identical to the dispatched frame.
 
 Diff (compares records, exits non-zero under ``--strict`` on any FAIL)::
 
@@ -32,6 +34,9 @@ Severity model — what fails vs what only warns:
 * warm-path recompiles (``outcome=miss`` in the current record): **fail**;
 * cold-vs-current output hash mismatch (same process ladder, same
   machine): **fail** — the cache changed what the model serves;
+* forecast-cache identity mismatch (a cache-hit frame's sha differs from
+  the dispatched frame's, or the hit counter stayed 0): **fail** — the
+  materialized cache may never serve different bytes than the batcher;
 * donation-proof regression (the dispatched state-update program loses
   its stripped/donated shape — argument_bytes no longer below the raw
   kernel's, or alias_bytes back to 0): **fail**;
@@ -133,6 +138,8 @@ def collect(workdir: str, reps: int = 20, expect_warm: bool = False) -> Dict:
                 f"{labels.get('entry', '')}|{labels.get('key', '')}", {})
             bucket[field] = value
 
+    forecast_cache = _cache_section(fc, req, reps)
+
     outcomes = _entry_outcomes(metrics_registry().snapshot())
     misses = sorted(e for e, o in outcomes.items() if o.get("miss"))
     if expect_warm and misses:
@@ -161,6 +168,7 @@ def collect(workdir: str, reps: int = 20, expect_warm: bool = False) -> Dict:
         "throughput_rows_per_s": round(rows_per_dispatch / p50, 1),
         "windowed": windowed,
         "autoprep": autoprep,
+        "forecast_cache": forecast_cache,
         "output_sha256": hashlib.sha256(
             out.to_csv(index=False).encode()).hexdigest(),
     }
@@ -247,6 +255,40 @@ def _autoprep_section() -> Dict:
         "repaired_points": int(summary.get("prep_repaired_points", 0)),
         "output_sha256": hashlib.sha256(
             np.asarray(res.batch.y, np.float32).tobytes()).hexdigest(),
+    }
+
+
+def _cache_section(fc, req, reps: int) -> Dict:
+    """Exercise the materialized forecast cache against the SAME request
+    the timing loop dispatches: one cold miss (full-S rebuild through the
+    AOT-cached predict machinery), then pure hits.  The cached frame's sha
+    lands next to the record's ``output_sha256`` so the diff side
+    (:func:`_diff_cache`) fails the build the moment a cache read serves
+    different bytes than the batcher path — the byte-identity contract
+    docs/serving.md documents, sentinel-gated."""
+    from distributed_forecasting_tpu.serving.forecast_cache import (
+        build_forecast_cache,
+    )
+
+    cache = build_forecast_cache({"enabled": True, "max_horizons": 1}, fc)
+    if cache is None:
+        return {}
+    frame = cache.lookup(req, 30, False, None, "raise", None)
+    samples = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        frame = cache.lookup(req, 30, False, None, "raise", None)
+        samples.append(time.perf_counter() - t0)
+    samples.sort()
+    hits = int(cache.metrics.hits.value)
+    misses = int(sum(cache.metrics.misses.snapshot().values()))
+    return {
+        "hits": hits,
+        "misses": misses,
+        "hit_rate": round(hits / max(hits + misses, 1), 4),
+        "read_p50_ms": round(samples[len(samples) // 2] * 1e3, 3),
+        "cached_sha256": hashlib.sha256(
+            frame.to_csv(index=False).encode()).hexdigest(),
     }
 
 
@@ -428,6 +470,7 @@ def diff_records(baseline: Dict, current: Dict,
 
     findings.append(_diff_recompiles(current))
     findings.append(_diff_donation(current))
+    findings.append(_diff_cache(current))
 
     if cold is not None:
         a, b = cold.get("output_sha256"), current.get("output_sha256")
@@ -602,6 +645,39 @@ def _diff_donation(current: Dict) -> Dict:
         f"({_pct(pa, da)}) with {alias:g} alias bytes donated")
 
 
+def _diff_cache(current: Dict) -> Dict:
+    """Assert the materialized forecast cache serves the dispatch bytes.
+
+    Two invariants from the collect-side section (:func:`_cache_section`):
+    the sha of a cache-hit frame must equal the record's ``output_sha256``
+    (the timing loop's dispatched frame — same request, same horizon), and
+    the hit counter must be nonzero (the reads actually came out of the
+    cache, not silently out of fall-through dispatch)."""
+    sec = current.get("forecast_cache")
+    if not sec:
+        return _finding(
+            "cache_identity", "warn",
+            "current record has no forecast_cache section (collected by an "
+            "older perf_report?); re-collect to assert cache identity")
+    cached, dispatched = sec.get("cached_sha256"), current.get("output_sha256")
+    if cached != dispatched:
+        return _finding(
+            "cache_identity", "fail",
+            f"cache-hit frame {str(cached)[:12]} != dispatched frame "
+            f"{str(dispatched)[:12]}: the materialized cache serves "
+            f"different bytes than the batcher path")
+    if not sec.get("hits"):
+        return _finding(
+            "cache_identity", "fail",
+            "forecast-cache hit counter is 0 — every read fell through to "
+            "dispatch, so the identity check never exercised a cached frame")
+    return _finding(
+        "cache_identity", "ok",
+        f"cache hits byte-identical to dispatch ({str(cached)[:12]}; "
+        f"hit rate {sec.get('hit_rate')}, read p50 "
+        f"{sec.get('read_p50_ms')}ms)")
+
+
 def _pct(bv: float, cv: float) -> str:
     return f"{100.0 * (cv - bv) / bv:+.1f}%" if bv else "n/a"
 
@@ -720,6 +796,10 @@ def _write_bench(path: str, report: Dict, current: Dict,
             proof.get("donated") or {}).get("argument_bytes")
         parsed["plain_argument_bytes"] = (
             proof.get("plain") or {}).get("argument_bytes")
+    fcache = current.get("forecast_cache") or {}
+    if fcache:
+        parsed["cache_hit_rate"] = fcache.get("hit_rate")
+        parsed["cache_read_p50_ms"] = fcache.get("read_p50_ms")
     bench = {
         "n": int(m.group(1)) if m else None,
         "cmd": ("python scripts/perf_report.py --baseline PERF_BASELINE.json"

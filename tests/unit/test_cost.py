@@ -308,7 +308,7 @@ def test_debug_cost_endpoint_gated(fresh_cost):
 # -- perf sentinel ------------------------------------------------------------
 
 def _perf_record(p50=5.0, flops=1e6, miss=0, sha="aa11", backend=None,
-                 donated_arg=288.0, alias=32.0):
+                 donated_arg=288.0, alias=32.0, cache_sha=None, cache_hits=5):
     return {
         "format": "dftpu-perf-baseline-v1",
         "backend": backend or {"platform": "cpu", "device_kind": "cpu",
@@ -324,6 +324,10 @@ def _perf_record(p50=5.0, flops=1e6, miss=0, sha="aa11", backend=None,
             "entry": "state_update:holt_winters",
             "plain": {"argument_bytes": 1312.0, "alias_bytes": 0.0},
             "donated": {"argument_bytes": donated_arg, "alias_bytes": alias},
+        },
+        "forecast_cache": {
+            "hits": cache_hits, "misses": 1, "hit_rate": 0.8333,
+            "read_p50_ms": 0.05, "cached_sha256": cache_sha or sha,
         },
         "timings_ms": {"p50": p50},
         "output_sha256": sha,
@@ -381,6 +385,22 @@ def test_perf_sentinel_donation_proof_gate():
     del old["donation_proof"]
     findings = pr.diff_records(_perf_record(), old)
     assert _levels(findings)["donation"] == "warn"
+
+
+def test_perf_sentinel_cache_identity_gate():
+    pr = _load_script("perf_report")
+    # cache hits serving different bytes than the batcher path: fail
+    findings = pr.diff_records(_perf_record(),
+                               _perf_record(cache_sha="deadbeef"))
+    assert _levels(findings)["cache_identity"] == "fail"
+    # zero hits: the identity check never exercised a cached frame
+    findings = pr.diff_records(_perf_record(), _perf_record(cache_hits=0))
+    assert _levels(findings)["cache_identity"] == "fail"
+    # a record collected by an older perf_report degrades to warn, not fail
+    old = _perf_record()
+    del old["forecast_cache"]
+    findings = pr.diff_records(_perf_record(), old)
+    assert _levels(findings)["cache_identity"] == "warn"
 
 
 def test_perf_sentinel_cpu_noise_floor():

@@ -1144,3 +1144,105 @@ def test_failpoint_on_host_orchestration_path_negative(tmp_path):
             return step(x)
     """)
     assert _lint(tmp_path, "ops/driver.py") == []
+
+
+# ---------------------------------------------------------------------------
+# ISSUE-16 fixtures: the serving.cache conf block + cache reads under
+# write-path invalidation
+# ---------------------------------------------------------------------------
+
+def test_cache_conf_block_drift_positive_and_negative(tmp_path):
+    # mirrors conf/tasks/serve_config.yml's serving.cache block: a typo'd
+    # max_horizon key is spellable from YAML but no CacheConfig field
+    # consumes it -> a cache the operator thinks is horizon-capped isn't
+    _write(tmp_path, "conf/serve.yml", """
+        serving:
+          cache:
+            enabled: true
+            max_horizon: 4
+            quantile_sets: []
+            max_bytes: 268435456
+    """)
+    _write(tmp_path, "src/cache_cfg.py", """
+        import dataclasses
+
+        @dataclasses.dataclass(frozen=True)
+        class CacheConfig:
+            enabled: bool = False
+            max_horizons: int = 4
+            quantile_sets: tuple = ()
+            mmap_dir: str = None
+            max_bytes: int = 268435456
+
+            @classmethod
+            def from_conf(cls, conf):
+                block = conf.get("serving", {}).get("cache", {})
+                known = {f.name for f in dataclasses.fields(cls)}
+                return cls(**{k: v for k, v in block.items() if k in known})
+    """)
+    found = _lint(tmp_path, "src/cache_cfg.py")
+    assert [f.rule for f in found] == ["config-drift"]
+    assert "max_horizon" in found[0].message
+    assert found[0].path == "conf/serve.yml"
+
+    # the real key name makes the block clean
+    _write(tmp_path, "conf/serve.yml", """
+        serving:
+          cache:
+            enabled: true
+            max_horizons: 4
+            quantile_sets: []
+            max_bytes: 268435456
+    """)
+    assert _lint(tmp_path, "src/cache_cfg.py") == []
+
+
+def test_cache_read_under_invalidation_positive(tmp_path):
+    # the torn-read shape the epoch design exists to prevent: a state
+    # install rewrites the entry map under the lock while lookup() reads
+    # it bare — a request served mid-install can observe a half-updated
+    # map (an entry for the OLD state published against the NEW epoch)
+    _write(tmp_path, "serving/cache.py", """
+        import threading
+
+        class Cache:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._entries = {}
+
+            def invalidate(self, entries):
+                with self._lock:
+                    self._entries = dict(entries)
+
+            def lookup(self, sig):
+                return self._entries.get(sig)
+    """)
+    found = _lint(tmp_path, "serving/cache.py")
+    assert "unlocked-shared-state" in _rules(found)
+    assert any("lookup" in f.message for f in found)
+
+
+def test_cache_epoch_snapshot_negative(tmp_path):
+    # the shape serving/forecast_cache.py actually uses: take a reference
+    # snapshot of the (immutable) entry under the lock, gather rows from
+    # it outside — invalidation swaps the map, never mutates an entry a
+    # reader already holds
+    _write(tmp_path, "serving/cache.py", """
+        import threading
+
+        class Cache:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._entries = {}
+
+            def invalidate(self, entries):
+                with self._lock:
+                    self._entries = dict(entries)
+
+            def lookup(self, sig):
+                with self._lock:
+                    entry = self._entries.get(sig)
+                return entry
+    """)
+    found = _lint(tmp_path, "serving/cache.py")
+    assert "unlocked-shared-state" not in _rules(found)

@@ -1,0 +1,492 @@
+"""Materialized forecast cache (serving/forecast_cache.py): byte-identity
+vs the dispatch path across families, staleness-after-write for EVERY
+writer that funnels through swap_state (streaming apply, full refit,
+windowed tail refit, day1-only grid advance), epoch-race discard, strict
+conf parse, mmap persistence round-trip and torn-file recovery, eviction,
+and the server/metrics integration — the invalidation-completeness
+contract docs/serving.md documents.
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from distributed_forecasting_tpu.engine.state_store import SeriesStateStore
+from distributed_forecasting_tpu.serving.forecast_cache import (
+    CacheConfig,
+    ForecastCache,
+    build_forecast_cache,
+    canonical_quantiles,
+)
+
+# ---------------------------------------------------------------------------
+# fixtures (mirror test_ingest.py: one theta fit per module)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def theta_fit():
+    from distributed_forecasting_tpu.data import (
+        synthetic_store_item_sales,
+        tensorize,
+    )
+    from distributed_forecasting_tpu.models import ThetaConfig
+    from distributed_forecasting_tpu.models.base import get_model
+
+    df = synthetic_store_item_sales(n_stores=2, n_items=2, n_days=120,
+                                    seed=13)
+    batch = tensorize(df)
+    cfg = ThetaConfig()
+    params = get_model("theta").fit(batch.y, batch.mask, batch.day, cfg)
+    return batch, params, cfg
+
+
+def _fresh_fc(theta_fit):
+    from distributed_forecasting_tpu.serving import BatchForecaster
+
+    batch, params, cfg = theta_fit
+    return BatchForecaster.from_fit(batch, params, "theta", cfg)
+
+
+def _history(theta_fit):
+    batch, _, _ = theta_fit
+    return np.asarray(batch.y), np.asarray(batch.mask)
+
+
+def _cache(fc, **over):
+    conf = {"enabled": True, "quantile_sets": [[0.1, 0.5, 0.9]], **over}
+    cache = build_forecast_cache(conf, fc)
+    assert cache is not None
+    return cache
+
+
+def _req(fc, rows=None):
+    keys = fc.keys if rows is None else fc.keys[rows]
+    return pd.DataFrame(keys, columns=fc.key_names)
+
+
+def _read(cache, req, horizon=14, quantiles=None):
+    return cache.lookup(req, horizon=horizon, include_history=False,
+                        quantiles=quantiles, on_missing="raise", xreg=None)
+
+
+def _assert_identical(cached, dispatched):
+    """Byte-identity, not closeness: same columns, same dtypes, same bits."""
+    assert cached is not None
+    assert list(cached.columns) == list(dispatched.columns)
+    for col in dispatched.columns:
+        assert cached[col].dtype == dispatched[col].dtype, col
+        assert np.array_equal(cached[col].to_numpy(),
+                              dispatched[col].to_numpy()), col
+    assert cached.to_csv(index=False) == dispatched.to_csv(index=False)
+
+
+# ---------------------------------------------------------------------------
+# strict conf
+# ---------------------------------------------------------------------------
+
+
+def test_cache_config_strict_parse():
+    cfg = CacheConfig.from_conf({
+        "enabled": True, "max_horizons": 2,
+        "quantile_sets": [[0.9, 0.1, 0.5, 0.5]], "max_bytes": 1024})
+    assert cfg.enabled and cfg.max_horizons == 2
+    # canonicalized exactly like the request path: sorted, deduped, 3dp
+    assert cfg.quantile_sets == ((0.1, 0.5, 0.9),)
+    assert CacheConfig.from_conf(None) == CacheConfig()
+    with pytest.raises(ValueError, match="serving.cache"):
+        CacheConfig.from_conf({"max_horizon": 4})  # typo'd key
+    with pytest.raises(ValueError, match="max_horizons"):
+        CacheConfig.from_conf({"max_horizons": 0})
+    with pytest.raises(ValueError, match="quantile_sets"):
+        CacheConfig.from_conf({"quantile_sets": [[0.5, 1.5]]})
+
+
+def test_canonical_quantiles_matches_request_path():
+    assert canonical_quantiles([0.9, 0.1, 0.9]) == (0.1, 0.9)
+    assert canonical_quantiles((0.5004,)) == (0.5,)
+
+
+# ---------------------------------------------------------------------------
+# byte-identity across families (theta/prophet anchor tier-1, the other
+# five ride the CI slow set — same split as the sharded-fleet identity)
+# ---------------------------------------------------------------------------
+
+_FAMILIES = [
+    "theta",
+    "prophet",
+    pytest.param("arima", marks=pytest.mark.slow),
+    pytest.param("croston", marks=pytest.mark.slow),
+    pytest.param("curve", marks=pytest.mark.slow),
+    pytest.param("holt_winters", marks=pytest.mark.slow),
+    pytest.param("prophet_ar", marks=pytest.mark.slow),
+]
+
+
+@pytest.mark.parametrize("family", _FAMILIES)
+def test_cached_read_byte_identical(family):
+    from distributed_forecasting_tpu.data import (
+        synthetic_store_item_sales,
+        tensorize,
+    )
+    from distributed_forecasting_tpu.engine import fit_forecast
+    from distributed_forecasting_tpu.models.base import get_model
+    from distributed_forecasting_tpu.serving import BatchForecaster
+
+    df = synthetic_store_item_sales(n_stores=2, n_items=2, n_days=60,
+                                    seed=7)
+    batch = tensorize(df)
+    cfg = get_model(family).config_cls()
+    params, _ = fit_forecast(batch, model=family, config=cfg, horizon=7)
+    fc = BatchForecaster.from_fit(batch, params, family, cfg)
+    cache = _cache(fc)
+
+    # full set, a subset, and a scrambled order — the bucket regimes the
+    # coalesce_safe contract spans
+    for rows in (None, [1, 3], [3, 0, 2, 1]):
+        req = _req(fc, rows)
+        _assert_identical(_read(cache, req, horizon=7),
+                          fc.predict(req, horizon=7))
+    # quantile frames take the same gather path with more columns
+    req = _req(fc, [0, 2])
+    _assert_identical(
+        _read(cache, req, horizon=7, quantiles=[0.9, 0.1, 0.5]),
+        fc.predict_quantiles(req, quantiles=(0.1, 0.5, 0.9), horizon=7))
+
+
+def test_miss_then_hit_counters(theta_fit):
+    fc = _fresh_fc(theta_fit)
+    cache = _cache(fc)
+    req = _req(fc, [0])
+    assert _read(cache, req) is not None  # cold -> inline rebuild -> serve
+    assert cache.metrics.rebuilds.value == 1
+    assert cache.metrics.hits.value == 1
+    assert _read(cache, req) is not None  # resident now
+    assert cache.metrics.hits.value == 2
+    assert cache.metrics.rebuilds.value == 1  # no second dispatch
+
+
+def test_inadmissible_requests_fall_through(theta_fit):
+    fc = _fresh_fc(theta_fit)
+    cache = _cache(fc, max_horizons=1)
+    req = _req(fc, [0])
+    assert _read(cache, req, horizon=14) is not None
+    # exotic requests always dispatch: history rows, xreg, unlisted sets
+    assert cache.lookup(req, 14, True, None, "raise", None) is None
+    assert cache.lookup(req, 14, False, None, "raise", object()) is None
+    assert _read(cache, req, quantiles=[0.25]) is None
+    # a second distinct horizon is past max_horizons=1: dispatch-only
+    assert _read(cache, req, horizon=30) is None
+    assert cache.metrics.misses.value(reason="horizon_cap") == 1
+    assert cache.metrics.misses.value(reason="bypass") == 3
+
+
+def test_unknown_series_raises_like_dispatch(theta_fit):
+    from distributed_forecasting_tpu.serving.predictor import (
+        UnknownSeriesError,
+    )
+
+    fc = _fresh_fc(theta_fit)
+    cache = _cache(fc)
+    bad = pd.DataFrame({k: [999] for k in fc.key_names})
+    with pytest.raises(UnknownSeriesError):
+        _read(cache, bad)
+    # on_missing=skip: every row unknown -> empty -> dispatch handles shape
+    assert cache.lookup(bad, 14, False, None, "skip", None) is None
+
+
+# ---------------------------------------------------------------------------
+# staleness after every writer: the invalidation-completeness contract
+# ---------------------------------------------------------------------------
+
+
+def test_stale_read_impossible_after_ingest_apply(theta_fit):
+    fc = _fresh_fc(theta_fit)
+    y, mask = _history(theta_fit)
+    store = SeriesStateStore(fc, time_bucket=16, history_y=y,
+                             history_mask=mask)
+    cache = _cache(fc)
+    req = _req(fc)
+    before = _read(cache, req)
+    assert before is not None
+
+    store.ingest([(0, store.day_cur + 1, 123.0)])
+    out = store.apply_pending()  # -> swap_state -> cache invalidation
+    assert out["points"] == 1
+    after = _read(cache, req)
+    _assert_identical(after, fc.predict(req, horizon=14))
+    # the state actually moved: the grid advanced a day
+    assert not after["ds"].equals(before["ds"])
+    assert cache.metrics.invalidations.value >= 1
+
+
+def test_stale_read_impossible_after_full_refit(theta_fit):
+    fc = _fresh_fc(theta_fit)
+    y, mask = _history(theta_fit)
+    store = SeriesStateStore(fc, time_bucket=16, history_y=y,
+                             history_mask=mask)
+    cache = _cache(fc)
+    req = _req(fc)
+    before = _read(cache, req)
+    assert before is not None
+
+    # stream enough signal that the refit lands different parameters
+    day1 = store.day_cur
+    store.ingest([(s, day1 + 1 + d, 50.0 + 7.0 * s + d)
+                  for s in range(fc.keys.shape[0]) for d in range(3)])
+    store.apply_pending()
+    prep, dispatch, complete = store.refit_stages()
+    complete(dispatch(prep()))  # _install_refit -> swap_state
+
+    after = _read(cache, req)
+    _assert_identical(after, fc.predict(req, horizon=14))
+    assert not np.array_equal(after["yhat"].to_numpy(),
+                              before["yhat"].to_numpy())
+
+
+def test_stale_read_impossible_after_windowed_tail_refit():
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_forecasting_tpu.data.tensorize import SeriesBatch
+    from distributed_forecasting_tpu.engine.windowed import (
+        WindowedConfig,
+        WindowedSeriesStateStore,
+        windowed_fit_forecast,
+    )
+    from distributed_forecasting_tpu.models.arima import ArimaConfig
+    from distributed_forecasting_tpu.serving import BatchForecaster
+
+    rng = np.random.default_rng(3)
+    S, T = 2, 2000
+    eps = rng.normal(0.0, 1.0, (S, T))
+    y = np.zeros((S, T))
+    for t in range(2, T):
+        y[:, t] = 0.55 * y[:, t - 1] + 0.20 * y[:, t - 2] + eps[:, t]
+    batch = SeriesBatch(
+        y=jnp.asarray(y + 10.0, jnp.float32),
+        mask=jnp.ones((S, T), jnp.float32),
+        day=jnp.arange(T, dtype=jnp.float32),
+        keys=jnp.arange(S, dtype=jnp.int32)[:, None],
+        key_names=("series",), start_date="1970-01-01")
+    wcfg = WindowedConfig(enabled=True, window_len=512, overlap=64,
+                          min_windows=2)
+    cfg = ArimaConfig()
+    params, _ = windowed_fit_forecast(batch, model="arima", config=cfg,
+                                      horizon=14, key=jax.random.PRNGKey(0),
+                                      wconfig=wcfg)
+    fc = BatchForecaster("arima", cfg, params, np.asarray(batch.keys),
+                         batch.key_names, day0=T - wcfg.window_len,
+                         day1=T - 1)
+    store = WindowedSeriesStateStore(
+        fc, np.asarray(batch.y), np.asarray(batch.mask), history_day0=0,
+        wconfig=wcfg)
+    cache = _cache(fc)
+    req = _req(fc)
+    before = _read(cache, req, horizon=7)
+    assert before is not None
+
+    # writer 1: day1-only grid advance (swap_state with no new params)
+    store.ingest([(s, T + d, 10.0 + s + 0.5 * d)
+                  for s in range(S) for d in range(2)])
+    store.apply_pending()
+    mid = _read(cache, req, horizon=7)
+    _assert_identical(mid, fc.predict(req, horizon=7))
+    assert not mid["ds"].equals(before["ds"])
+
+    # writer 2: the tail-window refit installs new params
+    prep, dispatch, complete = store.refit_stages()
+    complete(dispatch(prep()))
+    after = _read(cache, req, horizon=7)
+    _assert_identical(after, fc.predict(req, horizon=7))
+
+
+def test_epoch_race_discards_overtaken_rebuild(theta_fit):
+    """A rebuild whose dispatch a writer overtakes must NOT publish: the
+    frame mixes the old params with the new generation.  The writer's own
+    listener pass re-materializes, and reads only ever see frames whose
+    epoch equals the live generation."""
+    fc = _fresh_fc(theta_fit)
+    cache = _cache(fc)
+    req = _req(fc)
+    real_predict = fc.predict
+    raced = threading.Event()
+
+    def racing_predict(*a, **k):
+        out = real_predict(*a, **k)
+        if not raced.is_set():
+            raced.set()
+            # a writer lands between this dispatch and the publish; the
+            # listener's eager rebuild (using the un-patched path next
+            # call) repopulates from the NEW state
+            fc.predict = real_predict
+            fc.swap_state(day1=fc.day1 + 1)
+        return out
+
+    fc.predict = racing_predict
+    first = _read(cache, req)
+    # the raced rebuild was discarded; the listener's rebuild (from the
+    # new generation) is resident, so this read — whichever path it took —
+    # must equal a fresh dispatch of the NEW state
+    _assert_identical(_read(cache, req), real_predict(req, horizon=14))
+    if first is not None:
+        _assert_identical(first, real_predict(req, horizon=14))
+    with cache._lock:
+        entry = cache._entries[(14, None)]
+    assert entry.epoch == fc.state_generation()
+
+
+# ---------------------------------------------------------------------------
+# persistence: adopt-on-boot, fingerprint gating, torn files
+# ---------------------------------------------------------------------------
+
+
+def test_persist_roundtrip_adopted_on_boot(theta_fit, tmp_path):
+    fc = _fresh_fc(theta_fit)
+    cache = _cache(fc, mmap_dir=str(tmp_path))
+    req = _req(fc, [0, 2])
+    first = _read(cache, req)
+    assert first is not None and cache.metrics.persists.value == 1
+
+    # a process restart: same artifact state, fresh cache over the files
+    fc2 = _fresh_fc(theta_fit)
+    cache2 = _cache(fc2, mmap_dir=str(tmp_path))
+    assert cache2.metrics.loads.value == 1
+    hit = _read(cache2, req)
+    assert cache2.metrics.rebuilds.value == 0  # served from the mmap frame
+    _assert_identical(hit, fc2.predict(req, horizon=14))
+
+
+def test_persisted_frames_from_other_state_discarded(theta_fit, tmp_path):
+    fc = _fresh_fc(theta_fit)
+    cache = _cache(fc, mmap_dir=str(tmp_path))
+    assert _read(cache, _req(fc)) is not None
+
+    fc2 = _fresh_fc(theta_fit)
+    fc2.swap_state(day1=fc2.day1 + 5)  # restart against NEWER state
+    cache2 = _cache(fc2, mmap_dir=str(tmp_path))
+    assert cache2.metrics.loads.value == 0
+    assert cache2.metrics.load_errors.value == 1
+    # the stale files are gone and serving is correct via rebuild
+    _assert_identical(_read(cache2, _req(fc2)),
+                      fc2.predict(_req(fc2), horizon=14))
+
+
+def test_torn_persisted_payload_discarded(theta_fit, tmp_path):
+    fc = _fresh_fc(theta_fit)
+    cache = _cache(fc, mmap_dir=str(tmp_path))
+    assert _read(cache, _req(fc)) is not None
+    (payload,) = [p for p in os.listdir(tmp_path) if p.endswith(".npy")]
+    path = os.path.join(tmp_path, payload)
+    blob = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(blob[: len(blob) // 2])  # torn mid-write
+
+    fc2 = _fresh_fc(theta_fit)
+    cache2 = _cache(fc2, mmap_dir=str(tmp_path))
+    assert cache2.metrics.load_errors.value == 1
+    assert cache2.metrics.loads.value == 0
+    assert not os.listdir(tmp_path)  # both halves of the pair removed
+    _assert_identical(_read(cache2, _req(fc2)),
+                      fc2.predict(_req(fc2), horizon=14))
+
+
+def test_cache_persist_failpoint_keeps_memory_serving(theta_fit, tmp_path):
+    from distributed_forecasting_tpu.monitoring import failpoints as fp
+
+    fc = _fresh_fc(theta_fit)
+    cache = _cache(fc, mmap_dir=str(tmp_path))
+    fp.configure("cache.persist=raise OSError")
+    try:
+        hit = _read(cache, _req(fc))
+        assert hit is not None  # the in-memory frame serves regardless
+        assert fp.fired("cache.persist")
+        assert cache.metrics.persist_errors.value == 1
+        assert not os.listdir(tmp_path)
+    finally:
+        fp.deactivate()
+    _assert_identical(hit, fc.predict(_req(fc), horizon=14))
+
+
+# ---------------------------------------------------------------------------
+# eviction, composite gating, integration
+# ---------------------------------------------------------------------------
+
+
+def test_eviction_holds_max_bytes_budget(theta_fit):
+    fc = _fresh_fc(theta_fit)
+    cache = _cache(fc, max_horizons=4)
+    req = _req(fc, [0])
+    assert _read(cache, req, horizon=14) is not None
+    one = cache._entries[(14, None)].nbytes
+    # room for ~2 h14-sized frames; longer-horizon frames are bigger, so
+    # admitting h21 + h30 must push the OLDEST entries out until the
+    # budget holds again (the newest admit always survives)
+    object.__setattr__(cache.config, "max_bytes", int(one * 2.5))
+    assert _read(cache, req, horizon=21) is not None
+    assert _read(cache, req, horizon=30) is not None
+    with cache._lock:
+        assert (14, None) not in cache._entries  # oldest went first
+        assert (30, None) in cache._entries
+        assert cache._bytes <= cache.config.max_bytes
+    assert cache.metrics.evictions.value >= 1
+
+
+def test_composite_forecasters_serve_uncached():
+    class NotCoalesceSafe:
+        pass
+
+    assert build_forecast_cache({"enabled": True}, NotCoalesceSafe()) is None
+    # and disabled conf is None regardless of the forecaster
+    assert build_forecast_cache({"enabled": False}, object()) is None
+    assert build_forecast_cache(None, object()) is None
+
+
+def test_entry_age_gauge_is_fleet_max_merged():
+    from distributed_forecasting_tpu.serving.fleet import _GAUGE_MAX_MERGE
+
+    assert "dftpu_cache_entry_age_seconds" in _GAUGE_MAX_MERGE
+
+
+def test_server_serves_cache_hits_byte_identical(theta_fit):
+    import http.client
+
+    from distributed_forecasting_tpu.serving.server import start_server
+
+    fc = _fresh_fc(theta_fit)
+    cache = _cache(fc)
+    srv = start_server(fc, port=0, cache=cache)
+    try:
+        host, port = srv.server_address
+        body = json.dumps({
+            "inputs": [dict(zip(fc.key_names, map(int, row)))
+                       for row in fc.keys[:2]],
+            "horizon": 9,
+        }).encode()
+
+        def call(path="/invocations", method="POST", payload=body):
+            conn = http.client.HTTPConnection(host, port, timeout=30)
+            conn.request(method, path, payload,
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            out = resp.read()
+            conn.close()
+            return resp.status, out
+
+        s1, p1 = call()  # miss -> inline rebuild -> cached serve
+        s2, p2 = call()  # resident hit
+        assert s1 == s2 == 200
+        assert p1 == p2
+        assert cache.metrics.hits.value == 2
+        s3, metrics = call("/metrics", "GET", None)
+        assert s3 == 200
+        text = metrics.decode()
+        assert "dftpu_cache_hits_total 2" in text
+        assert "# TYPE dftpu_cache_entry_age_seconds gauge" in text
+    finally:
+        srv.shutdown()
+        srv.server_close()

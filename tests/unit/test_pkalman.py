@@ -23,13 +23,15 @@ def _simulate_arma(rng, T, phi, theta):
     return z[50:]
 
 
+# The mixed AR+MA-with-gaps case anchors tier-1 (870s budget); the
+# pure-AR / pure-MA / gap-free corners ride the CI unit step's slow set.
 @pytest.mark.parametrize(
     "phi,theta,missing",
     [
-        ((0.6, -0.2), (0.3,), 0.0),
+        pytest.param((0.6, -0.2), (0.3,), 0.0, marks=pytest.mark.slow),
         ((0.6, -0.2), (0.3,), 0.2),
-        ((0.9,), (), 0.0),
-        ((), (0.5, 0.2), 0.15),
+        pytest.param((0.9,), (), 0.0, marks=pytest.mark.slow),
+        pytest.param((), (0.5, 0.2), 0.15, marks=pytest.mark.slow),
     ],
 )
 def test_parallel_kalman_matches_sequential(phi, theta, missing):
@@ -146,6 +148,9 @@ def test_serving_horizon_longer_than_training_not_flat():
     assert np.ptp(tail) > 0.0
 
 
+# The vmapped production path stays covered tier-1 by
+# test_arima_fit_kalman_flag_equivalence (kalman='pscan' full fit).
+@pytest.mark.slow
 def test_parallel_kalman_vmaps():
     rng = np.random.default_rng(9)
     S, T = 4, 120
