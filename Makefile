@@ -1,7 +1,7 @@
 # Developer workflow — the reference drives deploy/test through a Makefile
 # (its Makefile:1-5 wraps dbx execute/deploy/launch); same shape, no cluster.
 
-.PHONY: install lint test test-tpu native bench e2e clean
+.PHONY: install lint tsan test test-tpu native bench e2e clean
 
 install:
 	pip install -e ".[local,test]"
@@ -10,6 +10,28 @@ install:
 # never initializes a device; exit 1 on any error-severity finding
 lint:
 	python scripts/dflint.py distributed_forecasting_tpu/
+
+# dynamic layer (docs/static-analysis.md "Dynamic layer"): run the
+# threaded test subset under the runtime concurrency sanitizer with
+# seeded schedule perturbation, then cross-check the observed lock graph
+# and guarded-attribute accesses against dflint's static model.  Exit 1
+# on any unsuppressed error-severity finding.
+TSAN_REPORT_DIR ?= /tmp/dftpu-tsan-reports
+tsan:
+	rm -rf $(TSAN_REPORT_DIR) && mkdir -p $(TSAN_REPORT_DIR)
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+	  DFTPU_TSAN=1 DFTPU_TSAN_REPORT_DIR=$(TSAN_REPORT_DIR) \
+	  DFTPU_FAILPOINTS="sanitizer.yield=sleep 1:0.05" \
+	  DFTPU_FAILPOINTS_SEED=42 \
+	  python -m pytest tests/unit/test_batcher.py tests/unit/test_ingest.py \
+	    tests/unit/test_forecast_cache.py tests/unit/test_fleet.py \
+	    -q -m 'not slow' -p no:cacheprovider
+	# own process, NOT instrumented: these tests arm/reset the sanitizer
+	# themselves, which would wipe the recorder the run above is filling
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+	  python -m pytest tests/unit/test_dftsan.py tests/unit/test_dflint_v3.py \
+	    -q -p no:cacheprovider
+	python scripts/dftsan.py $(TSAN_REPORT_DIR)
 
 native:
 	$(MAKE) -C native

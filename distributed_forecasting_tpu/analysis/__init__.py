@@ -17,11 +17,14 @@ from distributed_forecasting_tpu.analysis.core import (  # noqa: F401
     find_root,
 )
 
-# importing the rule modules populates REGISTRY
+# importing the rule modules populates REGISTRY (dftsan registers the
+# runtime-fed rule shells so SARIF/--list-rules/config cover them too)
 from distributed_forecasting_tpu.analysis import (  # noqa: F401
     absint,
+    dftsan,
     rules_config,
     rules_donation,
+    rules_drift,
     rules_jax,
     rules_lockorder,
     rules_purity,

@@ -46,6 +46,7 @@ import numpy as np
 
 from distributed_forecasting_tpu.engine.compile_cache import donated_variant
 from distributed_forecasting_tpu.models.base import get_model
+from distributed_forecasting_tpu.monitoring import sanitizer
 from distributed_forecasting_tpu.monitoring.failpoints import failpoint
 from distributed_forecasting_tpu.monitoring.trace import get_tracer
 from distributed_forecasting_tpu.ops.update import apply_update, column_bucket
@@ -83,13 +84,14 @@ class SeriesStateStore:
 
         self._lock = threading.Lock()        # pending + installed-state refs
         self._apply_gate = threading.BoundedSemaphore(1)  # state writers
-        self._day_cur = int(forecaster.day1)
+        # one locked snapshot: attaching to a forecaster that is already
+        # serving must not pair post-swap params with a pre-swap day1
+        params, day1 = forecaster._state_snapshot()
+        self._day_cur = int(day1)
         self._pending: Dict[int, Dict[int, float]] = {}
         self._applied_since_refit = 0
         self._late_points = 0
         self._last_refit_monotonic = time.monotonic()
-
-        params = forecaster.params
         S, T0 = params.fitted.shape
         self.n_series = S
         t_cap = time_cap(T0, self.time_bucket)
@@ -115,6 +117,10 @@ class SeriesStateStore:
         # — history_splice only gathers days <= t_fit_end)
         forecaster.time_bucket = self.time_bucket
         forecaster.swap_state(params=self._params, day1=self._day_cur)
+        # dftsan (no-op unless DFTPU_TSAN armed): the pending-points buffer
+        # every ingest/apply/stats path reads or mutates
+        sanitizer.attach(self, cls=SeriesStateStore, guards={
+            "_lock": ("_pending",)})
 
     # -- introspection -------------------------------------------------------
     @property

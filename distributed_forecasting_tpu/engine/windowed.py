@@ -411,7 +411,10 @@ class WindowedSeriesStateStore(SeriesStateStore):
 
         self._lock = threading.Lock()
         self._apply_gate = threading.BoundedSemaphore(1)
-        self._day_cur = int(forecaster.day1)
+        # one locked snapshot (see SeriesStateStore): params is consumed
+        # further down, day1 here — they must come from the same state
+        _snap_params, _snap_day1 = forecaster._state_snapshot()
+        self._day_cur = int(_snap_day1)
         self._pending: Dict[int, Dict[int, float]] = {}
         self._applied_since_refit = 0
         self._late_points = 0
@@ -439,7 +442,7 @@ class WindowedSeriesStateStore(SeriesStateStore):
         # lands inside it.
         self._frozen: Dict[int, dict] = {}
 
-        params = forecaster.params
+        params = _snap_params
         w_fit = params.fitted.shape[1]
         fitted = jnp.pad(jnp.asarray(params.fitted),
                          ((0, 0), (0, time_cap(w_fit, self.time_bucket)

@@ -204,7 +204,12 @@ class QualityMonitor:
         # grid buckets each date to its period (tensorize's GROUP BY rule)
         obs["_ord"] = period_ordinals(obs["ds"], freq)
 
-        day1 = getattr(fc, "day1", None)
+        # locked snapshot where the forecaster has one: this runs on HTTP
+        # handler threads concurrently with streaming swap_state writers
+        if hasattr(fc, "_state_snapshot"):
+            day1 = fc._state_snapshot()[1]
+        else:  # composite artifacts have no swap path (nor a day1)
+            day1 = getattr(fc, "day1", None)
         if day1 is not None:
             horizon = int(np.clip(obs["_ord"].max() - day1, 1,
                                   self.config.max_horizon))

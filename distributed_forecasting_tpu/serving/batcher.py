@@ -52,6 +52,7 @@ from typing import Optional
 
 import pandas as pd
 
+from distributed_forecasting_tpu.monitoring import sanitizer
 from distributed_forecasting_tpu.monitoring.monitor import MetricsRegistry
 from distributed_forecasting_tpu.monitoring.trace import clock as trace_clock
 from distributed_forecasting_tpu.monitoring.trace import get_tracer
@@ -212,6 +213,11 @@ class RequestBatcher:
         self._queue: deque = deque()
         self._cond = threading.Condition()
         self._closed = False
+        # dftsan (no-op unless DFTPU_TSAN armed): MUST run before the
+        # scheduler thread starts, so producer and scheduler see the same
+        # (wrapped) condition object
+        sanitizer.attach(self, cls=RequestBatcher, guards={
+            "_cond": ("_queue", "_closed")})
         self._thread = threading.Thread(
             target=self._run, name="dftpu-batcher", daemon=True)
         self._thread.start()

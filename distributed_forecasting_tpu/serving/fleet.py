@@ -42,6 +42,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, List, Optional
 
 from distributed_forecasting_tpu.monitoring.failpoints import failpoint
+from distributed_forecasting_tpu.monitoring import sanitizer
 from distributed_forecasting_tpu.monitoring.monitor import MetricsRegistry
 from distributed_forecasting_tpu.monitoring.trace import get_tracer
 from distributed_forecasting_tpu.serving.resilience import (
@@ -207,12 +208,46 @@ _GAUGE_MAX_MERGE = frozenset({
     # frame anywhere — summing per-replica ages would fabricate an age no
     # frame has (the hit/miss/invalidation counters still SUM)
     "dftpu_cache_entry_age_seconds",
+    # ratios / thresholds / enum states: the fleet-level signal is the
+    # worst (largest) replica's value, never the arithmetic sum
+    "dftpu_anomaly_threshold",
+    "dftpu_data_quality_gap_ratio",
+    "dftpu_fleet_breaker_state",
+    "dftpu_ingest_pending_days",
+    "dftpu_quality_metric",
+    "dftpu_quality_nominal_coverage",
 })
 
-#: per-replica capacity watermarks (host RSS, device bytes in use) —
-#: max-merged: fleet headroom is set by the WORST replica, and summing
-#: would invent memory pressure no single process has
-_GAUGE_MAX_PREFIX = "dftpu_cost_watermark_"
+#: gauges that are genuinely ADDITIVE across replicas (per-replica counts
+#: and resource totals) — listed explicitly so the metrics-merge-drift lint
+#: can prove every ``dftpu_*`` gauge has a deliberate fleet-merge policy
+_GAUGE_SUM_MERGE = frozenset({
+    "dftpu_anomaly_last_batch_flagged",
+    "dftpu_cache_bytes",
+    "dftpu_cache_entries",
+    # a fraction per replica, but summing is the HISTORICAL contract the
+    # cost tests pin (callers divide by replica count downstream)
+    "dftpu_cost_device_saturation",
+    "dftpu_data_quality_rows",
+    "dftpu_data_quality_series",
+    "dftpu_data_quality_duplicate_rows",
+    "dftpu_data_quality_negative_sales",
+    "dftpu_data_quality_nonfinite_sales",
+    "dftpu_data_quality_short_series",
+    "dftpu_data_quality_constant_series",
+    "dftpu_data_quality_issues",
+    "dftpu_ingest_dirty_series",
+    "dftpu_ingest_refit_backlog",
+    "dftpu_quality_series_observed",
+    "dftpu_shard_owned",
+    "dftpu_shard_resident_series",
+})
+
+#: max-merged gauge FAMILIES: SLO burn/firing state (an SLO burning on ANY
+#: replica is burning fleet-wide) and per-replica capacity watermarks
+#: (host RSS, device bytes in use — fleet headroom is set by the WORST
+#: replica, and summing would invent memory pressure no single process has)
+_GAUGE_MAX_PREFIXES = ("dftpu_slo_", "dftpu_cost_watermark_")
 
 #: compiled-program cost registry gauges — REPLICATED, not summed: every
 #: replica shares one AOT store and reports the same program fingerprints,
@@ -242,15 +277,18 @@ def aggregate_prometheus(texts: List[str]) -> str:
         reports the SAME on-disk log and applied frontier, so summing a
         3-replica fleet would triple the WAL size and the convergence
         point is the furthest-ahead replica.  The per-replica capacity
-        watermarks (:data:`_GAUGE_MAX_PREFIX` — host RSS, device bytes)
+        watermarks (:data:`_GAUGE_MAX_PREFIXES` — host RSS, device bytes)
         also merge by MAX: headroom is set by the worst replica.
       * **``dftpu_cost_program_*`` gauges** REPLICATE — first replica
         wins: the fleet shares one AOT store, every replica reports the
         same compiled-program fingerprints, and summing would multiply a
         program's FLOPs by the replica count.
-      * everything else — counters, additive gauges (queue depth in flight
-        across the fleet, ``dftpu_cost_device_saturation``) — sums by
-        name+labels.
+      * everything else — counters and the additive gauges enumerated in
+        :data:`_GAUGE_SUM_MERGE` (queue depth in flight across the fleet,
+        ``dftpu_cost_device_saturation``) — sums by name+labels.
+    The metrics-merge-drift lint rule holds this section honest: every
+    ``dftpu_*`` gauge in the tree must appear in exactly one policy set
+    (or match a policy prefix) or ``make lint`` fails.
     """
     entries: List[tuple] = []      # ("meta", raw) | ("sample", key) |
     #                                ("hist", group_key), in first-seen order
@@ -306,8 +344,7 @@ def aggregate_prometheus(texts: List[str]) -> str:
                 group.setdefault(replica_i, {})[le] = v
                 continue
             if key in values:
-                if (name.startswith("dftpu_slo_")
-                        or name.startswith(_GAUGE_MAX_PREFIX)
+                if (name.startswith(_GAUGE_MAX_PREFIXES)
                         or name in _GAUGE_MAX_MERGE) and \
                         types.get(name) == "gauge":
                     values[key] = max(values[key], v)
@@ -564,6 +601,10 @@ class FleetSupervisor:
             "dftpu_fleet_hedge_cancelled_total",
             "losing duplicate legs discarded after first-response-wins")
         self._g_total.set(config.replicas)
+        # dftsan (no-op unless DFTPU_TSAN armed): the routing tables the
+        # PR-16 stop() race corrupted are exactly the guarded set
+        sanitizer.attach(self, cls=FleetSupervisor, guards={
+            "_lock": ("_replicas", "_rr", "_assignments")})
 
     # -- introspection (snapshot under lock, return plain data) -------------
     @property

@@ -68,6 +68,7 @@ from typing import Dict, Optional, Tuple
 import numpy as np
 import pandas as pd
 
+from distributed_forecasting_tpu.monitoring import sanitizer
 from distributed_forecasting_tpu.monitoring.failpoints import failpoint
 from distributed_forecasting_tpu.monitoring.monitor import MetricsRegistry
 from distributed_forecasting_tpu.monitoring.trace import get_tracer
@@ -252,6 +253,10 @@ class ForecastCache:
         # subscribe AFTER the persisted adoption so a boot-time WAL replay
         # (replica.py replays before ready) invalidates adopted entries too
         forecaster.register_state_listener(self._on_state_install)
+        # dftsan (no-op unless DFTPU_TSAN armed): the entry table + byte
+        # accounting that every lookup/install/invalidate touches
+        sanitizer.attach(self, cls=ForecastCache, guards={
+            "_lock": ("_entries", "_horizons", "_bytes")})
 
     # -- read path -----------------------------------------------------------
 

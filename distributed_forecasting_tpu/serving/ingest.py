@@ -43,6 +43,7 @@ import pandas as pd
 
 from distributed_forecasting_tpu.data.tensorize import period_ordinals
 from distributed_forecasting_tpu.engine.state_store import SeriesStateStore
+from distributed_forecasting_tpu.monitoring import sanitizer
 from distributed_forecasting_tpu.monitoring.failpoints import failpoint
 from distributed_forecasting_tpu.monitoring.monitor import IngestMetrics
 from distributed_forecasting_tpu.monitoring.store import (
@@ -137,6 +138,9 @@ class WriteAheadLog:
         self._lock = threading.Lock()  # segment-cursor bookkeeping ONLY
         self._seg = seg
         self._seg_bytes = seg_bytes
+        # dftsan (no-op unless DFTPU_TSAN armed): the append cursor pair
+        sanitizer.attach(self, cls=WriteAheadLog, guards={
+            "_lock": ("_seg", "_seg_bytes")})
 
     @staticmethod
     def _seal_torn_tail(path: str) -> int:

@@ -31,6 +31,7 @@ import numpy as np
 import pandas as pd
 
 from distributed_forecasting_tpu.models.base import get_model
+from distributed_forecasting_tpu.monitoring import sanitizer
 from distributed_forecasting_tpu.monitoring.cost import cost_metrics
 from distributed_forecasting_tpu.monitoring.trace import (
     clock as trace_clock,
@@ -229,6 +230,11 @@ class BatchForecaster:
         # scan families are invariant to trailing grid padding (the padded
         # rows are computed then trimmed before include_history logic).
         self.time_bucket = 1
+        # dftsan (no-op unless DFTPU_TSAN armed): the atomic state unit plus
+        # the generation counter and listener table swap_state mutates
+        sanitizer.attach(self, cls=BatchForecaster, guards={
+            "_state_lock": ("params", "day1", "_state_gen",
+                            "_state_listeners")})
 
     # -- construction -------------------------------------------------------
     @classmethod

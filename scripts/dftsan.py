@@ -1,0 +1,22 @@
+#!/usr/bin/env python
+"""dftsan CLI — cross-check runtime sanitizer reports against dflint's
+static lock-order model.
+
+Usage: python scripts/dftsan.py REPORT [REPORT...] [--format json|sarif]
+       [--root DIR] [--write-baseline]
+
+REPORT is a JSON file written by ``monitoring/sanitizer.py`` (run the
+workload with ``DFTPU_TSAN=1 DFTPU_TSAN_REPORT_DIR=...``), or a directory
+of them.  See docs/static-analysis.md ("Dynamic layer").
+"""
+
+import os
+import sys
+
+# runnable straight from a checkout, installed or not
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributed_forecasting_tpu.analysis.dftsan import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

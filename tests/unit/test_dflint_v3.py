@@ -1,0 +1,307 @@
+"""dflint v3 rules: the catalogue-drift family.
+
+Each rule diffs code against a prose or policy catalogue in BOTH
+directions — an undocumented artifact and a stale catalogue row are both
+errors.  Fixtures are source strings in tmp trees (same idiom as
+test_dflint.py); every rule also has a neutrality test proving it stays
+silent in trees that don't carry the catalogue at all, so the existing
+rule fixtures (which call ``failpoint(...)`` etc. in doc-less tmp trees)
+keep linting clean.
+"""
+
+from test_dflint import _lint, _write
+
+
+def _rules(found, name):
+    return [f for f in found if f.rule == name]
+
+
+# ---------------------------------------------------------------------------
+# metrics-merge-drift
+# ---------------------------------------------------------------------------
+
+_FLEET_POLICY = """
+    _GAUGE_MAX_MERGE = frozenset({"dftpu_wal_bytes"})
+    _GAUGE_SUM_MERGE = frozenset({"dftpu_queue_depth"})
+    _GAUGE_MAX_PREFIXES = ("dftpu_slo_",)
+
+    def aggregate(texts):
+        return texts
+"""
+
+
+def test_merge_drift_unpoliced_gauge(tmp_path):
+    _write(tmp_path, "serving/fleet.py", _FLEET_POLICY)
+    _write(tmp_path, "monitoring/metrics.py", """
+        def build(r):
+            r.gauge("dftpu_wal_bytes", "policed: fine")
+            r.gauge("dftpu_queue_depth", "policed: fine")
+            r.gauge("dftpu_orphan_gauge", "no policy anywhere")
+            r.gauge("dftpu_slo_burn", "prefix-policed: fine")
+            r.counter("dftpu_requests_total", "counters sum by TYPE")
+            r.gauge("other_system_gauge", "not a dftpu_ family")
+    """)
+    found = _rules(_lint(tmp_path, "serving/fleet.py",
+                         "monitoring/metrics.py"), "metrics-merge-drift")
+    assert len(found) == 1
+    assert "dftpu_orphan_gauge" in found[0].message
+    assert found[0].severity == "error"
+    assert found[0].path == "monitoring/metrics.py"
+
+
+def test_merge_drift_gauge_in_multiple_policies(tmp_path):
+    _write(tmp_path, "serving/fleet.py", """
+        _GAUGE_MAX_MERGE = frozenset({"dftpu_depth"})
+        _GAUGE_SUM_MERGE = frozenset({"dftpu_depth"})
+    """)
+    _write(tmp_path, "monitoring/metrics.py", """
+        def build(r):
+            r.gauge("dftpu_depth", "claimed by two policies")
+    """)
+    found = _rules(_lint(tmp_path, "serving/fleet.py",
+                         "monitoring/metrics.py"), "metrics-merge-drift")
+    assert len(found) == 1
+    assert "multiple merge policies" in found[0].message
+
+
+def test_merge_drift_stale_and_dead_policy_entries(tmp_path):
+    _write(tmp_path, "serving/fleet.py", """
+        _GAUGE_MAX_MERGE = frozenset({
+            "dftpu_never_registered",    # stale: nothing carries this name
+            "dftpu_rows_total",          # dead: registered as a counter
+        })
+    """)
+    _write(tmp_path, "monitoring/metrics.py", """
+        def build(r):
+            r.counter("dftpu_rows_total", "a counter, sums by TYPE")
+    """)
+    found = _rules(_lint(tmp_path, "serving/fleet.py",
+                         "monitoring/metrics.py"), "metrics-merge-drift")
+    msgs = sorted(f.message for f in found)
+    assert len(found) == 2
+    assert "no statically registered metric" in msgs[0]
+    assert "registered as a counter" in msgs[1]
+
+
+def test_merge_drift_labeled_ctors_and_clean_tree(tmp_path):
+    _write(tmp_path, "serving/fleet.py", """
+        _GAUGE_MAX_MERGE = frozenset({"dftpu_breaker_state"})
+        _GAUGE_SUM_MERGE = frozenset({"dftpu_shard_owned"})
+    """)
+    _write(tmp_path, "monitoring/metrics.py", """
+        def build(r):
+            r.labeled_gauge("dftpu_breaker_state", ("port",), "labeled ok")
+            r.labeled_gauge("dftpu_shard_owned", ("shard",), "labeled ok")
+            r.histogram("dftpu_latency_seconds", (1, 2), "buckets merge")
+    """)
+    assert _rules(_lint(tmp_path, "serving/fleet.py",
+                        "monitoring/metrics.py"), "metrics-merge-drift") == []
+
+
+def test_merge_drift_silent_without_policy_constants(tmp_path):
+    # a tree with gauges but no aggregate policy is out of scope — the
+    # rule must not demand policy bookkeeping from code that never merges
+    _write(tmp_path, "monitoring/metrics.py", """
+        def build(r):
+            r.gauge("dftpu_anything", "no fleet, no policy, no finding")
+    """)
+    assert _rules(_lint(tmp_path, "monitoring/metrics.py"),
+                  "metrics-merge-drift") == []
+
+
+def test_merge_drift_ignores_test_modules(tmp_path):
+    _write(tmp_path, "serving/fleet.py", _FLEET_POLICY)
+    _write(tmp_path, "serving/test_fixture.py", """
+        def build(r):
+            r.gauge("dftpu_test_only_gauge", "test modules don't register")
+    """)
+    _write(tmp_path, "monitoring/metrics.py", """
+        def build(r):
+            r.gauge("dftpu_wal_bytes", "fine")
+            r.gauge("dftpu_queue_depth", "fine")
+    """)
+    assert _rules(_lint(tmp_path, "serving/fleet.py",
+                        "serving/test_fixture.py",
+                        "monitoring/metrics.py"), "metrics-merge-drift") == []
+
+
+# ---------------------------------------------------------------------------
+# failpoint-site-drift
+# ---------------------------------------------------------------------------
+
+_FP_DOC = """
+    # Resilience
+
+    ## Failpoint catalogue
+
+    | site | module | boundary |
+    | --- | --- | --- |
+    | `wal.append` | `serving/wal.py` | append write |
+    | `doc.only.site` | `nowhere.py` | stale row |
+"""
+
+
+def test_failpoint_drift_both_directions(tmp_path):
+    _write(tmp_path, "docs/resilience.md", _FP_DOC)
+    _write(tmp_path, "serving/wal.py", """
+        from distributed_forecasting_tpu.monitoring.failpoints import failpoint
+
+        def append(buf):
+            failpoint("wal.append")
+            failpoint("wal.undocumented")
+    """)
+    found = _rules(_lint(tmp_path, "serving/wal.py", "docs/resilience.md"),
+                   "failpoint-site-drift")
+    assert len(found) == 2
+    by_path = {f.path: f for f in found}
+    assert "wal.undocumented" in by_path["serving/wal.py"].message
+    stale = by_path["docs/resilience.md"]
+    assert "doc.only.site" in stale.message and "stale" in stale.message
+    assert "`doc.only.site`" in stale.snippet
+
+
+def test_failpoint_drift_harness_arms_unknown_site(tmp_path):
+    _write(tmp_path, "docs/resilience.md", """
+        ## Failpoint catalogue
+
+        | site | module | boundary |
+        | --- | --- | --- |
+        | `wal.append` | `serving/wal.py` | append write |
+    """)
+    _write(tmp_path, "serving/wal.py", """
+        def append(buf):
+            failpoint("wal.append")
+    """)
+    _write(tmp_path, "scripts/chaos_harness.py", """
+        SPEC = "wal.append=kill9; wal.ghost=raise OSError:0.3"
+    """)
+    found = _rules(_lint(tmp_path, "serving/wal.py",
+                         "scripts/chaos_harness.py", "docs/resilience.md"),
+                   "failpoint-site-drift")
+    assert len(found) == 1
+    assert "wal.ghost" in found[0].message
+    assert "vacuous" in found[0].message
+    assert found[0].path == "scripts/chaos_harness.py"
+
+
+def test_failpoint_drift_silent_without_catalogue(tmp_path):
+    # v1/v2 rule fixtures call failpoint() in doc-less tmp trees — the
+    # drift rule must not start flagging them
+    _write(tmp_path, "ops/step.py", """
+        def run():
+            failpoint("ops.step")
+    """)
+    assert _rules(_lint(tmp_path, "ops/step.py"),
+                  "failpoint-site-drift") == []
+
+
+def test_failpoint_drift_ignores_registry_and_tests(tmp_path):
+    _write(tmp_path, "docs/resilience.md", """
+        ## Failpoint catalogue
+
+        | site | module | boundary |
+        | --- | --- | --- |
+        | `wal.append` | `serving/wal.py` | append write |
+    """)
+    _write(tmp_path, "serving/wal.py", """
+        def append(buf):
+            failpoint("wal.append")
+    """)
+    # the registry's own examples and test-only sites are not "sites"
+    _write(tmp_path, "monitoring/failpoints.py", """
+        def failpoint(name):
+            pass
+
+        def _example():
+            failpoint("doc.example.site")
+    """)
+    _write(tmp_path, "tests/unit/test_wal.py", """
+        def test_x():
+            failpoint("test.only.site")
+    """)
+    assert _rules(_lint(tmp_path, "serving/wal.py",
+                        "monitoring/failpoints.py",
+                        "tests/unit/test_wal.py", "docs/resilience.md"),
+                  "failpoint-site-drift") == []
+
+
+# ---------------------------------------------------------------------------
+# span-kind-drift
+# ---------------------------------------------------------------------------
+
+_SPAN_DOC = """
+    # Observability
+
+    ## Span catalog
+
+    | span | thread | meaning |
+    | --- | --- | --- |
+    | `serve.predict` | handler | the predictor call |
+    | `doc.only.span` | nobody | stale row |
+"""
+
+
+def test_span_drift_both_directions(tmp_path):
+    _write(tmp_path, "docs/observability.md", _SPAN_DOC)
+    _write(tmp_path, "serving/server.py", """
+        from distributed_forecasting_tpu.monitoring.trace import get_tracer
+
+        def handle(tracer):
+            with tracer.span("serve.predict"):
+                pass
+            with get_tracer().span("serve.undocumented"):
+                pass
+    """)
+    found = _rules(_lint(tmp_path, "serving/server.py",
+                         "docs/observability.md"), "span-kind-drift")
+    assert len(found) == 2
+    by_path = {f.path: f for f in found}
+    assert "serve.undocumented" in by_path["serving/server.py"].message
+    assert "doc.only.span" in by_path["docs/observability.md"].message
+
+
+def test_span_drift_non_tracer_receivers_ignored(tmp_path):
+    _write(tmp_path, "docs/observability.md", """
+        ## Span catalog
+
+        | span | thread | meaning |
+        | --- | --- | --- |
+        | `serve.predict` | handler | the predictor call |
+    """)
+    _write(tmp_path, "serving/server.py", """
+        def handle(tracer, match):
+            with tracer.span("serve.predict"):
+                pass
+            match.span("regex.group.span")  # not a tracer: no finding
+    """)
+    assert _rules(_lint(tmp_path, "serving/server.py",
+                        "docs/observability.md"), "span-kind-drift") == []
+
+
+def test_span_drift_silent_without_catalog(tmp_path):
+    _write(tmp_path, "serving/server.py", """
+        def handle(tracer):
+            with tracer.span("serve.predict"):
+                pass
+    """)
+    assert _rules(_lint(tmp_path, "serving/server.py"),
+                  "span-kind-drift") == []
+
+
+# ---------------------------------------------------------------------------
+# the real tree agrees with its own catalogues
+# ---------------------------------------------------------------------------
+
+
+def test_shipped_tree_catalogues_are_in_sync():
+    """The committed docs and policy constants agree with the code — with
+    an EMPTY baseline.  If this fails you added a gauge/span/failpoint (or
+    a catalogue row) without its counterpart; fix the drift, don't
+    baseline it."""
+    import pathlib
+
+    repo = pathlib.Path(__file__).resolve().parents[2]
+    found = _lint(repo, "distributed_forecasting_tpu")
+    drift = [f for f in found if f.rule in (
+        "metrics-merge-drift", "failpoint-site-drift", "span-kind-drift")]
+    assert drift == [], [f.render() for f in drift]

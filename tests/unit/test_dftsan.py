@@ -1,0 +1,509 @@
+"""dftsan: the runtime concurrency sanitizer and its static cross-check.
+
+Covers both halves and the seam between them:
+
+* ``monitoring/sanitizer.py`` — lock wrapping + acquisition-order
+  recording, guarded-attribute violation detection (positive AND
+  negative), Condition wait/wait_for owner bookkeeping, the structural
+  no-op guarantee when disarmed, report writing, and seeded-perturbation
+  determinism through the failpoint registry;
+* ``analysis/dftsan.py`` — the observed-vs-static graph join
+  (cycle-confirmed / unmodeled-edge / unlocked-access), the test-path
+  filter, report merging, and the CLI's SARIF/exit-code contract;
+* a regression fixture reproducing the pre-fix ``FleetSupervisor.stop()``
+  shape (unlocked write-back of a shared table) proving the sanitizer
+  catches that class of bug.
+
+No jax import anywhere: the sanitizer must be usable in processes that
+never initialize a device.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from distributed_forecasting_tpu.monitoring import failpoints, sanitizer
+from distributed_forecasting_tpu.analysis.core import DflintConfig, build_project
+from distributed_forecasting_tpu.analysis.dftsan import (
+    cross_check,
+    load_reports,
+    main as dftsan_main,
+)
+
+from test_dflint import _write
+
+
+@pytest.fixture
+def san():
+    """Arm the sanitizer for one test, restore the prior state after."""
+    was = sanitizer.is_enabled()
+    sanitizer.configure()
+    sanitizer.reset()
+    yield sanitizer
+    sanitizer.reset()
+    if not was:
+        sanitizer.deactivate()
+
+
+# ---------------------------------------------------------------------------
+# runtime: structural freeness when disarmed
+# ---------------------------------------------------------------------------
+
+
+class _Plain:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        sanitizer.attach(self, guards={"_lock": ("count",)})
+
+
+def test_disarmed_attach_is_structurally_free():
+    assert not sanitizer.is_enabled()
+    obj = _Plain()
+    # no class swap, no lock wrapping, no descriptors — the exact object
+    # a build without the sanitizer would produce
+    assert type(obj) is _Plain
+    assert type(obj._lock) is type(threading.Lock())
+    obj.count = 1  # no checks fire
+    assert sanitizer.snapshot()["violations"] == []
+
+
+def test_disarmed_overhead_is_noise(san):
+    """The disabled fast path must stay within 15% of raw attribute/lock
+    work.  Both sides run the IDENTICAL disarmed code, so this guards
+    against someone making attach/descriptors unconditionally active."""
+    sanitizer.deactivate()
+
+    class Raw:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.n = 0
+
+    attached = _Plain()
+    raw = Raw()
+
+    def drive(obj, attr):
+        t0 = time.perf_counter()
+        for _ in range(20000):
+            with obj._lock:
+                setattr(obj, attr, getattr(obj, attr) + 1)
+        return time.perf_counter() - t0
+
+    drive(raw, "n"), drive(attached, "count")  # warm both paths
+    # interleaved min-of-7: both sides run the same disarmed code, so any
+    # honest measurement lands near 1.0 — the margin absorbs CI jitter
+    t_raw, t_att = [], []
+    for _ in range(7):
+        t_raw.append(drive(raw, "n"))
+        t_att.append(drive(attached, "count"))
+    assert min(t_att) < min(t_raw) * 1.15, (min(t_att), min(t_raw))
+
+
+# ---------------------------------------------------------------------------
+# runtime: lock-order recording
+# ---------------------------------------------------------------------------
+
+
+class _Duo:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.RLock()
+        sanitizer.attach(self, locks=("_a", "_b"))
+
+
+def test_lock_order_edges_recorded(san):
+    d = _Duo()
+    with d._a:
+        with d._b:
+            pass
+    with d._a:  # repeat: same edge, count bumps, no duplicate
+        with d._b:
+            pass
+    snap = sanitizer.snapshot()
+    assert len(snap["edges"]) == 1
+    edge = snap["edges"][0]
+    assert edge["src"][1:] == ["_Duo", "_a"]
+    assert edge["dst"][1:] == ["_Duo", "_b"]
+    assert edge["count"] == 2
+    kinds = {tuple(e["id"])[2]: e["kind"] for e in snap["locks"]}
+    assert kinds == {"_a": "lock", "_b": "rlock"}
+    acquires = {tuple(e["id"])[2]: e["acquires"] for e in snap["locks"]}
+    assert acquires == {"_a": 2, "_b": 2}
+
+
+def test_rlock_reentry_is_not_a_self_edge(san):
+    d = _Duo()
+    with d._b:
+        with d._b:  # re-entry on the same RLock: depth, not an edge
+            pass
+    assert sanitizer.snapshot()["edges"] == []
+
+
+# ---------------------------------------------------------------------------
+# runtime: guarded-attribute violations
+# ---------------------------------------------------------------------------
+
+
+def test_unlocked_access_flagged_with_provenance(san):
+    obj = _Plain()
+    obj.count = 7          # write without the lock
+    _ = obj.count          # read without the lock
+    snap = sanitizer.snapshot()
+    ops = sorted((v["op"], v["attr"]) for v in snap["violations"])
+    assert ops == [("read", "count"), ("write", "count")]
+    v = snap["violations"][0]
+    assert v["lock"][1:] == ["_Plain", "_lock"]
+    assert v["thread"] == threading.current_thread().name
+    assert "test_dftsan" in v["stack"]
+
+
+def test_locked_access_is_clean(san):
+    obj = _Plain()
+    with obj._lock:
+        obj.count = 7
+        assert obj.count == 7
+    assert sanitizer.snapshot()["violations"] == []
+
+
+def test_lock_held_by_other_thread_still_flags(san):
+    obj = _Plain()
+    entered = threading.Event()
+    release = threading.Event()
+
+    def holder():
+        with obj._lock:
+            entered.set()
+            release.wait(5)
+
+    th = threading.Thread(target=holder)
+    th.start()
+    entered.wait(5)
+    obj.count = 9  # the lock is held — but by ANOTHER thread
+    release.set()
+    th.join()
+    viol = sanitizer.snapshot()["violations"]
+    assert len(viol) == 1 and viol[0]["op"] == "write"
+
+
+def test_condition_wait_for_runs_predicate_marked_held(san):
+    class Gate:
+        def __init__(self):
+            self._cond = threading.Condition()
+            self.ready = False
+            sanitizer.attach(self, guards={"_cond": ("ready",)})
+
+    g = Gate()
+
+    def setter():
+        with g._cond:
+            g.ready = True
+            g._cond.notify_all()
+
+    th = threading.Thread(target=setter)
+    with g._cond:
+        th.start()
+        # wait releases the lock for real (setter gets in) but the
+        # predicate — which READS the guarded attr — must run marked held
+        assert g._cond.wait_for(lambda: g.ready, timeout=5)
+    th.join()
+    assert sanitizer.snapshot()["violations"] == []
+
+
+# ---------------------------------------------------------------------------
+# regression: the PR-16 FleetSupervisor.stop() race shape
+# ---------------------------------------------------------------------------
+
+
+class _RacySupervisor:
+    """The pre-fix stop() shape: snapshot the replica table under the
+    lock, terminate outside it, then WRITE THE TABLE BACK UNLOCKED —
+    clobbering whatever a concurrent resize installed in between."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._replicas = ["r0", "r1"]
+        sanitizer.attach(self, guards={"_lock": ("_replicas",)})
+
+    def stop(self):
+        with self._lock:
+            doomed = list(self._replicas)
+        doomed.clear()              # "terminate" outside the lock: fine
+        self._replicas = []         # unlocked write-back: the bug
+
+
+def test_dftsan_catches_the_stop_race_shape(san):
+    _RacySupervisor().stop()
+    viol = sanitizer.snapshot()["violations"]
+    assert len(viol) == 1
+    assert viol[0]["attr"] == "_replicas" and viol[0]["op"] == "write"
+    assert "stop" in viol[0]["stack"]
+
+
+def test_shipped_supervisor_stop_is_clean(san):
+    """The ACTUAL FleetSupervisor guards (_replicas/_rr/_assignments)
+    wired in serving/fleet.py — exercised structurally via a stand-in
+    with the same discipline, since booting real replicas is test_fleet's
+    job (which make tsan runs under this same instrumentation)."""
+
+    class Fixed(_RacySupervisor):
+        def stop(self):
+            with self._lock:
+                doomed = list(self._replicas)
+            doomed.clear()
+            with self._lock:
+                self._replicas = []
+
+    Fixed().stop()
+    assert sanitizer.snapshot()["violations"] == []
+
+
+# ---------------------------------------------------------------------------
+# runtime: seeded schedule perturbation
+# ---------------------------------------------------------------------------
+
+
+def test_perturbation_is_deterministic_under_fixed_seed(san):
+    def run(seed):
+        failpoints.configure("sanitizer.yield=sleep 0:0.5", seed=seed)
+        try:
+            obj = _Plain()
+            for _ in range(200):
+                with obj._lock:
+                    pass
+            return failpoints.fired("sanitizer.yield")
+        finally:
+            failpoints.deactivate()
+
+    a, b = run(42), run(42)
+    assert a == b and a > 0
+    # a different seed draws a different firing pattern (not a constant)
+    assert run(7) != a or run(9) != a
+
+
+def test_disarmed_lock_path_fires_no_failpoints(san):
+    obj = _Plain()
+    with obj._lock:
+        pass
+    assert failpoints.fired("sanitizer.yield") == 0
+
+
+# ---------------------------------------------------------------------------
+# report writing / loading
+# ---------------------------------------------------------------------------
+
+
+def test_report_roundtrip_through_dir(san, tmp_path):
+    obj = _Plain()
+    obj.count = 1
+    path = sanitizer.write_report(str(tmp_path))
+    assert path.endswith(".json")
+    merged, loaded = load_reports([str(tmp_path)])
+    assert loaded == [path]
+    assert len(merged["violations"]) == 1
+    ((lid, attr, op, _, _),) = merged["violations"].keys()
+    assert (lid[1], attr, op) == ("_Plain", "count", "write")
+
+
+def test_load_reports_merges_counts(san, tmp_path):
+    obj = _Plain()
+    obj.count = 1
+    sanitizer.write_report(str(tmp_path / "a.json"))
+    sanitizer.write_report(str(tmp_path / "b.json"))
+    merged, loaded = load_reports([str(tmp_path)])
+    assert len(loaded) == 2
+    (v,) = merged["violations"].values()
+    assert v["count"] == 2  # same site, counts add across reports
+
+
+# ---------------------------------------------------------------------------
+# the join: observed graph vs static model
+# ---------------------------------------------------------------------------
+
+_STATIC_CYCLE = """
+    import threading
+
+    class Duo:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+
+        def one(self):
+            with self._a:
+                with self._b:
+                    pass
+
+        def two(self):
+            with self._b:
+                with self._a:
+                    pass
+"""
+
+
+def _project(root):
+    return build_project(str(root), [str(root)], config=DflintConfig())
+
+
+def _edge(src, dst, path="serving/duo.py", line=9):
+    return {"src": list(src), "dst": list(dst), "count": 3,
+            "path": path, "line": line, "thread": "worker"}
+
+
+def test_join_confirms_static_cycle(tmp_path):
+    _write(tmp_path, "serving/duo.py", _STATIC_CYCLE)
+    a = ("serving/duo.py", "Duo", "_a")
+    b = ("serving/duo.py", "Duo", "_b")
+    report, _ = load_reports([])
+    report["edges"][(a, b)] = _edge(a, b)
+    found = cross_check(report, _project(tmp_path))
+    assert [f.rule for f in found] == ["dftsan-cycle-confirmed"]
+    assert "deadlock is reachable" in found[0].message
+    assert found[0].severity == "error"
+
+
+def test_join_flags_unmodeled_edge_as_warning(tmp_path):
+    _write(tmp_path, "serving/duo.py", """
+        import threading
+
+        class Duo:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def one(self):
+                with self._a:
+                    with self._b:
+                        pass
+    """)
+    a = ("serving/duo.py", "Duo", "_a")
+    b = ("serving/duo.py", "Duo", "_b")
+    report, _ = load_reports([])
+    # observed the REVERSE of the only modeled edge: not a static cycle,
+    # but the model doesn't know this order exists
+    report["edges"][(b, a)] = _edge(b, a)
+    found = cross_check(report, _project(tmp_path))
+    assert [f.rule for f in found] == ["dftsan-unmodeled-edge"]
+    assert found[0].severity == "warning"
+    assert "static lock-order graph has no such edge" in found[0].message
+
+
+def test_join_modeled_edge_is_clean(tmp_path):
+    # model exactly one order, observe exactly that order: no finding
+    _write(tmp_path, "serving/uno.py", """
+        import threading
+
+        class Uno:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def one(self):
+                with self._a:
+                    with self._b:
+                        pass
+    """)
+    a = ("serving/uno.py", "Uno", "_a")
+    b = ("serving/uno.py", "Uno", "_b")
+    report, _ = load_reports([])
+    report["edges"][(a, b)] = _edge(a, b, path="serving/uno.py")
+    found = cross_check(report, _project(tmp_path))
+    assert [f for f in found if f.path == "serving/uno.py"] == []
+
+
+def test_join_renders_violations_and_filters_test_paths(tmp_path):
+    _write(tmp_path, "serving/duo.py", _STATIC_CYCLE)
+    lid = ("serving/duo.py", "Duo", "_a")
+    report, _ = load_reports([])
+    report["violations"][(lid, "table", "write", "serving/duo.py", 4)] = {
+        "count": 2, "thread": "worker", "stack": "serving/duo.py:4 in f"}
+    report["violations"][(lid, "table", "write",
+                          "tests/unit/test_duo.py", 9)] = {
+        "count": 1, "thread": "MainThread", "stack": "t"}
+    found = cross_check(report, _project(tmp_path))
+    assert [f.rule for f in found] == ["dftsan-unlocked-access"]
+    assert found[0].path == "serving/duo.py"
+    assert "write of Duo.table" in found[0].message
+    assert "worker" in found[0].message
+
+
+# ---------------------------------------------------------------------------
+# CLI contract
+# ---------------------------------------------------------------------------
+
+
+def _fake_report(tmp_path, **extra):
+    rep = {"version": 1, "pid": 1, "locks": [], "edges": [],
+           "violations": [], "dropped": {"edges": 0, "violations": 0}}
+    rep.update(extra)
+    tmp_path.mkdir(parents=True, exist_ok=True)
+    p = tmp_path / "dftsan-1.json"
+    p.write_text(json.dumps(rep))
+    return p
+
+
+def _cli_tree(tmp_path):
+    _write(tmp_path, "pyproject.toml", """
+        [tool.dflint]
+    """)
+    _write(tmp_path, "distributed_forecasting_tpu/serving/duo.py",
+           _STATIC_CYCLE)
+    return tmp_path
+
+
+def test_cli_exit_codes_and_sarif_shape(tmp_path, capsys):
+    root = _cli_tree(tmp_path)
+    rep = _fake_report(tmp_path / "reports", violations=[{
+        "lock": ["serving/duo.py", "Duo", "_a"], "attr": "t", "op": "write",
+        "path": "distributed_forecasting_tpu/serving/duo.py", "line": 5,
+        "count": 1, "thread": "worker", "stack": "s"}])
+    assert dftsan_main([str(rep), "--root", str(root)]) == 1
+    capsys.readouterr()
+
+    assert dftsan_main([str(rep), "--root", str(root),
+                        "--format", "sarif"]) == 1
+    sarif = json.loads(capsys.readouterr().out)
+    run = sarif["runs"][0]
+    rules = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {"dftsan-unlocked-access", "dftsan-cycle-confirmed",
+            "dftsan-unmodeled-edge"} <= rules
+    (result,) = run["results"]
+    assert result["ruleId"] == "dftsan-unlocked-access"
+    assert result["partialFingerprints"]["dflint/v1"]
+    loc = result["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == \
+        "distributed_forecasting_tpu/serving/duo.py"
+
+
+def test_cli_clean_report_exits_zero(tmp_path, capsys):
+    root = _cli_tree(tmp_path)
+    rep = _fake_report(tmp_path / "reports")
+    assert dftsan_main([str(rep), "--root", str(root)]) == 0
+    out = capsys.readouterr().out
+    assert "0 error(s), 0 warning(s)" in out
+
+
+def test_cli_missing_reports_are_a_broken_setup(tmp_path, capsys):
+    root = _cli_tree(tmp_path)
+    empty = tmp_path / "reports"
+    empty.mkdir()
+    # an instrumented run that wrote nothing must NOT read as clean
+    assert dftsan_main([str(empty), "--root", str(root)]) == 2
+
+
+def test_cli_inline_suppression_at_site(tmp_path, capsys):
+    root = _cli_tree(tmp_path)
+    _write(root, "distributed_forecasting_tpu/serving/duo.py", """
+        import threading
+
+        class Duo:
+            def __init__(self):
+                self._a = threading.Lock()
+                self.t = 0  # dflint: disable=dftsan-unlocked-access
+    """)
+    rep = _fake_report(tmp_path / "reports", violations=[{
+        "lock": ["serving/duo.py", "Duo", "_a"], "attr": "t", "op": "write",
+        "path": "distributed_forecasting_tpu/serving/duo.py", "line": 7,
+        "count": 1, "thread": "worker", "stack": "s"}])
+    assert dftsan_main([str(rep), "--root", str(root)]) == 0
+    assert "1 suppressed inline" in capsys.readouterr().out
