@@ -868,6 +868,170 @@ def _windowed_probe():
     return out
 
 
+_GRADFIT_PROBE_CODE = r"""
+import json
+import os
+import time
+
+import numpy as np
+
+from distributed_forecasting_tpu.utils import apply_platform_override
+apply_platform_override()
+
+import jax
+import jax.numpy as jnp
+
+from distributed_forecasting_tpu.engine import gradfit
+from distributed_forecasting_tpu.models import arnet
+from distributed_forecasting_tpu.models.arnet import ArnetConfig
+
+SIZES = [int(s) for s in
+         os.environ.get("DFTPU_GRADFIT_SIZES", "64,256,1024").split(",")]
+T = int(os.environ.get("DFTPU_GRADFIT_DAYS", "400"))
+# per-series loop cost is measured on this many series and extrapolated
+# linearly (the loop is embarrassingly independent, so the extrapolation
+# is exact up to allocator noise) — running 1024 single-series epochs for
+# real would take minutes for a number we can read off 32
+LOOP_CAP = int(os.environ.get("DFTPU_GRADFIT_LOOP_CAP", "32"))
+
+cfg = ArnetConfig(lags=7, epochs=2, batch_size=64)
+out = {
+    "backend": jax.default_backend(),
+    "n_time": T,
+    "train": {"lags": cfg.lags, "epochs": cfg.epochs,
+              "batch_size": cfg.batch_size, "optimizer": cfg.optimizer,
+              "loss": cfg.loss},
+    "sizes": {},
+}
+rng = np.random.default_rng(0)
+for S in SIZES:
+    y = (10.0 + 2.0 * np.sin(2 * np.pi * np.arange(T) / 7)[None, :]
+         + rng.normal(0.0, 0.5, (S, T))).astype(np.float32)
+    mask = np.ones((S, T), np.float32)
+    z, _mu, _sd, xz, valid, _xm, _xs = arnet.prep_training(y, mask, cfg)
+    schedule = np.asarray(gradfit.minibatch_schedule(
+        jax.random.PRNGKey(cfg.seed), T, cfg.batch_size, cfg.epochs))
+    # pre-gather every minibatch on device: the probe times the train
+    # STEP (the claim under test), not host assembly — the engine path
+    # hides assembly behind prefetch anyway
+    batches = [
+        jax.block_until_ready(gradfit.gather_minibatch(
+            z, xz, valid, jnp.asarray(idx), cfg.lags))
+        for idx in schedule
+    ]
+    steps = len(batches)
+
+    def run_batched():
+        wp = gradfit.init_weights(S, cfg.lags, 0)
+        init_fn, _u, _a = gradfit.make_optimizer(cfg)
+        st = init_fn(wp)
+        for zb, lagb, xb, vb in batches:
+            wp, st, _loss = gradfit.train_step(wp, st, zb, lagb, xb, vb,
+                                               config=cfg)
+        return jax.block_until_ready(wp)
+
+    run_batched()  # compile
+    t0 = time.perf_counter()
+    run_batched()
+    batched_s = time.perf_counter() - t0
+
+    n_probe = min(S, LOOP_CAP)
+    # equal-work loop: the SAME jitted step at S=1 shapes, one series at a
+    # time — the pre-batched-engine way to gradient-fit a tenant.  Slices
+    # are cut outside the timed region (the comparison is fit math vs fit
+    # math, not slicing overhead).
+    sliced = [
+        [jax.block_until_ready((zb[s:s + 1], lagb[s:s + 1], xb,
+                                vb[s:s + 1]))
+         for zb, lagb, xb, vb in batches]
+        for s in range(n_probe)
+    ]
+
+    def run_one(series_batches):
+        wp = gradfit.init_weights(1, cfg.lags, 0)
+        init_fn, _u, _a = gradfit.make_optimizer(cfg)
+        st = init_fn(wp)
+        for zb, lagb, xb, vb in series_batches:
+            wp, st, _loss = gradfit.train_step(wp, st, zb, lagb, xb, vb,
+                                               config=cfg)
+        return wp
+
+    run_one(sliced[0])  # compile the S=1 program
+    t0 = time.perf_counter()
+    for s in range(n_probe):
+        jax.block_until_ready(run_one(sliced[s]))
+    probe_s = time.perf_counter() - t0
+    loop_s = probe_s * (S / n_probe)
+    out["sizes"][str(S)] = {
+        "steps": steps,
+        "batched_s": round(batched_s, 4),
+        "per_series_loop": {
+            "n_measured": n_probe,
+            "measured_s": round(probe_s, 4),
+            "extrapolated_s": round(loop_s, 4),
+            "extrapolated": bool(S > n_probe),
+        },
+        "speedup": round(loop_s / max(batched_s, 1e-9), 1),
+    }
+print("GRADFITPROBE=" + json.dumps(out))
+"""
+
+
+def _gradfit_probe_child(platform: str, sizes: str = "64,256,1024",
+                         timeout: float = 600.0):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = platform
+    env["DFTPU_FORCE_PLATFORM"] = platform
+    env["DFTPU_GRADFIT_SIZES"] = sizes
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", _GRADFIT_PROBE_CODE],
+            env=env, capture_output=True, text=True, timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        print(f"[bench] gradfit probe timed out ({timeout:.0f}s)",
+              file=sys.stderr)
+        return None
+    for line in p.stdout.splitlines():
+        if line.startswith("GRADFITPROBE="):
+            return json.loads(line.split("=", 1)[1])
+    tail = (p.stderr or "").strip().splitlines()
+    print(f"[bench] gradfit probe failed (rc={p.returncode}): "
+          f"{tail[-1] if tail else '?'}", file=sys.stderr)
+    return None
+
+
+def _gradfit_probe():
+    """Batched-vs-per-series gradient training for the headline JSON.
+
+    One fresh CPU-forced child trains the SAME arnet schedule two ways at
+    S in {64, 256, 1024}: one ``engine/gradfit.train_step`` advancing all
+    S series per dispatch, vs an equal-work loop running the identical
+    step at S=1 shapes one series at a time (the pre-batched-engine
+    baseline; measured on min(S, 32) series and extrapolated linearly —
+    flagged in the artifact).  CPU-forced like the windowed probe: the
+    claim is dispatch amortization + batch vectorization, which CPU
+    demonstrates without a tunnel in the loop.  Returns the probe dict
+    for the headline's ``gradfit`` field; ``DFTPU_BENCH_GRADFIT=0``
+    skips.
+    """
+    if os.environ.get("DFTPU_BENCH_GRADFIT", "1") == "0":
+        return None
+    t0 = time.perf_counter()
+    res = _gradfit_probe_child("cpu")
+    if res:
+        for size, row in res["sizes"].items():
+            print(
+                f"[bench] gradfit probe S={size} "
+                f"({time.perf_counter() - t0:.0f}s): batched "
+                f"{row['batched_s']:.3f}s vs per-series loop "
+                f"{row['per_series_loop']['extrapolated_s']:.2f}s "
+                f"(x{row['speedup']:.1f})",
+                file=sys.stderr,
+            )
+    return res
+
+
 def _kernel_probe(platform: str):
     """Per-backend filter-solver micro-benchmark for the headline JSON.
 
@@ -939,6 +1103,28 @@ def main() -> None:
             )
         sys.exit(0 if ok else 1)
 
+    if "--gradfit-only" in sys.argv:
+        # CI smoke: ONE batched-vs-per-series gradient-training child at a
+        # small S (default 64, env DFTPU_GRADFIT_SIZES), no backend
+        # probing, no jax in this process.  Gates the batched step beating
+        # the equal-work per-series loop at all (speedup > 1; the >= 10x
+        # claim is the full probe's S=1024 row, too slow for smoke) and
+        # prints the probe JSON as the only stdout line either way.
+        sizes = os.environ.get("DFTPU_GRADFIT_SIZES", "64")
+        timeout = float(os.environ.get("DFTPU_GRADFIT_TIMEOUT", "600"))
+        out = _gradfit_probe_child("cpu", sizes=sizes, timeout=timeout)
+        print(json.dumps({"gradfit": out}), flush=True)
+        ok = bool(out) and all(
+            row["speedup"] > 1.0 for row in out["sizes"].values())
+        if out and not ok:
+            print(
+                "[bench] gradfit smoke FAILED gate: speedups "
+                f"{ {s: r['speedup'] for s, r in out['sizes'].items()} } "
+                f"(need > 1 at every size)",
+                file=sys.stderr,
+            )
+        sys.exit(0 if ok else 1)
+
     platform, force = choose_backend()
     # soft wall-clock budget for the OPTIONAL probes: once exceeded, the
     # remaining probes are skipped.  The clock starts AFTER backend
@@ -977,6 +1163,7 @@ def main() -> None:
     pipeline_overlap = _overlap_probe()
     kernel_probe = _kernel_probe(platform)
     windowed_fit = _windowed_probe()
+    gradfit_probe = _gradfit_probe()
 
     import jax
 
@@ -1153,6 +1340,11 @@ def main() -> None:
                 # behind engine/windowed.py's auto-activation; see
                 # _windowed_probe
                 "windowed_fit": windowed_fit,
+                # batched arnet train_step vs equal-work per-series loop
+                # at S in {64, 256, 1024} (CPU-forced child) — the
+                # measurements behind engine/gradfit.py's one-step-for-
+                # all-series design; see _gradfit_probe
+                "gradfit": gradfit_probe,
             }
         ),
         flush=True,

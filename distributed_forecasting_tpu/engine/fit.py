@@ -149,7 +149,8 @@ def validate_xreg(fns, model: str, config, xreg, expected_T, what: str,
     if not fns.supports_xreg:
         raise ValueError(
             f"model {model!r} does not accept exogenous regressors; "
-            f"use the curve model ('prophet')"
+            f"use the curve model ('prophet') or the AR-Net family "
+            f"('arnet')"
         )
     xreg = jnp.asarray(xreg, jnp.float32)
     if xreg.ndim not in (2, 3):
@@ -341,6 +342,21 @@ def fit_forecast(
     validate_changepoint_days(config, batch.day)
     xreg = validate_xreg(fns, model, config, xreg, batch.n_time + horizon,
                          "fit_forecast")
+    if model == "arnet":
+        # eager-trainer auto-activation (engine.gradfit conf block): the
+        # host-driven loop feeds prefetched minibatches into donated AOT
+        # train steps instead of unrolling the whole optimizer schedule
+        # into one in-trace scan program (docs/automl.md).
+        from distributed_forecasting_tpu.engine.gradfit import (
+            gradfit_config,
+            gradfit_fit_forecast,
+        )
+
+        if gradfit_config().enabled:
+            return gradfit_fit_forecast(
+                batch, config=config, horizon=horizon, key=key,
+                min_points=min_points, xreg=xreg,
+            )
     # routed through the AOT executable store when one is configured
     # (engine/compile_cache): a warm process skips trace+lower+compile and
     # calls the deserialized per-(family, config, shape) binary directly

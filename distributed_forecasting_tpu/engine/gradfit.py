@@ -1,0 +1,465 @@
+"""Batched gradient-training engine: one jitted step for ALL series.
+
+Every other family fits closed-form or by a fixed-iteration in-trace
+optimizer.  The AR-Net family (models/arnet.py, NeuralProphet's linear
+AR + future-regressor head, arXiv 2111.15397) is fit by minibatch SGD —
+and the batch-shaped way to do that here is ONE optimizer step advancing
+all S series simultaneously over ``(S, B, L)`` minibatch tensors:
+
+* the forward model is ``z_t ~ w·[z_{t-1}..z_{t-L}] + beta·x_t + b`` with
+  per-series weights ``w (S, L)``, ``beta (S, R)``, ``b (S,)``;
+* the loss is a SUM over series of each series' masked minibatch mean —
+  so series never couple through the loss scale, and a padded bucket row
+  (mask all zero) contributes exactly zero gradient: training S series
+  inside an S_bucket-padded batch is bitwise the same as training them
+  alone (tests/unit/test_gradfit.py bucket-boundary gate);
+* the optimizer is optax (adam / sgd / momentum) when the container has
+  it, else the pure-jax fallbacks in ``ops/optim.py`` — a loud capability
+  log, not an import failure, when optax is absent;
+* :func:`train_step` is the single jitted update — the host epoch loop
+  dispatches it through :func:`~..engine.compile_cache.aot_call` under
+  entry ``gradfit_step:arnet`` with the params + optimizer state donated,
+  so the steady-state inner loop allocates nothing and the compiled
+  program is cost-fingerprinted like every serving entry;
+* epoch loops feed minibatches through the PR-4 executor's
+  :func:`~..engine.executor.prefetch_to_device`, so host batch assembly
+  (numpy gathers) overlaps device steps.
+
+Two training paths share every numeric ingredient (same schedule, same
+gather arithmetic, same step body):
+
+* :func:`train_scan` — fully in-trace (``lax.scan`` over the minibatch
+  schedule), used by ``models/arnet.fit`` so the family works unchanged
+  under ``fit_forecast``/``cross_validate``/vmapped CV cutoffs;
+* :func:`gradfit_fit_forecast` — the eager engine path ``fit_forecast``
+  routes to when the ``engine.gradfit`` conf block is armed: host-
+  assembled minibatches, prefetch overlap, donated AOT steps, then one
+  ``gradfit_finalize:arnet`` program for the fitted path + forecast +
+  health fallback.
+
+The host loop charges its device time to the PR-10 cost-attribution
+counters (entry ``gradfit_step:arnet``) — the same meter the AutoML
+successive-halving sweep budgets against (engine/hyper.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from functools import partial
+from typing import Iterator, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_forecasting_tpu.ops import optim as _fallback_optim
+from distributed_forecasting_tpu.utils import get_logger
+
+try:  # optional dependency: the image usually has it, CI stubs may not
+    import optax
+
+    HAS_OPTAX = True
+except ImportError:  # pragma: no cover - exercised via the fallback tests
+    optax = None
+    HAS_OPTAX = False
+
+logger = get_logger(__name__)
+
+if not HAS_OPTAX:
+    logger.warning(
+        "engine.gradfit: optax is not installed — batched gradient fits "
+        "fall back to the pure-jax sgd/momentum/adam updates in "
+        "ops/optim.py (same update math, no optax-only transforms); "
+        "install optax to restore the full optimizer surface"
+    )
+
+_EPS = 1e-6
+
+
+# -- conf block --------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class GradFitConfig:
+    """The strict ``engine.gradfit`` conf block (tasks/common.py).
+
+    ``enabled`` arms the eager engine path in ``engine.fit_forecast``: an
+    arnet fit routes through :func:`gradfit_fit_forecast` (host-assembled
+    minibatches, prefetch overlap, donated AOT steps) instead of the
+    in-trace ``lax.scan`` trainer.  CV keeps the in-trace path regardless
+    — vmapped cutoffs cannot host-loop.
+    """
+
+    enabled: bool = False
+    #: series rows are padded up to ``series_bucket * 2^k`` so the step
+    #: executable is shared per (series-bucket, lag-window, xreg-count)
+    series_bucket: int = 64
+    #: minibatch ``device_put`` lookahead for the epoch loop (the PR-4
+    #: executor's prefetch depth; 0 = no overlap)
+    prefetch_depth: int = 2
+    #: donate params + optimizer state into each step (alias the update
+    #: in place of the inputs; the steady-state loop allocates nothing)
+    donate: bool = True
+
+    def __post_init__(self):
+        if self.series_bucket < 1:
+            raise ValueError(
+                f"series_bucket must be >= 1, got {self.series_bucket}")
+        if self.prefetch_depth < 0:
+            raise ValueError(
+                f"prefetch_depth must be >= 0, got {self.prefetch_depth}")
+
+    @classmethod
+    def from_conf(cls, conf: Optional[dict]) -> "GradFitConfig":
+        conf = conf or {}
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(conf) - known
+        if unknown:
+            # a typo like series_bucet must not silently fall back
+            raise ValueError(
+                f"unknown engine.gradfit conf key(s) {sorted(unknown)}; "
+                f"valid: {sorted(known)}")
+        kwargs = {
+            f.name: type(f.default)(conf[f.name])
+            for f in dataclasses.fields(cls)
+            if f.name in conf and conf[f.name] is not None
+        }
+        return cls(**kwargs)
+
+
+_active_config = GradFitConfig()
+
+
+def configure_gradfit(conf) -> GradFitConfig:
+    """Install the process-wide gradfit config (tasks/common parses the
+    ``engine.gradfit`` conf block into this)."""
+    global _active_config
+    cfg = conf if isinstance(conf, GradFitConfig) \
+        else GradFitConfig.from_conf(conf)
+    _active_config = cfg
+    return cfg
+
+
+def gradfit_config() -> GradFitConfig:
+    return _active_config
+
+
+def series_bucket(n_series: int, base: int) -> int:
+    """Pow2 ladder over ``base``: the smallest ``base * 2^k >= n_series``
+    (so a growing tenant re-pads instead of recompiling per row count)."""
+    b = max(int(base), 1)
+    while b < int(n_series):
+        b *= 2
+    return b
+
+
+# -- optimizer factory -------------------------------------------------------
+
+def make_optimizer(config):
+    """``(init, update, apply)`` for ``config.optimizer`` — optax when
+    available, the ``ops/optim.py`` pure-jax fallback otherwise.  The
+    update signature is normalized to ``update(grads, state)``."""
+    name = config.optimizer
+    lr = config.learning_rate
+    if HAS_OPTAX:
+        if name == "adam":
+            tx = optax.adam(lr)
+        elif name == "sgd":
+            tx = optax.sgd(lr)
+        elif name == "momentum":
+            tx = optax.sgd(lr, momentum=0.9)
+        else:
+            raise ValueError(
+                f"unknown ArnetConfig.optimizer {name!r}; "
+                f"'adam' | 'sgd' | 'momentum'")
+        return tx.init, (lambda g, s: tx.update(g, s)), optax.apply_updates
+    if name == "adam":
+        tx = _fallback_optim.adam(lr)
+    elif name == "sgd":
+        tx = _fallback_optim.sgd(lr)
+    elif name == "momentum":
+        tx = _fallback_optim.momentum(lr)
+    else:
+        raise ValueError(
+            f"unknown ArnetConfig.optimizer {name!r}; "
+            f"'adam' | 'sgd' | 'momentum'")
+    return tx.init, tx.update, _fallback_optim.apply_updates
+
+
+# -- shared numeric core -----------------------------------------------------
+
+def init_weights(n_series: int, lags: int, n_reg: int, dtype=jnp.float32):
+    """Zero init: the model starts at 'predict the (standardized) mean',
+    which is also what a fully-masked padding row trains to (no gradient
+    ever moves it)."""
+    return {
+        "w": jnp.zeros((n_series, lags), dtype),
+        "beta": jnp.zeros((n_series, n_reg), dtype),
+        "b": jnp.zeros((n_series,), dtype),
+    }
+
+
+def predict_minibatch(wp, lagb, xb):
+    """Forward AR + xreg linear head over one minibatch.
+
+    lagb: (S, B, L) lagged standardized targets (lag 1 first);
+    xb:   (B, R) shared or (S, B, R) per-series standardized regressors.
+    Returns (S, B) predictions in standardized space.
+    """
+    pred = jnp.einsum("sl,sbl->sb", wp["w"], lagb) + wp["b"][:, None]
+    if xb.shape[-1]:
+        if xb.ndim == 2:
+            pred = pred + jnp.einsum("br,sr->sb", xb, wp["beta"])
+        else:
+            pred = pred + jnp.einsum("sbr,sr->sb", xb, wp["beta"])
+    return pred
+
+
+def loss_fn(wp, zb, lagb, xb, vb, config):
+    """SUM over series of each series' masked minibatch mean loss.
+
+    Summing (not meaning) over the series axis keeps every series'
+    gradient independent of how many OTHER rows ride in the bucket —
+    padding rows change nothing, which is what makes the shape-bucket
+    ladder safe for training (see module docstring).
+    """
+    err = predict_minibatch(wp, lagb, xb) - zb
+    if config.loss == "huber":
+        d = config.huber_delta
+        ae = jnp.abs(err)
+        per = jnp.where(ae <= d, 0.5 * err * err, d * (ae - 0.5 * d))
+    elif config.loss == "mse":
+        per = 0.5 * err * err
+    else:
+        raise ValueError(
+            f"unknown ArnetConfig.loss {config.loss!r}; 'huber' | 'mse'")
+    per_series = jnp.sum(per * vb, axis=1) / jnp.maximum(
+        jnp.sum(vb, axis=1), 1.0)
+    return jnp.sum(per_series)
+
+
+def _train_step_core(wp, opt_state, zb, lagb, xb, vb, config):
+    """One optimizer step — the single body both training paths run."""
+    _init, update, apply = make_optimizer(config)
+    loss, grads = jax.value_and_grad(loss_fn)(wp, zb, lagb, xb, vb, config)
+    updates, opt_state = update(grads, opt_state)
+    return apply(wp, updates), opt_state, loss
+
+
+@partial(jax.jit, static_argnames=("config",))
+def train_step(wp, opt_state, zb, lagb, xb, vb, config):
+    """The jitted batched update — the ``gradfit_step:arnet`` AOT entry.
+
+    Dispatched with ``donate_argnums=(0, 1)`` by the host loop: XLA
+    aliases the new params/optimizer state onto the donated inputs, so
+    the inner loop's only allocations are the prefetched minibatches.
+    """
+    return _train_step_core(wp, opt_state, zb, lagb, xb, vb, config)
+
+
+def minibatch_schedule(key, n_time: int, batch_size: int, epochs: int):
+    """Deterministic epoch schedule: (steps, B) int32 time positions.
+
+    Each epoch is an independent permutation of the grid (folded key), cut
+    into ``floor(T/B)`` full batches — a sub-B remainder per epoch is
+    dropped rather than ragged-shaped (every step shares one executable).
+    Both training paths derive their schedule from this one function, so
+    the eager engine path replays the exact in-trace batch order.
+    ``n_time``/``batch_size``/``epochs`` are static Python ints (shape +
+    config values), never traced.
+    """
+    B = min(batch_size, n_time)
+    nb = max(n_time // B, 1)
+
+    def one_epoch(k):
+        return jax.random.permutation(k, n_time)[: nb * B].reshape(nb, B)
+
+    keys = jax.random.split(key, max(epochs, 1))
+    return jax.vmap(one_epoch)(keys).reshape(-1, B).astype(jnp.int32)
+
+
+def gather_minibatch(z, xz, valid, idx, lags: int):
+    """Slice one ``(S, B, L)`` minibatch out of the standardized tensors.
+
+    z/valid: (S, T); xz: (T, R) shared or (S, T, R) per-series; idx: (B,)
+    time positions.  Lag features are gathered off a front-padded copy so
+    positions with ``t < lags`` read zeros — their ``valid`` weight is 0
+    anyway (teacher forcing needs every lag observed).  ``lags`` is a
+    static config int, never traced.
+    """
+    zp = jnp.pad(z, ((0, 0), (lags, 0)))
+    cols = idx[:, None] + (lags - 1 - jnp.arange(lags))[None, :]  # (B, L)
+    lagb = zp[:, cols]                                            # (S, B, L)
+    zb = z[:, idx]
+    vb = valid[:, idx]
+    xb = xz[idx] if xz.ndim == 2 else xz[:, idx, :]
+    return zb, lagb, xb, vb
+
+
+def train_scan(z, xz, valid, config):
+    """In-trace trainer: ``lax.scan`` over the full minibatch schedule.
+
+    Jit-safe with static config (shapes only depend on T/B/L/epochs), so
+    ``models/arnet.fit`` runs it inside ``fit_forecast:arnet`` and under
+    vmapped CV cutoffs unchanged.  Returns (weights, per-step losses).
+    """
+    S, T = z.shape
+    R = xz.shape[-1]
+    schedule = minibatch_schedule(
+        jax.random.PRNGKey(config.seed), T, config.batch_size, config.epochs)
+    wp = init_weights(S, config.lags, R, z.dtype)
+    init_fn, _update, _apply = make_optimizer(config)
+    opt_state = init_fn(wp)
+
+    def step(carry, idx):
+        wp, st = carry
+        zb, lagb, xb, vb = gather_minibatch(z, xz, valid, idx, config.lags)
+        wp, st, loss = _train_step_core(wp, st, zb, lagb, xb, vb, config)
+        return (wp, st), loss
+
+    (wp, _), losses = jax.lax.scan(step, (wp, opt_state), schedule)
+    return wp, losses
+
+
+# -- host-driven engine path -------------------------------------------------
+
+def _host_batches(z_np, xz_np, valid_np, schedule, lags: int
+                  ) -> Iterator[Tuple[np.ndarray, ...]]:
+    """Host-side minibatch assembly (numpy gathers, float32) — the prep
+    stage that :func:`prefetch_to_device` overlaps with device steps.
+    Same arithmetic as :func:`gather_minibatch` (gathers are exact)."""
+    L = int(lags)
+    zp = np.pad(z_np, ((0, 0), (L, 0)))
+    offs = (L - 1 - np.arange(L))[None, :]
+    for idx in schedule:
+        cols = idx[:, None] + offs                           # (B, L)
+        lagb = zp[:, cols]                                   # (S, B, L)
+        zb = z_np[:, idx]
+        vb = valid_np[:, idx]
+        xb = xz_np[idx] if xz_np.ndim == 2 else xz_np[:, idx, :]
+        yield zb, lagb, xb, vb
+
+
+def host_train(y, mask, day, config, xreg_hist=None,
+               gcfg: Optional[GradFitConfig] = None):
+    """Eager epoch loop: prefetch-fed, donation-backed AOT train steps.
+
+    Pads the series axis to the ``series_bucket`` pow2 ladder (the step
+    executable is shared per (series-bucket, lag-window, xreg-count) —
+    padded rows train to zero and are sliced off), assembles minibatches
+    on the host from the pinned schedule, and advances ALL series with
+    one ``gradfit_step:arnet`` dispatch per step.  Returns the (S,)-row
+    weights dict.  Charges the loop's device interval to the PR-10 cost
+    counters under the step entry.
+    """
+    from distributed_forecasting_tpu.engine.compile_cache import aot_call
+    from distributed_forecasting_tpu.engine.executor import prefetch_to_device
+    from distributed_forecasting_tpu.models import arnet
+
+    gcfg = gcfg if gcfg is not None else _active_config
+    S = int(y.shape[0])
+    Sb = series_bucket(S, gcfg.series_bucket)
+    pad = Sb - S
+    y_b = jnp.pad(jnp.asarray(y, jnp.float32), ((0, pad), (0, 0)))
+    m_b = jnp.pad(jnp.asarray(mask, jnp.float32), ((0, pad), (0, 0)))
+    xreg_b = xreg_hist
+    if xreg_hist is not None and xreg_hist.ndim == 3:
+        xreg_b = jnp.pad(jnp.asarray(xreg_hist, jnp.float32),
+                         ((0, pad), (0, 0), (0, 0)))
+
+    z, _mu, _sd, xz, valid, _xmu, _xsd = arnet.prep_training(
+        y_b, m_b, config, xreg=xreg_b)
+    schedule = np.asarray(minibatch_schedule(
+        jax.random.PRNGKey(config.seed), int(y.shape[1]),
+        config.batch_size, config.epochs))
+    z_np = np.asarray(z)
+    xz_np = np.asarray(xz)
+    valid_np = np.asarray(valid)
+
+    wp = init_weights(Sb, config.lags, xz.shape[-1], jnp.float32)
+    init_fn, _update, _apply = make_optimizer(config)
+    opt_state = init_fn(wp)
+    donate = (0, 1) if gcfg.donate else ()
+
+    t0 = time.perf_counter()
+    batches = _host_batches(z_np, xz_np, valid_np, schedule, config.lags)
+    for zb, lagb, xb, vb in prefetch_to_device(
+            batches, depth=gcfg.prefetch_depth):
+        wp, opt_state, _loss = aot_call(
+            "gradfit_step:arnet", train_step,
+            args=(wp, opt_state, zb, lagb, xb, vb),
+            static_kwargs=dict(config=config),
+            donate_argnums=donate,
+        )
+    from distributed_forecasting_tpu.engine.executor import device_pull
+
+    wp = jax.tree_util.tree_map(lambda a: a[:S], device_pull(wp))
+    try:
+        from distributed_forecasting_tpu.monitoring.cost import cost_metrics
+
+        cost_metrics().record_dispatch(
+            "gradfit_step:arnet", "arnet", time.perf_counter() - t0)
+    except Exception:  # noqa: BLE001 - accounting must never fail a fit
+        pass
+    return wp
+
+
+@partial(jax.jit, static_argnames=("config", "horizon", "min_points"))
+def _finalize_impl(y, mask, day, key, w, beta, b, config, horizon,
+                   min_points, xreg=None):
+    """Post-training tail as ONE compiled program (``gradfit_finalize``):
+    fitted-path scan, forecast, health fallback — the exact composition
+    ``engine.fit._fit_forecast_impl`` runs, minus the training that
+    already happened eagerly."""
+    from distributed_forecasting_tpu.engine.fit import (
+        day_grid,
+        health_fallback,
+    )
+    from distributed_forecasting_tpu.models import arnet
+
+    day_all = day_grid(day, horizon)
+    t_end = day[day.shape[0] - 1].astype(jnp.float32)
+    T = day.shape[0]
+    xreg_hist = None
+    if xreg is not None:
+        xreg_hist = xreg[:T] if xreg.ndim == 2 else xreg[:, :T]
+    params = arnet.params_from_weights(y, mask, day, config, w, beta, b,
+                                       xreg=xreg_hist)
+    yhat, lo, hi = arnet.forecast(params, day_all, t_end, config, key,
+                                  xreg=xreg)
+    yhat, lo, hi, ok = health_fallback(y, mask, yhat, lo, hi, horizon,
+                                       min_points)
+    return params, yhat, lo, hi, ok, day_all
+
+
+def gradfit_fit_forecast(batch, config=None, horizon: int = 90, key=None,
+                         min_points: int = 14, xreg=None,
+                         gcfg: Optional[GradFitConfig] = None):
+    """The engine path ``fit_forecast`` routes arnet fits through when the
+    ``engine.gradfit`` conf block is armed.  Train eagerly (prefetch +
+    donated AOT steps), then finalize + forecast in one AOT program whose
+    forecast bytes equal the serving predictor's dispatch on the same
+    params (same ``arnet.forecast``, same arguments)."""
+    from distributed_forecasting_tpu.engine.compile_cache import aot_call
+    from distributed_forecasting_tpu.engine.fit import ForecastResult
+    from distributed_forecasting_tpu.models import arnet
+
+    config = config if config is not None else arnet.ArnetConfig()
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    T = batch.n_time
+    xreg_hist = None
+    if xreg is not None:
+        xreg_hist = xreg[:T] if xreg.ndim == 2 else xreg[:, :T]
+    wp = host_train(batch.y, batch.mask, batch.day, config,
+                    xreg_hist=xreg_hist, gcfg=gcfg)
+    params, yhat, lo, hi, ok, day_all = aot_call(
+        "gradfit_finalize:arnet", _finalize_impl,
+        args=(batch.y, batch.mask, batch.day, key,
+              wp["w"], wp["beta"], wp["b"]),
+        static_kwargs=dict(config=config, horizon=horizon,
+                           min_points=min_points),
+        dynamic_kwargs=dict(xreg=xreg),
+    )
+    return params, ForecastResult(yhat=yhat, lo=lo, hi=hi, ok=ok,
+                                  day_all=day_all)

@@ -51,6 +51,85 @@ from distributed_forecasting_tpu.ops import metrics as metrics_ops
 
 
 @dataclasses.dataclass(frozen=True)
+class AutoMLConfig:
+    """The strict ``engine.automl`` conf block: the cross-family
+    successive-halving sweep (engine/select.py,
+    :func:`~distributed_forecasting_tpu.engine.select.successive_halving_select`).
+
+    Successive halving in the auto-sktime spirit (arXiv 2312.08528): rung
+    r evaluates the surviving families on a ``base_series * eta**r``-sized
+    series subset and the last ``base_cutoffs * eta**r`` CV cutoffs, then
+    keeps the best ``1/eta`` fraction.  Subset sizes follow the shared
+    pow2 shape-bucket ladder, so every rung (and every later sweep) reuses
+    the same compiled CV programs per family.  ``budget_device_seconds``
+    is a LAUNCH GATE against the PR-10 cost-attribution counters: no new
+    family evaluation starts once the sweep's attributed device-seconds
+    meter reads >= budget (docs/automl.md#budget-accounting).
+    """
+
+    enabled: bool = False
+    budget_device_seconds: float = 60.0
+    eta: int = 2
+    rungs: int = 3
+    base_series: int = 64
+    base_cutoffs: int = 1
+    metric: str = "smape"
+    families: tuple = ("prophet", "holt_winters", "theta", "croston",
+                       "arima", "arnet")
+
+    def __post_init__(self):
+        if self.eta < 2:
+            raise ValueError(f"eta must be >= 2, got {self.eta}")
+        if self.rungs < 1:
+            raise ValueError(f"rungs must be >= 1, got {self.rungs}")
+        if self.budget_device_seconds <= 0:
+            raise ValueError(
+                f"budget_device_seconds must be > 0, got "
+                f"{self.budget_device_seconds}")
+        if self.base_series < 1:
+            raise ValueError(
+                f"base_series must be >= 1, got {self.base_series}")
+        if self.base_cutoffs < 1:
+            raise ValueError(
+                f"base_cutoffs must be >= 1, got {self.base_cutoffs}")
+        if not self.families:
+            raise ValueError("families must name at least one family")
+
+    @classmethod
+    def from_conf(cls, conf: Optional[dict]) -> "AutoMLConfig":
+        conf = conf or {}
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(conf) - known
+        if unknown:
+            raise ValueError(
+                f"unknown engine.automl conf key(s) {sorted(unknown)}; "
+                f"valid: {sorted(known)}")
+        kwargs = {
+            f.name: type(f.default)(conf[f.name])
+            for f in dataclasses.fields(cls)
+            if f.name in conf and conf[f.name] is not None
+        }
+        return cls(**kwargs)
+
+
+_active_automl = AutoMLConfig()
+
+
+def configure_automl(conf) -> AutoMLConfig:
+    """Install the process-wide AutoML sweep config (tasks/common parses
+    the ``engine.automl`` conf block into this)."""
+    global _active_automl
+    cfg = conf if isinstance(conf, AutoMLConfig) \
+        else AutoMLConfig.from_conf(conf)
+    _active_automl = cfg
+    return cfg
+
+
+def automl_config() -> AutoMLConfig:
+    return _active_automl
+
+
+@dataclasses.dataclass(frozen=True)
 class HyperSearchConfig:
     n_trials: int = 8
     metric: str = "smape"  # selection metric (reference automl: val_smape)

@@ -104,6 +104,205 @@ def select_model(
     )
 
 
+@dataclasses.dataclass
+class AutoMLResult:
+    """Outcome of one :func:`successive_halving_select` sweep."""
+
+    leaderboard: pd.DataFrame     # one row per (rung, family) evaluation:
+    #                               family, rung, n_series, n_cutoffs,
+    #                               score, device_seconds, cumulative
+    #                               device-seconds after the eval
+    survivors: Tuple[str, ...]    # families alive after the last rung
+    selection: SelectionResult    # final per-series assignment
+    spent_device_seconds: float   # total attributed device time
+    budget_exhausted: bool        # True when the launch gate closed early
+    metric: str = "smape"
+
+
+def _rung_subset(batch: SeriesBatch, n_sub: int) -> SeriesBatch:
+    """Evenly-strided deterministic series subset of bucket size ``n_sub``
+    (stride sampling keeps every demand regime represented; a prefix slice
+    would score whatever the tenant's row order put first)."""
+    S = batch.n_series
+    if n_sub >= S:
+        return batch
+    idx = (np.arange(n_sub) * S) // n_sub
+    return dataclasses.replace(
+        batch,
+        y=batch.y[idx],
+        mask=batch.mask[idx],
+        keys=np.asarray(batch.keys)[idx],
+    )
+
+
+def _rung_cv(cv: CVConfig, n_time: int, n_cutoffs: int) -> CVConfig:
+    """CV variant covering only the LAST ``n_cutoffs`` cutoffs of ``cv``
+    (the most recent windows — the ones the final selection scores too)."""
+    from distributed_forecasting_tpu.engine.cv import cutoff_indices
+
+    cuts = cutoff_indices(n_time, cv)
+    if n_cutoffs >= len(cuts):
+        return cv
+    return dataclasses.replace(cv, initial=cuts[-n_cutoffs] + 1)
+
+
+def successive_halving_select(
+    batch: SeriesBatch,
+    config=None,
+    configs: Optional[Dict[str, object]] = None,
+    cv: CVConfig = CVConfig(),
+    key: Optional[jax.Array] = None,
+) -> AutoMLResult:
+    """Cross-family successive halving under a device-seconds budget.
+
+    Rung r scores every surviving family on a ``base_series * eta**r``
+    series subset (pow2 shape-bucket ladder, evenly strided) over the last
+    ``base_cutoffs * eta**r`` CV cutoffs, then keeps the top ``1/eta``
+    fraction by rung-mean metric — cheap rungs triage, expensive rungs
+    discriminate (auto-sktime's budgeted halving, arXiv 2312.08528).
+    After the rungs (or once a single family is left), the survivors get
+    one full-batch :func:`select_model` pass for the per-series
+    assignment.
+
+    The budget is metered with the PR-10 cost-attribution counters
+    (monitoring/cost.py): every evaluation is timed to completion
+    (``block_until_ready``), charged via ``record_dispatch`` under entry
+    ``automl:cv:<family>`` (``automl:final`` for the full pass), and
+    accumulated through an attribution scope.  It is a LAUNCH GATE: no
+    new evaluation starts once the meter reads >= budget — the sweep then
+    returns the best-so-far ranking with ``budget_exhausted=True`` and a
+    uniform best-family assignment instead of a per-series one.
+
+    ``config``: an :class:`~distributed_forecasting_tpu.engine.hyper.
+    AutoMLConfig` (defaults to the process-wide ``engine.automl`` block);
+    ``configs``: optional per-family model configs, passed through to CV
+    and the final selection.
+    """
+    import time
+
+    from distributed_forecasting_tpu.engine.gradfit import series_bucket
+    from distributed_forecasting_tpu.engine.hyper import (
+        AutoMLConfig,
+        automl_config,
+    )
+    from distributed_forecasting_tpu.monitoring.cost import cost_metrics
+
+    cfg: AutoMLConfig = config if config is not None else automl_config()
+    configs = configs or {}
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    for name in cfg.families:
+        get_model(name)  # fail fast on unknown family
+    S = batch.n_series
+    cm = cost_metrics()
+    rows = []
+    survivors = list(cfg.families)
+    ranking: Dict[str, float] = {}
+    exhausted = False
+
+    with cm.attribution() as acc:
+
+        def eval_once(fam, sub, cv_r, rung, fold):
+            t0 = time.perf_counter()
+            res = cross_validate(
+                batch=sub, model=fam, config=configs.get(fam), cv=cv_r,
+                key=jax.random.fold_in(key, fold),
+            )
+            from distributed_forecasting_tpu.engine.executor import (
+                device_pull,
+            )
+
+            vals = np.asarray(device_pull(res[cfg.metric]),
+                              dtype=np.float64)
+            dt = time.perf_counter() - t0
+            cm.record_dispatch(f"automl:cv:{fam}", fam, dt)
+            finite = np.isfinite(vals)
+            score = float(np.mean(vals[finite])) if finite.any() \
+                else float("inf")
+            if cfg.metric in _HIGHER_BETTER:
+                score = -score if np.isfinite(score) else float("inf")
+            rows.append({
+                "family": fam, "rung": rung,
+                "n_series": sub.n_series,
+                "n_cutoffs": int(res["_n_cutoffs"]),
+                f"mean_{cfg.metric}": score
+                if cfg.metric not in _HIGHER_BETTER else -score,
+                "device_seconds": dt,
+                "cumulative_device_seconds": acc["device_seconds"],
+            })
+            return score
+
+        for r in range(cfg.rungs):
+            if len(survivors) <= 1:
+                break
+            n_sub = min(S, series_bucket(
+                min(S, cfg.base_series * cfg.eta ** r), cfg.base_series))
+            sub = _rung_subset(batch, n_sub)
+            cv_r = _rung_cv(cv, batch.n_time,
+                            cfg.base_cutoffs * cfg.eta ** r)
+            scores: Dict[str, float] = {}
+            for i, fam in enumerate(survivors):
+                if acc["device_seconds"] >= cfg.budget_device_seconds:
+                    exhausted = True
+                    break
+                scores[fam] = eval_once(fam, sub, cv_r, r, r * 100 + i)
+            ranking.update(scores)
+            if exhausted:
+                # families the gate cut off keep their previous-rung rank
+                break
+            order = sorted(survivors, key=lambda f: scores[f])
+            keep = max(1, -(-len(survivors) // cfg.eta))  # ceil division
+            survivors = order[:keep]
+
+        final_gate_open = (
+            not exhausted
+            and acc["device_seconds"] < cfg.budget_device_seconds
+        )
+        if final_gate_open:
+            t0 = time.perf_counter()
+            selection = select_model(
+                batch, models=tuple(survivors), configs=configs,
+                metric=cfg.metric, cv=cv, key=key,
+            )
+            dt = time.perf_counter() - t0
+            cm.record_dispatch("automl:final", "select", dt)
+            rows.append({
+                "family": "+".join(survivors), "rung": "final",
+                "n_series": S,
+                "n_cutoffs": -1,
+                f"mean_{cfg.metric}": float(np.nanmean(np.where(
+                    np.isfinite(selection.best_score),
+                    selection.best_score, np.nan))),
+                "device_seconds": dt,
+                "cumulative_device_seconds": acc["device_seconds"],
+            })
+        else:
+            exhausted = True
+            # budget closed before the full pass: broadcast the
+            # best-ranked family uniformly (documented degraded mode —
+            # still a usable assignment, never a crash)
+            best = min(ranking, key=ranking.get) if ranking \
+                else survivors[0]
+            sc = ranking.get(best, float("inf"))
+            selection = SelectionResult(
+                models=(best,),
+                assignment=np.zeros(S, dtype=int),
+                best_score=np.full(S, sc),
+                scores=pd.DataFrame({best: np.full(S, sc)}),
+                metric=cfg.metric,
+            )
+        spent = acc["device_seconds"]
+
+    return AutoMLResult(
+        leaderboard=pd.DataFrame(rows),
+        survivors=tuple(survivors),
+        selection=selection,
+        spent_device_seconds=float(spent),
+        budget_exhausted=exhausted,
+        metric=cfg.metric,
+    )
+
+
 def fit_forecast_auto(
     batch: SeriesBatch,
     models: Sequence[str] = DEFAULT_FAMILIES,

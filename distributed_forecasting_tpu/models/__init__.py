@@ -1,6 +1,7 @@
 from distributed_forecasting_tpu.models.base import MODEL_REGISTRY, register_model
 from distributed_forecasting_tpu.models import (  # noqa: F401 (registration)
     arima,
+    arnet,
     croston,
     holt_winters,
     prophet_glm,
@@ -11,6 +12,7 @@ from distributed_forecasting_tpu.models.holt_winters import HoltWintersConfig
 from distributed_forecasting_tpu.models.arima import ArimaConfig
 from distributed_forecasting_tpu.models.croston import CrostonConfig
 from distributed_forecasting_tpu.models.theta import ThetaConfig
+from distributed_forecasting_tpu.models.arnet import ArnetConfig
 
 __all__ = [
     "MODEL_REGISTRY",
@@ -20,4 +22,5 @@ __all__ = [
     "ArimaConfig",
     "CrostonConfig",
     "ThetaConfig",
+    "ArnetConfig",
 ]

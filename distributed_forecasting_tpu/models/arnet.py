@@ -1,0 +1,326 @@
+"""Batched AR-Net: linear autoregression + future-regressor head, fit by
+minibatch gradient descent over ALL series at once (NeuralProphet's AR-Net
+core, arXiv 2111.15397, without the hidden layers).
+
+The model per series s, in per-series standardized space ``z``:
+
+    z_t ~ w_s · [z_{t-1} .. z_{t-L}] + beta_s · x_t + b_s
+
+``x_t`` are regressors KNOWN over history + horizon (exactly the
+``(T+horizon, R)`` holiday tensors autoprep emits), standardized with
+stats frozen at fit time.  Unlike every other family here there is no
+closed form — fitting is the batched gradient loop in
+``engine/gradfit.py``: one jitted optimizer step advances all S series
+over ``(S, B, L)`` minibatch tensors (sum-of-per-series losses, so series
+never couple and shape-bucket padding rows are exact no-ops).
+
+Two fit paths, one numeric core:
+
+* :func:`fit` (registered) trains fully in-trace via
+  ``gradfit.train_scan`` — jit/vmap-safe with static config, so the family
+  rides ``fit_forecast``, vmapped CV cutoffs, the TrainingPipeline and the
+  serving predictor like the other families;
+* the eager engine path (``gradfit.gradfit_fit_forecast``, armed by the
+  ``engine.gradfit`` conf block) trains with host-assembled prefetched
+  minibatches + donated AOT steps, then calls :func:`params_from_weights`
+  + :func:`forecast` — the same post-training code as this module.
+
+Forecasting rolls the AR recursion forward from the fit-grid-end lag
+buffer (honest recursive multi-step: predictions feed back as lag
+inputs).  Interval growth uses the AR(1) proxy ``a = sum(w)`` (the lag
+polynomial's total persistence): h-step variance ``sigma^2 ·
+(1 - a^{2h}) / (1 - a^2)``, the exact AR(1) forward-variance recursion —
+cheap, monotone, and collapsing to the 1-step sigma in-sample.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import ndtri
+
+from distributed_forecasting_tpu.models.base import (
+    history_splice,
+    register_model,
+)
+
+_EPS = 1e-6
+
+
+@dataclasses.dataclass(frozen=True)
+class ArnetConfig:
+    lags: int = 28
+    n_regressors: int = 0
+    loss: str = "huber"            # "huber" | "mse"
+    huber_delta: float = 1.0
+    optimizer: str = "adam"        # "adam" | "sgd" | "momentum"
+    learning_rate: float = 0.05
+    epochs: int = 30
+    batch_size: int = 64
+    seed: int = 0
+    interval_width: float = 0.95
+
+    def __post_init__(self):
+        if self.lags < 1:
+            raise ValueError(f"lags must be >= 1, got {self.lags}")
+        if self.epochs < 1:
+            raise ValueError(f"epochs must be >= 1, got {self.epochs}")
+        if self.batch_size < 1:
+            raise ValueError(
+                f"batch_size must be >= 1, got {self.batch_size}")
+        if not 0.0 < self.interval_width < 1.0:
+            raise ValueError(
+                f"interval_width must lie in (0, 1), got "
+                f"{self.interval_width}")
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class ArnetParams:
+    w: jax.Array         # (S, L) AR lag weights (lag 1 first), z-space
+    beta: jax.Array      # (S, R) regressor weights, standardized space
+    b: jax.Array         # (S,) bias, z-space
+    mu: jax.Array        # (S,) per-series target mean (standardization)
+    sd: jax.Array        # (S,) per-series target std (standardization)
+    xmu: jax.Array       # (S, R) regressor means — identical rows; kept
+    xsd: jax.Array       # (S, R) S-leading so the serving param gather
+    #                      slices them like every other leaf
+    sigma: jax.Array     # (S,) one-step residual std, data space
+    buf_end: jax.Array   # (S, L) z-space lag buffer at the fit-grid end
+    fitted: jax.Array    # (S, T) one-step fitted path, data space
+    day0: jax.Array
+    t_fit_end: jax.Array
+
+
+def _check_xreg(xreg, config: ArnetConfig, what: str) -> bool:
+    if config.n_regressors == 0:
+        if xreg is not None:
+            raise ValueError(
+                "xreg passed but config.n_regressors == 0 — set "
+                f"ArnetConfig(n_regressors={xreg.shape[-1]}) ({what})")
+        return False
+    if xreg is None:
+        raise ValueError(
+            f"config.n_regressors={config.n_regressors} but no xreg "
+            f"values passed to {what}")
+    if xreg.shape[-1] != config.n_regressors:
+        raise ValueError(
+            f"xreg has {xreg.shape[-1]} columns, config.n_regressors="
+            f"{config.n_regressors} ({what})")
+    return True
+
+
+def prep_training(y, mask, config: ArnetConfig, xreg=None):
+    """Standardized training tensors:
+    ``(z, mu, sd, xz, valid, xmu, xsd)``.
+
+    z: (S, T) per-series standardized targets, masked positions zeroed;
+    xz: regressors standardized with GLOBAL per-column stats, same layout
+    as the input ((T, R) shared / (S, T, R) per-series; (T, 0) when the
+    family runs without regressors); valid: (S, T) teacher-forcing weight
+    — 1 only where the target AND all ``lags`` lag positions are observed.
+
+    Every reduction is masked, so a fully-padded bucket row yields
+    ``z = 0, valid = 0`` and the stats of real rows are untouched —
+    training S series inside a padded bucket matches training them alone.
+    """
+    y = jnp.asarray(y, jnp.float32)
+    mask = jnp.asarray(mask, jnp.float32)
+    T = y.shape[1]
+    n = jnp.maximum(jnp.sum(mask, axis=1), 1.0)
+    mu = jnp.sum(y * mask, axis=1) / n
+    var = jnp.sum(((y - mu[:, None]) ** 2) * mask, axis=1) / n
+    sd = jnp.sqrt(var)
+    sd = jnp.where(sd > _EPS, sd, 1.0)
+    z = jnp.where(mask > 0, (y - mu[:, None]) / sd[:, None], 0.0)
+
+    # valid_t = mask_t * prod_{i=1..L} mask_{t-i}: unrolled shift product
+    # (L static and small — no (S, T, L) window materialization)
+    valid = mask
+    for i in range(1, config.lags + 1):
+        valid = valid * jnp.pad(mask, ((0, 0), (i, 0)))[:, :T]
+
+    if _check_xreg(xreg, config, "fit"):
+        xreg = jnp.asarray(xreg, jnp.float32)
+        if xreg.ndim == 3:
+            # per-series values: mask-weighted global stats so padded
+            # bucket rows (mask == 0) cannot shift them
+            w = mask[:, :, None]
+            cnt = jnp.maximum(jnp.sum(w), 1.0)
+            xmu = jnp.sum(xreg * w, axis=(0, 1)) / cnt          # (R,)
+            xvar = jnp.sum(((xreg - xmu) ** 2) * w, axis=(0, 1)) / cnt
+        else:
+            # shared calendar: plain time stats (identical for every
+            # series, so bucket padding is irrelevant by construction)
+            xmu = jnp.mean(xreg, axis=0)                        # (R,)
+            xvar = jnp.mean((xreg - xmu) ** 2, axis=0)
+        xsd = jnp.sqrt(xvar)
+        xsd = jnp.where(xsd > _EPS, xsd, 1.0)
+        xz = (xreg - xmu) / xsd
+    else:
+        xmu = jnp.zeros((0,), jnp.float32)
+        xsd = jnp.ones((0,), jnp.float32)
+        xz = jnp.zeros((T, 0), jnp.float32)
+    return z, mu, sd, xz, valid, xmu, xsd
+
+
+def _fitted_scan(z, mask, xc, w):
+    """One-step-ahead fitted path in z-space with an honest recursive lag
+    buffer: observed positions enter the buffer as-is, masked positions
+    (gaps, CV eval windows) enter as their own prediction — the same
+    closed-loop dynamics the future rollout uses, so a forecast spliced at
+    the grid end continues the carry seamlessly.
+
+    Returns (preds (S, T), buf_end (S, L))."""
+    S, L = w.shape
+
+    def step(buf, inp):
+        z_t, m_t, xc_t = inp
+        pred = jnp.sum(buf * w, axis=1) + xc_t
+        v = jnp.where(m_t > 0, z_t, pred)
+        return jnp.concatenate([v[:, None], buf[:, :-1]], axis=1), pred
+
+    buf_end, preds = jax.lax.scan(
+        step, jnp.zeros((S, L), z.dtype), (z.T, mask.T, xc.T))
+    return preds.T, buf_end
+
+
+def _xreg_contrib(xreg_grid, params: ArnetParams):
+    """(S, T_grid) regressor contribution from RAW values: fold the frozen
+    standardization into the weights (``beta·(x-mu)/sd = (beta/sd)·x -
+    beta·mu/sd``) instead of materializing an (S, T, R) standardized
+    tensor for a shared calendar."""
+    xreg_grid = jnp.asarray(xreg_grid, jnp.float32)
+    beta_eff = params.beta / params.xsd                         # (S, R)
+    offset = jnp.sum(params.beta * params.xmu / params.xsd, axis=1)
+    if xreg_grid.ndim == 3:
+        contrib = jnp.einsum("str,sr->st", xreg_grid, beta_eff)
+    else:
+        contrib = jnp.einsum("tr,sr->st", xreg_grid, beta_eff)
+    return contrib - offset[:, None]
+
+
+@partial(jax.jit, static_argnames=("config",))
+def params_from_weights(y, mask, day, config: ArnetConfig, w, beta, b,
+                        xreg=None) -> ArnetParams:
+    """Finalize trained weights into the family's params pytree: fitted
+    path, residual sigma, grid-end lag buffer, frozen standardization.
+    Shared verbatim by the in-trace :func:`fit` and the eager gradfit
+    engine path (``gradfit_finalize:arnet``) — one post-training body, so
+    the two trainers differ only in who ran the optimizer loop."""
+    z, mu, sd, xz, _valid, xmu_g, xsd_g = prep_training(
+        y, mask, config, xreg=xreg)
+    S = y.shape[0]
+    xc = jnp.broadcast_to(b[:, None], z.shape)
+    if xz.shape[-1]:
+        if xz.ndim == 2:
+            xc = xc + jnp.einsum("tr,sr->st", xz, beta)
+        else:
+            xc = xc + jnp.einsum("str,sr->st", xz, beta)
+    preds, buf_end = _fitted_scan(z, jnp.asarray(mask, jnp.float32), xc, w)
+    fitted = mu[:, None] + sd[:, None] * preds
+    m = jnp.asarray(mask, jnp.float32)
+    resid = (jnp.asarray(y, jnp.float32) - fitted) * m
+    sigma = jnp.sqrt(
+        jnp.sum(resid * resid, axis=1)
+        / jnp.maximum(jnp.sum(m, axis=1), 1.0))
+    R = config.n_regressors
+    return ArnetParams(
+        w=w, beta=beta, b=b, mu=mu, sd=sd,
+        xmu=jnp.broadcast_to(xmu_g[None, :], (S, R)),
+        xsd=jnp.broadcast_to(xsd_g[None, :], (S, R)),
+        sigma=sigma, buf_end=buf_end, fitted=fitted,
+        day0=day[0].astype(jnp.float32),
+        t_fit_end=day[-1].astype(jnp.float32),
+    )
+
+
+@partial(jax.jit, static_argnames=("config",))
+def fit(y, mask, day, config: ArnetConfig, xreg=None) -> ArnetParams:
+    """In-trace batched gradient fit (``gradfit.train_scan``) — jit- and
+    vmap-safe with static config, so CV cutoffs vmap over it unchanged.
+    Determinism comes from ``config.seed`` (no key argument in the family
+    protocol): two fits on identical inputs are bitwise identical."""
+    from distributed_forecasting_tpu.engine import gradfit
+
+    z, _mu, _sd, xz, valid, _xmu, _xsd = prep_training(
+        y, mask, config, xreg=xreg)
+    wp, _losses = gradfit.train_scan(z, xz, valid, config)
+    return params_from_weights(y, mask, day, config,
+                               wp["w"], wp["beta"], wp["b"], xreg=xreg)
+
+
+@partial(jax.jit, static_argnames=("config",))
+def forecast(params: ArnetParams, day_all, t_end, config: ArnetConfig,
+             key=None, xreg=None):
+    """Recursive multi-step rollout from the fit-grid-end lag buffer.
+
+    ``xreg`` (when the family runs with regressors) covers the FULL
+    history + horizon grid — future steps read their regressor row through
+    the frozen standardization (folded into the weights, see
+    :func:`_xreg_contrib`).
+    """
+    if config.n_regressors and xreg is None:
+        raise ValueError(
+            f"config.n_regressors={config.n_regressors} but no xreg "
+            f"values passed to forecast")
+    S, L = params.w.shape
+    T_fit = params.fitted.shape[1]
+    T_all = day_all.shape[0]
+    H = T_all - T_fit + 1 if T_all > T_fit else T_all
+
+    dayf = day_all.astype(jnp.float32)
+    h = dayf - params.t_fit_end
+    h_unc = dayf - t_end.astype(jnp.float32)
+
+    if config.n_regressors:
+        xc_all = params.b[:, None] + _xreg_contrib(xreg, params)  # (S, T_all)
+    else:
+        xc_all = jnp.broadcast_to(params.b[:, None], (S, T_all))
+    # future step j (1-based h = j+1) sits at grid position T_fit + j
+    pos = jnp.clip(T_fit + jnp.arange(H), 0, T_all - 1)
+    xc_fut = xc_all[:, pos]                                       # (S, H)
+
+    def step(buf, xc_t):
+        pred = jnp.sum(buf * params.w, axis=1) + xc_t
+        return jnp.concatenate([pred[:, None], buf[:, :-1]], axis=1), pred
+
+    _, fut_z = jax.lax.scan(step, params.buf_end, xc_fut.T)
+    fut = params.mu[:, None] + params.sd[:, None] * fut_z.T       # (S, H)
+
+    hidx = jnp.clip(h.astype(jnp.int32) - 1, 0, H - 1)
+    fut_g = jnp.take_along_axis(
+        fut, jnp.broadcast_to(hidx[None, :], (S, T_all)), axis=1)
+    yhat = history_splice(params.fitted, fut_g, day_all, params.day0, h)
+
+    # AR(1) persistence proxy for band growth: a = sum of lag weights,
+    # clipped inside the unit circle so the geometric series is finite
+    a2 = jnp.clip(jnp.sum(params.w, axis=1), -0.98, 0.98) ** 2    # (S,)
+    steps = jnp.maximum(h_unc, 1.0)[None, :]
+    growth = (1.0 - a2[:, None] ** steps) / (1.0 - a2[:, None])
+    sd_path = params.sigma[:, None] * jnp.sqrt(growth)
+    z_w = ndtri(0.5 + config.interval_width / 2.0)
+    return yhat, yhat - z_w * sd_path, yhat + z_w * sd_path
+
+
+def forecast_quantiles(params: ArnetParams, day_all, t_end,
+                       config: ArnetConfig, quantiles=(0.1, 0.5, 0.9),
+                       key=None, xreg=None):
+    """Gaussian quantile paths WITH xreg passthrough — the generic
+    ``gaussian_quantiles`` wrapper doesn't forward regressor values, and
+    arnet's point path needs them."""
+    if not quantiles or not all(0.0 < q < 1.0 for q in quantiles):
+        raise ValueError(f"quantiles must lie in (0, 1), got {quantiles!r}")
+    yhat, _lo, hi = forecast(params, day_all, t_end, config, key,
+                             xreg=xreg)
+    z_w = ndtri(0.5 + config.interval_width / 2.0)
+    sd = (hi - yhat) / z_w
+    qs = jnp.asarray(tuple(quantiles), jnp.float32)
+    return yhat[:, None, :] + ndtri(qs)[None, :, None] * sd[:, None, :]
+
+
+register_model("arnet", fit, forecast, ArnetConfig, supports_xreg=True,
+               forecast_quantiles=forecast_quantiles)

@@ -1,0 +1,95 @@
+"""Pure-jax first-order optimizers (the optax fallback surface).
+
+``engine/gradfit.py`` prefers optax when the container has it; when it
+doesn't, these three updates keep the batched-gradient family available
+instead of hard-failing the import (the same optional-dependency posture
+as pandas-holidays in ``data/holidays.py``).  The API mirrors the slice
+of optax the gradfit engine touches so the call sites are agnostic:
+
+    tx = sgd(1e-2)                 # or momentum(...), adam(...)
+    state = tx.init(params)
+    updates, state = tx.update(grads, state)
+    params = apply_updates(params, updates)
+
+Every transform is a pair of pure functions over pytrees — states are
+pytrees of arrays (plus adam's scalar step count), so they donate, AOT-
+serialize, and ride ``lax.scan`` carries exactly like optax's.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class Transform(NamedTuple):
+    """An (init, update) pair — the subset of optax.GradientTransformation
+    the gradfit engine relies on (``update`` here never needs params)."""
+
+    init: Callable[[Any], Any]
+    update: Callable[[Any, Any], tuple]
+
+
+def apply_updates(params, updates):
+    """``params + updates`` leafwise, preserving leaf dtypes."""
+    return jax.tree_util.tree_map(
+        lambda p, u: p + u.astype(p.dtype), params, updates)
+
+
+def sgd(learning_rate: float) -> Transform:
+    """Plain gradient descent: state-free.  ``learning_rate`` (like every
+    hyperparameter here) is a static Python float, never traced."""
+    lr = learning_rate
+
+    def init(params):
+        del params
+        return ()
+
+    def update(grads, state):
+        return jax.tree_util.tree_map(lambda g: -lr * g, grads), state
+
+    return Transform(init, update)
+
+
+def momentum(learning_rate: float, decay: float = 0.9) -> Transform:
+    """Heavy-ball momentum: ``v <- decay·v + g``, step ``-lr·v``."""
+    lr, mu = learning_rate, decay
+
+    def init(params):
+        return jax.tree_util.tree_map(jnp.zeros_like, params)
+
+    def update(grads, state):
+        v = jax.tree_util.tree_map(lambda s, g: mu * s + g, state, grads)
+        return jax.tree_util.tree_map(lambda vv: -lr * vv, v), v
+
+    return Transform(init, update)
+
+
+def adam(learning_rate: float, b1: float = 0.9, b2: float = 0.999,
+         eps: float = 1e-8) -> Transform:
+    """Adam with the standard bias correction (Kingma & Ba 2015) — the
+    same update optax.adam applies, so swapping implementations moves
+    results only at float-rounding scale, not convergence scale."""
+    lr, b1f, b2f, epsf = learning_rate, b1, b2, eps
+
+    def init(params):
+        zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+        return {"count": jnp.zeros((), jnp.int32), "mu": zeros,
+                "nu": jax.tree_util.tree_map(jnp.zeros_like, params)}
+
+    def update(grads, state):
+        count = state["count"] + 1
+        mu = jax.tree_util.tree_map(
+            lambda m, g: b1f * m + (1.0 - b1f) * g, state["mu"], grads)
+        nu = jax.tree_util.tree_map(
+            lambda n, g: b2f * n + (1.0 - b2f) * (g * g), state["nu"], grads)
+        c = count.astype(jnp.float32)
+        bc1 = 1.0 - b1f ** c
+        bc2 = 1.0 - b2f ** c
+        updates = jax.tree_util.tree_map(
+            lambda m, n: -lr * (m / bc1) / (jnp.sqrt(n / bc2) + epsf), mu, nu)
+        return updates, {"count": count, "mu": mu, "nu": nu}
+
+    return Transform(init, update)

@@ -148,9 +148,23 @@ class Task(ABC):
         #       autoprep:
         #         enabled: false           # arms the fused pre-fit program
         #         (stage gates + thresholds: docs/autoprep.md)
+        # The batched-gradient trainer (engine/gradfit.py) and the
+        # successive-halving sweep (engine/hyper.py AutoMLConfig) ride the
+        # same block:
+        #
+        #     engine:
+        #       gradfit:
+        #         enabled: false           # arms the eager prefetch+donate
+        #         series_bucket: 64        # pow2 ladder base for the S axis
+        #         prefetch_depth: 2        # minibatch device_put lookahead
+        #         donate: true             # donate params + opt state
+        #       automl:
+        #         enabled: false
+        #         budget_device_seconds: 60.0
+        #         (rung/eta/families reference: docs/automl.md)
         eng = self.conf.get("engine") if isinstance(self.conf, dict) else None
         if eng is not None:
-            known_eng = {"windowed", "autoprep"}
+            known_eng = {"windowed", "autoprep", "gradfit", "automl"}
             unknown_eng = set(eng) - known_eng
             if unknown_eng:
                 raise ValueError(
@@ -168,6 +182,18 @@ class Task(ABC):
                 )
 
                 configure_autoprep(eng["autoprep"])
+            if eng.get("gradfit") is not None:
+                from distributed_forecasting_tpu.engine.gradfit import (
+                    configure_gradfit,
+                )
+
+                configure_gradfit(eng["gradfit"])
+            if eng.get("automl") is not None:
+                from distributed_forecasting_tpu.engine.hyper import (
+                    configure_automl,
+                )
+
+                configure_automl(eng["automl"])
 
     # lazy infra handles ----------------------------------------------------
     @property

@@ -1,0 +1,85 @@
+"""Step 14 — a budgeted cross-family AutoML sweep, leaderboard included.
+
+The reference's AutoML notebook tunes Prophet hyperparameters per series
+with one Spark task per trial (``notebooks/automl/22-09-26...py``).
+This framework races whole FAMILIES — each one a single compiled batched
+CV program — under a device-seconds budget with successive halving
+(``engine/select.successive_halving_select``, docs/automl.md#sweep):
+
+  1. load the committed 500-series store-item dataset and keep an
+     evenly-strided 64-series slice (every demand regime represented);
+  2. race six families — including ``arnet``, the batched-gradient
+     AR-Net member (docs/automl.md#family) — on cheap early rungs
+     (series subsets, last-N CV cutoffs), halving the roster each rung;
+  3. every evaluation is timed to completion and charged to the
+     cost-attribution counters; the budget is a LAUNCH GATE — no new
+     evaluation starts once the meter crosses it;
+  4. print the leaderboard: accuracy (rung-mean smape) against
+     cumulative device-seconds, then the final per-series assignment.
+
+Run: python examples/14_automl_leaderboard.py   (~2 min on CPU)
+"""
+
+import dataclasses
+import os
+
+import numpy as np
+
+from distributed_forecasting_tpu.data import tensorize
+from distributed_forecasting_tpu.data.dataset import load_sales_csv
+from distributed_forecasting_tpu.engine import CVConfig
+from distributed_forecasting_tpu.engine.hyper import AutoMLConfig
+from distributed_forecasting_tpu.engine.select import successive_halving_select
+from distributed_forecasting_tpu.models import ArnetConfig
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DATASET = os.path.join(REPO, "datasets", "store_item_demand.csv.gz")
+
+if __name__ == "__main__":
+    batch = tensorize(load_sales_csv(DATASET))
+
+    # evenly-strided 64-series slice: representative, and a pow2 bucket
+    S_keep = 64
+    idx = (np.arange(S_keep) * batch.n_series) // S_keep
+    batch = dataclasses.replace(
+        batch, y=batch.y[idx], mask=batch.mask[idx],
+        keys=np.asarray(batch.keys)[idx],
+    )
+    print(f"dataset: {batch.n_series} series x {batch.n_time} days")
+
+    cfg = AutoMLConfig(
+        enabled=True,
+        families=("prophet", "holt_winters", "theta", "croston",
+                  "arima", "arnet"),
+        budget_device_seconds=120.0,
+        eta=2,
+        rungs=3,
+        base_series=8,     # rung 0: 8 series, 1 cutoff; rung 2: 32, 4
+        base_cutoffs=1,
+        metric="smape",
+    )
+    cv = CVConfig(initial=730, period=360, horizon=90)
+    # a lighter arnet for the race: the sweep scores generalization, not
+    # the last 0.1% of training convergence
+    configs = {"arnet": ArnetConfig(lags=14, epochs=10)}
+
+    res = successive_halving_select(batch, config=cfg, configs=configs,
+                                    cv=cv)
+
+    print(f"\n=== leaderboard (budget {cfg.budget_device_seconds:.0f} "
+          f"device-seconds, spent {res.spent_device_seconds:.1f}, "
+          f"exhausted={res.budget_exhausted}) ===")
+    cols = ["rung", "family", "n_series", "n_cutoffs", "mean_smape",
+            "device_seconds", "cumulative_device_seconds"]
+    with np.printoptions(precision=3):
+        print(res.leaderboard[cols].to_string(
+            index=False, float_format=lambda v: f"{v:.3f}"))
+
+    print(f"\nsurvivors after the rungs: {res.survivors}")
+    print("final per-series assignment:")
+    for fam, n in sorted(res.selection.counts().items(),
+                         key=lambda kv: -kv[1]):
+        print(f"  {fam:>14}: {n:3d} series")
+    best = res.leaderboard.sort_values("mean_smape").iloc[0]
+    print(f"\nbest rung-mean smape: {best.mean_smape:.3f} "
+          f"({best.family}, rung {best.rung})")

@@ -116,6 +116,7 @@ def collect(workdir: str, reps: int = 20, expect_warm: bool = False) -> Dict:
 
     windowed = _windowed_section(workdir)
     autoprep = _autoprep_section()
+    gradfit = _gradfit_section()
 
     req = pd.DataFrame({"store": [1, 1, 2], "item": [1, 2, 3]})
     out = fc.predict(req, horizon=30)  # warmup: compile or store-load
@@ -168,6 +169,7 @@ def collect(workdir: str, reps: int = 20, expect_warm: bool = False) -> Dict:
         "throughput_rows_per_s": round(rows_per_dispatch / p50, 1),
         "windowed": windowed,
         "autoprep": autoprep,
+        "gradfit": gradfit,
         "forecast_cache": forecast_cache,
         "output_sha256": hashlib.sha256(
             out.to_csv(index=False).encode()).hexdigest(),
@@ -255,6 +257,45 @@ def _autoprep_section() -> Dict:
         "repaired_points": int(summary.get("prep_repaired_points", 0)),
         "output_sha256": hashlib.sha256(
             np.asarray(res.batch.y, np.float32).tobytes()).hexdigest(),
+    }
+
+
+def _gradfit_section() -> Dict:
+    """Exercise the batched-gradient trainer through the AOT cache.
+
+    One eager arnet fit drives both gradfit entries — the donated
+    ``gradfit_step:arnet`` minibatch update and the
+    ``gradfit_finalize:arnet`` fitted-path + forecast program — so their
+    compiled-program costs land in the per-entry registry the diff side
+    gates, and ``--expect-warm`` proves a restarted process deserializes
+    them instead of recompiling.  The fixed-seed forecast sha gives the
+    cold-vs-warm output-identity check for the gradient-trained family
+    (:func:`diff_records`' ``gradfit_output_hash``)."""
+    import numpy as np
+
+    from distributed_forecasting_tpu.data import (
+        synthetic_store_item_sales,
+        tensorize,
+    )
+    from distributed_forecasting_tpu.engine.gradfit import (
+        GradFitConfig,
+        gradfit_fit_forecast,
+    )
+    from distributed_forecasting_tpu.models.arnet import ArnetConfig
+
+    df = synthetic_store_item_sales(n_stores=2, n_items=3, n_days=400, seed=7)
+    batch = tensorize(df)
+    cfg = ArnetConfig(lags=7, epochs=10, seed=0)
+    gcfg = GradFitConfig(enabled=True, series_bucket=8)
+    _, res = gradfit_fit_forecast(batch, config=cfg, horizon=30,
+                                  gcfg=gcfg)
+    return {
+        "workload": {"n_series": batch.n_series, "n_days": batch.n_time,
+                     "lags": cfg.lags, "epochs": cfg.epochs,
+                     "series_bucket": gcfg.series_bucket, "horizon": 30},
+        "all_ok": bool(res.ok.all()),
+        "output_sha256": hashlib.sha256(
+            np.asarray(res.yhat, np.float32).tobytes()).hexdigest(),
     }
 
 
@@ -515,6 +556,22 @@ def diff_records(baseline: Dict, current: Dict,
                 f"repaired tensors byte-identical cold vs warm "
                 f"({(pb or pa or '?')[:12]})" if (pa and pb) else
                 "autoprep section present in only one record (older "
+                "perf_report on the other side?); hash check skipped"))
+        ga = (cold.get("gradfit") or {}).get("output_sha256")
+        gb = (current.get("gradfit") or {}).get("output_sha256")
+        if ga and gb and ga != gb:
+            findings.append(_finding(
+                "gradfit_output_hash", "fail",
+                f"cold-run gradfit forecast {ga[:12]} != warm-run "
+                f"{gb[:12]}: the AOT cache changed what the batched "
+                f"gradient trainer produces"))
+        elif ga or gb:
+            findings.append(_finding(
+                "gradfit_output_hash",
+                "ok" if (ga and gb) else "warn",
+                f"gradfit forecasts byte-identical cold vs warm "
+                f"({(gb or ga or '?')[:12]})" if (ga and gb) else
+                "gradfit section present in only one record (older "
                 "perf_report on the other side?); hash check skipped"))
     return findings
 

@@ -613,6 +613,177 @@ def test_config_drift_engine_windowed_block_clean(tmp_path):
     assert _lint(tmp_path, "engine/windowed.py") == []
 
 
+def test_config_drift_engine_gradfit_block(tmp_path):
+    # the engine.gradfit conf block (conf/tasks/train_config.yml): its
+    # keys are GradFitConfig dataclass fields, so a typo'd key
+    # (series_bucet) is drift while the real spelling passes
+    _write(tmp_path, "conf/train.yml", """
+        engine:
+          gradfit:
+            enabled: true
+            series_bucet: 64
+            prefetch_depth: 2
+            donate: true
+    """)
+    _write(tmp_path, "engine/gradfit.py", """
+        import dataclasses
+
+        @dataclasses.dataclass(frozen=True)
+        class GradFitConfig:
+            enabled: bool = False
+            series_bucket: int = 64
+            prefetch_depth: int = 2
+            donate: bool = True
+
+            @classmethod
+            def from_conf(cls, conf):
+                return cls(**(conf or {}))
+
+        def build(conf):
+            return GradFitConfig.from_conf(
+                (conf.get("engine") or {}).get("gradfit"))
+    """)
+    found = _lint(tmp_path, "engine/gradfit.py")
+    assert [f.rule for f in found] == ["config-drift"]
+    assert "series_bucet" in found[0].message
+    assert found[0].path == "conf/train.yml"
+
+
+def test_config_drift_engine_gradfit_block_clean(tmp_path):
+    _write(tmp_path, "conf/train.yml", """
+        engine:
+          gradfit:
+            enabled: false
+            series_bucket: 64
+            prefetch_depth: 2
+            donate: true
+    """)
+    _write(tmp_path, "engine/gradfit.py", """
+        import dataclasses
+
+        @dataclasses.dataclass(frozen=True)
+        class GradFitConfig:
+            enabled: bool = False
+            series_bucket: int = 64
+            prefetch_depth: int = 2
+            donate: bool = True
+
+            @classmethod
+            def from_conf(cls, conf):
+                return cls(**(conf or {}))
+
+        def build(conf):
+            return GradFitConfig.from_conf(
+                (conf.get("engine") or {}).get("gradfit"))
+    """)
+    assert _lint(tmp_path, "engine/gradfit.py") == []
+
+
+def test_config_drift_engine_automl_block(tmp_path):
+    # the engine.automl conf block: a typo'd key (budget_device_secs)
+    # must surface as drift against the AutoMLConfig fields
+    _write(tmp_path, "conf/train.yml", """
+        engine:
+          automl:
+            enabled: true
+            budget_device_secs: 60.0
+            eta: 2
+            rungs: 3
+    """)
+    _write(tmp_path, "engine/hyper.py", """
+        import dataclasses
+
+        @dataclasses.dataclass(frozen=True)
+        class AutoMLConfig:
+            enabled: bool = False
+            budget_device_seconds: float = 60.0
+            eta: int = 2
+            rungs: int = 3
+            base_series: int = 64
+            base_cutoffs: int = 1
+            metric: str = "smape"
+
+            @classmethod
+            def from_conf(cls, conf):
+                return cls(**(conf or {}))
+
+        def build(conf):
+            return AutoMLConfig.from_conf(
+                (conf.get("engine") or {}).get("automl"))
+    """)
+    found = _lint(tmp_path, "engine/hyper.py")
+    assert [f.rule for f in found] == ["config-drift"]
+    assert "budget_device_secs" in found[0].message
+    assert found[0].path == "conf/train.yml"
+
+
+def test_config_drift_engine_automl_block_clean(tmp_path):
+    _write(tmp_path, "conf/train.yml", """
+        engine:
+          automl:
+            enabled: false
+            budget_device_seconds: 60.0
+            eta: 2
+            rungs: 3
+            base_series: 64
+            base_cutoffs: 1
+            metric: smape
+    """)
+    _write(tmp_path, "engine/hyper.py", """
+        import dataclasses
+
+        @dataclasses.dataclass(frozen=True)
+        class AutoMLConfig:
+            enabled: bool = False
+            budget_device_seconds: float = 60.0
+            eta: int = 2
+            rungs: int = 3
+            base_series: int = 64
+            base_cutoffs: int = 1
+            metric: str = "smape"
+
+            @classmethod
+            def from_conf(cls, conf):
+                return cls(**(conf or {}))
+
+        def build(conf):
+            return AutoMLConfig.from_conf(
+                (conf.get("engine") or {}).get("automl"))
+    """)
+    assert _lint(tmp_path, "engine/hyper.py") == []
+
+
+def test_host_sync_gradfit_epoch_loop_clean(tmp_path):
+    # the gradfit host epoch loop shape: prefetch-fed minibatches driving
+    # a donated jitted step, with the ONE final pull routed through a
+    # @sanctioned_pull device_pull — no raw syncs, no defensive casts, so
+    # the hot-dir host-sync rule must stay quiet
+    _write(tmp_path, "engine/epoch_loop.py", """
+        import jax
+        from distributed_forecasting_tpu.engine.executor import (
+            sanctioned_pull,
+        )
+
+        @sanctioned_pull
+        def device_pull(tree):
+            return jax.block_until_ready(tree)
+
+        def prefetch_to_device(items, depth=2):
+            for it in items:
+                yield jax.device_put(it)
+
+        @jax.jit
+        def train_step(params, batch):
+            return params + batch
+
+        def host_train(params, batches):
+            for batch in prefetch_to_device(batches, depth=2):
+                params = train_step(params, batch)
+            return device_pull(params)
+    """)
+    assert _lint(tmp_path, "engine/epoch_loop.py") == []
+
+
 def test_host_sync_windowed_combine_path(tmp_path):
     # the WLS combine (ops/combine.py) is a hot dispatch between the
     # window-fit and finalize entrypoints: a host pull of the combined

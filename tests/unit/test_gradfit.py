@@ -1,0 +1,368 @@
+"""Batched-gradient AR-Net gates (engine/gradfit.py + models/arnet.py).
+
+The load-bearing invariants of the family:
+
+* ACCURACY — on clean AR(K) data the mse-trained weights land on the
+  masked Yule-Walker solve (ops/solve.py), the closed-form least-squares
+  answer, so the optimizer is actually minimizing the model it claims;
+* DETERMINISM — two fixed-seed fits are bitwise identical, the eager
+  engine path (host minibatches + donated AOT steps) is bitwise the
+  in-trace ``lax.scan`` path, and a warm AOT reload serves the same bytes;
+* BUCKET INVARIANCE — the sum-of-per-series-masked-means loss means a
+  padded bucket row contributes zero gradient: training S series inside a
+  larger pow2 bucket is bitwise training them alone;
+* AUTOML — successive-halving rungs (series subsets, last-N CV cutoffs)
+  rank families the way the full selection does on separable data, and
+  the device-seconds budget is a real launch gate;
+* the family rides the PR-8 conformal path (``calibrate=True``) and the
+  serving predictor unchanged.
+
+Tier-1 keeps only the cheap core (fixed-seed bitwise, bucket ladder,
+conf strictness, optimizer math) — the suite sits just under the 870s
+budget, so the compile-heavy gates ride the CI unit step's slow set like
+the PR-12/13/16 trims before them.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+import pytest
+
+from distributed_forecasting_tpu.data.tensorize import SeriesBatch
+from distributed_forecasting_tpu.engine import fit_forecast
+from distributed_forecasting_tpu.engine.cv import CVConfig, cross_validate
+from distributed_forecasting_tpu.engine.gradfit import (
+    HAS_OPTAX,
+    GradFitConfig,
+    gradfit_fit_forecast,
+    make_optimizer,
+    series_bucket,
+)
+from distributed_forecasting_tpu.engine.hyper import AutoMLConfig
+from distributed_forecasting_tpu.engine.select import (
+    select_model,
+    successive_halving_select,
+)
+from distributed_forecasting_tpu.models.arnet import ArnetConfig
+from distributed_forecasting_tpu.ops import optim as fallback_optim
+from distributed_forecasting_tpu.ops.solve import yule_walker_masked
+
+
+def _ar_batch(n_series=3, n_time=800, coefs=(0.5, -0.2), noise=0.3, seed=0):
+    """Stationary AR(K) series with per-series level offsets."""
+    rng = np.random.default_rng(seed)
+    K = len(coefs)
+    y = np.zeros((n_series, n_time), np.float64)
+    for t in range(K, n_time):
+        y[:, t] = sum(c * y[:, t - 1 - k] for k, c in enumerate(coefs))
+        y[:, t] += noise * rng.normal(size=n_series)
+    y += 20.0 * (1.0 + np.arange(n_series))[:, None]
+    return SeriesBatch(
+        y=jnp.asarray(y, jnp.float32),
+        mask=jnp.ones((n_series, n_time), jnp.float32),
+        day=jnp.arange(n_time, dtype=jnp.float32),
+        keys=np.arange(n_series)[:, None],
+        key_names=("id",),
+        start_date="2020-01-01",
+        freq="D",
+    )
+
+
+def _mixed_batch(n_series=8, n_time=760, seed=0):
+    """Separable families: smooth weekly-seasonal series (theta territory)
+    — croston's flat intermittent-demand level is badly misspecified."""
+    rng = np.random.default_rng(seed)
+    t = np.arange(n_time)
+    y = (
+        50.0
+        + 0.02 * t[None, :]
+        + 8.0 * np.sin(2 * np.pi * t / 7 + rng.uniform(0, 6, (n_series, 1)))
+        + 1.5 * rng.normal(size=(n_series, n_time))
+    )
+    return SeriesBatch(
+        y=jnp.asarray(y, jnp.float32),
+        mask=jnp.ones((n_series, n_time), jnp.float32),
+        day=jnp.arange(n_time, dtype=jnp.float32),
+        keys=np.array([f"s{i}" for i in range(n_series)]),
+        key_names=("id",),
+        start_date="2020-01-01",
+        freq="D",
+    )
+
+
+# ---------------------------------------------------------------------------
+# accuracy: the optimizer finds the closed-form answer
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_arnet_matches_yule_walker_on_ar_data():
+    coefs = (0.5, -0.2)
+    batch = _ar_batch(coefs=coefs, n_time=1000, seed=1)
+    cfg = ArnetConfig(lags=2, loss="mse", epochs=120, batch_size=256,
+                      learning_rate=0.05, seed=0)
+    params, res = fit_forecast(batch, model="arnet", config=cfg, horizon=30)
+    assert bool(np.asarray(res.ok).all())
+
+    # the same standardized target the trainer sees
+    y = np.asarray(batch.y, np.float64)
+    mu = y.mean(axis=1, keepdims=True)
+    sd = y.std(axis=1, keepdims=True)
+    z = jnp.asarray((y - mu) / sd, jnp.float32)
+    yw_coef, _ = yule_walker_masked(z, batch.mask, K=2)
+
+    w = np.asarray(params.w)  # (S, L): column j multiplies lag j+1
+    np.testing.assert_allclose(w, np.asarray(yw_coef), atol=0.08)
+    # and both sit near the generating process
+    np.testing.assert_allclose(w.mean(axis=0), coefs, atol=0.08)
+
+    # in-sample one-step residuals beat the series scale by a wide margin
+    fitted = np.asarray(params.fitted)
+    resid = fitted[:, 10:] - y[:, 10:]
+    assert np.sqrt((resid ** 2).mean()) < 0.6 * y.std()
+
+
+# ---------------------------------------------------------------------------
+# determinism gates
+# ---------------------------------------------------------------------------
+
+
+def test_fixed_seed_fits_are_bitwise_identical():
+    batch = _ar_batch(n_time=400, seed=2)
+    cfg = ArnetConfig(lags=5, epochs=8, seed=7)
+    p1, r1 = fit_forecast(batch, model="arnet", config=cfg, horizon=21)
+    p2, r2 = fit_forecast(batch, model="arnet", config=cfg, horizon=21)
+    assert np.asarray(r1.yhat).tobytes() == np.asarray(r2.yhat).tobytes()
+    assert np.asarray(r1.lo).tobytes() == np.asarray(r2.lo).tobytes()
+    assert np.asarray(p1.w).tobytes() == np.asarray(p2.w).tobytes()
+
+
+@pytest.mark.slow  # compiles both the lax.scan trainer and the AOT step —
+# the heaviest gate in this module; rides the CI slow set with the others.
+def test_eager_gradfit_path_matches_in_trace_bitwise():
+    """host minibatches + donated AOT steps must reproduce the lax.scan
+    trainer EXACTLY — same schedule, same gathers, same step body."""
+    batch = _ar_batch(n_series=3, n_time=400, seed=3)
+    cfg = ArnetConfig(lags=7, epochs=5, seed=0)
+    _, res_trace = fit_forecast(batch, model="arnet", config=cfg, horizon=30)
+    gcfg = GradFitConfig(enabled=True, series_bucket=4)
+    _, res_eager = gradfit_fit_forecast(
+        batch, config=cfg, horizon=30, gcfg=gcfg)
+    assert (np.asarray(res_eager.yhat).tobytes()
+            == np.asarray(res_trace.yhat).tobytes())
+    assert (np.asarray(res_eager.lo).tobytes()
+            == np.asarray(res_trace.lo).tobytes())
+
+
+@pytest.mark.slow
+def test_bucket_boundary_growth_is_bitwise_invariant():
+    """S=5 series trained inside an 8-bucket and a 16-bucket must produce
+    identical bytes: padded rows (mask all zero) shed zero gradient into
+    the sum-of-per-series-means loss."""
+    batch = _ar_batch(n_series=5, n_time=400, seed=4)
+    cfg = ArnetConfig(lags=7, epochs=5, seed=0)
+    outs = []
+    for base in (8, 16):
+        gcfg = GradFitConfig(enabled=True, series_bucket=base)
+        params, res = gradfit_fit_forecast(
+            batch, config=cfg, horizon=30, gcfg=gcfg)
+        outs.append((np.asarray(params.w), np.asarray(res.yhat)))
+    (w8, y8), (w16, y16) = outs
+    assert w8.tobytes() == w16.tobytes()
+    assert y8.tobytes() == y16.tobytes()
+
+
+def test_series_bucket_ladder():
+    assert series_bucket(1, 64) == 64
+    assert series_bucket(64, 64) == 64
+    assert series_bucket(65, 64) == 128
+    assert series_bucket(1000, 64) == 1024
+
+
+@pytest.mark.slow
+def test_warm_aot_reload_serves_identical_bytes(tmp_path):
+    """A fresh store over the same cache directory is a fresh process:
+    the gradfit step + finalize executables come back from DISK and the
+    forecast bytes must not move."""
+    from distributed_forecasting_tpu.engine import compile_cache as cc
+
+    directory = str(tmp_path / "cc")
+    batch = _ar_batch(n_series=3, n_time=400, seed=5)
+    cfg = ArnetConfig(lags=7, epochs=4, seed=0)
+    gcfg = GradFitConfig(enabled=True, series_bucket=4)
+    try:
+        cc.configure_compile_cache(cc.CompileCacheConfig(
+            enabled=True, directory=directory))
+        _, cold = gradfit_fit_forecast(batch, config=cfg, horizon=30,
+                                       gcfg=gcfg)
+        # fresh store over the same directory = warm boot
+        cc.configure_compile_cache(cc.CompileCacheConfig(
+            enabled=True, directory=directory))
+        s0 = cc.cache_stats()
+        _, warm = gradfit_fit_forecast(batch, config=cfg, horizon=30,
+                                       gcfg=gcfg)
+        s1 = cc.cache_stats()
+        assert s1["hits"] > s0["hits"]          # at least one AOT reload
+        assert s1["misses"] == s0["misses"]     # ... and zero recompiles
+        assert (np.asarray(warm.yhat).tobytes()
+                == np.asarray(cold.yhat).tobytes())
+        assert (np.asarray(warm.hi).tobytes()
+                == np.asarray(cold.hi).tobytes())
+    finally:
+        cc.configure_compile_cache(cc.CompileCacheConfig(enabled=False))
+
+
+@pytest.mark.slow
+def test_serving_predict_matches_training_forecast():
+    from distributed_forecasting_tpu.serving import BatchForecaster
+
+    batch = _ar_batch(n_series=3, n_time=400, seed=6)
+    cfg = ArnetConfig(lags=7, epochs=5, seed=0)
+    h = 14
+    params, res = fit_forecast(batch, model="arnet", config=cfg, horizon=h)
+    fc = BatchForecaster.from_fit(batch, params, "arnet", cfg)
+    req = pd.DataFrame({"id": [0, 1, 2]})
+    out = fc.predict(req, horizon=h)
+    assert len(out) == 3 * h
+    got = (out.sort_values(["id", "ds"]).yhat
+           .to_numpy(np.float32).reshape(3, h))
+    want = np.asarray(res.yhat[:, -h:], np.float32)
+    np.testing.assert_array_equal(got, want)
+
+
+# ---------------------------------------------------------------------------
+# optimizer surface (satellite: optax optional)
+# ---------------------------------------------------------------------------
+
+
+def test_fallback_adam_one_step_math():
+    """The pure-jax fallback implements standard bias-corrected adam."""
+    tx = fallback_optim.adam(0.1)
+    params = {"w": jnp.asarray([1.0, 2.0])}
+    grads = {"w": jnp.asarray([0.5, -0.5])}
+    state = tx.init(params)
+    updates, state = tx.update(grads, state)
+    new = fallback_optim.apply_updates(params, updates)
+    # first step of adam moves every coordinate by ~lr against the grad sign
+    step = np.asarray(new["w"]) - np.asarray(params["w"])
+    np.testing.assert_allclose(step, [-0.1, 0.1], atol=1e-4)
+
+
+@pytest.mark.skipif(not HAS_OPTAX, reason="optax not installed")
+def test_fallback_optimizers_match_optax_updates():
+    import optax
+
+    params = {"w": jnp.linspace(-1.0, 1.0, 8), "b": jnp.asarray(0.3)}
+    grads = {"w": jnp.linspace(0.2, -0.4, 8), "b": jnp.asarray(-0.1)}
+    pairs = [
+        (optax.adam(0.05), fallback_optim.adam(0.05)),
+        (optax.sgd(0.05), fallback_optim.sgd(0.05)),
+        (optax.sgd(0.05, momentum=0.9), fallback_optim.momentum(0.05, 0.9)),
+    ]
+    for ox, fb in pairs:
+        so, sf = ox.init(params), fb.init(params)
+        p_ox, p_fb = params, params
+        for _ in range(3):  # a few steps so state (mu/nu/trace) matters
+            u_ox, so = ox.update(grads, so)
+            p_ox = optax.apply_updates(p_ox, u_ox)
+            u_fb, sf = fb.update(grads, sf)
+            p_fb = fallback_optim.apply_updates(p_fb, u_fb)
+        for k in params:
+            np.testing.assert_allclose(
+                np.asarray(p_ox[k]), np.asarray(p_fb[k]), atol=1e-6)
+
+
+def test_make_optimizer_rejects_unknown_name():
+    with pytest.raises(ValueError, match="optimizer"):
+        make_optimizer(ArnetConfig(optimizer="lion"))
+
+
+# ---------------------------------------------------------------------------
+# conf-block strictness
+# ---------------------------------------------------------------------------
+
+
+def test_gradfit_conf_rejects_unknown_keys():
+    with pytest.raises(ValueError, match="series_bucet"):
+        GradFitConfig.from_conf({"series_bucet": 64})
+    assert GradFitConfig.from_conf(
+        {"enabled": True, "series_bucket": 128}).series_bucket == 128
+
+
+def test_automl_conf_rejects_unknown_keys_and_bad_values():
+    with pytest.raises(ValueError, match="budget_device_secs"):
+        AutoMLConfig.from_conf({"budget_device_secs": 60.0})
+    with pytest.raises(ValueError):
+        AutoMLConfig(eta=1)
+    with pytest.raises(ValueError):
+        AutoMLConfig(budget_device_seconds=0.0)
+    cfg = AutoMLConfig.from_conf(
+        {"families": ["theta", "croston"], "rungs": 2})
+    assert cfg.families == ("theta", "croston") and cfg.rungs == 2
+
+
+# ---------------------------------------------------------------------------
+# AutoML sweep
+# ---------------------------------------------------------------------------
+
+_CV = CVConfig(initial=540, period=90, horizon=30)
+
+
+@pytest.mark.slow
+def test_rung_ranking_matches_full_selection():
+    """Early rungs (series subset, last-N cutoffs) must rank the clearly
+    separable pair the same way the full-batch selection does."""
+    batch = _mixed_batch(n_series=8, seed=7)
+    cfg = AutoMLConfig(
+        enabled=True, families=("theta", "croston"), rungs=2,
+        base_series=4, base_cutoffs=1, budget_device_seconds=600.0)
+    res = successive_halving_select(batch, config=cfg, cv=_CV)
+    assert not res.budget_exhausted
+    assert res.survivors == ("theta",)
+
+    rung0 = res.leaderboard[res.leaderboard.rung == 0]
+    rank_rung = rung0.sort_values("mean_smape").family.tolist()
+    full = select_model(batch, models=("theta", "croston"), cv=_CV)
+    full_means = full.scores.mean(axis=0)
+    rank_full = full_means.sort_values().index.tolist()
+    assert rank_rung == rank_full == ["theta", "croston"]
+
+    # the final pass assigns per series; theta dominates this data
+    assert res.selection.counts().get("theta", 0) >= 6
+    assert res.spent_device_seconds > 0.0
+
+
+@pytest.mark.slow
+def test_budget_gate_halts_launches():
+    batch = _mixed_batch(n_series=6, seed=8)
+    cfg = AutoMLConfig(
+        enabled=True, families=("theta", "croston"), rungs=3,
+        base_series=4, base_cutoffs=1, budget_device_seconds=1e-6)
+    res = successive_halving_select(batch, config=cfg, cv=_CV)
+    assert res.budget_exhausted
+    # the gate closes after the first eval: one leaderboard row per family
+    # at most, and never the full rung ladder
+    assert len(res.leaderboard) <= len(cfg.families)
+    # best-so-far family broadcast uniformly
+    assert len(set(res.selection.chosen.tolist())) == 1
+    assert res.selection.assignment.shape == (6,)
+
+
+# ---------------------------------------------------------------------------
+# PR-8 conformal path
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_arnet_rides_conformal_calibration():
+    batch = _ar_batch(n_series=3, n_time=760, seed=9)
+    cfg = ArnetConfig(lags=5, epochs=5, seed=0)
+    out = cross_validate(batch, model="arnet", config=cfg, cv=_CV,
+                         calibrate=True)
+    scale = np.asarray(out["_interval_scale"])
+    assert scale.shape == (3,)
+    assert np.isfinite(scale).all() and (scale > 0).all()
+    assert np.isfinite(np.asarray(out["smape"])).all()
