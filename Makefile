@@ -25,6 +25,7 @@ tsan:
 	  DFTPU_FAILPOINTS_SEED=42 \
 	  python -m pytest tests/unit/test_batcher.py tests/unit/test_ingest.py \
 	    tests/unit/test_forecast_cache.py tests/unit/test_fleet.py \
+	    tests/unit/test_dataplane.py \
 	    -q -m 'not slow' -p no:cacheprovider
 	# own process, NOT instrumented: these tests arm/reset the sanitizer
 	# themselves, which would wipe the recorder the run above is filling

@@ -7,6 +7,11 @@ from distributed_forecasting_tpu.serving.batcher import (
     ShuttingDownError,
 )
 from distributed_forecasting_tpu.serving.bucketed import BucketedForecaster
+from distributed_forecasting_tpu.serving.dataplane import (
+    ConnectionPool,
+    HttpConfig,
+    PooledHTTPServer,
+)
 from distributed_forecasting_tpu.serving.ensemble import (
     BlendedForecaster,
     MultiModelForecaster,
@@ -38,11 +43,14 @@ __all__ = [
     "MultiModelForecaster",
     "BlendedForecaster",
     "CacheConfig",
+    "ConnectionPool",
     "FleetConfig",
     "FleetSupervisor",
     "ForecastCache",
     "ForecastServer",
     "FrontDoorServer",
+    "HttpConfig",
+    "PooledHTTPServer",
     "QueueFullError",
     "RequestBatcher",
     "ServingMetrics",

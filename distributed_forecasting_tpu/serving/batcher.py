@@ -105,6 +105,10 @@ class ServingMetrics:
             "forecaster predict calls (coalesced device dispatches)")
         self.queue_depth = r.gauge(
             "serving_queue_depth", "requests waiting in the batching queue")
+        self.http_workers_busy = r.gauge(
+            "dftpu_http_workers_busy",
+            "HTTP pool workers currently handling a request (fleet mode: "
+            "summed across replicas — per-replica busy counts are additive)")
         self.latency = r.histogram(
             "serving_request_latency_seconds", _LATENCY_BUCKETS,
             "request latency, parse to response")

@@ -38,14 +38,22 @@ import subprocess
 import sys
 import threading
 import time
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
 from typing import Callable, List, Optional
 
 from distributed_forecasting_tpu.monitoring.failpoints import failpoint
 from distributed_forecasting_tpu.monitoring import sanitizer
 from distributed_forecasting_tpu.monitoring.monitor import MetricsRegistry
 from distributed_forecasting_tpu.monitoring.trace import get_tracer
+from distributed_forecasting_tpu.serving.dataplane import (
+    ConnectionPool,
+    HttpConfig,
+    KeepAliveHandlerMixin,
+    PooledHTTPServer,
+    pooled_get,
+)
 from distributed_forecasting_tpu.serving.resilience import (
+    OPEN,
     CircuitBreaker,
     LatencyReservoir,
     ResilienceConfig,
@@ -123,7 +131,17 @@ def _free_port(host: str) -> int:
         return s.getsockname()[1]
 
 
-def _probe_ready(host: str, port: int, timeout: float) -> bool:
+def _probe_ready(host: str, port: int, timeout: float,
+                 pool: Optional[ConnectionPool] = None) -> bool:
+    """One /readyz probe.  With a pool the probe rides (and health-checks)
+    the same keep-alive sockets the forward path reuses; without one it
+    dials fresh (boot-time callers that predate the supervisor's pool)."""
+    if pool is not None:
+        try:
+            status, _ = pooled_get(pool, host, port, "/readyz", timeout)
+            return status == 200
+        except (OSError, http.client.HTTPException):
+            return False
     conn = http.client.HTTPConnection(host, port, timeout=timeout)
     try:
         conn.request("GET", "/readyz")
@@ -134,7 +152,14 @@ def _probe_ready(host: str, port: int, timeout: float) -> bool:
         conn.close()
 
 
-def _fetch(host: str, port: int, path: str, timeout: float) -> Optional[bytes]:
+def _fetch(host: str, port: int, path: str, timeout: float,
+           pool: Optional[ConnectionPool] = None) -> Optional[bytes]:
+    if pool is not None:
+        try:
+            status, body = pooled_get(pool, host, port, path, timeout)
+            return body if status == 200 else None
+        except (OSError, http.client.HTTPException):
+            return None
     conn = http.client.HTTPConnection(host, port, timeout=timeout)
     try:
         conn.request("GET", path)
@@ -225,6 +250,9 @@ _GAUGE_SUM_MERGE = frozenset({
     "dftpu_anomaly_last_batch_flagged",
     "dftpu_cache_bytes",
     "dftpu_cache_entries",
+    # per-replica busy worker counts are additive: the fleet-level signal
+    # is total in-flight handler occupancy across the worker pools
+    "dftpu_http_workers_busy",
     # a fraction per replica, but summing is the HISTORICAL contract the
     # cost tests pin (callers divide by replica count downstream)
     "dftpu_cost_device_saturation",
@@ -463,6 +491,9 @@ def default_spawn_fn(
             # (WAL apply/refit) — no cross-replica fan-out needed because a
             # shard's writes only ever land at its owners
             "cache": serving_conf.get("cache"),
+            # HTTP data plane: one serving.http block tunes keep-alive,
+            # worker-pool size and idle timeout on replica AND front door
+            "http": serving_conf.get("http"),
             # series partition: the child subsets its forecaster/WAL to
             # these shards and follows only their wal_dir/shard-<k>/ logs
             "sharding": (None if sharding is None
@@ -500,10 +531,12 @@ class FleetSupervisor:
                  sharding: Optional[ShardingConfig] = None,
                  key_names: Optional[tuple] = None,
                  resilience: Optional[ResilienceConfig] = None,
-                 request_timeout_s: Optional[float] = None):
+                 request_timeout_s: Optional[float] = None,
+                 http: Optional[HttpConfig] = None):
         self._config = config
         self._spawn = spawn_fn
         self.resilience = resilience or ResilienceConfig()
+        self.http = http or HttpConfig()
         # satellite of the deadline work: every forwarded leg gets an
         # explicit timeout bounded by the replica's own request timeout
         # (plus slack for transport), so a hung socket can no longer pin
@@ -542,6 +575,10 @@ class FleetSupervisor:
                     sharding.quota_rps, sharding.quota_burst)
         self.logger = get_logger("FleetSupervisor")
         self.registry = MetricsRegistry()
+        # keep-alive connections to replicas, shared by every forward/
+        # scatter/health leg; its dftpu_http_pool_* counters land on this
+        # registry and ride the front door's /metrics exposition
+        self.pool = ConnectionPool(self.http, registry=self.registry)
         self._g_total = self.registry.gauge(
             "fleet_replicas_total", "replicas the supervisor manages")
         self._g_ready = self.registry.gauge(
@@ -679,8 +716,11 @@ class FleetSupervisor:
     # -- front-door feedback ------------------------------------------------
     def report_failure(self, port: int) -> None:
         """A connection-level forward failure: stop routing to this replica
-        until the next successful health probe flips it back."""
+        until the next successful health probe flips it back.  Its pooled
+        idle connections drain too — they point at a peer that just proved
+        unreliable, and a later checkout must dial (and re-verify) fresh."""
         self._c_conn_failures.inc()
+        self.pool.drain(self._config.replica_host, port)
         with self._lock:
             for r in self._replicas:
                 if r.port == port:
@@ -726,6 +766,11 @@ class FleetSupervisor:
         if br is not None:
             br.record_failure()
             self._g_breaker.set(br.state, port=str(port))
+            if br.state == OPEN:
+                # breaker-aware eviction: an ejected replica's idle
+                # keep-alive sockets must not survive into its half-open
+                # probe — the probe decides on a FRESH connection
+                self.pool.drain(self._config.replica_host, port)
 
     def request_deadline(self, headers) -> Optional[float]:
         """Monotonic deadline for an incoming request (header or conf
@@ -844,7 +889,12 @@ class FleetSupervisor:
         for rep, proc, port in snapshot:
             alive = proc is not None and proc.poll() is None
             ready = alive and _probe_ready(cfg.replica_host, port,
-                                           cfg.probe_timeout_s)
+                                           cfg.probe_timeout_s,
+                                           pool=self.pool)
+            if not alive:
+                # a dead replica's pooled sockets are dead too; drop them
+                # before the restart brings a new process up on the port
+                self.pool.drain(cfg.replica_host, port)
             observed.append((rep, alive, ready))
         now = time.monotonic()
         to_restart = []
@@ -913,6 +963,13 @@ class FleetSupervisor:
                 proc.kill()
             except OSError:
                 pass
+        with self._lock:
+            port = next((r.port for r in self._replicas
+                         if r.index == int(index)), None)
+        if port is not None:
+            # pooled keep-alive sockets into the killed process would fail
+            # on next reuse; drop them now so forwards dial the restart
+            self.pool.drain(self._config.replica_host, port)
 
     def resize(self, replicas: int) -> None:
         """Grow or shrink the replica set and rebalance shard ownership.
@@ -1006,6 +1063,9 @@ class FleetSupervisor:
             for r in self._replicas:
                 r.ready = False
         self._g_ready.set(0)
+        # close idle keep-alive sockets BEFORE the SIGTERMs: a drain must
+        # not leave half-open connections for the replicas to time out on
+        self.pool.close()
         for proc in procs:
             if proc is not None and proc.poll() is None:
                 try:
@@ -1034,7 +1094,7 @@ class _DeadlineExhausted(Exception):
     (shed, not "no ready replica")."""
 
 
-class _FrontDoorHandler(BaseHTTPRequestHandler):
+class _FrontDoorHandler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
     server_version = "dftpu-fleet/1.0"
 
     def log_message(self, fmt, *args):
@@ -1101,7 +1161,7 @@ class _FrontDoorHandler(BaseHTTPRequestHandler):
             # every live replica contributes, ready or not (a draining
             # replica's counters still belong in the fleet totals)
             payload = _fetch(cfg.replica_host, port, "/metrics",
-                             cfg.probe_timeout_s)
+                             cfg.probe_timeout_s, pool=sup.pool)
             if payload is not None:
                 texts.append(payload.decode())
         body = (aggregate_prometheus(texts) + sup.render_metrics()).encode()
@@ -1119,22 +1179,35 @@ class _FrontDoorHandler(BaseHTTPRequestHandler):
         # OSError takes the callers' report-failure-and-retry path, an
         # injected sleep models a hung socket against the leg timeout
         failpoint("fleet.forward")
-        conn = http.client.HTTPConnection(
-            host, port, timeout=sup.leg_timeout_s(deadline))
-        try:
-            headers = {"Content-Type": self.headers.get(
-                "Content-Type", "application/json")} if body is not None else {}
-            rem = remaining_ms(deadline)
-            if rem is not None:
-                # the remaining budget travels downstream; a replica that
-                # receives <= 0 sheds before dispatch (serving/server.py)
-                headers["X-Deadline-Ms"] = str(int(rem))
-            conn.request(method, self.path, body=body, headers=headers)
-            resp = conn.getresponse()
+        timeout = sup.leg_timeout_s(deadline)
+        headers = {"Content-Type": self.headers.get(
+            "Content-Type", "application/json")} if body is not None else {}
+        rem = remaining_ms(deadline)
+        if rem is not None:
+            # the remaining budget travels downstream; a replica that
+            # receives <= 0 sheds before dispatch (serving/server.py)
+            headers["X-Deadline-Ms"] = str(int(rem))
+        for attempt in (0, 1):
+            conn, reused = sup.pool.acquire(host, port, timeout)
+            try:
+                conn.request(method, self.path, body=body, headers=headers)
+                resp = conn.getresponse()
+                payload = resp.read()
+            except (OSError, http.client.HTTPException):
+                sup.pool.discard(conn)
+                if reused and attempt == 0:
+                    # the half-closed keep-alive race (replica restarted or
+                    # reaped the idle socket a beat before us), not a sick
+                    # replica: retry ONCE on a guaranteed-fresh connection
+                    # so the race never becomes a client-visible failure.
+                    # predict is idempotent, so the replay is safe.
+                    continue
+                raise
+            # a response the server is about to close (HTTP/1.0 replica,
+            # Connection: close) is not reusable; everything else is
+            sup.pool.release(conn, healthy=not resp.will_close)
             return resp.status, resp.getheader(
-                "Content-Type", "application/json"), resp.read()
-        finally:
-            conn.close()
+                "Content-Type", "application/json"), payload
 
     # -- routed dispatch (sharded fleets) ------------------------------------
 
@@ -1156,7 +1229,7 @@ class _FrontDoorHandler(BaseHTTPRequestHandler):
         cfg = sup.config
         for port in sup.rotation():
             payload = _fetch(cfg.replica_host, port, "/schema",
-                             cfg.probe_timeout_s)
+                             cfg.probe_timeout_s, pool=sup.pool)
             if payload is None:
                 continue
             try:
@@ -1476,12 +1549,17 @@ class _FrontDoorHandler(BaseHTTPRequestHandler):
         )
 
 
-class FrontDoorServer(ThreadingHTTPServer):
-    daemon_threads = True
-    request_queue_size = 128  # match ForecastServer's burst posture
+class FrontDoorServer(PooledHTTPServer):
+    # keep-alive, TCP_NODELAY, backlog and the bounded worker pool come
+    # from PooledHTTPServer — same serving.http block as the replicas.
+    # No busy gauge here: the replicas already register
+    # dftpu_http_workers_busy, and the front door's /metrics aggregates
+    # their expositions — a second registration would duplicate the family.
 
-    def __init__(self, addr, supervisor: FleetSupervisor):
-        super().__init__(addr, _FrontDoorHandler)
+    def __init__(self, addr, supervisor: FleetSupervisor,
+                 http: Optional[HttpConfig] = None):
+        super().__init__(addr, _FrontDoorHandler,
+                         http=http if http is not None else supervisor.http)
         self.supervisor = supervisor
         self.logger = get_logger("FrontDoor")
 
@@ -1523,6 +1601,10 @@ def start_fleet(
     batching = (serving_conf or {}).get("batching") or {}
     if batching.get("request_timeout_s") is not None:
         request_timeout_s = float(batching["request_timeout_s"])
+    # one serving.http block tunes the whole data plane: the supervisor's
+    # outbound keep-alive pool, the front door's worker pool, and (via
+    # default_spawn_fn's pass-through) every replica's server
+    http = HttpConfig.from_conf((serving_conf or {}).get("http"))
     if spawn_fn is None:
         if artifact_dir is None:
             raise ValueError(
@@ -1534,13 +1616,14 @@ def start_fleet(
     supervisor = FleetSupervisor(config, spawn_fn, sharding=sharding,
                                  key_names=key_names,
                                  resilience=resilience,
-                                 request_timeout_s=request_timeout_s)
+                                 request_timeout_s=request_timeout_s,
+                                 http=http)
     supervisor.start()
     if wait and not supervisor.wait_ready(min_ready=1):
         supervisor.stop()
         raise RuntimeError(
             f"no replica became ready within {config.ready_timeout_s}s")
-    front = FrontDoorServer((front_host, front_port), supervisor)
+    front = FrontDoorServer((front_host, front_port), supervisor, http=http)
     t = threading.Thread(target=front.serve_forever, daemon=True)
     t.start()
     supervisor.logger.info(

@@ -206,10 +206,14 @@ class _Entry:
     any lock, so invalidation can never tear a frame a read is mid-way
     through.  ``memo`` caches assembled request frames by series-index
     key; it is epoch-private (dies with the entry at invalidation) and
-    its dict get/set are GIL-atomic, so no lock guards it."""
+    its dict get/set are GIL-atomic, so no lock guards it.  ``body_memo``
+    is the serialized-response byte cache — final encoded HTTP bodies by
+    the same series-index key — with the identical epoch-private
+    lifecycle: an epoch bump drops the entry and every memoized body with
+    it, so stale bytes are impossible by construction."""
 
     __slots__ = ("sig", "epoch", "day1", "ds", "columns", "values",
-                 "built_at", "nbytes", "memo")
+                 "built_at", "nbytes", "memo", "body_memo")
 
     def __init__(self, sig, epoch, day1, ds, columns, values, built_at):
         self.sig = sig            # (horizon, quantile tuple | None)
@@ -221,6 +225,7 @@ class _Entry:
         self.built_at = built_at  # monotonic clock
         self.nbytes = int(values.nbytes) + int(ds.nbytes)
         self.memo: Dict[bytes, pd.DataFrame] = {}
+        self.body_memo: Dict[bytes, bytes] = {}
 
 
 class ForecastCache:
@@ -267,16 +272,54 @@ class ForecastCache:
         None to fall through to the dispatch path.  Raises exactly what the
         dispatch path would for unknown series, so the HTTP status story is
         identical on both paths."""
-        if not self.config.enabled:
+        entry, sidx = self._lookup_entry(frame, horizon, include_history,
+                                         quantiles, on_missing, xreg)
+        if entry is None:
             return None
+        return self._gather(entry, sidx)
+
+    def lookup_response(self, frame: pd.DataFrame, horizon: int,
+                        include_history: bool, quantiles, on_missing: str,
+                        xreg, encode) -> Optional[bytes]:
+        """Serve the final ENCODED response body from the cache, or return
+        None to fall through to dispatch — the transport-level sibling of
+        :meth:`lookup` for handlers that would immediately serialize the
+        frame anyway.  ``encode(frame) -> bytes`` is the caller's own
+        serializer (the server passes its ``_encode_predictions``), run at
+        most once per (entry, series subset): repeat hits return memoized
+        bytes and skip frame assembly AND json encoding.  Same admission,
+        metrics, epoch and UnknownSeriesError story as :meth:`lookup`;
+        the memo dies with its entry on every epoch bump, so a stale body
+        can never outlive the state it was encoded from."""
+        entry, sidx = self._lookup_entry(frame, horizon, include_history,
+                                         quantiles, on_missing, xreg)
+        if entry is None:
+            return None
+        memo_key = sidx.tobytes()
+        body = entry.body_memo.get(memo_key)
+        if body is None:
+            body = encode(self._gather(entry, sidx))
+            if len(entry.body_memo) < _FRAME_MEMO_MAX:
+                entry.body_memo[memo_key] = body
+        return body
+
+    def _lookup_entry(self, frame, horizon, include_history, quantiles,
+                      on_missing, xreg):
+        """The shared read path behind :meth:`lookup` and
+        :meth:`lookup_response`: admission checks, series resolution, the
+        epoch-checked entry fetch (with inline cold rebuild) and all
+        hit/miss metrics.  Returns ``(entry, sidx)`` on a current-epoch
+        hit, ``(None, None)`` on any miss or bypass."""
+        if not self.config.enabled:
+            return None, None
         if xreg is not None or include_history:
             self.metrics.misses.inc(reason="bypass")
-            return None
+            return None, None
         if quantiles is not None:
             quantiles = canonical_quantiles(quantiles)
             if quantiles not in self.config.quantile_sets:
                 self.metrics.misses.inc(reason="bypass")
-                return None
+                return None, None
         sig = (int(horizon), quantiles)
         with get_tracer().span("cache.lookup", horizon=int(horizon),
                                quantiles=len(quantiles or ())) as span:
@@ -287,7 +330,7 @@ class ForecastCache:
                 # rare enough to just dispatch
                 span.set_attribute("outcome", "bypass")
                 self.metrics.misses.inc(reason="bypass")
-                return None
+                return None, None
             entry, reason = self._current_entry(sig)
             if entry is None and reason == "cold":
                 entry = self._rebuild_for_miss(sig)
@@ -296,10 +339,10 @@ class ForecastCache:
             if entry is None:
                 span.set_attribute("outcome", reason)
                 self.metrics.misses.inc(reason=reason)
-                return None
+                return None, None
             span.set_attribute("outcome", "hit")
             self.metrics.hits.inc()
-            return self._gather(entry, sidx)
+            return entry, sidx
 
     def _current_entry(self, sig):
         """(entry, miss_reason): the resident entry iff its epoch is the

@@ -157,6 +157,12 @@ def main(argv=None) -> None:
                         mesh_devices)
 
     batching = BatchingConfig.from_conf(conf.get("batching"))
+    from distributed_forecasting_tpu.serving.dataplane import HttpConfig
+
+    # the serving.http data-plane block (keep-alive, worker pool, idle
+    # timeout) — parsed fail-fast here exactly like batching, so a typo'd
+    # key kills the replica at boot instead of silently serving defaults
+    http = HttpConfig.from_conf(conf.get("http"))
     mon_conf = conf.get("monitoring")
     quality = None
     if mon_conf:
@@ -282,6 +288,7 @@ def main(argv=None) -> None:
         anomaly=anomaly,
         extra_metrics=shard_metrics,
         cache=cache,
+        http=http,
     )
     sizes = conf.get("warmup_sizes")
     if sizes:

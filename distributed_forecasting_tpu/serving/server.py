@@ -67,7 +67,7 @@ import threading
 import time
 import urllib.parse
 from concurrent.futures import TimeoutError as _FutureTimeoutError
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
 from typing import Optional
 
 import numpy as np
@@ -85,6 +85,11 @@ from distributed_forecasting_tpu.serving.batcher import (
     RequestBatcher,
     ServingMetrics,
     ShuttingDownError,
+)
+from distributed_forecasting_tpu.serving.dataplane import (
+    HttpConfig,
+    KeepAliveHandlerMixin,
+    PooledHTTPServer,
 )
 from distributed_forecasting_tpu.serving.ensemble import (
     BlendedForecaster,
@@ -128,6 +133,24 @@ def resolve_from_registry(registry, model_name: str, stage: Optional[str] = None
     return load_forecaster(sub if os.path.isdir(sub) else version.artifact_dir), version
 
 
+def _encode_predictions(out: pd.DataFrame, key_names) -> bytes:
+    """A forecast frame -> the exact ``/invocations`` 200 response body.
+
+    One function on purpose: the dispatch path encodes through it AND the
+    byte cache (``ForecastCache.lookup_response``) memoizes its output, so
+    cached bytes are byte-identical to encode-on-read by construction —
+    there is no second serializer to drift.  The shallow copy keeps the
+    ``ds`` stringification off the caller's (possibly cached) frame."""
+    out = out.copy(deep=False)
+    out["ds"] = out["ds"].astype(str)
+    keys = list(key_names)
+    n_series = int(out[keys].drop_duplicates().shape[0]) if len(out) else 0
+    return json.dumps({
+        "predictions": out.to_dict(orient="records"),
+        "n_series": n_series,
+    }).encode()
+
+
 def _safe_trace_id(raw: Optional[str]) -> Optional[str]:
     """Accept a client-supplied X-Trace-Id only when it is a sane token —
     a hostile header must not ride into log files or dump names."""
@@ -139,17 +162,20 @@ def _safe_trace_id(raw: Optional[str]) -> Optional[str]:
     return None
 
 
-class _Handler(BaseHTTPRequestHandler):
+class _Handler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
     server_version = "dftpu-serve/1.0"
 
-    # per-request trace state (assigned before _invoke; BaseHTTPRequestHandler
-    # instances are per-connection, so these are not shared across requests)
+    # per-connection trace state, reset per request in do_POST/do_GET
+    # (with keep-alive one handler instance now serves many requests)
     _trace_id: Optional[str] = None
     _status: int = 0
 
     # the forecaster and metadata ride on the server object
     def _send(self, code: int, payload: dict, extra_headers=()) -> None:
-        body = json.dumps(payload).encode()
+        self._send_bytes(code, json.dumps(payload).encode(),
+                         extra_headers=extra_headers)
+
+    def _send_bytes(self, code: int, body: bytes, extra_headers=()) -> None:
         self._status = code
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
@@ -167,6 +193,9 @@ class _Handler(BaseHTTPRequestHandler):
         self.server.logger.info("%s " + fmt, self.address_string(), *args)
 
     def do_GET(self):
+        # a keep-alive connection reuses this handler instance: a trace id
+        # from an earlier POST must not echo onto an unrelated GET
+        self._trace_id = None
         fc = self.server.forecaster
         parsed = urllib.parse.urlsplit(self.path)
         if parsed.path == "/healthz":
@@ -419,24 +448,39 @@ class _Handler(BaseHTTPRequestHandler):
                                   "interval (0.001, 0.999)"},
                     )
                     return
+            include_history = bool(req.get("include_history", False))
+            on_missing = req.get("on_missing", "raise")
+            key_names = self.server.forecaster.key_names
+            if self.server.cache is not None:
+                # serialized-response fast path: a current-epoch hit skips
+                # frame assembly AND json.dumps — the memoized bytes were
+                # produced by the same _encode_predictions as the dispatch
+                # path below, so the response is byte-identical either way
+                body = self.server.cache.lookup_response(
+                    frame,
+                    horizon=horizon,
+                    include_history=include_history,
+                    quantiles=quantiles,
+                    on_missing=on_missing,
+                    xreg=xreg,
+                    encode=lambda f: _encode_predictions(f, key_names),
+                )
+                if body is not None:
+                    self._send_bytes(200, body)
+                    return
             out = self.server.execute(
                 frame,
                 horizon=horizon,
-                include_history=bool(req.get("include_history", False)),
+                include_history=include_history,
                 quantiles=quantiles,
-                on_missing=req.get("on_missing", "raise"),
+                on_missing=on_missing,
                 xreg=xreg,
+                # the byte lookup above already consulted (and counted) the
+                # cache; a second frame-level lookup would double the miss
+                # metrics and re-race the same epoch check
+                use_cache=False,
             )
-            out["ds"] = out["ds"].astype(str)
-            keys = list(self.server.forecaster.key_names)
-            n_series = int(out[keys].drop_duplicates().shape[0]) if len(out) else 0
-            self._send(
-                200,
-                {
-                    "predictions": out.to_dict(orient="records"),
-                    "n_series": n_series,
-                },
-            )
+            self._send_bytes(200, _encode_predictions(out, key_names))
         except UnknownSeriesError as e:
             self._send(404, {"error": str(e)})
         except QueueFullError as e:
@@ -630,13 +674,10 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(500, {"error": f"{type(e).__name__}: {e}"})
 
 
-class ForecastServer(ThreadingHTTPServer):
-    daemon_threads = True
-    # socketserver's default listen backlog is 5 — a burst of concurrent
-    # clients (the exact traffic micro-batching exists for) gets connection
-    # resets before a handler ever runs.  128 matches the admission-control
-    # story: shedding load is the batcher's 429, not the kernel's RST.
-    request_queue_size = 128
+class ForecastServer(PooledHTTPServer):
+    # listen backlog, worker pool, keep-alive and TCP_NODELAY all come
+    # from PooledHTTPServer + the serving.http conf block — shedding load
+    # stays the batcher's 429, not the kernel's RST
 
     def __init__(
         self,
@@ -649,12 +690,14 @@ class ForecastServer(ThreadingHTTPServer):
         extra_metrics=None,
         anomaly=None,
         cache=None,
+        http: Optional[HttpConfig] = None,
     ):
-        super().__init__(addr, _Handler)
+        super().__init__(addr, _Handler, http=http)
         self.forecaster = forecaster
         self.model_version = model_version
         self.logger = get_logger("ForecastServer")
         self.metrics = ServingMetrics()
+        self.busy_gauge = self.metrics.http_workers_busy
         self.batching = batching
         # extra exposition appended to GET /metrics — any object with a
         # ``render() -> str`` (sharded replicas attach their per-shard
@@ -728,6 +771,7 @@ class ForecastServer(ThreadingHTTPServer):
         quantiles,
         on_missing: str,
         xreg,
+        use_cache: bool = True,
     ):
         """Run one parsed /invocations request — through the coalescer when
         batching is on, as a direct forecaster call otherwise (both paths
@@ -735,8 +779,11 @@ class ForecastServer(ThreadingHTTPServer):
         coalescing story in either mode).  The materialized cache gets
         first refusal: a current-epoch hit is a row gather (no dispatch,
         no batch metrics — it genuinely wasn't one); a None is a miss or
-        an inadmissible request and takes the dispatch path below."""
-        if self.cache is not None:
+        an inadmissible request and takes the dispatch path below.
+        ``use_cache=False`` skips that refusal — the HTTP handler passes it
+        after its own byte-level lookup already consulted (and counted)
+        the cache for this request."""
+        if use_cache and self.cache is not None:
             cached = self.cache.lookup(
                 frame,
                 horizon=horizon,
@@ -821,6 +868,7 @@ def start_server(
     extra_metrics=None,
     anomaly=None,
     cache=None,
+    http: Optional[HttpConfig] = None,
 ) -> ForecastServer:
     """Start serving on a background thread; returns the server (its
     ``server_address[1]`` is the bound port — port=0 picks a free one).
@@ -829,7 +877,7 @@ def start_server(
     srv = ForecastServer((host, port), forecaster, model_version, batching,
                          quality=quality, ingest=ingest,
                          extra_metrics=extra_metrics, anomaly=anomaly,
-                         cache=cache)
+                         cache=cache, http=http)
     if ready:
         srv.mark_ready()
     t = threading.Thread(target=srv.serve_forever, daemon=True)
@@ -847,10 +895,11 @@ def serve(
     ingest=None,
     anomaly=None,
     cache=None,
+    http: Optional[HttpConfig] = None,
 ) -> None:
     srv = ForecastServer((host, port), forecaster, model_version, batching,
                          quality=quality, ingest=ingest, anomaly=anomaly,
-                         cache=cache)
+                         cache=cache, http=http)
     srv.mark_ready()
     srv.logger.info("serving on %s:%d", host, port)
     srv.serve_forever()

@@ -72,6 +72,11 @@ class FleetTask(Task):
             fleet = dataclasses.replace(fleet, enabled=True)
         # fail on a batching typo in milliseconds, before artifact resolution
         BatchingConfig.from_conf(conf.get("batching"))
+        # same discipline for the data-plane block (start_fleet re-parses
+        # it, but this fails before the registry load does any work)
+        from distributed_forecasting_tpu.serving.dataplane import HttpConfig
+
+        HttpConfig.from_conf(conf.get("http"))
         # strict parse: a typo'd sharding key fails here, not as a fleet
         # that silently serves unpartitioned
         sharding = ShardingConfig.from_conf(conf.get("sharding"))

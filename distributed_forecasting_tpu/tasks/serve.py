@@ -110,6 +110,7 @@ from distributed_forecasting_tpu.monitoring.trace import (
     configure_tracing,
 )
 from distributed_forecasting_tpu.serving.batcher import BatchingConfig
+from distributed_forecasting_tpu.serving.dataplane import HttpConfig
 from distributed_forecasting_tpu.serving.forecast_cache import (
     CacheConfig,
     build_forecast_cache,
@@ -129,6 +130,7 @@ class ServeTask(Task):
         batching = BatchingConfig.from_conf(conf.get("batching"))
         tracing = TraceConfig.from_conf(conf.get("tracing"))
         CacheConfig.from_conf(conf.get("cache"))  # fail-fast on typos
+        http = HttpConfig.from_conf(conf.get("http"))  # fail-fast on typos
         configure_tracing(tracing)
         forecaster, version = resolve_from_registry(self.registry, name, stage=stage)
         env = self.conf.get("env", {})
@@ -203,6 +205,7 @@ class ServeTask(Task):
             ingest=ingest,
             anomaly=anomaly,
             cache=cache,
+            http=http,
         )
 
     def _build_ingest(self, ingest_conf, forecaster, version, quality, env):

@@ -59,6 +59,7 @@ import sys
 import tempfile
 import threading
 import time
+import urllib.error
 import urllib.request
 
 
@@ -66,14 +67,58 @@ def _call(port: int, payload: dict) -> bytes:
     return _post(port, "/invocations", payload)
 
 
+# Keep-alive client connections, one per (thread, port): the servers speak
+# HTTP/1.1 now (serving/dataplane.py), so the bench must NOT pay a TCP
+# handshake per request or it measures its own client overhead instead of
+# the data plane under test.
+_conn_local = threading.local()
+
+
+def _client_conn(port: int):
+    import http.client
+
+    conns = getattr(_conn_local, "conns", None)
+    if conns is None:
+        conns = _conn_local.conns = {}
+    conn = conns.get(port)
+    if conn is None:
+        conn = conns[port] = http.client.HTTPConnection(
+            "127.0.0.1", port, timeout=120)
+    return conn
+
+
+def _drop_client_conn(port: int) -> None:
+    conn = getattr(_conn_local, "conns", {}).pop(port, None)
+    if conn is not None:
+        conn.close()
+
+
 def _post(port: int, path: str, payload: dict) -> bytes:
-    req = urllib.request.Request(
-        f"http://127.0.0.1:{port}{path}",
-        data=json.dumps(payload).encode(),
-        headers={"Content-Type": "application/json"},
-    )
-    with urllib.request.urlopen(req, timeout=120) as r:
-        return r.read()
+    import http.client
+
+    body = json.dumps(payload).encode()
+    headers = {"Content-Type": "application/json"}
+    for attempt in (0, 1):
+        conn = _client_conn(port)
+        try:
+            conn.request("POST", path, body=body, headers=headers)
+            resp = conn.getresponse()
+            data = resp.read()
+        except (OSError, http.client.HTTPException):
+            # half-closed keep-alive (idle reap / server restart): retry
+            # once on a fresh connection, then let the error surface
+            _drop_client_conn(port)
+            if attempt == 0:
+                continue
+            raise
+        if resp.status >= 400:
+            _drop_client_conn(port)
+            raise urllib.error.HTTPError(
+                f"http://127.0.0.1:{port}{path}", resp.status,
+                data.decode(errors="replace"), resp.headers, None)
+        if resp.will_close:
+            _drop_client_conn(port)
+        return data
 
 
 def _metrics(port: int) -> str:
@@ -752,6 +797,10 @@ def main() -> None:
                          "the value is the read fraction (default 0.95), "
                          "the rest are interleaved state installs that "
                          "churn invalidation under the identity gate")
+    ap.add_argument("--http-speedup-gate", type=float, default=0.0,
+                    help="with --read-mix: fail unless qps_speedup_http "
+                         "(cached vs dispatch through live HTTP servers) "
+                         "reaches this factor (0 = report-only)")
     ap.add_argument("--fleet-mesh-devices", type=int, default=0,
                     help="shard each replica's predict over a mesh of this "
                          "size (>1; replicas force host devices to match)")
@@ -787,6 +836,10 @@ def main() -> None:
         if out["replica_level"]["identity_failures"]:
             sys.exit(f"{out['replica_level']['identity_failures']} cached "
                      f"read(s) diverged under invalidation churn")
+        if (args.http_speedup_gate
+                and out["qps_speedup_http"] < args.http_speedup_gate):
+            sys.exit(f"qps_speedup_http {out['qps_speedup_http']} below the "
+                     f"--http-speedup-gate {args.http_speedup_gate} bar")
         return
 
     if args.fleet:

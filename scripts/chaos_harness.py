@@ -1,6 +1,6 @@
 """Chaos harness: injected faults -> asserted invariants, reproducibly.
 
-Six scenarios over the failpoint registry (``monitoring/failpoints.py``)
+Seven scenarios over the failpoint registry (``monitoring/failpoints.py``)
 and the degradation layer (``serving/resilience.py``), each a pure
 function returning a result dict and raising AssertionError on a broken
 invariant:
@@ -30,6 +30,12 @@ invariant:
                         boot adopts only cleanly committed frames,
                         discards a torn payload via the sha256 digest,
                         and serves byte-identical forecasts either way.
+  keepalive_kill9_mid_stream
+                        kill a replica while a persistent client connection
+                        streams through the front door's pooled keep-alive
+                        legs; every request still gets a 200 on the SAME
+                        client connection, and the pool evicts the dead
+                        replica's sockets.
 
 Every scenario is deterministic from its seed — a failing run replays
 bit-for-bit.  CI runs the three fast scenarios as the chaos smoke::
@@ -46,6 +52,7 @@ import argparse
 import http.client
 import json
 import os
+import socket
 import subprocess
 import sys
 import tempfile
@@ -236,8 +243,21 @@ def _make_fake_replica(port, delay_s=0.0):
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     class Handler(BaseHTTPRequestHandler):
+        # speak HTTP/1.1 so the supervisor's outbound ConnectionPool can
+        # actually keep legs alive (every response below sets
+        # Content-Length, the 1.1 framing requirement)
+        protocol_version = "HTTP/1.1"
+
         def log_message(self, *a):
             pass
+
+        def setup(self):
+            super().setup()
+            # a real replica death (SIGKILL) severs EVERY socket, not just
+            # the listener; track accepted connections so _FakeProc can do
+            # the same — without this, pooled keep-alive legs into a
+            # "dead" fake replica would keep answering forever
+            self.server.conns.append(self.connection)
 
         def _send(self, code, body):
             self.send_response(code)
@@ -265,6 +285,7 @@ def _make_fake_replica(port, delay_s=0.0):
     srv.daemon_threads = True
     srv.delay_s = delay_s
     srv.hits = 0
+    srv.conns = []
     threading.Thread(target=srv.serve_forever, daemon=True).start()
     return srv
 
@@ -278,6 +299,11 @@ class _FakeProc:
         if self.server is not None:
             self.server.shutdown()
             self.server.server_close()
+            for c in self.server.conns:
+                try:  # sever established keep-alive legs like SIGKILL would
+                    c.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
             self.server = None
 
     def poll(self):
@@ -565,6 +591,66 @@ def cache_kill9_mid_persist(workdir: str, seed: int = 0) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# scenario 7: replica killed mid-keep-alive-stream
+# ---------------------------------------------------------------------------
+
+def keepalive_kill9_mid_stream(workdir: str, seed: int = 0) -> dict:
+    """Kill a replica while a persistent client connection is streaming
+    requests through the front door's pooled keep-alive legs (PR 19 data
+    plane).  Invariants: every request on the surviving CLIENT connection
+    still gets a 200 (the half-closed-leg retry + next-replica retry keep
+    the death invisible), the pool evicts the dead replica's sockets
+    (``dftpu_http_pool_evicted_total`` > 0), and reuse actually happened
+    before the kill (``dftpu_http_pool_reused_total`` > 0 — otherwise this
+    scenario silently degraded to connection-per-leg and proved nothing).
+    """
+    from distributed_forecasting_tpu.serving.resilience import (
+        ResilienceConfig,
+    )
+
+    sup, front, procs = _boot_fake_fleet(ResilienceConfig())
+    host, fport = front.server_address
+    conn = http.client.HTTPConnection(host, fport, timeout=10)
+    try:
+        def stream_one():
+            conn.request("POST", "/invocations", body=b"{}",
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            body = resp.read()
+            assert not resp.will_close, (
+                "front door closed the keep-alive client connection")
+            return resp.status, json.loads(body).get("port")
+
+        statuses = [stream_one() for _ in range(8)]
+        reused_before = int(sup.pool.reused.value)
+        assert reused_before > 0, (
+            "8 round-robin forwards over 2 replicas never reused a pooled "
+            "leg — keep-alive pooling is not engaged")
+        assert {p for _, p in statuses} == set(sup.all_ports()), statuses
+
+        # mid-stream kill: replica 0 dies with pooled legs pointing at it
+        dead_port = procs[0].server.server_address[1]
+        procs[0].kill()
+        statuses += [stream_one() for _ in range(8)]
+
+        assert all(s == 200 for s, _ in statuses), statuses
+        # post-kill traffic converged on the survivor
+        live_port = next(p for p in sup.all_ports() if p != dead_port)
+        assert all(p == live_port for _, p in statuses[-4:]), statuses
+        evicted = int(sup.pool.evicted.value)
+        assert evicted > 0, (
+            "replica death never evicted its pooled connections")
+        render = sup.render_metrics()
+        assert "dftpu_http_pool_evicted_total" in render, render
+        return {"requests": len(statuses), "dead_port": dead_port,
+                "reused_before_kill": reused_before, "evicted": evicted}
+    finally:
+        conn.close()
+        front.shutdown()
+        sup.stop()
+
+
+# ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
 
@@ -575,6 +661,7 @@ SCENARIOS = {
     "slow_replica_brownout": slow_replica_brownout,
     "breaker_trip_recover": breaker_trip_recover,
     "cache_kill9_mid_persist": cache_kill9_mid_persist,
+    "keepalive_kill9_mid_stream": keepalive_kill9_mid_stream,
 }
 
 

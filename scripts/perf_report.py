@@ -140,6 +140,7 @@ def collect(workdir: str, reps: int = 20, expect_warm: bool = False) -> Dict:
             bucket[field] = value
 
     forecast_cache = _cache_section(fc, req, reps)
+    dataplane = _dataplane_section(fc, req, reps)
 
     outcomes = _entry_outcomes(metrics_registry().snapshot())
     misses = sorted(e for e, o in outcomes.items() if o.get("miss"))
@@ -171,6 +172,7 @@ def collect(workdir: str, reps: int = 20, expect_warm: bool = False) -> Dict:
         "autoprep": autoprep,
         "gradfit": gradfit,
         "forecast_cache": forecast_cache,
+        "dataplane": dataplane,
         "output_sha256": hashlib.sha256(
             out.to_csv(index=False).encode()).hexdigest(),
     }
@@ -330,6 +332,73 @@ def _cache_section(fc, req, reps: int) -> Dict:
         "read_p50_ms": round(samples[len(samples) // 2] * 1e3, 3),
         "cached_sha256": hashlib.sha256(
             frame.to_csv(index=False).encode()).hexdigest(),
+    }
+
+
+def _dataplane_section(fc, req, reps: int) -> Dict:
+    """Exercise the serialized-response byte cache plus a live keep-alive
+    HTTP server against the SAME request the timing loop dispatches.
+
+    Three numbers land in the record: the sha of the memoized response
+    body, the sha of an encode-on-read of the same cached frame (the two
+    MUST match — :func:`_diff_dataplane` fails the build on divergence,
+    the transport-level extension of the ``cache_identity`` gate), and the
+    p50 of a cache-hit POST /invocations over ONE persistent HTTP/1.1
+    connection — the number PR 19's pooling work moves, where
+    ``forecast_cache.read_p50_ms`` only sees the row gather."""
+    import http.client
+
+    from distributed_forecasting_tpu.serving import start_server
+    from distributed_forecasting_tpu.serving.dataplane import HttpConfig
+    from distributed_forecasting_tpu.serving.forecast_cache import (
+        build_forecast_cache,
+    )
+    from distributed_forecasting_tpu.serving.server import (
+        _encode_predictions,
+    )
+
+    cache = build_forecast_cache({"enabled": True, "max_horizons": 1}, fc)
+    if cache is None:
+        return {}
+
+    def encode(frame):
+        return _encode_predictions(frame, fc.key_names)
+
+    # miss -> materialize + memoize, then a memo hit, then encode-on-read
+    # of the same cached frame: the memoized bytes must equal a fresh
+    # serialization or the byte cache is drifting from the encoder
+    cache.lookup_response(req, 30, False, None, "raise", None, encode)
+    body = cache.lookup_response(req, 30, False, None, "raise", None, encode)
+    fresh = encode(cache.lookup(req, 30, False, None, "raise", None))
+
+    srv = start_server(fc, cache=cache, http=HttpConfig())
+    port = srv.server_address[1]
+    payload = json.dumps({
+        "inputs": req.to_dict(orient="records"), "horizon": 30}).encode()
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    samples = []
+    http_body = None
+    try:
+        for i in range(reps + 1):
+            t0 = time.perf_counter()
+            conn.request("POST", "/invocations", body=payload,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            http_body = resp.read()
+            if i:                       # first request warms the connection
+                samples.append(time.perf_counter() - t0)
+    finally:
+        conn.close()
+        srv.shutdown()
+        srv.server_close()
+    samples.sort()
+    return {
+        "cached_body_sha256": hashlib.sha256(body).hexdigest(),
+        "encoded_body_sha256": hashlib.sha256(fresh).hexdigest(),
+        "http_body_sha256": hashlib.sha256(http_body).hexdigest(),
+        "byte_identical": bool(body == fresh == http_body),
+        "http_hit_p50_ms": round(samples[len(samples) // 2] * 1e3, 3),
+        "http_keepalive": True,
     }
 
 
@@ -512,6 +581,7 @@ def diff_records(baseline: Dict, current: Dict,
     findings.append(_diff_recompiles(current))
     findings.append(_diff_donation(current))
     findings.append(_diff_cache(current))
+    findings.append(_diff_dataplane(current))
 
     if cold is not None:
         a, b = cold.get("output_sha256"), current.get("output_sha256")
@@ -735,6 +805,36 @@ def _diff_cache(current: Dict) -> Dict:
         f"{sec.get('read_p50_ms')}ms)")
 
 
+def _diff_dataplane(current: Dict) -> Dict:
+    """Assert the serialized-response byte cache serves the encoder's bytes.
+
+    The collect-side section (:func:`_dataplane_section`) memoizes a
+    response body, re-encodes the same cached frame fresh, and reads the
+    request once more through a live keep-alive server; the three byte
+    strings must be identical — a memo that survives an encoder change or
+    an epoch bump would serve stale transport bytes that the frame-level
+    ``cache_identity`` gate can never see."""
+    sec = current.get("dataplane")
+    if not sec:
+        return _finding(
+            "dataplane_identity", "warn",
+            "current record has no dataplane section (collected by an "
+            "older perf_report?); re-collect to assert byte-cache identity")
+    if not sec.get("byte_identical"):
+        return _finding(
+            "dataplane_identity", "fail",
+            f"memoized body {str(sec.get('cached_body_sha256'))[:12]} vs "
+            f"fresh encode {str(sec.get('encoded_body_sha256'))[:12]} vs "
+            f"HTTP read {str(sec.get('http_body_sha256'))[:12]} diverged: "
+            f"the serialized-response cache is not byte-identical to "
+            f"encode-on-read")
+    return _finding(
+        "dataplane_identity", "ok",
+        f"byte cache identical to encode-on-read and the live HTTP "
+        f"response ({str(sec.get('cached_body_sha256'))[:12]}; keep-alive "
+        f"hit p50 {sec.get('http_hit_p50_ms')}ms)")
+
+
 def _pct(bv: float, cv: float) -> str:
     return f"{100.0 * (cv - bv) / bv:+.1f}%" if bv else "n/a"
 
@@ -857,6 +957,10 @@ def _write_bench(path: str, report: Dict, current: Dict,
     if fcache:
         parsed["cache_hit_rate"] = fcache.get("hit_rate")
         parsed["cache_read_p50_ms"] = fcache.get("read_p50_ms")
+    dataplane = current.get("dataplane") or {}
+    if dataplane:
+        parsed["http_hit_p50_ms"] = dataplane.get("http_hit_p50_ms")
+        parsed["dataplane_byte_identical"] = dataplane.get("byte_identical")
     bench = {
         "n": int(m.group(1)) if m else None,
         "cmd": ("python scripts/perf_report.py --baseline PERF_BASELINE.json"

@@ -329,6 +329,14 @@ def _perf_record(p50=5.0, flops=1e6, miss=0, sha="aa11", backend=None,
             "hits": cache_hits, "misses": 1, "hit_rate": 0.8333,
             "read_p50_ms": 0.05, "cached_sha256": cache_sha or sha,
         },
+        "dataplane": {
+            "cached_body_sha256": cache_sha or sha,
+            "encoded_body_sha256": cache_sha or sha,
+            "http_body_sha256": cache_sha or sha,
+            "byte_identical": True,
+            "http_hit_p50_ms": 1.2,
+            "http_keepalive": True,
+        },
         "timings_ms": {"p50": p50},
         "output_sha256": sha,
     }
@@ -343,6 +351,15 @@ def test_perf_sentinel_clean_diff_passes():
     findings = pr.diff_records(_perf_record(), _perf_record(),
                                cold=_perf_record())
     assert set(_levels(findings).values()) == {"ok"}
+
+
+def test_perf_sentinel_fails_on_dataplane_byte_divergence():
+    pr = _load_script("perf_report")
+    rec = _perf_record()
+    rec["dataplane"]["http_body_sha256"] = "ff00"
+    rec["dataplane"]["byte_identical"] = False
+    findings = pr.diff_records(_perf_record(), rec)
+    assert _levels(findings)["dataplane_identity"] == "fail"
 
 
 def test_perf_sentinel_fails_on_injected_cost_regression():

@@ -1,0 +1,522 @@
+"""Serving data plane (serving/dataplane.py + forecast_cache.lookup_response):
+strict ``serving.http`` conf parse, keep-alive connection pooling (reuse
+counted, idle expiry, overflow, half-closed-socket retry with zero errors
+surfaced), breaker/failure-driven pool drains, the bounded worker pool,
+and the serialized-response byte cache — memoized bytes byte-identical to
+encode-on-read and to a live keep-alive server's responses, invalidated
+through the same swap_state epoch choke point as the frame cache.
+"""
+
+import http.client
+import json
+import socket
+import threading
+import time
+
+import pandas as pd
+import pytest
+
+from distributed_forecasting_tpu.serving.dataplane import (
+    ConnectionPool,
+    HttpConfig,
+    KeepAliveHandlerMixin,
+    PooledHTTPServer,
+    pooled_get,
+)
+
+# ---------------------------------------------------------------------------
+# fixtures (mirror test_forecast_cache.py: one theta fit per module)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def theta_fit():
+    import numpy as np  # noqa: F401  (jax platform override first)
+
+    from distributed_forecasting_tpu.data import (
+        synthetic_store_item_sales,
+        tensorize,
+    )
+    from distributed_forecasting_tpu.models import ThetaConfig
+    from distributed_forecasting_tpu.models.base import get_model
+
+    df = synthetic_store_item_sales(n_stores=2, n_items=2, n_days=120,
+                                    seed=13)
+    batch = tensorize(df)
+    cfg = ThetaConfig()
+    params = get_model("theta").fit(batch.y, batch.mask, batch.day, cfg)
+    return batch, params, cfg
+
+
+def _fresh_fc(theta_fit):
+    from distributed_forecasting_tpu.serving import BatchForecaster
+
+    batch, params, cfg = theta_fit
+    return BatchForecaster.from_fit(batch, params, "theta", cfg)
+
+
+def _cache(fc, **over):
+    from distributed_forecasting_tpu.serving.forecast_cache import (
+        build_forecast_cache,
+    )
+
+    conf = {"enabled": True, "quantile_sets": [[0.1, 0.5, 0.9]], **over}
+    cache = build_forecast_cache(conf, fc)
+    assert cache is not None
+    return cache
+
+
+def _req(fc, rows=None):
+    keys = fc.keys if rows is None else fc.keys[rows]
+    return pd.DataFrame(keys, columns=fc.key_names)
+
+
+def _echo_server(http=None):
+    """A minimal keep-alive GET server on a PooledHTTPServer."""
+    from http.server import BaseHTTPRequestHandler
+
+    class Handler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            body = json.dumps(
+                {"port": self.server.server_address[1]}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv = PooledHTTPServer(("127.0.0.1", 0), Handler,
+                           http=http or HttpConfig(workers=2))
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+# ---------------------------------------------------------------------------
+# strict conf
+# ---------------------------------------------------------------------------
+
+
+def test_http_config_strict_parse():
+    cfg = HttpConfig.from_conf(
+        {"keepalive": True, "pool_size": 4, "workers": 3,
+         "idle_timeout_s": 7})
+    assert cfg.pool_size == 4 and cfg.workers == 3
+    assert cfg.idle_timeout_s == 7.0  # int conf value cast to the field type
+    assert HttpConfig.from_conf(None) == HttpConfig()
+    with pytest.raises(ValueError, match="serving.http"):
+        HttpConfig.from_conf({"pool_sizes": 4})  # typo'd key
+    with pytest.raises(ValueError, match="pool_size"):
+        HttpConfig(pool_size=0)
+    with pytest.raises(ValueError, match="workers"):
+        HttpConfig(workers=0)
+    with pytest.raises(ValueError, match="idle_timeout_s"):
+        HttpConfig(idle_timeout_s=0)
+
+
+# ---------------------------------------------------------------------------
+# connection pool
+# ---------------------------------------------------------------------------
+
+
+def test_pool_reuse_counted_and_nodelay():
+    srv = _echo_server()
+    host, port = srv.server_address
+    pool = ConnectionPool(HttpConfig(pool_size=2))
+    try:
+        status, body = pooled_get(pool, host, port, "/x", timeout=5.0)
+        assert status == 200 and json.loads(body)["port"] == port
+        assert int(pool.opened.value) == 1
+        assert pool.idle_count(host, port) == 1
+
+        # second checkout reuses the pooled socket
+        status, _ = pooled_get(pool, host, port, "/x", timeout=5.0)
+        assert status == 200
+        assert int(pool.opened.value) == 1
+        assert int(pool.reused.value) == 1
+
+        # outbound sockets run TCP_NODELAY
+        conn, reused = pool.acquire(host, port, timeout=5.0)
+        assert reused
+        assert conn.sock.getsockopt(
+            socket.IPPROTO_TCP, socket.TCP_NODELAY) != 0
+        pool.release(conn)
+    finally:
+        pool.close()
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_pool_idle_expiry_overflow_and_unhealthy_release():
+    srv = _echo_server()
+    host, port = srv.server_address
+    pool = ConnectionPool(HttpConfig(pool_size=1, idle_timeout_s=0.05))
+    try:
+        pooled_get(pool, host, port, "/x", timeout=5.0)
+        time.sleep(0.1)
+        # the idle socket aged past idle_timeout_s: evicted, dial fresh
+        pooled_get(pool, host, port, "/x", timeout=5.0)
+        assert int(pool.opened.value) == 2
+        assert int(pool.reused.value) == 0
+        assert int(pool.evicted.value) == 1
+
+        # overflow: two checked-out conns, pool_size=1 -> second release
+        # closes
+        c1, _ = pool.acquire(host, port, timeout=5.0)
+        c2, _ = pool.acquire(host, port, timeout=5.0)
+        evicted = int(pool.evicted.value)
+        pool.release(c1)
+        pool.release(c2)
+        assert pool.idle_count(host, port) == 1
+        assert int(pool.evicted.value) == evicted + 1
+
+        # unhealthy release never pools
+        c3, _ = pool.acquire(host, port, timeout=5.0)
+        pool.drain(host, port)
+        pool.release(c3, healthy=False)
+        assert pool.idle_count(host, port) == 0
+    finally:
+        pool.close()
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_pool_keepalive_disabled_never_pools():
+    srv = _echo_server()
+    host, port = srv.server_address
+    pool = ConnectionPool(HttpConfig(keepalive=False))
+    try:
+        pooled_get(pool, host, port, "/x", timeout=5.0)
+        pooled_get(pool, host, port, "/x", timeout=5.0)
+        assert int(pool.opened.value) == 2
+        assert int(pool.reused.value) == 0
+        assert pool.idle_count(host, port) == 0
+    finally:
+        pool.close()
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_half_closed_reused_socket_retried_with_zero_errors():
+    """The half-closed keep-alive race: the SERVER's idle timer reaps the
+    socket while the pool still holds it idle.  The next checkout reuses
+    the dead socket, the request fails, and the retry-once-on-fresh policy
+    makes the race invisible — the caller sees a 200, never an error."""
+    srv = _echo_server(HttpConfig(workers=2, idle_timeout_s=0.2))
+    host, port = srv.server_address
+    pool = ConnectionPool(HttpConfig(pool_size=2, idle_timeout_s=30.0))
+    try:
+        status, _ = pooled_get(pool, host, port, "/x", timeout=5.0)
+        assert status == 200
+        assert pool.idle_count(host, port) == 1
+        time.sleep(0.6)  # server reaps its side; our idle entry survives
+
+        status, body = pooled_get(pool, host, port, "/x", timeout=5.0)
+        assert status == 200 and json.loads(body)["port"] == port
+        assert int(pool.evicted.value) >= 1  # the poisoned conn discarded
+        assert int(pool.opened.value) == 2   # retry dialed fresh
+    finally:
+        pool.close()
+        srv.shutdown()
+        srv.server_close()
+
+
+# ---------------------------------------------------------------------------
+# supervisor integration: failure/breaker events drain the replica's pool
+# ---------------------------------------------------------------------------
+
+
+def _boot_sup(resilience=None):
+    from distributed_forecasting_tpu.serving.fleet import (
+        FleetConfig,
+        start_fleet,
+    )
+    from tests.unit.test_fleet import _FakeProc, _make_fake_replica
+
+    cfg = FleetConfig(
+        enabled=True, replicas=2, health_poll_interval_s=60.0,
+        restart_backoff_s=0.05, drain_timeout_s=1.0, retry_window_s=3.0)
+    procs = {}
+
+    def spawn(index, port):
+        proc = _FakeProc(_make_fake_replica(port))
+        procs[index] = proc
+        return proc
+
+    sup, front = start_fleet(cfg, spawn_fn=spawn, wait=False,
+                             resilience=resilience)
+    sup.poll_once()
+    assert sup.ready_count() == 2
+    return sup, front, procs
+
+
+def _prime_pool(sup, front):
+    """Forward until every replica's pool bucket holds an idle leg."""
+    host = "127.0.0.1"
+    conn = http.client.HTTPConnection(*front.server_address, timeout=10)
+    try:
+        for _ in range(4):
+            conn.request("POST", "/invocations", body=b"{}",
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            resp.read()
+            assert resp.status == 200
+    finally:
+        conn.close()
+    return {p: sup.pool.idle_count(host, p) for p in sup.all_ports()}
+
+
+def test_report_failure_drains_replica_pool():
+    sup, front, _ = _boot_sup()
+    try:
+        idle = _prime_pool(sup, front)
+        port = sup.all_ports()[0]
+        assert idle[port] >= 1, idle
+        sup.report_failure(port)
+        assert sup.pool.idle_count("127.0.0.1", port) == 0
+        # the OTHER replica's pooled legs are untouched
+        other = sup.all_ports()[1]
+        assert sup.pool.idle_count("127.0.0.1", other) == idle[other]
+    finally:
+        front.shutdown()
+        sup.stop()
+
+
+def test_breaker_open_drains_replica_pool():
+    from distributed_forecasting_tpu.serving.resilience import (
+        OPEN,
+        ResilienceConfig,
+    )
+
+    sup, front, _ = _boot_sup(
+        ResilienceConfig(breaker_failures=1, breaker_open_s=60.0))
+    try:
+        idle = _prime_pool(sup, front)
+        port = sup.all_ports()[0]
+        assert idle[port] >= 1, idle
+        sup.breaker_failure(port)  # breaker_failures=1: first failure opens
+        assert sup.breaker_for(port).state == OPEN
+        # breaker-aware eviction: the half-open probe must dial fresh
+        assert sup.pool.idle_count("127.0.0.1", port) == 0
+    finally:
+        front.shutdown()
+        sup.stop()
+
+
+def test_stop_closes_pool():
+    sup, front, _ = _boot_sup()
+    idle = _prime_pool(sup, front)
+    assert sum(idle.values()) >= 1
+    front.shutdown()
+    sup.stop()
+    for p in idle:
+        assert sup.pool.idle_count("127.0.0.1", p) == 0
+
+
+# ---------------------------------------------------------------------------
+# worker pool
+# ---------------------------------------------------------------------------
+
+
+def test_worker_pool_is_bounded_and_drains():
+    srv = _echo_server(HttpConfig(workers=3))
+    host, port = srv.server_address
+    try:
+        assert len(srv._workers) == 3
+        assert all(t.daemon and t.is_alive() for t in srv._workers)
+        # concurrent load over MORE connections than workers still serves
+        # everything (queue + backlog absorb the overage)
+        results = []
+
+        def one():
+            conn = http.client.HTTPConnection(host, port, timeout=10)
+            try:
+                conn.request("GET", "/x")
+                results.append(conn.getresponse().status)
+            finally:
+                conn.close()
+
+        threads = [threading.Thread(target=one) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert results == [200] * 8
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_keepalive_disabled_restores_close_per_request():
+    srv = _echo_server(HttpConfig(keepalive=False, workers=2))
+    host, port = srv.server_address
+    conn = http.client.HTTPConnection(host, port, timeout=5)
+    try:
+        conn.request("GET", "/x")
+        resp = conn.getresponse()
+        resp.read()
+        assert resp.status == 200
+        assert resp.will_close  # HTTP/1.0 close-per-request preserved
+    finally:
+        conn.close()
+        srv.shutdown()
+        srv.server_close()
+
+
+# ---------------------------------------------------------------------------
+# serialized-response byte cache
+# ---------------------------------------------------------------------------
+
+
+def _csv_encode(frame) -> bytes:
+    return frame.to_csv(index=False).encode()
+
+
+def _read_body(cache, req, encode=_csv_encode, horizon=14):
+    return cache.lookup_response(req, horizon, False, None, "raise", None,
+                                 encode)
+
+
+def test_body_memo_serves_memoized_bytes(theta_fit):
+    fc = _fresh_fc(theta_fit)
+    cache = _cache(fc)
+    req = _req(fc)
+    calls = []
+
+    def encode(frame):
+        calls.append(1)
+        return _csv_encode(frame)
+
+    first = _read_body(cache, req, encode)
+    second = _read_body(cache, req, encode)
+    assert first is not None and first == second
+    assert len(calls) == 1  # repeat hits skip frame assembly AND encoding
+    # the memo is keyed per series subset
+    sub = _read_body(cache, _req(fc, [0, 1]), encode)
+    assert len(calls) == 2
+    assert sub != first
+    # ... and byte-identical to encode-on-read of the frame path
+    assert first == _csv_encode(
+        cache.lookup(req, 14, False, None, "raise", None))
+
+
+def test_epoch_bump_invalidates_body_memo_per_writer(theta_fit):
+    """Every writer that funnels through swap_state kills the byte memo
+    with its entry: streaming ingest apply, full refit install, and a
+    day1-only grid advance (the windowed tail refit installs through the
+    SAME swap_state choke point — frame-level coverage in
+    test_forecast_cache.py::test_stale_read_impossible_after_windowed_tail_refit)."""
+    import numpy as np
+
+    from distributed_forecasting_tpu.engine.state_store import (
+        SeriesStateStore,
+    )
+
+    fc = _fresh_fc(theta_fit)
+    batch, _, _ = theta_fit
+    store = SeriesStateStore(fc, time_bucket=16,
+                             history_y=np.asarray(batch.y),
+                             history_mask=np.asarray(batch.mask))
+    cache = _cache(fc)
+    req = _req(fc)
+
+    def assert_fresh(before):
+        body = _read_body(cache, req)
+        assert body is not None and body != before
+        assert body == _csv_encode(fc.predict(req, horizon=14))
+        return body
+
+    body = _read_body(cache, req)
+    assert body is not None
+
+    # writer 1: streaming ingest apply
+    store.ingest([(0, store.day_cur + 1, 123.0)])
+    assert store.apply_pending()["points"] == 1
+    body = assert_fresh(body)
+
+    # writer 2: full refit install (stream signal so params actually move)
+    day1 = store.day_cur
+    store.ingest([(s, day1 + 1 + d, 50.0 + 7.0 * s + d)
+                  for s in range(fc.keys.shape[0]) for d in range(3)])
+    store.apply_pending()
+    body = _read_body(cache, req)  # re-memoize at the post-apply epoch
+    prep, dispatch, complete = store.refit_stages()
+    complete(dispatch(prep()))
+    body = assert_fresh(body)
+
+    # writer 3: day1-only grid advance (swap_state with no new params)
+    fc.swap_state(day1=fc.day1 + 1)
+    assert_fresh(body)
+
+
+def test_server_byte_identity_cached_vs_dispatch_over_keepalive(theta_fit):
+    """One persistent client connection against a live ForecastServer:
+    cached responses are byte-identical to each other AND to a no-cache
+    server's dispatch responses, served over genuine HTTP/1.1 reuse."""
+    from distributed_forecasting_tpu.serving import (
+        build_forecast_cache,
+        start_server,
+    )
+
+    fc = _fresh_fc(theta_fit)
+    cache = build_forecast_cache(
+        {"enabled": True, "quantile_sets": [[0.1, 0.5, 0.9]]}, fc)
+    srv = start_server(fc, cache=cache,
+                       http=HttpConfig(workers=4, idle_timeout_s=10.0))
+    srv2 = start_server(fc)  # dispatch-only control
+    payload = json.dumps({
+        "inputs": pd.DataFrame(fc.keys, columns=fc.key_names)
+        .to_dict(orient="records"),
+        "horizon": 14}).encode()
+
+    def post_n(port, n):
+        bodies = []
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        try:
+            for _ in range(n):
+                conn.request("POST", "/invocations", body=payload,
+                             headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                bodies.append(resp.read())
+                assert resp.status == 200
+                assert resp.version == 11
+                assert not resp.will_close  # the connection really persists
+        finally:
+            conn.close()
+        return bodies
+
+    try:
+        cached = post_n(srv.server_address[1], 3)
+        dispatched = post_n(srv2.server_address[1], 1)
+        assert cached[0] == cached[1] == cached[2] == dispatched[0]
+        assert cache.metrics.hits.value >= 2
+        # quantile reads ride the same byte-identity contract
+        q = json.loads(cached[0])
+        assert q["n_series"] == fc.keys.shape[0]
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        srv2.shutdown()
+        srv2.server_close()
+
+
+def test_server_registers_busy_gauge(theta_fit):
+    from distributed_forecasting_tpu.serving import start_server
+
+    fc = _fresh_fc(theta_fit)
+    srv = start_server(fc, http=HttpConfig(workers=2))
+    try:
+        assert srv.busy_gauge is srv.metrics.http_workers_busy
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", srv.server_address[1], timeout=10)
+        try:
+            conn.request("GET", "/metrics")
+            text = conn.getresponse().read().decode()
+        finally:
+            conn.close()
+        assert "dftpu_http_workers_busy" in text
+    finally:
+        srv.shutdown()
+        srv.server_close()

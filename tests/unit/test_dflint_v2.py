@@ -637,6 +637,51 @@ def test_fleet_conf_block_drift_positive_and_negative(tmp_path):
     assert _lint(tmp_path, "src/fleet_cfg.py") == []
 
 
+def test_http_conf_block_drift_positive_and_negative(tmp_path):
+    # mirrors conf/tasks/serve_config.yml's serving.http block (PR 19 data
+    # plane): a typo'd workers key parses from YAML but no HttpConfig field
+    # consumes it -> drift; every real key lands on a field
+    _write(tmp_path, "conf/serve.yml", """
+        serving:
+          http:
+            keepalive: true
+            pool_size: 8
+            workerz: 16
+            idle_timeout_s: 30
+    """)
+    _write(tmp_path, "src/http_cfg.py", """
+        import dataclasses
+
+        @dataclasses.dataclass(frozen=True)
+        class HttpConfig:
+            keepalive: bool = True
+            pool_size: int = 8
+            workers: int = 16
+            idle_timeout_s: float = 30.0
+
+            @classmethod
+            def from_conf(cls, conf):
+                http = conf.get("serving", {}).get("http", {})
+                known = {f.name for f in dataclasses.fields(cls)}
+                return cls(**{k: v for k, v in http.items() if k in known})
+    """)
+    found = _lint(tmp_path, "src/http_cfg.py")
+    assert [f.rule for f in found] == ["config-drift"]
+    assert "workerz" in found[0].message
+    assert found[0].path == "conf/serve.yml"
+
+    # fixing the typo makes the block clean
+    _write(tmp_path, "conf/serve.yml", """
+        serving:
+          http:
+            keepalive: true
+            pool_size: 8
+            workers: 16
+            idle_timeout_s: 30
+    """)
+    assert _lint(tmp_path, "src/http_cfg.py") == []
+
+
 def test_health_poll_probe_under_lock_positive(tmp_path):
     # the anti-pattern the fleet supervisor must avoid: holding the state
     # lock across the readiness probe, the restart spawn, and the backoff

@@ -12,6 +12,7 @@ slow: tier-1 skips it, CI's unit step runs it.
 import http.client
 import json
 import signal
+import socket
 import threading
 import time
 
@@ -95,6 +96,10 @@ def _make_fake_replica(port):
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     class Handler(BaseHTTPRequestHandler):
+        # HTTP/1.1 so the supervisor's outbound ConnectionPool can pool
+        # legs into fake replicas (every _send sets Content-Length)
+        protocol_version = "HTTP/1.1"
+
         def log_message(self, *a):
             pass
 
@@ -125,10 +130,18 @@ def _make_fake_replica(port):
                 200, json.dumps({"port": self.server.server_address[1]})
                 .encode())
 
+        def setup(self):
+            super().setup()
+            # track accepted sockets so _FakeProc._close can sever them
+            # like a real process death would — otherwise pooled keep-alive
+            # legs into a "dead" replica keep answering forever
+            self.server.conns.append(self.connection)
+
     srv = ThreadingHTTPServer(("127.0.0.1", port), Handler)
     srv.daemon_threads = True
     srv.ready = True
     srv.hits = 0
+    srv.conns = []
     t = threading.Thread(target=srv.serve_forever, daemon=True)
     t.start()
     return srv
@@ -147,6 +160,11 @@ class _FakeProc:
             self._closed = True
             self.server.shutdown()
             self.server.server_close()
+            for c in getattr(self.server, "conns", []):
+                try:  # sever established keep-alive legs like SIGKILL would
+                    c.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
 
     def poll(self):
         return self._returncode
