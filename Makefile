@@ -7,9 +7,11 @@ install:
 	pip install -e ".[local,test]"
 
 # pure-AST static analysis (docs/static-analysis.md) — seconds, CPU-only,
-# never initializes a device; exit 1 on any error-severity finding
+# never initializes a device; exit 1 on any error-severity finding.
+# scripts/ is in scope for the dfproto client-side contract extraction
+# (bench/chaos call sites) and docs/ for the endpoint-table drift rule.
 lint:
-	python scripts/dflint.py distributed_forecasting_tpu/
+	python scripts/dflint.py distributed_forecasting_tpu/ scripts/ docs/
 
 # dynamic layer (docs/static-analysis.md "Dynamic layer"): run the
 # threaded test subset under the runtime concurrency sanitizer with

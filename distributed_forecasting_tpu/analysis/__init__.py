@@ -22,11 +22,13 @@ from distributed_forecasting_tpu.analysis.core import (  # noqa: F401
 from distributed_forecasting_tpu.analysis import (  # noqa: F401
     absint,
     dftsan,
+    protocol,
     rules_config,
     rules_donation,
     rules_drift,
     rules_jax,
     rules_lockorder,
+    rules_propagation,
     rules_purity,
     rules_threads,
 )

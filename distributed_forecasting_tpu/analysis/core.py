@@ -47,6 +47,10 @@ class Finding:
     line: int          # 1-based
     message: str
     snippet: str = ""  # stripped source line — the baseline fingerprint key
+    #: interprocedural trace: (path, line, message) hops from source to sink,
+    #: rendered as SARIF relatedLocations + a codeFlow; excluded from the
+    #: fingerprint so a trace reroute doesn't invalidate a baselined finding
+    related: Tuple[Tuple[str, int, str], ...] = ()
 
     def fingerprint(self) -> Tuple[str, str, str]:
         """Line-number-insensitive identity: edits elsewhere in a file must
@@ -103,7 +107,8 @@ class Rule:
                 out.extend(self.check_module(module, project))
         return out
 
-    def finding(self, module: ModuleInfo, node, message: str) -> Finding:
+    def finding(self, module: ModuleInfo, node, message: str,
+                related: Sequence[Tuple[str, int, str]] = ()) -> Finding:
         line = getattr(node, "lineno", 1)
         return Finding(
             rule=self.name,
@@ -112,6 +117,7 @@ class Rule:
             line=line,
             message=message,
             snippet=module.line_text(line),
+            related=tuple(related),
         )
 
 

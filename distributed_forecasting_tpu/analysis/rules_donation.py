@@ -39,6 +39,7 @@ from distributed_forecasting_tpu.analysis.core import (
     Rule,
     register,
 )
+from distributed_forecasting_tpu.analysis.callgraph import get_callgraph
 from distributed_forecasting_tpu.analysis.jaxast import ImportMap
 
 #: statement fields holding nested blocks (processed after the header)
@@ -116,7 +117,8 @@ class HostReuseAfterDonation(Rule):
     dir_names = frozenset({"ops", "engine", "serving", "parallel"})
 
     def check_module(self, module: ModuleInfo, project) -> List[Finding]:
-        imap = ImportMap(module.tree, package=getattr(module, "package", None))
+        # shared, callgraph-cached ImportMap — no private per-rule re-walk
+        imap = get_callgraph(project).import_map(module)
         out: List[Finding] = []
         for node in ast.walk(module.tree):
             if isinstance(node, _FN_NODES):
@@ -155,7 +157,9 @@ class HostReuseAfterDonation(Rule):
                             f"buffer is deleted or aliased to an output, "
                             f"so this host read fails at run time; copy "
                             f"before donating, or rebind the name to the "
-                            f"call's result"))
+                            f"call's result",
+                            related=((module.relpath, consumed[n.id],
+                                      f"'{n.id}' donated here"),)))
                         del consumed[n.id]  # one finding per donation
             # 2. consumption + donor-factory registration
             for h in headers:

@@ -159,8 +159,9 @@ class _LockAnalysis:
         self._building: Set[int] = set()
         #: (src, dst, module, node) — dst acquired while src held
         self.edges: List[Tuple[LockId, LockId, ModuleInfo, ast.AST]] = []
-        #: (module, node, message)
-        self.block_hits: List[Tuple[ModuleInfo, ast.AST, str]] = []
+        #: (module, node, message, related-hops)
+        self.block_hits: List[Tuple[ModuleInfo, ast.AST, str,
+                                    Tuple[Tuple[str, int, str], ...]]] = []
 
         for m in project.all_modules:
             if m.tree is not None:
@@ -421,7 +422,7 @@ class _LockAnalysis:
             self.block_hits.append((ctx.module, call, (
                 f"{desc} while holding {_fmt(held[-1])} — every thread "
                 f"contending on that lock stalls behind this call; move it "
-                f"outside the critical section or add a timeout")))
+                f"outside the critical section or add a timeout"), ()))
             return
         callees = self._resolve_callees(call, ctx)
         for mod, callee in callees:
@@ -430,10 +431,13 @@ class _LockAnalysis:
                 for h in held:
                     self.edges.append((h, lock, ctx.module, call))
             for bdesc, rel, line in blk[:2]:
+                # the (rel, line) hop rides along so SARIF can render the
+                # interprocedural path, not just the call site
                 self.block_hits.append((ctx.module, call, (
                     f"call into '{callee.name}' ({rel}:{line}) reaches "
                     f"{bdesc} while holding {_fmt(held[-1])} — hoist the "
-                    f"slow work out of the critical section")))
+                    f"slow work out of the critical section"),
+                    ((rel, line, f"{bdesc} happens here"),)))
 
     # -- cycle detection ---------------------------------------------------
 
@@ -533,6 +537,7 @@ class LockOrderCycle(Rule):
         for src, dst, module, node in analysis.edges:
             if module.relpath not in targets:
                 continue
+            related: Tuple[Tuple[str, int, str], ...] = ()
             if src == dst and src in cyclic:
                 msg = (f"re-acquiring non-reentrant {_fmt(src)} while "
                        f"already holding it deadlocks the thread; use an "
@@ -544,12 +549,24 @@ class LockOrderCycle(Rule):
                        f"participates in a lock-order cycle [{order}]; two "
                        f"threads taking these locks in opposite orders "
                        f"deadlock")
+                # the cycle's OTHER acquisition sites, so the SARIF
+                # codeFlow shows the full deadlock loop
+                hops = []
+                for s2, d2, mod2, node2 in analysis.edges:
+                    if (s2, d2) == (src, dst) or s2 == d2:
+                        continue
+                    if s2 in cycle and d2 in cycle:
+                        hops.append((mod2.relpath, node2.lineno,
+                                     f"acquires {_fmt(d2)} while holding "
+                                     f"{_fmt(s2)}"))
+                related = tuple(dict.fromkeys(hops))[:6]
             else:
                 continue
             key = (module.relpath, node.lineno, msg)
             if key not in seen:
                 seen.add(key)
-                out.append(self.finding(module, node, msg))
+                out.append(self.finding(module, node, msg,
+                                        related=related))
         return out
 
 
@@ -563,11 +580,12 @@ class BlockingUnderLock(Rule):
         targets = {m.relpath for m in project.modules}
         out: List[Finding] = []
         seen: Set[Tuple[str, int, str]] = set()
-        for module, node, msg in analysis.block_hits:
+        for module, node, msg, related in analysis.block_hits:
             if module.relpath not in targets:
                 continue
             key = (module.relpath, node.lineno, msg)
             if key not in seen:
                 seen.add(key)
-                out.append(self.finding(module, node, msg))
+                out.append(self.finding(module, node, msg,
+                                        related=related))
         return out

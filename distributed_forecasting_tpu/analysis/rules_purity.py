@@ -29,7 +29,7 @@ from distributed_forecasting_tpu.analysis.core import (
     Rule,
     register,
 )
-from distributed_forecasting_tpu.analysis.jaxast import ImportMap
+from distributed_forecasting_tpu.analysis.callgraph import get_callgraph
 
 #: numpy.random constructors that ARE deterministic once given a seed
 _SEEDABLE = frozenset({"default_rng", "RandomState", "SeedSequence", "Generator"})
@@ -56,7 +56,9 @@ class Nondeterminism(Rule):
     dir_names = frozenset({"ops", "engine", "models", "monitoring"})
 
     def check_module(self, module: ModuleInfo, project) -> List[Finding]:
-        imap = ImportMap(module.tree)
+        # one shared ImportMap per module for every rule pass (the
+        # callgraph caches them), instead of a private re-walk here
+        imap = get_callgraph(project).import_map(module)
         out: List[Finding] = []
         for node in ast.walk(module.tree):
             if not isinstance(node, ast.Call):

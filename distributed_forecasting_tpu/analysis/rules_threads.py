@@ -29,6 +29,7 @@ from distributed_forecasting_tpu.analysis.core import (
     Rule,
     register,
 )
+from distributed_forecasting_tpu.analysis.callgraph import get_callgraph
 from distributed_forecasting_tpu.analysis.jaxast import ImportMap, base_name
 
 _LOCK_TYPES = frozenset({
@@ -132,7 +133,8 @@ class UnlockedSharedState(Rule):
     dir_names = frozenset({"serving", "monitoring"})
 
     def check_module(self, module: ModuleInfo, project) -> List[Finding]:
-        imap = ImportMap(module.tree)
+        # shared, callgraph-cached ImportMap — no private per-rule re-walk
+        imap = get_callgraph(project).import_map(module)
         out: List[Finding] = []
         for cls in ast.walk(module.tree):
             if not isinstance(cls, ast.ClassDef):

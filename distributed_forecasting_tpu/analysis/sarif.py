@@ -36,20 +36,27 @@ def _rule_descriptor(name: str) -> Dict:
     }
 
 
+def _location(path: str, line: int, message: str = "") -> Dict:
+    loc: Dict = {
+        "physicalLocation": {
+            "artifactLocation": {
+                "uri": path,
+                "uriBaseId": "%SRCROOT%",
+            },
+            "region": {"startLine": line},
+        },
+    }
+    if message:
+        loc["message"] = {"text": message}
+    return loc
+
+
 def _result(finding: Finding, rule_index: Dict[str, int]) -> Dict:
     out: Dict = {
         "ruleId": finding.rule,
         "level": _LEVEL.get(finding.severity, "warning"),
         "message": {"text": finding.message},
-        "locations": [{
-            "physicalLocation": {
-                "artifactLocation": {
-                    "uri": finding.path,
-                    "uriBaseId": "%SRCROOT%",
-                },
-                "region": {"startLine": finding.line},
-            },
-        }],
+        "locations": [_location(finding.path, finding.line)],
         # line-insensitive identity, same key the baseline uses — keeps
         # alerts stable across unrelated edits to the file
         "partialFingerprints": {
@@ -59,6 +66,22 @@ def _result(finding: Finding, rule_index: Dict[str, int]) -> Dict:
     idx = rule_index.get(finding.rule)
     if idx is not None:
         out["ruleIndex"] = idx
+    related = getattr(finding, "related", ())
+    if related:
+        # interprocedural findings carry a source->sink hop list: surface it
+        # both as relatedLocations (rendered inline by code scanning) and as
+        # one codeFlow/threadFlow ending at the sink, so the CI annotation
+        # shows the path rather than just the final line
+        out["relatedLocations"] = [
+            _location(path, line, msg) for path, line, msg in related
+        ]
+        hops = [_location(path, line, msg) for path, line, msg in related]
+        hops.append(_location(finding.path, finding.line, finding.message))
+        out["codeFlows"] = [{
+            "threadFlows": [{
+                "locations": [{"location": hop} for hop in hops],
+            }],
+        }]
     return out
 
 

@@ -89,7 +89,8 @@ _misses = _registry.counter(
     "AOT lookups that fell through to a fresh lower+compile")
 _errors = _registry.counter(
     "compile_cache_errors_total",
-    "corrupt/incompatible store entries discarded (fall-through)")
+    "store I/O failures: corrupt/incompatible entries discarded on read, "
+    "serialization failures on write (both fall through)")
 _stores = _registry.counter(
     "compile_cache_stores_total",
     "executables serialized into the store")
@@ -417,6 +418,7 @@ class AOTStore:
                 if os.path.exists(tmp):
                     os.remove(tmp)
         except Exception as e:  # the store is an optimization, never a crash
+            _errors.inc()
             _logger.warning("failed to store %s: %s: %s", entry,
                             type(e).__name__, e)
             return False

@@ -1105,6 +1105,12 @@ class _FrontDoorHandler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        tid = self._trace_id()
+        if tid:
+            # echo the sanitized correlation id on every front-door-built
+            # response (sheds, scatter merges, health) — same contract as
+            # the replica handler, so error bodies stay greppable by trace
+            self.send_header("X-Trace-Id", tid)
         for name, value in extra_headers:
             self.send_header(name, value)
         self.end_headers()
@@ -1119,10 +1125,16 @@ class _FrontDoorHandler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
             n = sup.ready_count()
             self._send_json(
                 200 if n > 0 else 503,
-                {"ready": n > 0, "ready_replicas": n, "replicas": sup.size})
+                {"ready": n > 0, "ready_replicas": n, "replicas": sup.size},
+                extra_headers=(() if n > 0
+                               else (("Retry-After", "1"),)))
         elif self.path == "/fleet":
             self._send_json(200, {"replicas": sup.describe()})
         elif self.path == "/metrics":
+            # the aggregation legs deliberately run under probe_timeout_s,
+            # not the request budget: a scrape should see every replica
+            # even when the scraper sent a tight X-Deadline-Ms
+            # dflint: disable=deadline-propagation — probe-budgeted scrape
             self._metrics()
         else:
             # /health, /schema, ... answer the same on any replica
@@ -1187,6 +1199,11 @@ class _FrontDoorHandler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
             # the remaining budget travels downstream; a replica that
             # receives <= 0 sheds before dispatch (serving/server.py)
             headers["X-Deadline-Ms"] = str(int(rem))
+        tid = self._trace_id()
+        if tid:
+            # the correlation id crosses the fleet hop too, so replica
+            # spans join the same trace the front door opened
+            headers["X-Trace-Id"] = tid
         for attempt in (0, 1):
             conn, reused = sup.pool.acquire(host, port, timeout)
             try:
@@ -1287,6 +1304,9 @@ class _FrontDoorHandler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
         handled here; False falls back to round-robin ``_proxy`` (body not
         shard-plannable: unknown path, missing key columns, non-JSON)."""
         sup = self.server.supervisor
+        # once-per-boot cached /schema discovery bounded by probe_timeout_s;
+        # not per-request work, so it does not spend the request's budget
+        # dflint: disable=deadline-propagation — probe-budgeted discovery
         names = self._schema_key_names()
         if names is None:
             return False
@@ -1382,26 +1402,32 @@ class _FrontDoorHandler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
         done = threading.Event()
         lock = threading.Lock()
         winner: list = []
+        tracer = get_tracer()
+        # hedge legs run on bare daemon threads: without this capture any
+        # span a leg opens would detach from the request's trace
+        ctx = tracer.current()
 
         def leg(port: int, is_hedge: bool):
-            t0 = time.monotonic()
-            try:
-                status, ctype, payload = self._forward(
-                    cfg.replica_host, port, method, body, deadline=deadline)
-            except (OSError, http.client.HTTPException):
-                sup.breaker_failure(port)
-                sup.report_failure(port)
-                return
-            sup.breaker_success(port, time.monotonic() - t0)
-            with lock:
-                if winner:
-                    # the race is over: this duplicate's answer is
-                    # discarded (the replica already did the work; predict
-                    # is idempotent, so discarding is safe)
-                    sup.note_hedge_cancelled()
+            with tracer.context(ctx):
+                t0 = time.monotonic()
+                try:
+                    status, ctype, payload = self._forward(
+                        cfg.replica_host, port, method, body,
+                        deadline=deadline)
+                except (OSError, http.client.HTTPException):
+                    sup.breaker_failure(port)
+                    sup.report_failure(port)
                     return
-                winner.append((status, ctype, payload, port, is_hedge))
-            done.set()
+                sup.breaker_success(port, time.monotonic() - t0)
+                with lock:
+                    if winner:
+                        # the race is over: this duplicate's answer is
+                        # discarded (the replica already did the work;
+                        # predict is idempotent, so discarding is safe)
+                        sup.note_hedge_cancelled()
+                        return
+                    winner.append((status, ctype, payload, port, is_hedge))
+                done.set()
 
         threading.Thread(
             target=leg, args=(ports[0], False), daemon=True).start()
@@ -1431,8 +1457,13 @@ class _FrontDoorHandler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
         NOT a whole-request 5xx; only every-shard-failed is)."""
         sup = self.server.supervisor
         responses: dict = {}
+        tracer = get_tracer()
 
         def one(shard: int):
+            with tracer.context(ctx):
+                return _one(shard)
+
+        def _one(shard: int):
             if not sup.shard_owners(shard):
                 sup.note_unowned(shard)
                 return 503, json.dumps(
@@ -1453,9 +1484,11 @@ class _FrontDoorHandler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
             status, _, payload, _ = res
             return status, payload
 
-        tracer = get_tracer()
         with tracer.root_span("route.scatter", trace_id=tid, path=self.path,
                               shards=len(plan.shards)):
+            # per-shard work runs on bare threads: capture the scatter span
+            # context here so each leg's forward spans stay under it
+            ctx = tracer.current()
             threads = [
                 threading.Thread(
                     target=lambda k=shard: responses.__setitem__(k, one(k)),
@@ -1471,9 +1504,11 @@ class _FrontDoorHandler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
         # merge dispatches on the path, not the plan's field name
         if plan.field == "inputs":
             status, merged = merge_invocation_responses(
+                # dflint: disable=deadline-propagation — cached discovery
                 plan, self._schema_key_names() or (), responses)
         elif self.path == "/detect_anomalies":
             status, merged = merge_detect_responses(
+                # dflint: disable=deadline-propagation — cached discovery
                 plan, self._schema_key_names() or (), responses)
         else:
             status, merged = merge_ingest_responses(plan, responses)

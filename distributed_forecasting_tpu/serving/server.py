@@ -205,7 +205,9 @@ class _Handler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
         if parsed.path == "/readyz":
             ready, reason = self.server.readiness()
             self._send(200 if ready else 503,
-                       {"ready": ready, "reason": reason})
+                       {"ready": ready, "reason": reason},
+                       extra_headers=(() if ready
+                                      else (("Retry-After", "1"),)))
             return
         if parsed.path.startswith("/debug/"):
             self._debug(parsed)
@@ -283,7 +285,8 @@ class _Handler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
                 return
             if not tracer.profiler.available:
                 self._send(503, {"error": "profiler capture not configured "
-                                          "(tracing.profile_dir is unset)"})
+                                          "(tracing.profile_dir is unset)"},
+                           extra_headers=(("Retry-After", "60"),))
                 return
             try:
                 # blocks THIS handler thread for the capture window; other
@@ -298,14 +301,16 @@ class _Handler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
             quality = self.server.quality
             if quality is None:
                 self._send(503, {"error": "quality monitoring not enabled "
-                                          "(monitoring.quality conf block)"})
+                                          "(monitoring.quality conf block)"},
+                           extra_headers=(("Retry-After", "60"),))
                 return
             self._send(200, quality.snapshot())
         elif parsed.path == "/debug/ingest":
             ingest = self.server.ingest
             if ingest is None:
                 self._send(503, {"error": "streaming ingest not enabled "
-                                          "(serving.ingest conf block)"})
+                                          "(serving.ingest conf block)"},
+                           extra_headers=(("Retry-After", "60"),))
                 return
             self._send(200, ingest.snapshot())
         elif parsed.path == "/debug/cost":
@@ -317,7 +322,8 @@ class _Handler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
             cconf = get_cost_config()
             if not cconf.enabled:
                 self._send(503, {"error": "cost observability disabled "
-                                          "(monitoring.cost conf block)"})
+                                          "(monitoring.cost conf block)"},
+                           extra_headers=(("Retry-After", "60"),))
                 return
             # per-entry cost table + roofline placement when the conf
             # carries backend peaks; watermarks are freshly sampled
@@ -493,9 +499,11 @@ class _Handler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
             # the request outlived request_timeout_s (queued or in flight)
             metrics.timeouts.inc()
             self._send(503, {"error": f"request timed out: {e}" if str(e)
-                             else "request timed out"})
+                             else "request timed out"},
+                       extra_headers=(("Retry-After", "1"),))
         except ShuttingDownError as e:
-            self._send(503, {"error": str(e)})
+            self._send(503, {"error": str(e)},
+                       extra_headers=(("Retry-After", "1"),))
         except (ValueError, TypeError, KeyError, json.JSONDecodeError) as e:
             # TypeError covers JSON-legal but wrong-typed fields, e.g.
             # "horizon": null / [90]
@@ -517,7 +525,8 @@ class _Handler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
         quality = self.server.quality
         if quality is None or quality.monitor is None:
             self._send(503, {"error": "quality monitoring not enabled "
-                                      "(monitoring.quality conf block)"})
+                                      "(monitoring.quality conf block)"},
+                       extra_headers=(("Retry-After", "60"),))
             return
         tracer = get_tracer()
         self._trace_id = _safe_trace_id(self.headers.get("X-Trace-Id"))
@@ -575,7 +584,8 @@ class _Handler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
         anomaly = self.server.anomaly
         if anomaly is None:
             self._send(503, {"error": "anomaly detection not enabled "
-                                      "(serving.anomaly conf block)"})
+                                      "(serving.anomaly conf block)"},
+                       extra_headers=(("Retry-After", "60"),))
             return
         tracer = get_tracer()
         self._trace_id = _safe_trace_id(self.headers.get("X-Trace-Id"))
@@ -623,7 +633,8 @@ class _Handler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
                        extra_headers=(("Retry-After", "1"),))
         except (TimeoutError, _FutureTimeoutError) as e:
             self._send(503, {"error": f"request timed out: {e}" if str(e)
-                             else "request timed out"})
+                             else "request timed out"},
+                       extra_headers=(("Retry-After", "1"),))
         except (ValueError, TypeError, KeyError, json.JSONDecodeError) as e:
             self._send(400, {"error": f"{type(e).__name__}: {e}"})
         except Exception as e:  # noqa: BLE001 — scorer must not die mid-request
@@ -642,7 +653,8 @@ class _Handler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
         ingest = self.server.ingest
         if ingest is None:
             self._send(503, {"error": "streaming ingest not enabled "
-                                      "(serving.ingest conf block)"})
+                                      "(serving.ingest conf block)"},
+                       extra_headers=(("Retry-After", "60"),))
             return
         tracer = get_tracer()
         self._trace_id = _safe_trace_id(self.headers.get("X-Trace-Id"))

@@ -323,6 +323,20 @@ class _FakeProc:
         return self._returncode
 
 
+def _assert_error_contract(status, headers, sent_trace_id=None):
+    """Contract-mandated headers on every client-visible error (the
+    runtime side of dfproto's proto-retry-after rule): retryable statuses
+    carry Retry-After so clients can back off, and when the caller sent a
+    well-formed X-Trace-Id every front-door-built error echoes it so the
+    failure stays greppable by trace."""
+    if status in (429, 503):
+        assert headers.get("Retry-After"), (
+            f"{status} without Retry-After: {headers}")
+    if sent_trace_id is not None and status >= 400:
+        assert headers.get("X-Trace-Id") == sent_trace_id, (
+            f"{status} did not echo X-Trace-Id={sent_trace_id}: {headers}")
+
+
 def _front_post(front, headers=None, timeout=10.0):
     host, port = front.server_address
     conn = http.client.HTTPConnection(host, port, timeout=timeout)
@@ -331,7 +345,30 @@ def _front_post(front, headers=None, timeout=10.0):
                      headers={"Content-Type": "application/json",
                               **(headers or {})})
         resp = conn.getresponse()
-        return resp.status, dict(resp.getheaders()), resp.read()
+        status, hdrs, body = resp.status, dict(resp.getheaders()), resp.read()
+        if status >= 400:
+            # EVERY error any scenario observes is held to the contract,
+            # not just the dedicated error_contract_headers scenario
+            _assert_error_contract(
+                status, hdrs,
+                sent_trace_id=(headers or {}).get("X-Trace-Id"))
+        return status, hdrs, body
+    finally:
+        conn.close()
+
+
+def _front_get(front, path, headers=None, timeout=10.0):
+    host, port = front.server_address
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request("GET", path, headers=headers or {})
+        resp = conn.getresponse()
+        status, hdrs, body = resp.status, dict(resp.getheaders()), resp.read()
+        if status >= 400:
+            _assert_error_contract(
+                status, hdrs,
+                sent_trace_id=(headers or {}).get("X-Trace-Id"))
+        return status, hdrs, body
     finally:
         conn.close()
 
@@ -651,6 +688,60 @@ def keepalive_kill9_mid_stream(workdir: str, seed: int = 0) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# scenario 8: error responses carry their contract-mandated headers
+# ---------------------------------------------------------------------------
+
+def error_contract_headers(workdir: str, seed: int = 0) -> dict:
+    """Runtime confirmation of the HTTP error-header contract that
+    dfproto proves statically: 503/429 responses carry Retry-After, a
+    well-formed X-Trace-Id on the request is echoed on every
+    front-door-built response, and the sharded-routing markers
+    (X-Fleet-Shard / X-Fleet-Scatter) never leak onto unsharded
+    round-robin traffic."""
+    from distributed_forecasting_tpu.serving.resilience import (
+        ResilienceConfig,
+    )
+
+    tid = f"chaos-contract-{seed}"
+    sup, front, procs = _boot_fake_fleet(ResilienceConfig(), delays=(0.0,))
+    try:
+        # healthy path first: 200s carry the trace echo but no
+        # Retry-After, and no sharded-routing markers
+        status, headers, _ = _front_get(front, "/readyz",
+                                        headers={"X-Trace-Id": tid})
+        assert status == 200, (status, headers)
+        assert headers.get("Retry-After") is None, headers
+        assert headers.get("X-Trace-Id") == tid, headers
+        status, headers, _ = _front_post(front, headers={"X-Trace-Id": tid})
+        assert status == 200, (status, headers)
+        assert headers.get("X-Fleet-Shard") is None, headers
+        assert headers.get("X-Fleet-Scatter") is None, headers
+        observed = []
+        # kill the only replica: /readyz flips to 503 and POSTs shed
+        procs[0].hang_up()
+        sup.poll_once()
+        status, headers, _ = _front_get(front, "/readyz",
+                                        headers={"X-Trace-Id": tid})
+        assert status == 503, (status, headers)
+        observed.append((status, headers.get("Retry-After"),
+                         headers.get("X-Trace-Id")))
+        # an exhausted X-Deadline-Ms budget sheds at the front door with
+        # the full error contract on the shed response
+        status, headers, _ = _front_post(
+            front, headers={"X-Trace-Id": tid, "X-Deadline-Ms": "250"})
+        assert status == 503, (status, headers)
+        observed.append((status, headers.get("Retry-After"),
+                         headers.get("X-Trace-Id")))
+        for status, retry_after, echoed in observed:
+            assert retry_after is not None, observed
+            assert echoed == tid, observed
+        return {"errors_observed": len(observed), "trace_id": tid}
+    finally:
+        front.shutdown()
+        sup.stop()
+
+
+# ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
 
@@ -662,6 +753,7 @@ SCENARIOS = {
     "breaker_trip_recover": breaker_trip_recover,
     "cache_kill9_mid_persist": cache_kill9_mid_persist,
     "keepalive_kill9_mid_stream": keepalive_kill9_mid_stream,
+    "error_contract_headers": error_contract_headers,
 }
 
 

@@ -1,0 +1,501 @@
+"""dfproto tests: the cross-process protocol-contract rules (layer 1)
+and the propagation-taint rules (layer 2), plus the SARIF codeFlow
+surface for interprocedural findings and --changed-only scoping.
+
+Every rule gets a positive fixture (MUST be flagged) and a negative
+(idiomatic code that must stay quiet).  Same fixture idiom as
+test_dflint.py: source STRINGS in tmp trees, nothing imports jax/numpy.
+"""
+
+import json
+import os
+import subprocess
+
+from distributed_forecasting_tpu.analysis import cli
+from distributed_forecasting_tpu.analysis.core import build_project
+from distributed_forecasting_tpu.analysis import protocol as proto
+
+from test_dflint import _write, _lint  # shared fixture helpers
+
+
+def _rules(found):
+    return sorted(f.rule for f in found)
+
+
+def _only(found, rule):
+    return [f for f in found if f.rule == rule]
+
+
+def _cli(tmp_path, capsys, *argv):
+    code = cli.main(["--root", str(tmp_path), *argv])
+    return code, capsys.readouterr().out
+
+
+def _git(tmp_path, *args):
+    subprocess.run(["git", *args], cwd=tmp_path, check=True,
+                   capture_output=True,
+                   env={**os.environ,
+                        "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+                        "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t"})
+
+
+# ---------------------------------------------------------------------------
+# layer-1 fixtures: a minimal handler + clients
+# ---------------------------------------------------------------------------
+
+_PREDICT_SERVER = """
+    class Handler:
+        def _send(self, status, body=None, extra_headers=()):
+            self.send_response(status)
+            for name, value in extra_headers:
+                self.send_header(name, value)
+
+        def do_POST(self):
+            if self.path == "/predict":
+                self._send(200, {"forecast": []})
+                return
+            self._send(404)
+"""
+
+
+def _client(path, method="POST", status=None, headers=None, read=None):
+    body = [
+        "import http.client",
+        "",
+        "def call():",
+        "    conn = http.client.HTTPConnection('localhost', 8080)",
+    ]
+    if headers:
+        body.append(f"    conn.request({method!r}, {path!r}, "
+                    f"headers={headers!r})")
+    else:
+        body.append(f"    conn.request({method!r}, {path!r})")
+    body.append("    resp = conn.getresponse()")
+    if read:
+        body.append(f"    resp.getheader({read!r})")
+    if status is not None:
+        body.append(f"    if resp.status == {status}:")
+        body.append("        return True")
+    body.append("    return resp")
+    return "\n".join(body) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# proto-unserved-route
+# ---------------------------------------------------------------------------
+
+def test_unserved_route_positive(tmp_path):
+    _write(tmp_path, "serving/server.py", _PREDICT_SERVER)
+    _write(tmp_path, "serving/client.py", _client("/forecast_v2"))
+    found = _only(_lint(tmp_path, "serving"), "proto-unserved-route")
+    assert len(found) == 1
+    assert found[0].path == "serving/client.py"
+    assert "/forecast_v2" in found[0].message
+
+
+def test_unserved_route_method_mismatch(tmp_path):
+    _write(tmp_path, "serving/server.py", _PREDICT_SERVER)
+    _write(tmp_path, "serving/client.py", _client("/predict", method="GET"))
+    found = _only(_lint(tmp_path, "serving"), "proto-unserved-route")
+    assert len(found) == 1
+    assert "GET" in found[0].message and "POST" in found[0].message
+
+
+def test_unserved_route_negative(tmp_path):
+    _write(tmp_path, "serving/server.py", _PREDICT_SERVER)
+    _write(tmp_path, "serving/client.py", _client("/predict"))
+    assert _only(_lint(tmp_path, "serving"), "proto-unserved-route") == []
+
+
+# ---------------------------------------------------------------------------
+# proto-status-drift
+# ---------------------------------------------------------------------------
+
+def test_status_drift_positive(tmp_path):
+    _write(tmp_path, "serving/server.py", _PREDICT_SERVER)
+    _write(tmp_path, "serving/client.py", _client("/predict", status=418))
+    found = _only(_lint(tmp_path, "serving"), "proto-status-drift")
+    assert len(found) == 1
+    assert "418" in found[0].message
+
+
+def test_status_drift_negative(tmp_path):
+    _write(tmp_path, "serving/server.py", _PREDICT_SERVER)
+    _write(tmp_path, "serving/client.py", _client("/predict", status=200))
+    assert _only(_lint(tmp_path, "serving"), "proto-status-drift") == []
+
+
+# ---------------------------------------------------------------------------
+# proto-retry-after
+# ---------------------------------------------------------------------------
+
+_SHED_SERVER = """
+    class Handler:
+        def _send(self, status, extra_headers=()):
+            self.send_response(status)
+            for name, value in extra_headers:
+                self.send_header(name, value)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._send(200)
+                return
+            self._send(503{extra})
+"""
+
+
+def test_retry_after_positive(tmp_path):
+    _write(tmp_path, "serving/server.py", _SHED_SERVER.format(extra=""))
+    found = _only(_lint(tmp_path, "serving"), "proto-retry-after")
+    assert len(found) == 1
+    assert "503" in found[0].message and "Retry-After" in found[0].message
+
+
+def test_retry_after_negative(tmp_path):
+    _write(tmp_path, "serving/server.py", _SHED_SERVER.format(
+        extra=', extra_headers=(("Retry-After", "1"),)'))
+    # a harness reads the header, so header-drift stays quiet too
+    _write(tmp_path, "serving/client.py",
+           _client("/healthz", method="GET", read="Retry-After"))
+    found = _lint(tmp_path, "serving")
+    assert _only(found, "proto-retry-after") == []
+    assert _only(found, "proto-header-drift") == []
+
+
+# ---------------------------------------------------------------------------
+# proto-header-drift (all four directions share one cross-check)
+# ---------------------------------------------------------------------------
+
+_BUDGET_SERVER = """
+    class Handler:
+        def _send(self, status, extra_headers=()):
+            self.send_response(status)
+            for name, value in extra_headers:
+                self.send_header(name, value)
+
+        def do_GET(self):
+            if self.path == "/status":
+                budget = self.headers.get("X-Budget-Ms")
+                self._send(200)
+                return
+            self._send(404)
+"""
+
+
+def test_header_drift_read_never_sent(tmp_path):
+    _write(tmp_path, "serving/server.py", _BUDGET_SERVER)
+    _write(tmp_path, "serving/client.py", _client("/status", method="GET"))
+    found = _only(_lint(tmp_path, "serving"), "proto-header-drift")
+    assert len(found) == 1
+    assert found[0].path == "serving/server.py"
+    assert "X-Budget-Ms" in found[0].message
+    assert "sends" in found[0].message
+
+
+def test_header_drift_write_never_read(tmp_path):
+    _write(tmp_path, "serving/server.py", _SHED_SERVER.format(
+        extra=', extra_headers=(("Retry-After", "1"),)'))
+    _write(tmp_path, "serving/client.py", _client("/healthz", method="GET"))
+    found = _only(_lint(tmp_path, "serving"), "proto-header-drift")
+    assert len(found) == 1
+    assert "Retry-After" in found[0].message
+    assert "reads" in found[0].message
+
+
+def test_header_drift_negative(tmp_path):
+    _write(tmp_path, "serving/server.py", _BUDGET_SERVER)
+    _write(tmp_path, "serving/client.py",
+           _client("/status", method="GET", headers={"X-Budget-Ms": "5"}))
+    assert _only(_lint(tmp_path, "serving"), "proto-header-drift") == []
+
+
+# ---------------------------------------------------------------------------
+# proto-endpoint-table-drift: the generated docs/serving.md table,
+# both directions
+# ---------------------------------------------------------------------------
+
+def _endpoint_table(tmp_path):
+    proj = build_project(str(tmp_path), [str(tmp_path)])
+    return proto.render_endpoint_table(
+        proto.get_protocol_analysis(proj).routes)
+
+
+def _write_doc(tmp_path, table_lines):
+    _write(tmp_path, "docs/serving.md", "# Serving\n\n"
+           "## Endpoint contract\n\n" + "\n".join(table_lines) + "\n\n"
+           "## Configuration\n\nnone\n")
+
+
+def test_endpoint_table_in_sync_is_quiet(tmp_path):
+    _write(tmp_path, "serving/server.py", _PREDICT_SERVER)
+    _write(tmp_path, "serving/client.py", _client("/predict"))
+    _write_doc(tmp_path, _endpoint_table(tmp_path))
+    found = _lint(tmp_path, "serving")
+    assert _only(found, "proto-endpoint-table-drift") == []
+
+
+def test_endpoint_table_missing_row(tmp_path):
+    _write(tmp_path, "serving/server.py", _PREDICT_SERVER)
+    _write(tmp_path, "serving/client.py", _client("/predict"))
+    table = _endpoint_table(tmp_path)
+    _write_doc(tmp_path, table[:-1])  # drop the last generated row
+    found = _only(_lint(tmp_path, "serving"), "proto-endpoint-table-drift")
+    assert len(found) == 1
+    assert found[0].path == "docs/serving.md"
+    assert "missing the generated row" in found[0].message
+
+
+def test_endpoint_table_stale_row(tmp_path):
+    _write(tmp_path, "serving/server.py", _PREDICT_SERVER)
+    _write(tmp_path, "serving/client.py", _client("/predict"))
+    table = _endpoint_table(tmp_path)
+    _write_doc(tmp_path, table + ["| `/zombie` | GET | 200 | — | — |"])
+    found = _only(_lint(tmp_path, "serving"), "proto-endpoint-table-drift")
+    assert len(found) == 1
+    assert "does not match the extracted contract" in found[0].message
+
+
+def test_endpoint_table_missing_section(tmp_path):
+    _write(tmp_path, "serving/server.py", _PREDICT_SERVER)
+    _write(tmp_path, "docs/serving.md", "# Serving\n\nno table here\n")
+    found = _only(_lint(tmp_path, "serving"), "proto-endpoint-table-drift")
+    assert len(found) == 1
+    assert "no '## Endpoint contract' section" in found[0].message
+
+
+# ---------------------------------------------------------------------------
+# deadline-propagation
+# ---------------------------------------------------------------------------
+
+def test_deadline_dropping_leg_positive(tmp_path):
+    _write(tmp_path, "serving/hop.py", """
+        import http.client
+
+        def forward(deadline, payload):
+            conn = http.client.HTTPConnection("replica")
+            conn.request("POST", "/predict", payload)
+            return conn.getresponse().read()
+    """)
+    found = _only(_lint(tmp_path, "serving"), "deadline-propagation")
+    assert len(found) == 1
+    assert "budget dies on this hop" in found[0].message
+
+
+def test_deadline_budgeted_leg_negative(tmp_path):
+    _write(tmp_path, "serving/hop.py", """
+        import http.client
+
+        def forward(deadline, payload):
+            timeout = leg_timeout_s(deadline)
+            headers = {"X-Deadline-Ms": str(remaining_ms(deadline))}
+            conn = http.client.HTTPConnection("replica", timeout=timeout)
+            conn.request("POST", "/predict", payload, headers)
+            return conn.getresponse().read()
+    """)
+    assert _only(_lint(tmp_path, "serving"), "deadline-propagation") == []
+
+
+def test_deadline_transitive_chain_carries_hops(tmp_path):
+    # the leg hides one call deep in a deadline-blind helper: the finding
+    # lands on the handoff call and carries the hop chain to the raw leg
+    _write(tmp_path, "serving/hop.py", """
+        import http.client
+
+        def outer(deadline, payload):
+            return fetch_all(payload)
+
+        def fetch_all(payload):
+            conn = http.client.HTTPConnection("replica")
+            conn.request("POST", "/predict", payload)
+            return conn.getresponse().read()
+    """)
+    found = _only(_lint(tmp_path, "serving"), "deadline-propagation")
+    assert len(found) == 1
+    assert "fetch_all" in found[0].message
+    assert found[0].related
+    assert "raw outbound leg" in found[0].related[-1][2]
+
+
+# ---------------------------------------------------------------------------
+# trace-context-loss
+# ---------------------------------------------------------------------------
+
+_THREAD_UNDER_SPAN = """
+    import threading
+
+    def work():
+        pass
+
+    def run(tracer):
+        with tracer.root_span("req"):
+            {capture}t = threading.Thread(target=work)
+            t.start()
+            t.join()
+"""
+
+
+def test_trace_context_loss_positive(tmp_path):
+    _write(tmp_path, "serving/spawn.py",
+           _THREAD_UNDER_SPAN.format(capture=""))
+    found = _only(_lint(tmp_path, "serving"), "trace-context-loss")
+    assert len(found) == 1
+    assert "captures the TraceContext" in found[0].message
+    assert found[0].related  # the span-scope hop chain
+    assert "span scope opens" in found[0].related[0][2]
+
+
+def test_trace_context_loss_negative_capture(tmp_path):
+    _write(tmp_path, "serving/spawn.py", _THREAD_UNDER_SPAN.format(
+        capture="ctx = tracer.current()\n            "))
+    assert _only(_lint(tmp_path, "serving"), "trace-context-loss") == []
+
+
+def test_trace_context_loss_negative_no_span(tmp_path):
+    # the same spawn outside any span scope owes nothing
+    _write(tmp_path, "serving/spawn.py", """
+        import threading
+
+        def work():
+            pass
+
+        def run():
+            t = threading.Thread(target=work)
+            t.start()
+    """)
+    assert _only(_lint(tmp_path, "serving"), "trace-context-loss") == []
+
+
+# ---------------------------------------------------------------------------
+# error-path-accounting
+# ---------------------------------------------------------------------------
+
+_SWALLOWED = """
+    def pull(counter):
+        try:
+            failpoint("serving.pull")
+            return fetch()
+        except Exception:
+            {handler}
+"""
+
+
+def test_error_path_accounting_positive(tmp_path):
+    _write(tmp_path, "serving/pull.py",
+           _SWALLOWED.format(handler="return None"))
+    found = _only(_lint(tmp_path, "serving"), "error-path-accounting")
+    assert len(found) == 1
+    assert "vanish" in found[0].message
+    assert found[0].related
+    assert "failpoint armed" in found[0].related[-1][2]
+
+
+def test_error_path_accounting_negative_counter(tmp_path):
+    _write(tmp_path, "serving/pull.py", _SWALLOWED.format(
+        handler="counter.inc()\n            return None"))
+    assert _only(_lint(tmp_path, "serving"), "error-path-accounting") == []
+
+
+def test_error_path_accounting_negative_reraise(tmp_path):
+    _write(tmp_path, "serving/pull.py", _SWALLOWED.format(handler="raise"))
+    assert _only(_lint(tmp_path, "serving"), "error-path-accounting") == []
+
+
+# ---------------------------------------------------------------------------
+# SARIF: interprocedural findings render codeFlows + relatedLocations
+# ---------------------------------------------------------------------------
+
+def test_sarif_codeflows_for_propagation_findings(tmp_path, capsys):
+    _write(tmp_path, "serving/spawn.py",
+           _THREAD_UNDER_SPAN.format(capture=""))
+    code, out = _cli(tmp_path, capsys, str(tmp_path / "serving"),
+                     "--format", "sarif", "--no-baseline")
+    assert code == 1
+    results = json.loads(out)["runs"][0]["results"]
+    hit = next(r for r in results if r["ruleId"] == "trace-context-loss")
+    related = hit["relatedLocations"]
+    assert related and all(
+        loc["message"]["text"] for loc in related)
+    flow = hit["codeFlows"][0]["threadFlows"][0]["locations"]
+    # the thread flow is the hop chain plus the sink itself
+    assert len(flow) == len(related) + 1
+    sink = flow[-1]["location"]["physicalLocation"]
+    assert sink["artifactLocation"]["uri"] == "serving/spawn.py"
+
+
+def test_lockorder_findings_carry_related_hops(tmp_path):
+    # interprocedural blocking-under-lock: the sleep happens one call deep
+    _write(tmp_path, "serving/crit.py", """
+        import threading
+        import time
+
+        L = threading.Lock()
+
+        def slow():
+            time.sleep(0.5)
+
+        def work():
+            with L:
+                slow()
+    """)
+    found = _only(_lint(tmp_path, "serving"), "blocking-under-lock")
+    assert len(found) == 1
+    assert found[0].related
+    assert "happens here" in found[0].related[0][2]
+
+
+def test_lock_order_cycle_related_shows_other_edge(tmp_path):
+    _write(tmp_path, "serving/ab.py", """
+        import threading
+
+        A = threading.Lock()
+        B = threading.Lock()
+
+        def f():
+            with A:
+                with B:
+                    pass
+
+        def g():
+            with B:
+                with A:
+                    pass
+    """)
+    found = _only(_lint(tmp_path, "serving"), "lock-order-cycle")
+    assert found
+    for f in found:
+        assert f.related  # each edge points at the opposing acquisition
+        assert "acquires" in f.related[0][2]
+
+
+def test_donation_finding_points_at_donating_call(tmp_path):
+    _write(tmp_path, "engine/reuse.py", """
+        import jax
+
+        def run(fn, x):
+            g = jax.jit(fn, donate_argnums=(0,))
+            y = g(x)
+            return x + y
+    """)
+    found = _only(_lint(tmp_path, "engine"), "host-reuse-after-donation")
+    assert len(found) == 1
+    assert found[0].related
+    assert "'x' donated here" in found[0].related[0][2]
+
+
+# ---------------------------------------------------------------------------
+# --changed-only scoping: cross-process findings still filter to the
+# files actually touched
+# ---------------------------------------------------------------------------
+
+def test_changed_only_scopes_proto_findings(tmp_path, capsys):
+    _git(tmp_path, "init", "-q")
+    _write(tmp_path, "serving/server.py", _PREDICT_SERVER)
+    _write(tmp_path, "serving/client_a.py", _client("/nope"))
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-qm", "seed")
+    _write(tmp_path, "serving/client_b.py", _client("/gone"))
+    code, out = _cli(tmp_path, capsys, str(tmp_path / "serving"),
+                     "--changed-only", "--no-baseline")
+    assert code == 1
+    assert "client_b.py" in out
+    assert "client_a.py" not in out
